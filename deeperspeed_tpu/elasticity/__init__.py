@@ -1,0 +1,4 @@
+from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize)
+from .elasticity import (compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config)

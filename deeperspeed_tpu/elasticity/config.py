@@ -1,0 +1,69 @@
+"""Elasticity config object (reference: `deepspeed/elasticity/config.py:29`)."""
+
+from . import constants as ec
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity errors."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Malformed elasticity configuration."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size is not in the valid device-count list for this config."""
+
+
+class ElasticityConfig:
+    """Parsed "elasticity" block.
+
+    Required when enabled: ``max_train_batch_size`` and ``micro_batch_sizes``.
+    """
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get(ec.ENABLED, ec.ENABLED_DEFAULT)
+        if self.enabled:
+            for required in (ec.MAX_ACCEPTABLE_BATCH_SIZE, ec.MICRO_BATCHES):
+                if required not in param_dict:
+                    raise ElasticityConfigError(
+                        f"Elasticity config missing {required}")
+            self.max_acceptable_batch_size = param_dict[
+                ec.MAX_ACCEPTABLE_BATCH_SIZE]
+            self.micro_batches = param_dict[ec.MICRO_BATCHES]
+        else:
+            self.max_acceptable_batch_size = param_dict.get(
+                ec.MAX_ACCEPTABLE_BATCH_SIZE,
+                ec.MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
+            self.micro_batches = param_dict.get(ec.MICRO_BATCHES,
+                                                ec.MICRO_BATCHES_DEFAULT)
+
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"{ec.MICRO_BATCHES} must be a list, got "
+                f"{type(self.micro_batches).__name__}: {self.micro_batches}")
+        if not all(isinstance(m, int) and not isinstance(m, bool)
+                   for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"{ec.MICRO_BATCHES} must contain only integers, got "
+                f"{self.micro_batches}")
+        if not all(m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"{ec.MICRO_BATCHES} must contain only positive integers, got "
+                f"{self.micro_batches}")
+
+        self.min_gpus = param_dict.get(ec.MIN_GPUS, ec.MIN_GPUS_DEFAULT)
+        self.max_gpus = param_dict.get(ec.MAX_GPUS, ec.MAX_GPUS_DEFAULT)
+        self.min_time = param_dict.get(ec.MIN_TIME, ec.MIN_TIME_DEFAULT)
+        self.version = param_dict.get(ec.VERSION, ec.VERSION_DEFAULT)
+        self.prefer_larger_batch_size = param_dict.get(
+            ec.PREFER_LARGER_BATCH, ec.PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            ec.IGNORE_NON_ELASTIC_BATCH_INFO,
+            ec.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return f"ElasticityConfig({self.__dict__})"

@@ -1,0 +1,204 @@
+"""Elastic batch/device-count solver (reference:
+`deepspeed/elasticity/elasticity.py:122-337`).
+
+Given a set of allowed micro-batch sizes and a ceiling on the global batch,
+find the global batch size that divides evenly across the largest number of
+device counts, so a job can be rescheduled onto different chip counts without
+changing the effective batch (gradient accumulation absorbs the difference).
+Pure Python; deterministic for a given config.
+"""
+
+import json
+import math
+import os
+import re
+from functools import reduce
+
+from ..utils.logging import logger
+from . import constants as ec
+from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize)
+
+# Smallest 38 highly composite numbers — covers batch sizes up to ~720K.
+HCN_LIST = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680,
+    2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440,
+    83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280,
+    720720
+]
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """For each base, the largest base*HCN not exceeding the ceiling."""
+    candidates = set()
+    for base in base_list:
+        best = base
+        for hcn in HCN_LIST:
+            scaled = base * hcn
+            if scaled > max_acceptable_batch_size:
+                break
+            best = scaled
+        candidates.add(best)
+    return list(candidates)
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """All device counts w for which some micro-batch m satisfies
+    batch_size == m * k * w for integer k (i.e. w divides batch_size/m)."""
+    valid = set()
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch != 0:
+            continue
+        max_gpus = batch_size // micro_batch
+        if min_valid_gpus <= max_gpus <= max_valid_gpus:
+            valid.add(max_gpus)
+        for i in range(1, max_gpus // 2 + 1):
+            if max_gpus % i == 0 and min_valid_gpus <= i <= max_valid_gpus:
+                valid.add(i)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus,
+                        max_gpus, prefer_larger):
+    max_valid_gpus = 0
+    valid_gpus = None
+    final_batch_size = int(min(micro_batches))
+
+    for batch_size in candidate_batch_sizes:
+        current = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        better_tie = (len(current) == max_valid_gpus and
+                      ((prefer_larger and batch_size > final_batch_size) or
+                       (not prefer_larger and batch_size < final_batch_size)))
+        if len(current) > max_valid_gpus or better_tie:
+            max_valid_gpus = len(current)
+            valid_gpus = current
+            final_batch_size = batch_size
+
+    return final_batch_size, valid_gpus
+
+
+def _get_compatible_gpus_v01(micro_batches,
+                             max_acceptable_batch_size,
+                             min_gpus=None,
+                             max_gpus=None,
+                             prefer_larger=True):
+    """v0.1 heuristic: candidate batches are each micro-batch (and their LCM)
+    scaled to the largest highly-composite multiple under the ceiling; pick
+    the candidate compatible with the most device counts."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or int(max_acceptable_batch_size // min(micro_batches))
+
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ElasticityConfigError(
+            f"All micro batches must be <= max_acceptable_batch_size="
+            f"{max_acceptable_batch_size}, got {micro_batches}")
+
+    lcm = reduce(math.lcm, micro_batches)
+    base_list = list(micro_batches) + [lcm]
+    candidates = get_candidate_batch_sizes(base_list,
+                                           max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus,
+                               prefer_larger)
+
+
+def _parse_version(version_str):
+    matched = re.search(r"^(\d+)\.(\d+)(?:\.(\d+))?", version_str)
+    if matched is None:
+        raise ElasticityError(
+            f"Cannot parse version {version_str!r}; expected major.minor[.patch]")
+    return (int(matched.group(1)), int(matched.group(2)),
+            int(matched.group(3) or 0))
+
+
+def _compatible_ds_version_check(target_version):
+    minimum = _parse_version(ec.MINIMUM_DEEPSPEED_VERSION)
+    target = _parse_version(target_version)
+    if target < minimum:
+        raise ElasticityError(
+            f"Target version {target_version} is below the minimum "
+            f"{ec.MINIMUM_DEEPSPEED_VERSION} supporting elasticity.")
+    return True
+
+
+def elasticity_enabled(ds_config):
+    if ec.ELASTICITY not in ds_config:
+        return False
+    return ds_config[ec.ELASTICITY].get(ec.ENABLED, ec.ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict):
+    """Verify the scheduler-stamped elastic config (env fingerprint) matches
+    the runtime one, so a rescheduled job cannot silently drift."""
+    if ec.DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            f"{ec.DEEPSPEED_ELASTICITY_CONFIG} env var not found; cannot "
+            "guarantee the resource scheduler will scale this job with "
+            "compatible device counts.")
+        return
+    scheduler = ElasticityConfig(
+        json.loads(os.environ[ec.DEEPSPEED_ELASTICITY_CONFIG]))
+    runtime = ElasticityConfig(runtime_elastic_config_dict)
+    for attr in ("max_acceptable_batch_size", "micro_batches", "version"):
+        if getattr(runtime, attr) != getattr(scheduler, attr):
+            raise ElasticityConfigError(
+                f"Elastic config '{attr}={getattr(scheduler, attr)}' seen by "
+                f"the resource scheduler does not match runtime "
+                f"{attr}={getattr(runtime, attr)}")
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version, world_size=0):
+    """Compute (final_batch_size, valid_gpus[, micro_batch]) for an elastic
+    job; deterministic for a given ds_config. See reference
+    `elasticity.py:240` for the contract."""
+    if not isinstance(ds_config, dict):
+        raise ValueError(
+            f"Expected ds_config dict, got {type(ds_config).__name__}")
+
+    if ec.ELASTICITY not in ds_config:
+        raise ElasticityConfigError(
+            f"'{ec.ELASTICITY}' is missing from the config json; add it if "
+            "running an elastic training job.")
+
+    elastic_config_dict = ds_config[ec.ELASTICITY]
+    if not elastic_config_dict.get(ec.ENABLED, ec.ENABLED_DEFAULT):
+        raise ElasticityConfigError(
+            "Elasticity is disabled; set 'enabled': true to run elastic.")
+
+    elastic_config = ElasticityConfig(elastic_config_dict)
+
+    if float(elastic_config.version) > ec.LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"Elasticity version {elastic_config.version} newer than latest "
+            f"supported {ec.LATEST_ELASTICITY_VERSION}")
+
+    _compatible_ds_version_check(target_deepspeed_version)
+
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size)
+        final_batch_size = int(final_batch_size)
+    else:
+        raise NotImplementedError(
+            f"No elasticity logic for version {elastic_config.version}")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size ({world_size}) is not in the valid device-count "
+                f"list: {valid_gpus}")
+        micro_batch_size = None
+        for mbsz in sorted(set(elastic_config.micro_batches), reverse=True):
+            if final_batch_size // world_size % mbsz == 0:
+                micro_batch_size = mbsz
+                break
+        if micro_batch_size is None:
+            raise ElasticityError(
+                f"No micro batch divides final_batch_size={final_batch_size} "
+                f"at world_size={world_size}")
+        return final_batch_size, valid_gpus, micro_batch_size
+
+    return final_batch_size, valid_gpus

@@ -1,0 +1,63 @@
+"""Activation-checkpointing config block (reference:
+`deepspeed/runtime/activation_checkpointing/config.py`).
+
+On TPU these knobs steer `jax.checkpoint` policies: `partition_activations`
+shards saved residuals over the `model` axis, `cpu_checkpointing` selects a
+host-offload remat policy, and `contiguous_memory_optimization` /
+`synchronize_checkpoint_boundary` are accepted as no-ops (XLA owns layout
+and scheduling).
+"""
+
+from dataclasses import dataclass
+
+from ..config_utils import get_scalar_param
+
+ACT_CHKPT = "activation_checkpointing"
+ACT_CHKPT_PARTITION_ACTIVATIONS = "partition_activations"
+ACT_CHKPT_NUMBER_CHECKPOINTS = "number_checkpoints"
+ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION = "contiguous_memory_optimization"
+ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY = "synchronize_checkpoint_boundary"
+ACT_CHKPT_PROFILE = "profile"
+ACT_CHKPT_CPU_CHECKPOINTING = "cpu_checkpointing"
+
+ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT = False
+ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT = None
+ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT = False
+ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT = False
+ACT_CHKPT_PROFILE_DEFAULT = False
+ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT = False
+
+
+@dataclass(frozen=True)
+class DeepSpeedActivationCheckpointingConfig:
+    partition_activations: bool = ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT
+    number_checkpoints: object = ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT
+    contiguous_memory_optimization: bool = (
+        ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT)
+    synchronize_checkpoint_boundary: bool = (
+        ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT)
+    profile: bool = ACT_CHKPT_PROFILE_DEFAULT
+    cpu_checkpointing: bool = ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT
+
+    @classmethod
+    def from_dict(cls, param_dict):
+        d = param_dict.get(ACT_CHKPT) or {}
+        return cls(
+            partition_activations=bool(get_scalar_param(
+                d, ACT_CHKPT_PARTITION_ACTIVATIONS,
+                ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT)),
+            number_checkpoints=get_scalar_param(
+                d, ACT_CHKPT_NUMBER_CHECKPOINTS,
+                ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT),
+            contiguous_memory_optimization=bool(get_scalar_param(
+                d, ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION,
+                ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT)),
+            synchronize_checkpoint_boundary=bool(get_scalar_param(
+                d, ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY,
+                ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT)),
+            profile=bool(get_scalar_param(
+                d, ACT_CHKPT_PROFILE, ACT_CHKPT_PROFILE_DEFAULT)),
+            cpu_checkpointing=bool(get_scalar_param(
+                d, ACT_CHKPT_CPU_CHECKPOINTING,
+                ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT)),
+        )

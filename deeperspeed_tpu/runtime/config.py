@@ -1,0 +1,442 @@
+"""DeepSpeed-schema JSON config → typed config object.
+
+Reference: `deepspeed/runtime/config.py:536` (`DeepSpeedConfig`), including
+the batch-triad resolution of `_set_batch_related_parameters`
+(`config.py:701`). The JSON schema is the compatibility surface — GPT-NeoX
+configs must parse unmodified — but the object model here is dataclass-based
+rather than the reference's getter functions.
+"""
+
+import jax.numpy as jnp
+
+from ..elasticity import (compute_elastic_config, elasticity_enabled,
+                          ensure_immutable_elastic_config)
+from ..elasticity.constants import (ELASTICITY,
+                                    IGNORE_NON_ELASTIC_BATCH_INFO,
+                                    IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+from ..profiling.config import DeepSpeedFlopsProfilerConfig
+from ..utils.logging import logger
+from ..version import __version__
+from . import constants as c
+from .activation_checkpointing.config import (
+    DeepSpeedActivationCheckpointingConfig)
+from .config_utils import (DeepSpeedConfigError, as_int, get_scalar_param,
+                           load_config_json)
+from .precision import needs_loss_scaling, resolve_precision
+from .swap_tensor.aio_config import DeepSpeedAIOConfig
+from .zero.config import DeepSpeedZeroConfig
+
+TENSOR_CORE_ALIGN_SIZE = 8
+ADAM_OPTIMIZER = "adam"
+LAMB_OPTIMIZER = "lamb"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER,
+    LAMB_OPTIMIZER,
+    ONEBIT_ADAM_OPTIMIZER,
+    ONEBIT_LAMB_OPTIMIZER,
+]
+
+
+def _parse_sparse_attention(param_dict):
+    """Parse the "sparse_attention" block into a plain dict of knobs
+    (reference `config.py:213-383`)."""
+    sparsity = param_dict.get(c.SPARSE_ATTENTION)
+    if sparsity is None:
+        return None
+    mode = get_scalar_param(sparsity, c.SPARSE_MODE, c.SPARSE_MODE_DEFAULT)
+
+    common = {
+        c.SPARSE_MODE: mode,
+        c.SPARSE_BLOCK: get_scalar_param(sparsity, c.SPARSE_BLOCK,
+                                         c.SPARSE_BLOCK_DEFAULT),
+        c.SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, c.SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+            c.SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+    }
+    if mode == c.SPARSE_DENSE_MODE:
+        return common
+    if mode == c.SPARSE_FIXED_MODE:
+        extra = {
+            c.SPARSE_NUM_LOCAL_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_LOCAL_BLOCKS,
+                c.SPARSE_NUM_LOCAL_BLOCKS_DEFAULT),
+            c.SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_GLOBAL_BLOCKS,
+                c.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+            c.SPARSE_ATTENTION_TYPE: get_scalar_param(
+                sparsity, c.SPARSE_ATTENTION_TYPE,
+                c.SPARSE_ATTENTION_TYPE_DEFAULT),
+            c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+                sparsity, c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+            c.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+                c.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT),
+        }
+    elif mode == c.SPARSE_VARIABLE_MODE:
+        extra = {
+            c.SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_RANDOM_BLOCKS,
+                c.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            c.SPARSE_LOCAL_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_LOCAL_WINDOW_BLOCKS,
+                c.SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT),
+            c.SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+                sparsity, c.SPARSE_GLOBAL_BLOCK_INDICES,
+                c.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            c.SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+                sparsity, c.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                c.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+            c.SPARSE_ATTENTION_TYPE: get_scalar_param(
+                sparsity, c.SPARSE_ATTENTION_TYPE,
+                c.SPARSE_ATTENTION_TYPE_DEFAULT),
+            c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+                sparsity, c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+        }
+    elif mode == c.SPARSE_BIGBIRD_MODE:
+        extra = {
+            c.SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_RANDOM_BLOCKS,
+                c.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            c.SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                c.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            c.SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_GLOBAL_BLOCKS,
+                c.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+        }
+    elif mode == c.SPARSE_BSLONGFORMER_MODE:
+        extra = {
+            c.SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, c.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                c.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            c.SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+                sparsity, c.SPARSE_GLOBAL_BLOCK_INDICES,
+                c.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            c.SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+                sparsity, c.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                c.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+        }
+    else:
+        raise DeepSpeedConfigError(
+            f"Invalid sparse_attention mode {mode!r}")
+    common.update(extra)
+    return common
+
+
+class DeepSpeedConfig:
+    """Parsed, validated DeepSpeed config.
+
+    Accepts a path to a JSON file or an already-loaded dict. ``mesh_shape``
+    carries the (dp, mp, pp) decomposition so the batch triad resolves
+    against the *data-parallel* world size, mirroring the mpu-aware logic in
+    the reference (`config.py:550-560`).
+    """
+
+    def __init__(self, json_file_or_dict, mpu=None, param_dict=None,
+                 world_size=None):
+        if param_dict is not None:
+            self._param_dict = dict(param_dict)
+        elif isinstance(json_file_or_dict, dict):
+            self._param_dict = dict(json_file_or_dict)
+        else:
+            self._param_dict = load_config_json(json_file_or_dict)
+
+        if world_size is not None:
+            self.world_size = int(world_size)
+        elif mpu is not None:
+            self.world_size = int(mpu.get_data_parallel_world_size())
+        else:
+            self.world_size = _default_dp_world_size()
+
+        # Elastic jobs overwrite the batch triad from the solver.
+        self.elasticity_enabled = elasticity_enabled(self._param_dict)
+        if self.elasticity_enabled:
+            (final_batch_size, valid_gpus,
+             micro_batch_size) = compute_elastic_config(
+                 ds_config=self._param_dict,
+                 target_deepspeed_version=__version__,
+                 world_size=self.world_size)
+            elastic_dict = self._param_dict[ELASTICITY]
+            ensure_immutable_elastic_config(elastic_dict)
+            self.elastic_model_parallel_size = 1
+            ignore_non_elastic = elastic_dict.get(
+                IGNORE_NON_ELASTIC_BATCH_INFO,
+                IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+            if not ignore_non_elastic:
+                batch_params = (c.TRAIN_BATCH_SIZE,
+                                c.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                                c.GRADIENT_ACCUMULATION_STEPS)
+                if any(k in self._param_dict for k in batch_params):
+                    raise DeepSpeedConfigError(
+                        "One or more batch-related parameters were found in "
+                        "your config json. These are superseded by the "
+                        "elasticity config; remove them or set "
+                        f"'{IGNORE_NON_ELASTIC_BATCH_INFO}': true")
+            gas = final_batch_size // (micro_batch_size * self.world_size)
+            self._param_dict[c.TRAIN_BATCH_SIZE] = final_batch_size
+            self._param_dict[c.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = \
+                micro_batch_size
+            self._param_dict[c.GRADIENT_ACCUMULATION_STEPS] = gas
+            self.elastic_valid_gpus = valid_gpus
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # -- parsing -----------------------------------------------------------
+
+    def _initialize_params(self, d):
+        self.train_batch_size = d.get(c.TRAIN_BATCH_SIZE,
+                                      c.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = d.get(
+            c.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            c.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = d.get(
+            c.GRADIENT_ACCUMULATION_STEPS,
+            c.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = as_int(
+            d.get(c.STEPS_PER_PRINT, c.STEPS_PER_PRINT_DEFAULT),
+            c.STEPS_PER_PRINT)
+        self.dump_state = bool(d.get(c.DUMP_STATE, c.DUMP_STATE_DEFAULT))
+
+        self.disable_allgather = bool(
+            d.get(c.DISABLE_ALLGATHER, c.DISABLE_ALLGATHER_DEFAULT))
+        self.gradient_predivide_factor = float(
+            d.get(c.GRADIENT_PREDIVIDE_FACTOR,
+                  c.GRADIENT_PREDIVIDE_FACTOR_DEFAULT))
+        self.sparse_gradients_enabled = bool(
+            d.get(c.SPARSE_GRADIENTS, c.SPARSE_GRADIENTS_DEFAULT))
+
+        self.zero_config = DeepSpeedZeroConfig.from_dict(d)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_config.enabled
+
+        self.activation_checkpointing_config = (
+            DeepSpeedActivationCheckpointingConfig.from_dict(d))
+        self.aio_config = DeepSpeedAIOConfig.from_dict(d)
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig.from_dict(d)
+
+        # Mixed precision. "fp16" block carries both fp16 and bf16 (fork).
+        fp16 = d.get(c.FP16) or {}
+        self.fp16_enabled = bool(
+            fp16.get(c.FP16_ENABLED, c.FP16_ENABLED_DEFAULT))
+        self.precision = (resolve_precision(
+            fp16.get(c.FP16_TYPE, c.FP16_TYPE_DEFAULT))
+            if self.fp16_enabled else jnp.float32)
+        self.bfloat16_enabled = self.precision == jnp.bfloat16
+        self.loss_scale = fp16.get(c.FP16_LOSS_SCALE,
+                                   c.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_dynamic_scale = 2 ** as_int(
+            fp16.get(c.FP16_INITIAL_SCALE_POWER,
+                     c.FP16_INITIAL_SCALE_POWER_DEFAULT),
+            c.FP16_INITIAL_SCALE_POWER)
+        self.dynamic_loss_scale_args = {
+            c.FP16_INITIAL_SCALE_POWER: as_int(
+                fp16.get(c.FP16_INITIAL_SCALE_POWER,
+                         c.FP16_INITIAL_SCALE_POWER_DEFAULT),
+                c.FP16_INITIAL_SCALE_POWER),
+            c.FP16_LOSS_SCALE_WINDOW: as_int(
+                fp16.get(c.FP16_LOSS_SCALE_WINDOW,
+                         c.FP16_LOSS_SCALE_WINDOW_DEFAULT),
+                c.FP16_LOSS_SCALE_WINDOW),
+            c.FP16_HYSTERESIS: as_int(
+                fp16.get(c.FP16_HYSTERESIS, c.FP16_HYSTERESIS_DEFAULT),
+                c.FP16_HYSTERESIS),
+            c.FP16_MIN_LOSS_SCALE: fp16.get(c.FP16_MIN_LOSS_SCALE,
+                                            c.FP16_MIN_LOSS_SCALE_DEFAULT),
+        } if self.fp16_enabled else None
+        # bf16/fp32 never need loss scaling even when configured.
+        self.loss_scaling_enabled = (self.fp16_enabled
+                                     and needs_loss_scaling(self.precision))
+
+        amp = d.get(c.AMP) or {}
+        self.amp_enabled = bool(amp.get(c.AMP_ENABLED, c.AMP_ENABLED_DEFAULT))
+        self.amp_params = {k: v for k, v in amp.items() if k != c.AMP_ENABLED}
+
+        self.gradient_clipping = float(
+            d.get(c.GRADIENT_CLIPPING, c.GRADIENT_CLIPPING_DEFAULT))
+        self.prescale_gradients = bool(
+            d.get(c.PRESCALE_GRADIENTS, c.PRESCALE_GRADIENTS_DEFAULT))
+        # bf16 grads default to fp32-upcast reductions (fork: engine.py:613-620).
+        fp32_allreduce_default = (c.FP32_ALLREDUCE_DEFAULT_BF16
+                                  if self.bfloat16_enabled else
+                                  c.FP32_ALLREDUCE_DEFAULT)
+        self.fp32_allreduce = bool(
+            d.get(c.FP32_ALLREDUCE, fp32_allreduce_default))
+
+        optimizer = d.get(c.OPTIMIZER)
+        if optimizer is not None:
+            self.optimizer_name = str(optimizer.get(c.TYPE, "")).lower() or None
+            self.optimizer_params = dict(optimizer.get(c.OPTIMIZER_PARAMS, {}))
+            self.optimizer_legacy_fusion = bool(
+                optimizer.get(c.LEGACY_FUSION, c.LEGACY_FUSION_DEFAULT))
+        else:
+            self.optimizer_name = c.OPTIMIZER_TYPE_DEFAULT
+            self.optimizer_params = None
+            self.optimizer_legacy_fusion = c.LEGACY_FUSION_DEFAULT
+        self.zero_allow_untested_optimizer = bool(
+            d.get(c.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+                  c.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT))
+
+        scheduler = d.get(c.SCHEDULER)
+        if scheduler is not None:
+            self.scheduler_name = scheduler.get(c.TYPE)
+            self.scheduler_params = dict(scheduler.get(c.SCHEDULER_PARAMS, {}))
+        else:
+            self.scheduler_name = c.SCHEDULER_TYPE_DEFAULT
+            self.scheduler_params = None
+
+        self.wall_clock_breakdown = bool(
+            d.get(c.WALL_CLOCK_BREAKDOWN, c.WALL_CLOCK_BREAKDOWN_DEFAULT))
+        self.memory_breakdown = bool(
+            d.get(c.MEMORY_BREAKDOWN, c.MEMORY_BREAKDOWN_DEFAULT))
+
+        tb = d.get(c.TENSORBOARD) or {}
+        self.tensorboard_enabled = bool(
+            tb.get(c.TENSORBOARD_ENABLED, c.TENSORBOARD_ENABLED_DEFAULT))
+        self.tensorboard_output_path = tb.get(
+            c.TENSORBOARD_OUTPUT_PATH, c.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.tensorboard_job_name = tb.get(c.TENSORBOARD_JOB_NAME,
+                                           c.TENSORBOARD_JOB_NAME_DEFAULT)
+
+        self.sparse_attention = _parse_sparse_attention(d)
+
+        pld = d.get(c.PROGRESSIVE_LAYER_DROP) or {}
+        self.pld_enabled = bool(pld.get(c.PLD_ENABLED, c.PLD_ENABLED_DEFAULT))
+        self.pld_params = {
+            c.PLD_THETA: pld.get(c.PLD_THETA, c.PLD_THETA_DEFAULT),
+            c.PLD_GAMMA: pld.get(c.PLD_GAMMA, c.PLD_GAMMA_DEFAULT),
+        } if self.pld_enabled else False
+
+        bs_sched = d.get(c.BATCH_SIZE_SCHEDULE) or {}
+        self.batch_size_schedule_enabled = bool(
+            bs_sched.get(c.BS_SCHEDULE_ENABLED, c.BS_SCHEDULE_ENABLED_DEFAULT))
+        self.batch_size_schedule_params = dict(
+            bs_sched.get(c.BS_SCHEDULE_PARAMS, {}))
+
+        ckpt = d.get(c.CHECKPOINT) or {}
+        self.checkpoint_tag_validation_mode = str(
+            ckpt.get(c.CHECKPOINT_TAG_VALIDATION,
+                     c.CHECKPOINT_TAG_VALIDATION_DEFAULT)).upper()
+        self.checkpoint_tag_validation_enabled = (
+            self.checkpoint_tag_validation_mode != c.ValidationMode.IGNORE)
+        self.checkpoint_tag_validation_fail = (
+            self.checkpoint_tag_validation_mode == c.ValidationMode.FAIL)
+
+        # Fork additions: gradient storage for debugging.
+        self.store_gradients = bool(
+            d.get(c.STORE_GRADIENTS, c.STORE_GRADIENTS_DEFAULT))
+        self.store_gradients_cpu = bool(
+            d.get(c.STORE_GRADIENTS_CPU, c.STORE_GRADIENTS_CPU_DEFAULT))
+
+        self.vocabulary_size = d.get(c.VOCABULARY_SIZE,
+                                     c.VOCABULARY_SIZE_DEFAULT)
+
+    # -- batch triad -------------------------------------------------------
+
+    def _configure_train_batch_size(self):
+        """Resolve train_batch = micro_batch * grad_acc * dp_world
+        (reference `config.py:681-756`)."""
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        world = self.world_size
+
+        if all(v is not None for v in (train, micro, gas)):
+            pass  # verified below
+        elif train is not None and micro is not None:
+            if train % (micro * world) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} is not divisible by "
+                    f"micro_batch * world = {micro} * {world}")
+            gas = train // (micro * world)
+        elif train is not None and gas is not None:
+            if train % (gas * world) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} is not divisible by "
+                    f"grad_acc * world = {gas} * {world}")
+            micro = train // (gas * world)
+        elif micro is not None:
+            gas = gas if gas is not None else 1
+            train = micro * gas * world
+        elif train is not None:
+            micro = train // world
+            gas = 1
+        elif gas is not None:
+            raise DeepSpeedConfigError(
+                "gradient_accumulation_steps alone cannot determine batch "
+                "sizes; also provide train_batch_size or "
+                "train_micro_batch_size_per_gpu")
+        else:
+            raise DeepSpeedConfigError(
+                "At least one of train_batch_size or "
+                "train_micro_batch_size_per_gpu must be configured")
+
+        self.train_batch_size = as_int(train, c.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = as_int(
+            micro, c.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = as_int(
+            gas, c.GRADIENT_ACCUMULATION_STEPS)
+
+    # -- validation --------------------------------------------------------
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def _do_error_check(self):
+        train, micro, gas = (self.train_batch_size,
+                             self.train_micro_batch_size_per_gpu,
+                             self.gradient_accumulation_steps)
+        for name, value in ((c.TRAIN_BATCH_SIZE, train),
+                            (c.TRAIN_MICRO_BATCH_SIZE_PER_GPU, micro),
+                            (c.GRADIENT_ACCUMULATION_STEPS, gas)):
+            if value <= 0:
+                raise DeepSpeedConfigError(f"{name} must be > 0, got {value}")
+        if train != micro * gas * self.world_size:
+            raise DeepSpeedConfigError(
+                f"Check batch related parameters. "
+                f"train_batch_size ({train}) is not equal to "
+                f"micro_batch_per_gpu ({micro}) * grad_acc ({gas}) * "
+                f"world_size ({self.world_size})")
+        if self.zero_enabled and \
+                self.zero_optimization_stage > len([1, 2, 3]):
+            raise DeepSpeedConfigError(
+                f"Max ZeRO stage is 3, got {self.zero_optimization_stage}")
+
+    def _do_warning_check(self):
+        if self.fp16_enabled and not self.bfloat16_enabled:
+            logger.debug("fp16 enabled: dynamic loss scaling active")
+        if (self.gradient_clipping > 0 and self.optimizer_params and
+                c.MAX_GRAD_NORM in self.optimizer_params):
+            logger.warning(
+                f"optimizer params include {c.MAX_GRAD_NORM}; DeepSpeed-style "
+                "gradient clipping from 'gradient_clipping' takes precedence")
+
+    # -- misc --------------------------------------------------------------
+
+    @property
+    def param_dict(self):
+        return self._param_dict
+
+    def print(self, name="DeepSpeedConfig"):
+        logger.info(f"{name}:")
+        for key in sorted(self.__dict__):
+            if key.startswith("_"):
+                continue
+            logger.info(f"  {key} {self.__dict__[key]}")
+
+
+def _default_dp_world_size():
+    """Data-parallel world size when no mpu/topology is supplied: the number
+    of addressable devices (the launcher exports one process per host; each
+    process drives all local chips)."""
+    try:
+        import jax
+        return jax.device_count()
+    except Exception:
+        return 1

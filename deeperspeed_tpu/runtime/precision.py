@@ -1,0 +1,33 @@
+"""Precision resolution: "fp16" config block → jnp dtypes.
+
+The fork's bf16 support (`"fp16": {"type": "bfloat16"}`, reference
+`deepspeed/runtime/config.py:97-114`) is first-class here: bf16 is the
+TPU-native compute dtype, fp16 is supported for config compatibility, and
+both keep fp32 master params/optimizer state.
+"""
+
+import jax.numpy as jnp
+
+from .config_utils import DeepSpeedConfigError
+from .constants import PRECISION_TYPES
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+}
+
+
+def resolve_precision(type_str):
+    """Map an "fp16.type" spelling to a jnp dtype."""
+    canonical = PRECISION_TYPES.get(str(type_str).lower())
+    if canonical is None:
+        raise DeepSpeedConfigError(
+            f"Unknown precision type {type_str!r}; expected one of "
+            f"{sorted(PRECISION_TYPES)}")
+    return _DTYPES[canonical]
+
+
+def needs_loss_scaling(dtype):
+    """Only fp16 needs loss scaling; bf16 has fp32's exponent range."""
+    return dtype == jnp.float16

@@ -1,0 +1,53 @@
+""""aio" config block for the NVMe swap tier (reference:
+`deepspeed/runtime/swap_tensor/constants.py`, `aio_config.py`).
+
+Consumed by the C++ async-IO spool (csrc/aio) that tiers tensors between
+host DRAM and NVMe on a TPU-VM.
+"""
+
+from dataclasses import dataclass
+
+from ..config_utils import as_int, get_scalar_param
+
+AIO = "aio"
+AIO_BLOCK_SIZE = "block_size"
+AIO_BLOCK_SIZE_DEFAULT = 1048576
+AIO_QUEUE_DEPTH = "queue_depth"
+AIO_QUEUE_DEPTH_DEFAULT = 8
+AIO_THREAD_COUNT = "thread_count"
+AIO_THREAD_COUNT_DEFAULT = 1
+AIO_SINGLE_SUBMIT = "single_submit"
+AIO_SINGLE_SUBMIT_DEFAULT = False
+AIO_OVERLAP_EVENTS = "overlap_events"
+AIO_OVERLAP_EVENTS_DEFAULT = True
+
+
+@dataclass(frozen=True)
+class DeepSpeedAIOConfig:
+    block_size: int = AIO_BLOCK_SIZE_DEFAULT
+    queue_depth: int = AIO_QUEUE_DEPTH_DEFAULT
+    thread_count: int = AIO_THREAD_COUNT_DEFAULT
+    single_submit: bool = AIO_SINGLE_SUBMIT_DEFAULT
+    overlap_events: bool = AIO_OVERLAP_EVENTS_DEFAULT
+
+    @classmethod
+    def from_dict(cls, param_dict):
+        d = param_dict.get(AIO) or {}
+        return cls(
+            block_size=as_int(
+                get_scalar_param(d, AIO_BLOCK_SIZE, AIO_BLOCK_SIZE_DEFAULT),
+                AIO_BLOCK_SIZE),
+            queue_depth=as_int(
+                get_scalar_param(d, AIO_QUEUE_DEPTH, AIO_QUEUE_DEPTH_DEFAULT),
+                AIO_QUEUE_DEPTH),
+            thread_count=as_int(
+                get_scalar_param(d, AIO_THREAD_COUNT,
+                                 AIO_THREAD_COUNT_DEFAULT),
+                AIO_THREAD_COUNT),
+            single_submit=bool(
+                get_scalar_param(d, AIO_SINGLE_SUBMIT,
+                                 AIO_SINGLE_SUBMIT_DEFAULT)),
+            overlap_events=bool(
+                get_scalar_param(d, AIO_OVERLAP_EVENTS,
+                                 AIO_OVERLAP_EVENTS_DEFAULT)),
+        )

@@ -1,0 +1,242 @@
+"""ZeRO config objects (reference: `deepspeed/runtime/zero/config.py`,
+`offload_config.py`).
+
+Parsed into frozen dataclasses. The semantics on TPU:
+
+- ``stage >= 1``: optimizer state carries a NamedSharding over the ``data``
+  mesh axis.
+- ``stage >= 2``: gradients are reduce-scattered (``psum_scatter``) instead of
+  all-reduced.
+- ``stage == 3``: parameters are sharded over ``data`` at rest and gathered
+  per-layer by XLA (FSDP-style); prefetch/persistence knobs become latency
+  hints.
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..config_utils import DeepSpeedConfigError, as_int, get_scalar_param
+from . import constants as zc
+
+
+@dataclass(frozen=True)
+class DeepSpeedZeroOffloadParamConfig:
+    device: str = zc.OFFLOAD_CPU_DEVICE
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+    @classmethod
+    def from_dict(cls, d):
+        device = get_scalar_param(d, zc.OFFLOAD_PARAM_DEVICE,
+                                  zc.OFFLOAD_CPU_DEVICE)
+        if device not in (zc.OFFLOAD_CPU_DEVICE, zc.OFFLOAD_NVME_DEVICE):
+            raise DeepSpeedConfigError(
+                f"offload_param device must be cpu|nvme, got {device!r}")
+        return cls(
+            device=device,
+            nvme_path=get_scalar_param(d, zc.OFFLOAD_PARAM_NVME_PATH, None),
+            buffer_count=as_int(
+                get_scalar_param(d, zc.OFFLOAD_PARAM_BUFFER_COUNT, 5),
+                zc.OFFLOAD_PARAM_BUFFER_COUNT),
+            buffer_size=as_int(
+                get_scalar_param(d, zc.OFFLOAD_PARAM_BUFFER_SIZE, 1e8),
+                zc.OFFLOAD_PARAM_BUFFER_SIZE),
+            max_in_cpu=as_int(
+                get_scalar_param(d, zc.OFFLOAD_PARAM_MAX_IN_CPU, 1e9),
+                zc.OFFLOAD_PARAM_MAX_IN_CPU),
+            pin_memory=bool(
+                get_scalar_param(d, zc.OFFLOAD_PARAM_PIN_MEMORY, False)),
+        )
+
+
+@dataclass(frozen=True)
+class DeepSpeedZeroOffloadOptimizerConfig:
+    device: str = zc.OFFLOAD_CPU_DEVICE
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+    @property
+    def pipeline(self):
+        return self.pipeline_read or self.pipeline_write
+
+    @classmethod
+    def from_dict(cls, d):
+        device = get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_DEVICE,
+                                  zc.OFFLOAD_CPU_DEVICE)
+        if device not in (zc.OFFLOAD_CPU_DEVICE, zc.OFFLOAD_NVME_DEVICE):
+            raise DeepSpeedConfigError(
+                f"offload_optimizer device must be cpu|nvme, got {device!r}")
+        return cls(
+            device=device,
+            nvme_path=get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_NVME_PATH, None),
+            buffer_count=as_int(
+                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_BUFFER_COUNT, 4),
+                zc.OFFLOAD_OPTIMIZER_BUFFER_COUNT),
+            pin_memory=bool(
+                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_PIN_MEMORY, False)),
+            pipeline_read=bool(
+                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_PIPELINE_READ, False)),
+            pipeline_write=bool(
+                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_PIPELINE_WRITE,
+                                 False)),
+            fast_init=bool(
+                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_FAST_INIT, False)),
+        )
+
+
+@dataclass(frozen=True)
+class DeepSpeedZeroConfig:
+    stage: int = zc.ZERO_OPTIMIZATION_STAGE_DEFAULT
+    contiguous_gradients: bool = zc.ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT
+    reduce_scatter: bool = zc.ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT
+    reduce_bucket_size: int = zc.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT
+    allgather_partitions: bool = zc.ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT
+    allgather_bucket_size: int = zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT
+    overlap_comm: bool = False
+    load_from_fp32_weights: bool = zc.ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT
+    elastic_checkpoint: bool = zc.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = zc.ZERO_OPTIMIZATION_SUB_GROUP_SIZE_DEFAULT
+    max_live_parameters: int = zc.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS_DEFAULT
+    max_reuse_distance: int = zc.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT
+    prefetch_bucket_size: int = zc.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT
+    param_persistence_threshold: int = (
+        zc.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)
+    gather_fp16_weights_on_model_save: bool = (
+        zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)
+
+    @property
+    def enabled(self):
+        return self.stage > zc.ZERO_OPTIMIZATION_DISABLED
+
+    @property
+    def cpu_offload(self):
+        return (self.offload_optimizer is not None
+                and self.offload_optimizer.device == zc.OFFLOAD_CPU_DEVICE)
+
+    @property
+    def cpu_offload_params(self):
+        return (self.offload_param is not None
+                and self.offload_param.device == zc.OFFLOAD_CPU_DEVICE)
+
+    @property
+    def nvme_offload(self):
+        return ((self.offload_optimizer is not None
+                 and self.offload_optimizer.device == zc.OFFLOAD_NVME_DEVICE)
+                or (self.offload_param is not None
+                    and self.offload_param.device == zc.OFFLOAD_NVME_DEVICE))
+
+    @classmethod
+    def from_dict(cls, param_dict):
+        d = param_dict.get(zc.ZERO_OPTIMIZATION)
+        # Legacy form: "zero_optimization": true  (== stage 1).
+        if d is True:
+            d = {zc.ZERO_OPTIMIZATION_STAGE: 1}
+        elif d is None or d is False:
+            d = {}
+        elif not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"'{zc.ZERO_OPTIMIZATION}' must be a dict or bool, got {d!r}")
+
+        stage = as_int(
+            get_scalar_param(d, zc.ZERO_OPTIMIZATION_STAGE,
+                             zc.ZERO_OPTIMIZATION_STAGE_DEFAULT),
+            zc.ZERO_OPTIMIZATION_STAGE)
+        if not 0 <= stage <= zc.MAX_STAGE_ZERO_OPTIMIZATION:
+            raise DeepSpeedConfigError(
+                f"ZeRO stage must be in [0, {zc.MAX_STAGE_ZERO_OPTIMIZATION}],"
+                f" got {stage}")
+
+        offload_param = None
+        if d.get(zc.OFFLOAD_PARAM) is not None:
+            offload_param = DeepSpeedZeroOffloadParamConfig.from_dict(
+                d[zc.OFFLOAD_PARAM])
+        offload_optimizer = None
+        if d.get(zc.OFFLOAD_OPTIMIZER) is not None:
+            offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig.from_dict(
+                d[zc.OFFLOAD_OPTIMIZER])
+        # Deprecated boolean spellings fold into the offload sub-configs.
+        if offload_optimizer is None and d.get(
+                zc.ZERO_OPTIMIZATION_CPU_OFFLOAD,
+                zc.ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT):
+            offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(
+                device=zc.OFFLOAD_CPU_DEVICE,
+                pin_memory=bool(d.get(
+                    zc.ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY,
+                    zc.ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY_DEFAULT)))
+        if offload_param is None and d.get(
+                zc.ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS,
+                zc.ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS_DEFAULT):
+            offload_param = DeepSpeedZeroOffloadParamConfig(
+                device=zc.OFFLOAD_CPU_DEVICE,
+                pin_memory=bool(d.get(
+                    zc.ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY,
+                    zc.ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY_DEFAULT)))
+
+        overlap_default = (zc.ZERO3_OPTIMIZATION_OVERLAP_COMM_DEFAULT
+                           if stage == 3 else
+                           zc.ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT)
+        allgather_bucket = get_scalar_param(
+            d, zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE,
+            d.get(zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
+                  zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT))
+
+        return cls(
+            stage=stage,
+            contiguous_gradients=bool(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS,
+                zc.ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT)),
+            reduce_scatter=bool(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_REDUCE_SCATTER,
+                zc.ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT)),
+            reduce_bucket_size=as_int(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
+                zc.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT),
+                zc.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE),
+            allgather_partitions=bool(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS,
+                zc.ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT)),
+            allgather_bucket_size=as_int(
+                allgather_bucket, zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE),
+            overlap_comm=bool(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_OVERLAP_COMM, overlap_default)),
+            load_from_fp32_weights=bool(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
+                zc.ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT)),
+            elastic_checkpoint=bool(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
+                zc.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)),
+            offload_param=offload_param,
+            offload_optimizer=offload_optimizer,
+            sub_group_size=as_int(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_SUB_GROUP_SIZE,
+                zc.ZERO_OPTIMIZATION_SUB_GROUP_SIZE_DEFAULT),
+                zc.ZERO_OPTIMIZATION_SUB_GROUP_SIZE),
+            max_live_parameters=as_int(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS,
+                zc.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS_DEFAULT),
+                zc.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS),
+            max_reuse_distance=as_int(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE,
+                zc.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT),
+                zc.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE),
+            prefetch_bucket_size=as_int(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE,
+                zc.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT),
+                zc.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE),
+            param_persistence_threshold=as_int(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD,
+                zc.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT),
+                zc.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD),
+            gather_fp16_weights_on_model_save=bool(get_scalar_param(
+                d, zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE,
+                zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)),
+        )

@@ -1,0 +1,6 @@
+__version__ = "0.3.15"
+__version_major__ = 0
+__version_minor__ = 3
+__version_patch__ = 15
+# TPU-native rebuild generation; bumped per round.
+__tpu_build__ = 1

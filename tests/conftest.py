@@ -1,0 +1,26 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of exercising multi-rank logic on one box
+(`tests/unit/common.py` forks N processes over NCCL); with JAX we instead give
+one process 8 XLA host devices and build real `jax.sharding.Mesh`es over
+them, so every collective path compiles and runs.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

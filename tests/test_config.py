@@ -1,0 +1,293 @@
+"""Config-system tests (parity with reference `tests/unit/test_ds_config.py`
+and `test_config.py` batch-triad semantics)."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from deeperspeed_tpu.runtime.config import DeepSpeedConfig
+from deeperspeed_tpu.runtime.config_utils import (DeepSpeedConfigError,
+                                                  loads_config_json)
+
+
+def make_config(d, world_size=1):
+    return DeepSpeedConfig(d, world_size=world_size)
+
+
+# --- batch triad ----------------------------------------------------------
+
+def test_all_three_consistent():
+    cfg = make_config({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_all_three_inconsistent():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 5,
+        }, world_size=4)
+
+
+def test_derive_gas():
+    cfg = make_config({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 2,
+    }, world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_derive_micro():
+    cfg = make_config({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_derive_from_micro_only():
+    cfg = make_config({"train_micro_batch_size_per_gpu": 3}, world_size=4)
+    assert cfg.train_batch_size == 12
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_derive_from_train_only():
+    cfg = make_config({"train_batch_size": 12}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 3
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_gas_only_rejected():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({"gradient_accumulation_steps": 2}, world_size=4)
+
+
+def test_no_batch_info_rejected():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({}, world_size=4)
+
+
+def test_indivisible_rejected():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({
+            "train_batch_size": 33,
+            "train_micro_batch_size_per_gpu": 4,
+        }, world_size=4)
+
+
+# --- precision ------------------------------------------------------------
+
+def test_fp16_default():
+    cfg = make_config({"train_batch_size": 1, "fp16": {"enabled": True}})
+    assert cfg.fp16_enabled
+    assert cfg.precision == jnp.float16
+    assert cfg.loss_scaling_enabled
+    assert not cfg.bfloat16_enabled
+
+
+def test_bf16_fork_spelling():
+    cfg = make_config({
+        "train_batch_size": 1,
+        "fp16": {"enabled": True, "type": "bfloat16"},
+    })
+    assert cfg.precision == jnp.bfloat16
+    assert cfg.bfloat16_enabled
+    assert not cfg.loss_scaling_enabled  # bf16 needs no loss scaling
+    assert cfg.fp32_allreduce  # bf16 defaults to fp32-upcast reductions
+
+
+def test_fp32_default():
+    cfg = make_config({"train_batch_size": 1})
+    assert cfg.precision == jnp.float32
+    assert not cfg.fp16_enabled
+
+
+def test_dynamic_loss_scale_args():
+    cfg = make_config({
+        "train_batch_size": 1,
+        "fp16": {
+            "enabled": True,
+            "loss_scale": 0,
+            "initial_scale_power": 16,
+            "loss_scale_window": 500,
+            "hysteresis": 3,
+            "min_loss_scale": 0.5,
+        },
+    })
+    assert cfg.initial_dynamic_scale == 2 ** 16
+    assert cfg.dynamic_loss_scale_args["loss_scale_window"] == 500
+    assert cfg.dynamic_loss_scale_args["hysteresis"] == 3
+    assert cfg.dynamic_loss_scale_args["min_loss_scale"] == 0.5
+
+
+# --- ZeRO -----------------------------------------------------------------
+
+def test_zero_defaults():
+    cfg = make_config({"train_batch_size": 1})
+    assert not cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 0
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages(stage):
+    cfg = make_config({
+        "train_batch_size": 1,
+        "zero_optimization": {"stage": stage},
+    })
+    assert cfg.zero_optimization_stage == stage
+    assert cfg.zero_enabled == (stage > 0)
+
+
+def test_zero_legacy_bool():
+    cfg = make_config({"train_batch_size": 1, "zero_optimization": True})
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({"train_batch_size": 1, "zero_optimization": {"stage": 4}})
+
+
+def test_zero_offload_blocks():
+    cfg = make_config({
+        "train_batch_size": 1,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+            "offload_optimizer": {"device": "cpu", "pipeline_read": True},
+            "stage3_max_live_parameters": 5e8,
+        },
+    })
+    z = cfg.zero_config
+    assert z.offload_param.device == "nvme"
+    assert z.offload_param.nvme_path == "/tmp/nvme"
+    assert z.offload_optimizer.device == "cpu"
+    assert z.offload_optimizer.pipeline
+    assert z.max_live_parameters == 500_000_000
+    assert z.nvme_offload
+    assert cfg.zero_config.cpu_offload
+
+
+def test_zero_deprecated_cpu_offload():
+    cfg = make_config({
+        "train_batch_size": 1,
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+    })
+    assert cfg.zero_config.cpu_offload
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+# --- misc blocks ----------------------------------------------------------
+
+def test_optimizer_scheduler_blocks():
+    cfg = make_config({
+        "train_batch_size": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001, "betas": [0.9, 0.999]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    })
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 0.001
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_num_steps"] == 10
+
+
+def test_sparse_attention_modes():
+    cfg = make_config({
+        "train_batch_size": 1,
+        "sparse_attention": {
+            "mode": "bigbird",
+            "block": 32,
+            "num_random_blocks": 2,
+        },
+    })
+    sa = cfg.sparse_attention
+    assert sa["mode"] == "bigbird"
+    assert sa["block"] == 32
+    assert sa["num_random_blocks"] == 2
+    assert sa["num_sliding_window_blocks"] == 3  # default
+
+
+def test_sparse_attention_invalid_mode():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({
+            "train_batch_size": 1,
+            "sparse_attention": {"mode": "nope"},
+        })
+
+
+def test_pld_block():
+    cfg = make_config({
+        "train_batch_size": 1,
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5},
+    })
+    assert cfg.pld_enabled
+    assert cfg.pld_params["theta"] == 0.5
+    assert cfg.pld_params["gamma"] == 0.001
+
+
+def test_duplicate_json_keys_rejected():
+    with pytest.raises(DeepSpeedConfigError):
+        loads_config_json('{"train_batch_size": 1, "train_batch_size": 2}')
+
+
+def test_config_from_file(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "type": "bfloat16"},
+        "zero_optimization": {"stage": 2},
+    }))
+    cfg = DeepSpeedConfig(str(path), world_size=2)
+    assert cfg.train_batch_size == 8
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.zero_optimization_stage == 2
+
+
+def test_checkpoint_tag_validation_modes():
+    cfg = make_config({"train_batch_size": 1,
+                       "checkpoint": {"tag_validation": "FAIL"}})
+    assert cfg.checkpoint_tag_validation_enabled
+    assert cfg.checkpoint_tag_validation_fail
+    cfg = make_config({"train_batch_size": 1,
+                       "checkpoint": {"tag_validation": "IGNORE"}})
+    assert not cfg.checkpoint_tag_validation_enabled
+
+
+def test_elasticity_integration():
+    cfg = make_config({
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [8, 12, 16, 17],
+            "min_gpus": 32,
+            "max_gpus": 1500,
+            "version": 0.1,
+        },
+    }, world_size=64)
+    assert cfg.train_batch_size == 9792
+    assert cfg.train_micro_batch_size_per_gpu == 17
+    assert cfg.train_batch_size == (cfg.train_micro_batch_size_per_gpu *
+                                    cfg.gradient_accumulation_steps * 64)
+
+
+def test_elasticity_rejects_explicit_batch():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({
+            "train_batch_size": 9792,
+            "elasticity": {
+                "enabled": True,
+                "max_train_batch_size": 10000,
+                "micro_batch_sizes": [8, 12, 16, 17],
+                "min_gpus": 32,
+                "max_gpus": 1500,
+                "version": 0.1,
+            },
+        }, world_size=64)

@@ -1,0 +1,106 @@
+"""Elastic batch solver tests (parity with reference
+`tests/unit/test_elastic.py` expectations)."""
+
+import pytest
+
+from deeperspeed_tpu import elasticity
+from deeperspeed_tpu.elasticity import (ElasticityConfigError, ElasticityError,
+                                        ElasticityIncompatibleWorldSize)
+from deeperspeed_tpu.version import __version__
+
+BASE_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def _config(**overrides):
+    cfg = {"elasticity": dict(BASE_CONFIG["elasticity"])}
+    cfg["elasticity"].update(overrides)
+    return cfg
+
+
+def test_basic_10k():
+    final_batch_size, valid_gpus = elasticity.compute_elastic_config(
+        ds_config=_config(), target_deepspeed_version=__version__)
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        batch_per_gpu = final_batch_size // gpu_num
+        assert any(batch_per_gpu % mb == 0
+                   for mb in BASE_CONFIG["elasticity"]["micro_batch_sizes"])
+    # Values pinned by the reference test suite.
+    assert len(valid_gpus) == 23
+    assert final_batch_size == 9792
+
+
+def test_old_version():
+    with pytest.raises(ElasticityError):
+        elasticity.compute_elastic_config(ds_config=_config(),
+                                          target_deepspeed_version="0.2")
+
+
+def test_disabled():
+    with pytest.raises(ElasticityError):
+        elasticity.compute_elastic_config(ds_config=_config(enabled=False),
+                                          target_deepspeed_version=__version__)
+
+
+def test_valid_world_size():
+    _, _, mbsize = elasticity.compute_elastic_config(
+        ds_config=_config(), target_deepspeed_version=__version__,
+        world_size=64)
+    assert mbsize == 17
+
+
+def test_invalid_world_size():
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        elasticity.compute_elastic_config(
+            ds_config=_config(), target_deepspeed_version=__version__,
+            world_size=128)
+
+
+def test_future_elastic_version():
+    with pytest.raises(ElasticityError):
+        elasticity.compute_elastic_config(ds_config=_config(version="0.2"),
+                                          target_deepspeed_version=__version__)
+
+
+def test_missing_max_batch():
+    cfg = _config()
+    del cfg["elasticity"]["max_train_batch_size"]
+    with pytest.raises(ElasticityError):
+        elasticity.compute_elastic_config(ds_config=cfg,
+                                          target_deepspeed_version=__version__)
+
+
+def test_missing_micro_batch():
+    cfg = _config()
+    del cfg["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(ElasticityError):
+        elasticity.compute_elastic_config(ds_config=cfg,
+                                          target_deepspeed_version=__version__)
+
+
+def test_empty_config():
+    with pytest.raises(ElasticityError):
+        elasticity.compute_elastic_config(
+            ds_config={"elasticity": {"enabled": True}},
+            target_deepspeed_version=__version__)
+
+
+@pytest.mark.parametrize("key, value", [
+    ("micro_batch_sizes", [1, 4, -1, 2, -10]),
+    ("micro_batch_sizes", [1.5, 4]),
+    ("micro_batch_sizes", "not-a-list"),
+])
+def test_invalid_config_values(key, value):
+    with pytest.raises(ElasticityConfigError):
+        elasticity.compute_elastic_config(ds_config=_config(**{key: value}),
+                                          target_deepspeed_version=__version__)
