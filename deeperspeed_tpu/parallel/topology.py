@@ -1,0 +1,148 @@
+"""N-D cartesian process/chip topology (reference:
+`deepspeed/runtime/pipe/topology.py:13-255`).
+
+Pure rank math, no communication. Row-major layout: the *last* axis varies
+fastest, so putting `data` (or `model`) last keeps those groups on adjacent
+chips — on TPU that means gradient reductions and tensor-parallel collectives
+ride high-bandwidth ICI while pipeline hops can cross DCN.
+
+The torch `ProcessGroup` plumbing of the reference is replaced by
+`deeperspeed_tpu.parallel.mesh`, which lowers a topology onto a
+`jax.sharding.Mesh` with one named axis per topology axis.
+"""
+
+from collections import namedtuple
+from itertools import product as cartesian_product
+
+
+class ProcessTopology:
+    """Maps n-dimensional cartesian coordinates to linear rank indices.
+
+    Axes are accessed by name; the given axis order defines a row-major
+    layout, so ``axes=['x', 'y']`` maps (x, y) and (x, y+1) to adjacent
+    ranks.
+    """
+
+    def __init__(self, axes, dims):
+        if len(axes) != len(dims):
+            raise ValueError(f"axes {axes} and dims {dims} length mismatch")
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+
+        self.mapping = {}
+        for global_rank, coord in enumerate(
+                cartesian_product(*[range(d) for d in self.dims])):
+            self.mapping[self.ProcessCoord(*coord)] = global_rank
+        self._coord_of_rank = {r: c for c, r in self.mapping.items()}
+
+    def get_rank(self, **coord_kwargs):
+        """Global rank of the process at the given full coordinate."""
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(
+                "get_rank() requires a full coordinate; use filter_match() "
+                "for slices")
+        key = self.ProcessCoord(**coord_kwargs)
+        if key not in self.mapping:
+            raise KeyError(f"coordinate {coord_kwargs} not in topology")
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_",
+                      outer_sep="-"):
+        """Checkpoint-style name for a rank, e.g. ``model_00`` (axes in
+        ``omit_axes`` are excluded; matches the reference's file naming)."""
+        omit = frozenset(omit_axes)
+        coord = self.get_coord(rank)
+        names = [f"{ax}{inner_sep}{getattr(coord, ax):02d}"
+                 for ax in self.axes if ax not in omit]
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        if rank not in self._coord_of_rank:
+            raise ValueError(f"rank {rank} not in topology")
+        return self._coord_of_rank[rank]
+
+    def get_axis_comm_lists(self, axis):
+        """Communicator groups along ``axis``: lists of ranks that agree on
+        every coordinate except ``axis``."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for coord in cartesian_product(
+                *[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, coord))
+            lists.append([
+                self.mapping[self.ProcessCoord(**fixed, **{axis: i})]
+                for i in range(self.get_dim(axis))
+            ])
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Ranks whose coordinates match all given axis=value criteria."""
+        return [rank for coord, rank in self.mapping.items()
+                if all(getattr(coord, k) == v
+                       for k, v in filter_kwargs.items())]
+
+    def get_axis_list(self, axis, idx):
+        """Ranks whose coordinate along ``axis`` equals ``idx``."""
+        axis_num = self.axes.index(axis)
+        return [rank for coord, rank in self.mapping.items()
+                if coord[axis_num] == idx]
+
+    def world_size(self):
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+def _prime_factors(n):
+    """Prime factorization of a positive integer, smallest first."""
+    if n <= 0:
+        raise ValueError("Values must be strictly positive.")
+    primes = []
+    candidate = 2
+    while n != 1:
+        while n % candidate == 0:
+            primes.append(candidate)
+            n //= candidate
+        candidate += 1
+    return primes
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Hybrid pipeline+data parallelism; `data` is the fast axis so gradient
+    reductions use the highest-bandwidth links."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D hybrid pipeline/model/data parallelism; `model` is the fast axis
+    (tensor-parallel collectives are the most latency-sensitive)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+def default_topology(world_size):
+    """Split world into pipe×data by alternating prime factors (reference
+    `topology.py:290-296`)."""
+    num_pp, num_dp = 1, 1
+    for idx, prime in enumerate(_prime_factors(world_size)):
+        if idx % 2 == 0:
+            num_pp *= prime
+        else:
+            num_dp *= prime
+    return PipeDataParallelTopology(num_pp=num_pp, num_dp=num_dp)

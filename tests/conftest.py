@@ -8,13 +8,19 @@ them, so every collective path compiles and runs.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even when a real TPU (e.g. the axon tunnel) is
+# attached — multi-device sharding logic needs 8 virtual devices. jax may
+# already be imported by sitecustomize, so set the platform via jax.config
+# (the env var alone is latched too early to help).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

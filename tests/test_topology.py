@@ -1,0 +1,169 @@
+"""Topology rank-math tests (parity with reference
+`tests/unit/test_topology.py`) plus mesh-lowering checks that replace the
+reference's NCCL collective assertions with shard_map psum over a virtual
+8-device mesh."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deeperspeed_tpu.parallel.mesh import PipelineParallelGrid, build_mesh
+from deeperspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                               PipeModelDataParallelTopology,
+                                               ProcessTopology,
+                                               _prime_factors)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_list(axis="row", idx=0) == [0, 1]
+    assert topo.get_axis_list(axis="row", idx=1) == [2, 3]
+    assert topo.get_axis_list(axis="col", idx=0) == [0, 2]
+    assert topo.get_axis_list(axis="col", idx=1) == [1, 3]
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+
+
+def test_topology_match():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.filter_match(pipe=0, data=1) == [2, 3]
+
+
+def test_topology_rank_repr():
+    topo = ProcessTopology(axes=["a", "b"], dims=[2, 2])
+    assert topo.get_rank_repr(rank=0) == "a_00-b_00"
+    assert topo.get_rank_repr(rank=3) == "a_01-b_01"
+    assert topo.get_rank_repr(rank=3, inner_sep="+") == "a+01-b+01"
+
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 2])
+    assert topo.get_rank_repr(rank=0) == ""
+    assert topo.get_rank_repr(rank=0, omit_axes=["pipe"]) == "data_00"
+    assert topo.get_rank_repr(rank=3, omit_axes=[]) == "pipe_01-data_01"
+
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert [topo.get_rank_repr(rank=r) for r in range(8)] == \
+        ["model_00", "model_01"] * 4
+
+
+def test_topology_3d():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 2, 2])
+    assert topo.get_rank(a=1, b=0, c=1) == 5
+    assert topo.get_axis_list("a", 1) == [4, 5, 6, 7]
+    assert topo.get_axis_list("b", 1) == [2, 3, 6, 7]
+    assert topo.get_axis_list("c", 1) == [1, 3, 5, 7]
+    assert topo.get_coord(6) == topo.ProcessCoord(1, 1, 0)
+    assert topo.filter_match(a=0) == [0, 1, 2, 3]
+    assert topo.filter_match(b=1, c=1) == [3, 7]
+    assert topo.filter_match(a=1, b=1, c=1) == [7]
+    assert topo.get_coord(0).a == 0
+
+
+def test_topology_comm_list():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.get_axis_comm_lists("pipe") == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert topo.get_axis_comm_lists("data") == [[0, 2], [1, 3], [4, 6], [5, 7]]
+    assert topo.get_axis_comm_lists("model") == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert topo.get_axis_comm_lists("jeff") == []
+
+
+def test_primes():
+    with pytest.raises(ValueError):
+        _prime_factors(0)
+    assert _prime_factors(2) == [2]
+    assert _prime_factors(12) == [2, 2, 3]
+    assert _prime_factors(97) == [97]
+    for n in (2, 12, 97, 720):
+        prod = 1
+        for p in _prime_factors(n):
+            prod *= p
+        assert prod == n
+
+
+def test_grid_pipe_data(devices):
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    grid = PipelineParallelGrid(topology=topo, devices=devices, rank=0)
+    assert grid.data_parallel_size == 4
+    assert grid.pipe_parallel_size == 2
+    assert grid.is_first_stage
+    assert grid.get_data_parallel_world_size() == 4
+    assert grid.get_pipe_parallel_world_size() == 2
+    assert grid.p2p_groups[0] == [0, 4]
+
+    # Collectives along mesh axes replace the reference's NCCL group checks:
+    # psum over 'data' must sum each rank's id within its data group.
+    mesh = grid.mesh
+    rank_ids = jnp.arange(8.0)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("pipe", "data"),
+             out_specs=P("pipe", "data"))
+    def psum_data(x):
+        return jax.lax.psum(x, axis_name="data") * jnp.ones_like(x)
+
+    result = psum_data(rank_ids.reshape(2, 4))
+    # data groups: [0..3] sum 6, [4..7] sum 22
+    np.testing.assert_allclose(np.asarray(result),
+                               [[6.0] * 4, [22.0] * 4])
+
+    @partial(shard_map, mesh=mesh, in_specs=P("pipe", "data"),
+             out_specs=P("pipe", "data"))
+    def psum_pipe(x):
+        return jax.lax.psum(x, axis_name="pipe") * jnp.ones_like(x)
+
+    result = psum_pipe(rank_ids.reshape(2, 4))
+    # pipe groups: (0,4)=4, (1,5)=6, (2,6)=8, (3,7)=10
+    np.testing.assert_allclose(np.asarray(result),
+                               [[4.0, 6.0, 8.0, 10.0]] * 2)
+
+
+def test_grid_3d(devices):
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, devices=devices, rank=5)
+    # rank 5 = coord (pipe=1, data=0, model=1)
+    assert grid.get_stage_id() == 1
+    assert grid.get_data_parallel_id() == 0
+    assert grid.get_slice_parallel_rank() == 1
+    assert grid.model_parallel_size == 2
+    assert grid.mesh.axis_names == ("pipe", "data", "model")
+    assert grid.mesh.devices.shape == (2, 2, 2)
+
+
+def test_stage_to_global(devices):
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, devices=devices[:4], rank=0)
+    assert grid.stage_to_global(stage_id=0, data=0) == 0
+    assert grid.stage_to_global(stage_id=0, data=1) == 1
+    assert grid.stage_to_global(stage_id=1, data=0) == 2
+    assert grid.stage_to_global(stage_id=1, data=1) == 3
+    assert grid.stage_to_global(stage_id=1) == 2  # rank 0 has data=0
+
+
+def test_mesh_device_order(devices):
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    mesh = build_mesh(topo, devices)
+    # Row-major: mesh position == topology rank == device index.
+    flat = mesh.devices.flatten()
+    for rank in range(8):
+        assert flat[rank] == devices[rank]
+
+
+def test_mesh_world_size_mismatch(devices):
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    with pytest.raises(ValueError):
+        build_mesh(topo, devices)  # 4 != 8
