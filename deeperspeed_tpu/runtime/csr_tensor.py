@@ -1,0 +1,73 @@
+"""Compressed-sparse-row container for sparse (embedding) gradients
+(reference: `deepspeed/runtime/csr_tensor.py:11`).
+
+A row-sparse gradient is stored as (indices, values); the DP reduction
+all-gathers both (engine `csr_allreduce`) instead of densifying. On TPU the
+all-gather is `jax.lax.all_gather` over the `data` axis; `to_dense` uses a
+segment-sum so duplicate rows gathered from different ranks accumulate.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class CSRTensor:
+    """Row-sparse view of a dense [rows, cols] gradient."""
+
+    def __init__(self, dense_tensor=None):
+        self.orig_dense_tensor = dense_tensor
+        if dense_tensor is not None:
+            self.dense_size = tuple(dense_tensor.shape)
+            row_sums = jnp.abs(dense_tensor).sum(
+                axis=tuple(range(1, dense_tensor.ndim)))
+            mask = row_sums > 0
+            (self.indices,) = jnp.nonzero(mask)
+            self.values = dense_tensor[self.indices]
+        else:
+            self.dense_size = None
+            self.indices = None
+            self.values = None
+
+    @staticmethod
+    def type():
+        return "deeperspeed_tpu.runtime.csr_tensor.CSRTensor"
+
+    def to_dense(self):
+        """Scatter-add values back to dense; duplicate indices accumulate."""
+        dense = jnp.zeros(self.dense_size, dtype=self.values.dtype)
+        return dense.at[self.indices].add(self.values)
+
+    def sparse_size(self):
+        num_sparse = int(self.indices.size) * int(
+            jnp.prod(jnp.asarray(self.values.shape[1:])))
+        num_dense = 1
+        for d in self.dense_size:
+            num_dense *= d
+        return num_sparse, num_dense
+
+    def add(self, other):
+        assert self.dense_size == other.dense_size
+        self.indices = jnp.concatenate([self.indices, other.indices])
+        self.values = jnp.concatenate([self.values, other.values])
+        return self
+
+    def __str__(self):
+        num_sparse, num_dense = self.sparse_size()
+        return (f"CSRTensor(indices={self.indices.size}, "
+                f"values={self.values.shape}, dense={self.dense_size}, "
+                f"density={num_sparse / num_dense:.4f})")
+
+
+def csr_allreduce(csr, axis_name="data"):
+    """All-gather indices+values across the data axis (inside shard_map) and
+    average — equivalent of engine.csr_allreduce (reference
+    `engine.py:1397-1448`)."""
+    world = jax.lax.psum(1, axis_name=axis_name)
+    indices = jax.lax.all_gather(csr.indices, axis_name=axis_name,
+                                 tiled=True)
+    values = jax.lax.all_gather(csr.values, axis_name=axis_name, tiled=True)
+    out = CSRTensor()
+    out.dense_size = csr.dense_size
+    out.indices = indices
+    out.values = values / world
+    return out

@@ -1,0 +1,184 @@
+"""Loss scaling (reference: `deepspeed/runtime/fp16/loss_scaler.py`).
+
+Two faces of the same state machine:
+
+- Host-side classes ``LossScaler`` / ``DynamicLossScaler`` with the
+  reference API (``update_scale``, ``cur_scale``, ``has_overflow``-driven).
+- A jit-side functional form (``LossScaleState`` + ``update_loss_scale``)
+  using ``jnp.where`` so step-skipping on overflow lives *inside* the
+  compiled train step — the torch version relies on eager control flow
+  (SURVEY.md "hard parts"), here it is branchless arithmetic.
+
+bf16/fp32 runs use ``LossScaler(scale=1)`` and skip overflow tracking.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScalerBase:
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jnp.asarray(grads) * self.loss_scale
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss):
+        return loss * self.loss_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scale; overflow never fires."""
+
+    def __init__(self, scale=1):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic scaling: halve on overflow (with `delayed_shift` hysteresis),
+    double after `scale_window` clean steps, floor at `min_scale`."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0,
+                 scale_window=1000, min_scale=1, delayed_shift=1,
+                 consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        return bool(jnp.logical_not(jnp.isfinite(x)).any())
+
+    def has_overflow_serial(self, params):
+        return any(self._has_inf_or_nan(p) for p in params)
+
+    has_overflow = has_overflow_serial
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor,
+                                     self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % \
+                    self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+# ---------------------------------------------------------------------------
+# jit-side functional form
+# ---------------------------------------------------------------------------
+
+class LossScaleState(NamedTuple):
+    """Loss-scale state as arrays, carried through the jitted train step."""
+    cur_scale: jnp.ndarray        # f32 scalar
+    cur_iter: jnp.ndarray         # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    cur_hysteresis: jnp.ndarray   # i32 scalar
+
+
+def init_loss_scale_state(init_scale=2 ** 32, delayed_shift=1,
+                          static=False):
+    """`static=True` yields a state update_loss_scale leaves untouched."""
+    return LossScaleState(
+        cur_scale=jnp.asarray(float(init_scale), jnp.float32),
+        cur_iter=jnp.asarray(0, jnp.int32),
+        last_overflow_iter=jnp.asarray(-1 if not static else -2 ** 30,
+                                       jnp.int32),
+        cur_hysteresis=jnp.asarray(delayed_shift, jnp.int32),
+    )
+
+
+def grads_finite(grads):
+    """Scalar bool: all leaves of the grad pytree are finite."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.asarray(True)
+    for leaf in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+    return finite
+
+
+def update_loss_scale(state, overflow, scale_factor=2.0, scale_window=1000,
+                      min_scale=1.0, delayed_shift=1,
+                      consecutive_hysteresis=False):
+    """Branchless version of DynamicLossScaler.update_scale."""
+    overflow = jnp.asarray(overflow)
+
+    shift_now = jnp.logical_or(delayed_shift == 1, state.cur_hysteresis <= 1)
+    scale_on_overflow = jnp.where(
+        shift_now,
+        jnp.maximum(state.cur_scale / scale_factor, min_scale),
+        state.cur_scale)
+    hysteresis_on_overflow = jnp.where(shift_now, state.cur_hysteresis,
+                                       state.cur_hysteresis - 1)
+
+    window_hit = (state.cur_iter - state.last_overflow_iter) % \
+        scale_window == 0
+    scale_on_ok = jnp.where(window_hit, state.cur_scale * scale_factor,
+                            state.cur_scale)
+    hysteresis_on_ok = jnp.where(
+        jnp.logical_or(consecutive_hysteresis, window_hit),
+        jnp.asarray(delayed_shift, jnp.int32), state.cur_hysteresis)
+
+    return LossScaleState(
+        cur_scale=jnp.where(overflow, scale_on_overflow, scale_on_ok),
+        cur_iter=state.cur_iter + 1,
+        last_overflow_iter=jnp.where(overflow, state.cur_iter,
+                                     state.last_overflow_iter),
+        cur_hysteresis=jnp.where(overflow, hysteresis_on_overflow,
+                                 hysteresis_on_ok),
+    )
+
+
+CLIP_GRAD = "clip_grad"
+
+
+def create_loss_scaler(config):
+    """Build the host-side scaler from a DeepSpeedConfig-like object."""
+    if not getattr(config, "loss_scaling_enabled", False):
+        return LossScaler(scale=1)
+    static_scale = getattr(config, "loss_scale", 0)
+    if static_scale and static_scale > 0:
+        return LossScaler(scale=static_scale)
+    args = getattr(config, "dynamic_loss_scale_args", None) or {}
+    return DynamicLossScaler(
+        init_scale=2 ** args.get("initial_scale_power", 32),
+        scale_window=args.get("loss_scale_window", 1000),
+        min_scale=args.get("min_loss_scale", 1),
+        delayed_shift=args.get("hysteresis", 1),
+    )

@@ -1,0 +1,326 @@
+"""Runtime utilities (reference: `deepspeed/runtime/utils.py`).
+
+Includes the balanced-partition solver used by the pipeline module
+(`partition_balanced`, reference `utils.py:399`), the `PartitionedTensor`
+scatter/gather container used for activation ("slice") parallelism
+(reference `utils.py:417-525`), gradient-norm helpers, and the fork's
+`GradientNoiseScale` estimator (reference `utils.py:618-674`).
+"""
+
+from bisect import bisect_left
+from math import floor
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+def noop_decorator(func):
+    return func
+
+
+def call_to_str(base, *args, **kwargs):
+    """Construct a string representation of a call, e.g. ``f(1, b=2)``."""
+    name = f"{base}("
+    name += ", ".join(repr(arg) for arg in args)
+    if args and kwargs:
+        name += ", "
+    name += ", ".join(f"{key}={repr(val)}" for key, val in kwargs.items())
+    name += ")"
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Norm helpers (jit-friendly; axis_name psums replace mpu allreduces)
+# ---------------------------------------------------------------------------
+
+def global_norm(tree, axis_name=None):
+    """L2 norm over a pytree; if `axis_name` is given (inside shard_map),
+    sums squares across that mesh axis first (model-parallel-aware norm,
+    reference `utils.py:300-306`)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+             for leaf in leaves)
+    if axis_name is not None:
+        sq = jax.lax.psum(sq, axis_name=axis_name)
+    return jnp.sqrt(sq)
+
+
+def clip_grad_norm_(grads, max_norm, axis_name=None, norm=None):
+    """Scale the grad pytree so its global L2 norm is at most `max_norm`.
+    Overflowed (non-finite) norms leave grads unscaled — the loss-scaler
+    skip path handles them. Returns (clipped_grads, total_norm)."""
+    total_norm = global_norm(grads, axis_name) if norm is None else norm
+    clip_coef = max_norm / (total_norm + 1e-6)
+    clip_coef = jnp.where(jnp.isfinite(total_norm),
+                          jnp.minimum(clip_coef, 1.0), 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads)
+    return clipped, total_norm
+
+
+def get_grad_norm(grads, mpu=None, norm_type=2):
+    """Host-side grad norm; -1 signals inf/nan (reference contract)."""
+    if norm_type != 2:
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = max(float(jnp.max(jnp.abs(l))) for l in leaves)
+    else:
+        total = float(global_norm(grads))
+    if not np.isfinite(total):
+        return -1
+    return total
+
+
+def get_weight_norm(weights, mpu=None, norm_type=2):
+    return get_grad_norm(weights, mpu=mpu, norm_type=norm_type)
+
+
+# ---------------------------------------------------------------------------
+# Balanced partitioning (pipeline layer assignment)
+# ---------------------------------------------------------------------------
+
+def prefix_sum_inc(weights):
+    """Inclusive prefix sum: [3,4,5] -> [3,7,12]."""
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+def partition_uniform(num_items, num_parts):
+    parts = [0] * (num_parts + 1)
+    if num_items <= num_parts:
+        for p in range(num_parts + 1):
+            parts[p] = min(p, num_items)
+        return parts
+    chunksize = floor(num_items / num_parts)
+    for p in range(num_parts):
+        parts[p] = min(chunksize * p, num_items)
+    parts[num_parts] = num_items
+    return parts
+
+
+def _lprobe(weights, num_parts, bottleneck):
+    """Greedy left-to-right probe: can `weights` (inclusive prefix sums) be
+    split into `num_parts` with no part heavier than `bottleneck`?"""
+    num_items = len(weights)
+    total_weight = weights[-1]
+
+    parts = [0] * (num_parts + 1)
+    for p in range(1, num_parts + 1):
+        parts[p] = num_items
+
+    bsum = bottleneck
+    chunksize = num_items // num_parts
+    step = chunksize
+    for p in range(1, num_parts):
+        while step < num_items and weights[step] < bsum:
+            step += chunksize
+        parts[p] = bisect_left(weights, bsum, lo=step - chunksize,
+                               hi=min(step, num_items))
+        if parts[p] == num_items:
+            part_size = weights[-1] - weights[parts[p - 1]]
+            return parts, part_size < bottleneck
+        bsum = weights[parts[p] - 1] + bottleneck
+
+    return parts, bsum >= total_weight
+
+
+def _rb_partition_balanced(weights, num_parts, eps):
+    """Binary-search the smallest feasible bottleneck."""
+    total_weight = weights[-1]
+    lower = total_weight / num_parts
+    upper = total_weight
+    while upper > lower + eps:
+        mid = lower + ((upper - lower) / 2)
+        _, success = _lprobe(weights, num_parts, mid)
+        if success:
+            upper = mid
+        else:
+            lower = mid + eps
+    return upper
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Split items into contiguous parts minimizing the heaviest part
+    (reference `utils.py:399`). Returns num_parts+1 boundary indices."""
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+    prefix = prefix_sum_inc(weights)
+    bottleneck = _rb_partition_balanced(prefix, num_parts, eps=eps)
+    parts, success = _lprobe(prefix, num_parts, bottleneck)
+    assert success
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# PartitionedTensor — activation ("slice") parallelism container
+# ---------------------------------------------------------------------------
+
+class PartitionedTensor:
+    """A flat 1/num_parts shard of a tensor plus meta to rebuild it.
+
+    Host-side counterpart of the reference's `PartitionedTensor`
+    (`utils.py:417`): the pipeline engine scatters inter-stage activations
+    across the model-parallel group and reassembles on receive. Inside a
+    jitted pipeline step the same job is done by sharding specs; this class
+    serves the eager paths (checkpoint layout, meta handshakes, tests).
+    """
+
+    def __init__(self, tensor=None, num_parts=1, rank=0):
+        self.num_parts = num_parts
+        self.rank = rank
+        if tensor is not None:
+            self.orig_size = list(tensor.shape)
+            self.local_data, self.partition = self._partition_tensor(
+                jnp.asarray(tensor))
+
+    @classmethod
+    def from_meta(cls, meta, local_part, num_parts=None, rank=None):
+        meta = [int(m) for m in np.asarray(meta)]
+        obj = cls(tensor=None,
+                  num_parts=num_parts if num_parts is not None else 0,
+                  rank=rank if rank is not None else 0)
+        ndims = meta[0]
+        obj.orig_size = meta[1:1 + ndims]
+        rest = meta[1 + ndims:]
+        obj.num_parts = rest[0]
+        obj.rank = rest[1]
+        obj.partition = rest[2:]  # CSR-style rowptr, length num_parts+1
+        obj.local_data = jnp.asarray(local_part)
+        return obj
+
+    def _partition_tensor(self, tensor):
+        partition = partition_uniform(num_items=int(tensor.size),
+                                      num_parts=self.num_parts)
+        start = partition[self.rank]
+        stop = partition[self.rank + 1]
+        return tensor.reshape(-1)[start:stop], partition
+
+    def full(self, gathered_parts=None):
+        """Rebuild the full tensor. Single-host: supply every rank's shard
+        via `gathered_parts`; defaults to zeros outside the local shard."""
+        full_numel = int(np.prod(self.full_size()))
+        flat = jnp.zeros([full_numel], dtype=self.local_data.dtype)
+        if gathered_parts is None:
+            gathered_parts = {self.rank: self.local_data}
+        for part_id, data in gathered_parts.items():
+            start = self.partition[part_id]
+            stop = self.partition[part_id + 1]
+            flat = flat.at[start:stop].set(jnp.asarray(data).reshape(-1))
+        return flat.reshape(self.full_size())
+
+    def to_meta(self):
+        meta = [len(self.orig_size)]
+        meta += list(self.orig_size)
+        meta += [self.num_parts, self.rank]
+        meta += list(self.partition)
+        return np.asarray(meta, dtype=np.int64)
+
+    def data(self):
+        return self.local_data
+
+    def local_size(self):
+        return self.local_data.shape
+
+    def full_size(self):
+        return self.orig_size
+
+
+# ---------------------------------------------------------------------------
+# Memory reporting
+# ---------------------------------------------------------------------------
+
+def see_memory_usage(message, force=False):
+    """Log device + host memory stats (reference `utils.py:569`)."""
+    if not force:
+        return
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / 2 ** 30
+        peak = stats.get("peak_bytes_in_use", 0) / 2 ** 30
+        limit = stats.get("bytes_limit", 0) / 2 ** 30
+        logger.info(f"{message} | HBM in-use {in_use:.2f} GB | "
+                    f"peak {peak:.2f} GB | limit {limit:.2f} GB")
+    except Exception:
+        logger.info(f"{message} | device memory stats unavailable")
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        logger.info(f"CPU virtual memory: used {vm.used / 2**30:.2f} GB, "
+                    f"percent {vm.percent}%")
+    except ImportError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Gradient noise scale (fork addition)
+# ---------------------------------------------------------------------------
+
+class GradientNoiseScale:
+    """Estimate the gradient noise scale B_noise = tr(Σ)/|G|² from grads at
+    two effective batch sizes (McCandlish et al. 2018), with EMA smoothing.
+    `update(grads)` takes the current micro-batch grad pytree; every
+    `n_batches` calls it compares the averaged grads against the freshest
+    one. Fork addition: reference `utils.py:618-674`.
+    """
+
+    def __init__(self, batch_size_small, n_batches, beta=0.99, model=None):
+        self.batch_size_small = batch_size_small
+        self.batch_size_large = batch_size_small * n_batches
+        self.n_batches = n_batches
+        self.beta = beta
+        self.model = model
+        self.buffer = []
+        self.ema_scale = None
+        self.ema_noise = None
+        self.scale = None
+        self.noise = None
+        self.noise_scale = None
+        self.n_updates = 0
+
+    def _ema(self, avg, value, i):
+        avg = (avg or 0) * self.beta + (1 - self.beta) * value
+        return avg, avg / (1 - self.beta ** (i + 1))
+
+    @staticmethod
+    def _flatten(grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        return jnp.concatenate(
+            [l.astype(jnp.float32).reshape(-1) for l in leaves])
+
+    def _get_scale(self, g_small, g_big):
+        return (g_small - g_big) / ((1 / self.batch_size_small) -
+                                    (1 / self.batch_size_large))
+
+    def _get_noise(self, g_small, g_big):
+        return (self.batch_size_large * g_big -
+                self.batch_size_small * g_small) / \
+            (self.batch_size_large - self.batch_size_small)
+
+    def update(self, grads):
+        curr = self._flatten(grads)
+        self.buffer.append(curr)
+        if self.n_updates % self.n_batches == self.n_batches - 1:
+            past = jnp.stack(self.buffer, axis=1).mean(axis=1)
+            self.buffer = []
+            g_big = float(jnp.mean(past ** 2))
+            g_small = float(jnp.mean(curr ** 2))
+
+            noise = self._get_noise(g_small, g_big)
+            scale = self._get_scale(g_small, g_big)
+
+            self.ema_scale, scale = self._ema(self.ema_scale, scale,
+                                              self.n_updates)
+            self.ema_noise, noise = self._ema(self.ema_noise, noise,
+                                              self.n_updates)
+            self.scale = float(scale)
+            self.noise = float(noise)
+            self.noise_scale = self.scale / self.noise if self.noise else None
+        self.n_updates += 1

@@ -1,0 +1,104 @@
+"""Loss-scaler state machine tests (parity with reference
+`tests/unit/test_dynamic_loss_scale.py` semantics), both the host-side class
+and the jit-side functional form — including that the two stay in lockstep.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.runtime.fp16.loss_scaler import (DynamicLossScaler,
+                                                      LossScaler,
+                                                      grads_finite,
+                                                      init_loss_scale_state,
+                                                      update_loss_scale)
+
+
+def test_static_scaler():
+    scaler = LossScaler(scale=128)
+    assert scaler.loss_scale == 128
+    assert not scaler.has_overflow([])
+    scaler.update_scale(True)
+    assert scaler.loss_scale == 128
+
+
+def test_dynamic_halves_on_overflow():
+    scaler = DynamicLossScaler(init_scale=2 ** 8, scale_window=1000)
+    scaler.update_scale(True)
+    assert scaler.cur_scale == 2 ** 7
+    scaler.update_scale(True)
+    assert scaler.cur_scale == 2 ** 6
+
+
+def test_dynamic_doubles_after_window():
+    scaler = DynamicLossScaler(init_scale=2 ** 8, scale_window=10)
+    for _ in range(10):
+        scaler.update_scale(False)
+    assert scaler.cur_scale == 2 ** 9
+
+
+def test_dynamic_min_scale_floor():
+    scaler = DynamicLossScaler(init_scale=4, min_scale=1, scale_window=1000)
+    for _ in range(10):
+        scaler.update_scale(True)
+    assert scaler.cur_scale == 1
+
+
+def test_hysteresis_delays_shift():
+    scaler = DynamicLossScaler(init_scale=2 ** 8, delayed_shift=2,
+                               scale_window=1000)
+    scaler.update_scale(True)   # consumes hysteresis
+    assert scaler.cur_scale == 2 ** 8
+    scaler.update_scale(True)   # now shifts
+    assert scaler.cur_scale == 2 ** 7
+
+
+def test_has_overflow():
+    scaler = DynamicLossScaler()
+    assert not scaler.has_overflow([jnp.ones(4)])
+    assert scaler.has_overflow([jnp.ones(4),
+                                jnp.array([1.0, float("inf")])])
+    assert scaler.has_overflow([jnp.array([float("nan")])])
+
+
+def test_grads_finite():
+    good = {"a": jnp.ones(3), "b": (jnp.zeros(2),)}
+    bad = {"a": jnp.ones(3), "b": (jnp.array([jnp.nan, 0.0]),)}
+    assert bool(grads_finite(good))
+    assert not bool(grads_finite(bad))
+
+
+@pytest.mark.parametrize("window,hysteresis", [(5, 1), (3, 2), (7, 3)])
+def test_functional_matches_class(window, hysteresis):
+    """The jit-side state machine must track the host-side class exactly."""
+    rng = np.random.default_rng(0)
+    overflows = rng.random(50) < 0.3
+
+    scaler = DynamicLossScaler(init_scale=2 ** 16, scale_window=window,
+                               delayed_shift=hysteresis)
+    state = init_loss_scale_state(init_scale=2 ** 16,
+                                  delayed_shift=hysteresis)
+
+    step = jax.jit(lambda s, o: update_loss_scale(
+        s, o, scale_window=window, delayed_shift=hysteresis))
+
+    for overflow in overflows:
+        scaler.update_scale(bool(overflow))
+        state = step(state, bool(overflow))
+        assert float(state.cur_scale) == pytest.approx(scaler.cur_scale), \
+            f"diverged at iter {int(state.cur_iter)}"
+        assert int(state.cur_iter) == scaler.cur_iter
+
+
+def test_functional_in_jit_loop():
+    """State machine must be traceable through lax.scan."""
+    state = init_loss_scale_state(init_scale=2 ** 4, delayed_shift=1)
+    overflows = jnp.array([True, True, False, False, False])
+
+    def body(carry, overflow):
+        return update_loss_scale(carry, overflow, scale_window=2), None
+
+    final, _ = jax.lax.scan(body, state, overflows)
+    # 2**4 → /2 → /2 = 4; then 1 clean step, then window hit doubles → 8
+    assert float(final.cur_scale) == 8.0

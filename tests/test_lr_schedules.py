@@ -1,0 +1,182 @@
+"""LR/batch-size schedule tests (parity with reference
+`tests/unit/test_lr_schedulers.py` semantics)."""
+
+import math
+
+import pytest
+
+from deeperspeed_tpu.runtime.bs_schedules import BatchSizeScheduler
+from deeperspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle,
+                                                  WarmupDecayLR, WarmupLR,
+                                                  make_schedule_fn)
+
+
+class FakeOptimizer:
+    def __init__(self, n_groups=1, lr=0.1):
+        self.param_groups = [{"lr": lr, "betas": (0.9, 0.999)}
+                             for _ in range(n_groups)]
+        self.defaults = {"betas": (0.9, 0.999)}
+
+
+def test_warmup_lr_ramp():
+    opt = FakeOptimizer()
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=0.1,
+                     warmup_num_steps=10)
+    lrs = []
+    for _ in range(15):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    assert lrs[0] == pytest.approx(0.0)
+    assert all(b >= a for a, b in zip(lrs, lrs[1:]))
+    assert lrs[9] == pytest.approx(0.1)
+    assert lrs[-1] == pytest.approx(0.1)  # held at max
+
+
+def test_warmup_lr_log_shape():
+    opt = FakeOptimizer()
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=1.0,
+                     warmup_num_steps=100)
+    sched.step(50)
+    expected = math.log(51) / math.log(100)
+    assert opt.param_groups[0]["lr"] == pytest.approx(expected)
+
+
+def test_warmup_decay_lr():
+    opt = FakeOptimizer()
+    sched = WarmupDecayLR(opt, total_num_steps=20, warmup_min_lr=0.0,
+                          warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(10):
+        sched.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.1)
+    sched.step(20)  # iteration == total_num_steps → fully decayed
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.0)
+
+
+def test_warmup_decay_midpoint():
+    opt = FakeOptimizer()
+    sched = WarmupDecayLR(opt, total_num_steps=30, warmup_min_lr=0.0,
+                          warmup_max_lr=0.1, warmup_num_steps=10)
+    sched.step(20)  # 10 steps into the 20-step decay
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.05)
+
+
+def test_lr_range_test_continuous():
+    opt = FakeOptimizer()
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.01)
+    sched.step()  # iteration 0
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.01 * (1 + 0.1))
+    for _ in range(9):
+        sched.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.01 * 2.0)
+
+
+def test_lr_range_test_staircase():
+    opt = FakeOptimizer()
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+    sched.step()
+    first = opt.param_groups[0]["lr"]
+    for _ in range(8):
+        sched.step()
+        assert opt.param_groups[0]["lr"] == pytest.approx(first)
+    sched.step()  # crosses the stair boundary
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.02)
+
+
+def test_one_cycle_lr():
+    opt = FakeOptimizer()
+    sched = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                     cycle_first_step_size=10, decay_step_size=10,
+                     decay_lr_rate=1.0)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    peak_idx = lrs.index(max(lrs))
+    assert 8 <= peak_idx <= 10
+    assert max(lrs) == pytest.approx(0.1, rel=0.15)
+    # Second half descends back toward min.
+    assert lrs[-1] < lrs[peak_idx]
+
+
+def test_one_cycle_momentum_inverse():
+    opt = FakeOptimizer()
+    sched = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                     cycle_first_step_size=10, cycle_momentum=True,
+                     cycle_min_mom=0.8, cycle_max_mom=0.9)
+    sched.step(5)
+    mom_mid = opt.param_groups[0]["betas"][0]
+    sched.step(9)
+    mom_peak = opt.param_groups[0]["betas"][0]
+    # Momentum cycles inversely to lr: lowest at the lr peak.
+    assert mom_peak < mom_mid <= 0.9
+
+
+def test_state_dict_roundtrip():
+    opt = FakeOptimizer()
+    sched = WarmupLR(opt, warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(5):
+        sched.step()
+    sd = sched.state_dict()
+    sched2 = WarmupLR(FakeOptimizer(), warmup_max_lr=0.1,
+                      warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    assert sched2.last_batch_iteration == sched.last_batch_iteration
+    sched.step()
+    sched2.step()
+    assert sched.get_last_lr() == sched2.get_last_lr()
+
+
+def test_make_schedule_fn():
+    fn = make_schedule_fn("WarmupLR", {
+        "warmup_min_lr": 0.0, "warmup_max_lr": 0.1, "warmup_num_steps": 10})
+    assert fn(0) == pytest.approx(0.0)
+    assert fn(9) == pytest.approx(0.1)
+    assert fn(100) == pytest.approx(0.1)
+
+
+def test_get_lr_before_step_warns():
+    opt = FakeOptimizer()
+    sched = WarmupLR(opt, warmup_max_lr=0.1)
+    assert sched.get_lr() == [0.0]
+
+
+# --- batch size schedule --------------------------------------------------
+
+def test_bs_scheduler_ramp():
+    sched = BatchSizeScheduler(final_batch_size=16, num_intervals=8,
+                               warmup_num_steps=100,
+                               min_batch_size_multiplier=0.25)
+    sched.step()
+    assert sched.current_batch_size == 4
+    sched.step(100)
+    assert sched.current_batch_size == 16
+    sched.step(1000)
+    assert sched.current_batch_size == 16
+
+    # Monotone non-decreasing over the ramp
+    sched = BatchSizeScheduler(final_batch_size=16, num_intervals=4,
+                               warmup_num_steps=1000)
+    seen = []
+    for i in range(1001):
+        sched.step()
+        seen.append(sched.current_batch_size)
+    assert seen == sorted(seen)
+    assert seen[-1] == 16
+
+
+def test_bs_scheduler_state_roundtrip():
+    sched = BatchSizeScheduler(final_batch_size=32, warmup_num_steps=10)
+    for _ in range(5):
+        sched.step()
+    sd = sched.state_dict()
+    sched2 = BatchSizeScheduler(final_batch_size=32, warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    sched.step()
+    sched2.step()
+    assert sched.current_batch_size == sched2.current_batch_size

@@ -1,0 +1,157 @@
+"""Runtime-utils tests (parity with reference `tests/unit/test_partition.py`
+and `test_runtime_utils.py`, plus fork noise-scale / CSR / PLD coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.runtime.csr_tensor import CSRTensor
+from deeperspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                RepeatingLoader)
+from deeperspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deeperspeed_tpu.runtime.utils import (GradientNoiseScale,
+                                           PartitionedTensor,
+                                           clip_grad_norm_, global_norm,
+                                           partition_balanced,
+                                           partition_uniform, prefix_sum_inc)
+
+
+def test_prefix_sum():
+    assert prefix_sum_inc([3, 4, 5]) == [3, 7, 12]
+
+
+def test_partition_uniform():
+    assert partition_uniform(10, 2) == [0, 5, 10]
+    assert partition_uniform(2, 4) == [0, 1, 2, 2, 2]
+    parts = partition_uniform(103, 4)
+    assert parts[0] == 0 and parts[-1] == 103
+    assert all(b >= a for a, b in zip(parts, parts[1:]))
+
+
+def test_partition_balanced_balances():
+    # Expectations pinned by reference tests/unit/test_partition.py.
+    parts = partition_balanced([1] * 8, 4)
+    sizes = [parts[i + 1] - parts[i] for i in range(4)]
+    assert sizes == [2, 2, 2, 2]
+    assert partition_balanced([0, 1, 2, 3, 3, 3], 4) == [0, 3, 4, 5, 6]
+    assert partition_balanced([0.0, 1.1, 1.9, 3.0, 3.0, 3.0], 4) == \
+        [0, 3, 4, 5, 6]
+    assert partition_balanced([0.0, 1.1, 30, 3.0], 3) == [0, 2, 3, 4]
+
+
+def test_partition_balanced_fewer_items_than_parts():
+    assert partition_balanced([5, 5], 4) == [0, 1, 2, 2, 2]
+
+
+def test_partitioned_tensor_roundtrip():
+    x = jnp.arange(24.0).reshape(4, 6)
+    parts = [PartitionedTensor(x, num_parts=3, rank=r) for r in range(3)]
+    gathered = {r: parts[r].local_data for r in range(3)}
+    full = parts[0].full(gathered)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(x))
+
+
+def test_partitioned_tensor_meta_roundtrip():
+    x = jnp.arange(10.0)
+    pt = PartitionedTensor(x, num_parts=2, rank=1)
+    meta = pt.to_meta()
+    rebuilt = PartitionedTensor.from_meta(meta, pt.local_data)
+    assert rebuilt.full_size() == [10]
+    assert rebuilt.num_parts == 2 and rebuilt.rank == 1
+    np.testing.assert_array_equal(np.asarray(rebuilt.data()),
+                                  np.asarray(pt.data()))
+
+
+def test_global_norm_and_clip():
+    grads = {"w": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+    norm = float(global_norm(grads))
+    assert norm == pytest.approx(10.0)
+    clipped, total = clip_grad_norm_(grads, max_norm=5.0)
+    assert float(total) == pytest.approx(10.0)
+    assert float(global_norm(clipped)) == pytest.approx(5.0, rel=1e-3)
+    # Under the limit: unchanged.
+    clipped2, _ = clip_grad_norm_(grads, max_norm=100.0)
+    np.testing.assert_allclose(np.asarray(clipped2["w"]),
+                               np.asarray(grads["w"]))
+
+
+def test_clip_grad_norm_nonfinite_passthrough():
+    grads = {"w": jnp.array([jnp.inf, 1.0])}
+    clipped, total = clip_grad_norm_(grads, max_norm=1.0)
+    assert not np.isfinite(float(total))
+    np.testing.assert_array_equal(np.asarray(clipped["w"]),
+                                  np.asarray(grads["w"]))
+
+
+def test_csr_tensor():
+    dense = jnp.zeros((6, 4)).at[1].set(2.0).at[4].set(-1.0)
+    csr = CSRTensor(dense)
+    assert csr.indices.tolist() == [1, 4]
+    np.testing.assert_array_equal(np.asarray(csr.to_dense()),
+                                  np.asarray(dense))
+    sparse, total = csr.sparse_size()
+    assert sparse == 8 and total == 24
+
+
+def test_csr_add_accumulates():
+    dense = jnp.zeros((4, 2)).at[1].set(1.0)
+    a, b = CSRTensor(dense), CSRTensor(dense)
+    a.add(b)
+    np.testing.assert_array_equal(np.asarray(a.to_dense()),
+                                  np.asarray(dense * 2))
+
+
+def test_pld_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert pld.get_theta() == pytest.approx(1.0)
+    pld.update_state(10_000)
+    assert pld.get_theta() == pytest.approx(0.5, abs=1e-4)
+    state = pld.get_state()
+    assert state["progressive_layer_drop"]
+
+
+def test_noise_scale():
+    gns = GradientNoiseScale(batch_size_small=4, n_batches=2, beta=0.9)
+    rng = np.random.default_rng(0)
+    for _ in range(6):
+        grads = {"w": jnp.asarray(rng.normal(size=(8,)), jnp.float32)}
+        gns.update(grads)
+    assert gns.noise_scale is not None
+    assert gns.n_updates == 6
+
+
+def test_repeating_loader():
+    loader = RepeatingLoader([1, 2, 3])
+    out = [next(loader) for _ in range(7)]
+    assert out == [1, 2, 3, 1, 2, 3, 1]
+
+
+def test_dataloader_batching():
+    data = [(np.full((2,), i, np.float32), np.int32(i)) for i in range(10)]
+    dl = DeepSpeedDataLoader(data, batch_size=4, num_replicas=1, rank=0)
+    batches = list(dl)
+    assert len(batches) == 2  # drop_last
+    xb, yb = batches[0]
+    assert xb.shape == (4, 2)
+    assert yb.shape == (4,)
+
+
+def test_dataloader_shuffle_deterministic():
+    data = [np.float32(i) for i in range(16)]
+    dl1 = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=7,
+                              num_replicas=1, rank=0)
+    dl2 = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=7,
+                              num_replicas=1, rank=0)
+    np.testing.assert_array_equal(np.concatenate(list(dl1)),
+                                  np.concatenate(list(dl2)))
+
+
+def test_dataloader_rank_strided():
+    data = [np.float32(i) for i in range(8)]
+    dl0 = DeepSpeedDataLoader(data, batch_size=2, num_replicas=2, rank=0)
+    dl1 = DeepSpeedDataLoader(data, batch_size=2, num_replicas=2, rank=1)
+    seen = np.concatenate(list(dl0) + list(dl1))
+    assert sorted(seen.tolist()) == [float(i) for i in range(8)]
