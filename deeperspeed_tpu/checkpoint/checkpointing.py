@@ -1,0 +1,290 @@
+"""Engine checkpoint save/load with the reference directory layout
+(reference: `deepspeed/runtime/engine.py:1491-1818`).
+
+Layout written:
+
+    {save_dir}/{tag}/mp_rank_{mp:02d}_model_states.pt
+    {save_dir}/{tag}/zero_pp_rank_{dp}_mp_rank_{mp:02d}_optim_states.pt
+    {save_dir}/latest
+
+Model-states files hold module params + scheduler/counter state; when ZeRO
+is enabled the fp32 masters + optimizer moments are written per-dp-rank as
+GSPMD-convention slices along each leaf's sharded dim, and reassembled (and
+re-placed with the *current* shardings) on load — which is exactly the
+reference's elastic checkpointing: a job restarted at a different dp world
+size merges the saved partitions and re-slices (`stage2.py:1825-1894`).
+"""
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.fp16.loss_scaler import LossScaleState
+from ..utils.logging import log_dist, logger
+from .serialization import (load_obj, save_obj, shard_slice,
+                            state_dict_to_tree, tree_to_state_dict,
+                            unshard_concat)
+
+LATEST_FILE = "latest"
+
+
+def _model_states_name(mp_rank):
+    return f"mp_rank_{mp_rank:02d}_model_states.pt"
+
+
+def _zero_ckpt_name(dp_rank, mp_rank):
+    return f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states.pt"
+
+
+def _sharded_dim(spec):
+    for i, axis in enumerate(spec):
+        if axis is not None:
+            return i
+    return None
+
+
+def save_checkpoint(engine, save_dir, tag=None, client_state=None,
+                    save_latest=True):
+    client_state = client_state or {}
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    # --- model states (params + host-side training state) ----------------
+    state = engine.state
+    model_state = {
+        "module": tree_to_state_dict(state.params),
+        "optimizer": None,
+        "lr_scheduler": (engine.lr_scheduler.state_dict()
+                         if engine.lr_scheduler is not None else None),
+        "batch_size_scheduler": (engine.batch_size_scheduler.state_dict()
+                                 if engine.batch_size_scheduler is not None
+                                 else None),
+        "csr_tensor_module_names": [],
+        "skipped_steps": engine.skipped_steps,
+        "global_steps": engine.global_steps,
+        "global_samples": engine.global_samples,
+        "micro_steps": engine.micro_steps,
+        "dp_world_size": engine.dp_world_size,
+        "mp_world_size": engine.mp_world_size,
+        "loss_scale_state": {
+            "cur_scale": float(state.scale.cur_scale),
+            "cur_iter": int(state.scale.cur_iter),
+            "last_overflow_iter": int(state.scale.last_overflow_iter),
+            "cur_hysteresis": int(state.scale.cur_hysteresis),
+        },
+        "ds_config": engine._config.param_dict,
+        "ds_version": "0.3.15+tpu",
+    }
+    model_state.update(client_state)
+    if not engine.zero_optimization():
+        model_state["optimizer"] = {
+            "state": tree_to_state_dict(state.opt_state),
+            "param_groups": [dict(g) for g in
+                             engine.optimizer.param_groups],
+        }
+    save_obj(model_state, os.path.join(ckpt_dir, _model_states_name(0)))
+
+    # --- zero partitions --------------------------------------------------
+    if engine.zero_optimization() or engine.keep_master:
+        _save_zero_checkpoint(engine, ckpt_dir)
+
+    if save_latest:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(str(tag))
+    log_dist(f"Saved checkpoint {tag} to {ckpt_dir}", ranks=[0])
+    return True
+
+
+def _flat_arrays(tree):
+    """{path: numpy array} view of a pytree (device_get applied)."""
+    sd = tree_to_state_dict(tree)
+    return sd["arrays"]
+
+
+def _save_zero_checkpoint(engine, ckpt_dir):
+    state = engine.state
+    rules = engine.zero_rules
+    dp = engine.dp_world_size if rules.stage >= 1 else 1
+
+    master_flat = (_flat_arrays(state.master)
+                   if state.master is not None else None)
+    opt_flat = _flat_arrays(state.opt_state)
+
+    def dims_of(flat):
+        return {k: _sharded_dim(rules.master_spec(v.shape))
+                for k, v in flat.items()}
+
+    master_dims = dims_of(master_flat) if master_flat is not None else None
+    opt_dims = dims_of(opt_flat)
+
+    for dp_rank in range(dp):
+        def slice_flat(flat, dims):
+            out = {}
+            for key, arr in flat.items():
+                dim = dims[key]
+                if dim is None or dp == 1:
+                    out[key] = arr  # replicated leaf: duplicated per rank
+                else:
+                    out[key] = shard_slice(arr, dp, dp_rank, dim)
+            return out
+
+        shard = {
+            "optimizer_state_dict": {
+                "state": slice_flat(opt_flat, opt_dims),
+                "shard_dims": opt_dims,
+                "param_groups": [dict(g) for g in
+                                 engine.optimizer.param_groups],
+            },
+            "fp32_master": (slice_flat(master_flat, master_dims)
+                            if master_flat is not None else None),
+            "fp32_master_dims": master_dims,
+            "zero_stage": rules.stage,
+            "partition_count": dp,
+            "dp_rank": dp_rank,
+        }
+        save_obj(shard, os.path.join(ckpt_dir, _zero_ckpt_name(dp_rank, 0)))
+
+
+def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
+                    load_lr_scheduler_states=True):
+    if tag is None:
+        latest_path = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.isfile(latest_path):
+            logger.warning(f"No 'latest' file at {latest_path}; "
+                           "cannot resume")
+            return None, {}
+        with open(latest_path) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    model_path = os.path.join(ckpt_dir, _model_states_name(0))
+    if not os.path.isfile(model_path):
+        logger.warning(f"Checkpoint file {model_path} not found")
+        return None, {}
+
+    model_state = load_obj(model_path)
+
+    # --- params -----------------------------------------------------------
+    params_np = state_dict_to_tree(model_state["module"],
+                                   like=engine.state.params)
+    rules = engine.zero_rules
+    params = rules.place(
+        jax.tree_util.tree_map(
+            lambda p, cur: jnp.asarray(p, cur.dtype),
+            params_np, engine.state.params),
+        rules.param_spec)
+
+    master = engine.state.master
+    opt_state = engine.state.opt_state
+
+    # --- optimizer --------------------------------------------------------
+    if load_optimizer_states:
+        if engine.zero_optimization() or engine.keep_master:
+            master, opt_state = _load_zero_checkpoint(engine, ckpt_dir)
+        elif model_state.get("optimizer"):
+            opt_np = state_dict_to_tree(model_state["optimizer"]["state"],
+                                        like=engine.state.opt_state)
+            opt_state = jax.tree_util.tree_map(
+                lambda n, cur: jax.device_put(
+                    jnp.asarray(n, cur.dtype), cur.sharding),
+                opt_np, engine.state.opt_state)
+            engine.optimizer.param_groups = [
+                dict(g) for g in model_state["optimizer"]["param_groups"]]
+
+    # --- schedulers / counters -------------------------------------------
+    if load_lr_scheduler_states and engine.lr_scheduler is not None and \
+            model_state.get("lr_scheduler") is not None:
+        engine.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
+    if engine.batch_size_scheduler is not None and \
+            model_state.get("batch_size_scheduler") is not None:
+        engine.batch_size_scheduler.load_state_dict(
+            model_state["batch_size_scheduler"])
+
+    engine.global_steps = model_state.get("global_steps", 0)
+    engine.global_samples = model_state.get("global_samples", 0)
+    engine.skipped_steps = model_state.get("skipped_steps", 0)
+    engine.micro_steps = model_state.get("micro_steps", 0)
+
+    ls = model_state.get("loss_scale_state", {})
+    scale_state = LossScaleState(
+        cur_scale=jnp.asarray(ls.get("cur_scale", 1.0), jnp.float32),
+        cur_iter=jnp.asarray(ls.get("cur_iter", 0), jnp.int32),
+        last_overflow_iter=jnp.asarray(ls.get("last_overflow_iter", -1),
+                                       jnp.int32),
+        cur_hysteresis=jnp.asarray(ls.get("cur_hysteresis", 1), jnp.int32))
+
+    engine.state = engine.state._replace(
+        params=params, master=master, opt_state=opt_state,
+        scale=scale_state,
+        global_steps=jnp.asarray(engine.global_steps, jnp.int32),
+        skipped_steps=jnp.asarray(engine.skipped_steps, jnp.int32))
+
+    client_state = {k: v for k, v in model_state.items()
+                    if k not in ("module", "optimizer", "lr_scheduler",
+                                 "batch_size_scheduler")}
+    log_dist(f"Loaded checkpoint {tag} from {load_dir}", ranks=[0])
+    return os.path.join(load_dir, str(tag)), client_state
+
+
+def _load_zero_checkpoint(engine, ckpt_dir):
+    """Merge per-dp-rank zero shards (possibly from a different world size)
+    and re-place with current shardings — elastic resume."""
+    rules = engine.zero_rules
+    shards = []
+    dp_rank = 0
+    while True:
+        path = os.path.join(ckpt_dir, _zero_ckpt_name(dp_rank, 0))
+        if not os.path.isfile(path):
+            break
+        shards.append(load_obj(path))
+        dp_rank += 1
+    if not shards:
+        logger.warning(f"No zero checkpoint files in {ckpt_dir}")
+        return engine.state.master, engine.state.opt_state
+
+    saved_dp = shards[0]["partition_count"]
+
+    def merge_flat(flats, dims):
+        """Merge per-rank {path: slice} dicts back to full arrays."""
+        out = {}
+        for key in flats[0]:
+            dim = dims.get(key) if dims else None
+            if dim is None or saved_dp == 1:
+                out[key] = flats[0][key]
+            else:
+                out[key] = unshard_concat([f[key] for f in flats], dim)
+        return out
+
+    opt_flats = [s["optimizer_state_dict"]["state"] for s in shards]
+    opt_dims = shards[0]["optimizer_state_dict"].get("shard_dims", {})
+    opt_full = merge_flat(opt_flats, opt_dims)
+
+    master_full = None
+    if shards[0].get("fp32_master") is not None:
+        master_flats = [s["fp32_master"] for s in shards]
+        master_full = merge_flat(master_flats,
+                                 shards[0].get("fp32_master_dims", {}))
+
+    master = engine.state.master
+    if master is not None and master_full is not None:
+        master_np = state_dict_to_tree({"arrays": master_full},
+                                       like=engine.state.master)
+        master = rules.place(
+            jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32),
+                                   master_np), rules.master_spec)
+    opt_state = engine.state.opt_state
+    if opt_full:
+        opt_np = state_dict_to_tree({"arrays": opt_full},
+                                    like=engine.state.opt_state)
+        opt_state = jax.tree_util.tree_map(
+            lambda n, cur: jax.device_put(jnp.asarray(n, cur.dtype),
+                                          cur.sharding),
+            opt_np, engine.state.opt_state)
+        engine.optimizer.param_groups = [
+            dict(g) for g in shards[0]["optimizer_state_dict"]
+            ["param_groups"]]
+    return master, opt_state

@@ -1,0 +1,93 @@
+"""Checkpoint serialization: pytree ↔ portable state dicts.
+
+Files use torch's container format (torch is host-side only here) so the
+on-disk layout matches the reference ecosystem's expectations
+(`*_model_states.pt`, `*_optim_states.pt` — reference `engine.py:1764-1818`);
+tensors are stored as numpy arrays inside. Falls back to pickle if torch is
+unavailable.
+"""
+
+import pickle
+
+import numpy as np
+
+import jax
+
+try:
+    import torch
+    _HAVE_TORCH = True
+except Exception:  # pragma: no cover
+    _HAVE_TORCH = False
+
+
+def save_obj(obj, path):
+    if _HAVE_TORCH:
+        torch.save(obj, path)
+    else:  # pragma: no cover
+        with open(path, "wb") as f:
+            pickle.dump(obj, f)
+
+
+def load_obj(path):
+    if _HAVE_TORCH:
+        return torch.load(path, map_location="cpu", weights_only=False)
+    with open(path, "rb") as f:  # pragma: no cover
+        return pickle.load(f)
+
+
+def _path_key(path):
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def tree_to_state_dict(tree):
+    """Flatten a pytree to {path: numpy array} + treedef pickle for exact
+    structure restoration."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    arrays = {_path_key(path): np.asarray(jax.device_get(leaf))
+              for path, leaf in flat}
+    return {"arrays": arrays, "treedef": pickle.dumps(treedef)}
+
+
+def state_dict_to_tree(sd, like=None):
+    """Rebuild the pytree. If `like` is given, values are matched to its
+    structure by path (robust to treedef pickle incompatibilities)."""
+    if like is not None:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for path, leaf in flat:
+            key = _path_key(path)
+            if key not in sd["arrays"]:
+                raise KeyError(f"checkpoint missing parameter {key!r}")
+            leaves.append(sd["arrays"][key])
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    treedef = pickle.loads(sd["treedef"])
+    # tree_flatten_with_path ordering == tree_flatten ordering.
+    keys = list(sd["arrays"].keys())
+    return jax.tree_util.tree_unflatten(treedef,
+                                        [sd["arrays"][k] for k in keys])
+
+
+def shard_slice(array, num_parts, rank, dim):
+    """GSPMD-convention shard: ceil-chunk along `dim` (last shard may be
+    short)."""
+    n = array.shape[dim]
+    chunk = -(-n // num_parts)
+    start = min(rank * chunk, n)
+    stop = min(start + chunk, n)
+    index = [slice(None)] * array.ndim
+    index[dim] = slice(start, stop)
+    return array[tuple(index)]
+
+
+def unshard_concat(shards, dim):
+    return np.concatenate(shards, axis=dim)

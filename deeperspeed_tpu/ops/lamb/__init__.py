@@ -1,0 +1,1 @@
+from .fused_lamb import FusedLamb

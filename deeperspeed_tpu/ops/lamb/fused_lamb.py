@@ -1,0 +1,124 @@
+"""LAMB optimizer (reference: `deepspeed/ops/lamb/fused_lamb.py:12`,
+`csrc/lamb/fused_lamb_cuda_kernel.cu`).
+
+LAMB = Adam with a per-tensor "trust ratio" ||p|| / ||update|| scaling the
+step (You et al. 2019). The reference computes the two norms in-kernel; XLA
+fuses the reductions here. Norm clamps (`max_coeff`/`min_coeff`) match the
+reference wrapper's options.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object
+    exp_avg_sq: object
+
+
+class FusedLamb:
+    def __init__(self, params=None, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, max_coeff=10.0,
+                 min_coeff=0.01, amsgrad=False):
+        if amsgrad:
+            raise ValueError("FusedLamb does not support amsgrad")
+        self.param_groups = [{
+            "lr": lr,
+            "betas": tuple(betas),
+            "eps": eps,
+            "weight_decay": weight_decay,
+            "bias_correction": bias_correction,
+            "max_coeff": max_coeff,
+            "min_coeff": min_coeff,
+        }]
+        self.eps_inside_sqrt = eps_inside_sqrt
+        self.defaults = dict(self.param_groups[0])
+        # Populated per-step for parity with the wrapper's introspection
+        # hooks (1-bit LAMB reads these).
+        self.lamb_coeffs = []
+
+    def init_state(self, master_params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
+        return LambState(
+            step=jnp.asarray(0, jnp.int32),
+            exp_avg=jax.tree_util.tree_map(zeros, master_params),
+            exp_avg_sq=jax.tree_util.tree_map(zeros, master_params),
+        )
+
+    def update(self, grads, state, master_params, lr=None):
+        group = self.param_groups[0]
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        weight_decay = group["weight_decay"]
+        max_coeff = group["max_coeff"]
+        min_coeff = group["min_coeff"]
+        lr = group["lr"] if lr is None else lr
+        step = state.step + 1
+
+        if group["bias_correction"]:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        lamb_coeffs = []
+
+        def leaf_update(p, g, m, v):
+            g = g.astype(jnp.float32)
+            p = p.astype(jnp.float32)
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+            if self.eps_inside_sqrt:
+                denom = jnp.sqrt(v_new / bc2 + eps)
+            else:
+                denom = jnp.sqrt(v_new / bc2) + eps
+            update = (m_new / bc1) / denom
+            if weight_decay != 0.0:
+                update = update + weight_decay * p
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(update.reshape(-1))
+            trust = jnp.where(
+                (p_norm > 0) & (u_norm > 0),
+                jnp.clip(p_norm / u_norm, min_coeff, max_coeff), 1.0)
+            lamb_coeffs.append(trust)
+            return p - lr * trust * update, m_new, v_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.exp_avg)
+        flat_v = treedef.flatten_up_to(state.exp_avg_sq)
+
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            pn, mn, vn = leaf_update(p, g, m, v)
+            new_p.append(pn)
+            new_m.append(mn)
+            new_v.append(vn)
+        self.lamb_coeffs = lamb_coeffs
+
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                LambState(step=step,
+                          exp_avg=jax.tree_util.tree_unflatten(treedef, new_m),
+                          exp_avg_sq=jax.tree_util.tree_unflatten(
+                              treedef, new_v)))
+
+    def get_lamb_coeffs(self):
+        return self.lamb_coeffs
+
+    def state_dict(self, state):
+        return {
+            "step": int(state.step),
+            "exp_avg": state.exp_avg,
+            "exp_avg_sq": state.exp_avg_sq,
+            "param_groups": [dict(g) for g in self.param_groups],
+        }
+
+    def load_state_dict(self, sd):
+        self.param_groups = [dict(g) for g in sd["param_groups"]]
+        return LambState(step=jnp.asarray(sd["step"], jnp.int32),
+                         exp_avg=sd["exp_avg"],
+                         exp_avg_sq=sd["exp_avg_sq"])

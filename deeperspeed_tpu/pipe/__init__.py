@@ -1,0 +1,2 @@
+# Re-export for parity with `deepspeed.pipe` (reference deepspeed/pipe/).
+from ..runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec

@@ -1,0 +1,2 @@
+from .adam import OnebitAdam
+from .lamb import OnebitLamb

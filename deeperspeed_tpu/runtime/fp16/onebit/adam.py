@@ -1,0 +1,93 @@
+"""1-bit Adam (reference: `deepspeed/runtime/fp16/onebit/adam.py:14`).
+
+Error-compensated momentum-compressed Adam: full-precision Adam during the
+`freeze_step` warmup, then variance is frozen and the *momentum delta* is
+communicated as sign+scale with an error-feedback buffer.
+
+On TPU the compression arithmetic (sign, scale, error feedback) is
+implemented with dense collectives over the `data` mesh axis — ICI
+bandwidth makes packed-bit transport unnecessary for correctness parity,
+and the compression *semantics* (what lands in the momentum) match the
+reference, so convergence behavior is preserved. See
+`deeperspeed_tpu.runtime.comm` for the sign-compressed reducer.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.adam.fused_adam import FusedAdam
+from ...comm.compressed import compressed_allreduce_dense
+
+
+class OnebitAdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object
+    exp_avg_sq: object
+    worker_error: object   # error-feedback residual per leaf
+
+
+class OnebitAdam(FusedAdam):
+    """FusedAdam + sign-compressed momentum sync after `freeze_step`."""
+
+    def __init__(self, params=None, deepspeed=None, lr=1e-3,
+                 freeze_step=100000, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, amsgrad=False,
+                 cuda_aware=False, **kwargs):
+        super().__init__(params, lr=lr, bias_correction=bias_correction,
+                         betas=betas, eps=eps, weight_decay=weight_decay,
+                         adam_w_mode=False)
+        self.freeze_step = freeze_step
+        self.deepspeed = deepspeed
+        self.adam_freeze_key = False
+        self.initialize = False
+        self.comm_backend_name = "xla"
+
+    def init_state(self, master_params):
+        base = super().init_state(master_params)
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+        return OnebitAdamState(step=base.step, exp_avg=base.exp_avg,
+                               exp_avg_sq=base.exp_avg_sq,
+                               worker_error=zeros)
+
+    def update(self, grads, state, master_params, lr=None, axis_name=None):
+        group = self.param_groups[0]
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        weight_decay = group["weight_decay"]
+        lr = group["lr"] if lr is None else lr
+        step = state.step + 1
+        in_warmup = step <= self.freeze_step
+
+        def leaf(p, g, m, v, err):
+            g = g.astype(jnp.float32)
+            p = p.astype(jnp.float32)
+            if weight_decay != 0.0:
+                g = g + weight_decay * p
+            m_new = beta1 * m + (1 - beta1) * g
+            # Variance frozen after warmup (reference adam.py freeze logic).
+            v_new = jnp.where(in_warmup,
+                              beta2 * v + (1 - beta2) * jnp.square(g), v)
+            if axis_name is not None:
+                m_comp, err_new = compressed_allreduce_dense(
+                    m_new, err, axis_name)
+                m_new = jnp.where(in_warmup, m_new, m_comp)
+                err = jnp.where(in_warmup, err, err_new)
+            update = m_new / (jnp.sqrt(v_new) + eps)
+            return p - lr * update, m_new, v_new, err
+
+        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.exp_avg)
+        flat_v = treedef.flatten_up_to(state.exp_avg_sq)
+        flat_e = treedef.flatten_up_to(state.worker_error)
+
+        outs = [leaf(p, g, m, v, e) for p, g, m, v, e in
+                zip(flat_p, flat_g, flat_m, flat_v, flat_e)]
+        unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
+            treedef, [o[i] for o in outs])
+        return unf(0), OnebitAdamState(step=step, exp_avg=unf(1),
+                                       exp_avg_sq=unf(2), worker_error=unf(3))

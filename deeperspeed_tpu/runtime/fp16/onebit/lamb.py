@@ -1,0 +1,104 @@
+"""1-bit LAMB (reference: `deepspeed/runtime/fp16/onebit/lamb.py:11`).
+
+LAMB with compressed momentum sync after `freeze_step`; trust ratios are
+computed from frozen scaling coefficients during the compressed phase,
+mirroring the reference's two-stage design.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.lamb.fused_lamb import FusedLamb
+from ...comm.compressed import compressed_allreduce_dense
+
+
+class OnebitLambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object
+    exp_avg_sq: object
+    worker_error: object
+    frozen_scale: object   # per-leaf trust scaling frozen at freeze_step
+
+
+class OnebitLamb(FusedLamb):
+    def __init__(self, params=None, deepspeed=None, lr=1e-3,
+                 freeze_step=100000, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, max_coeff=10.0,
+                 min_coeff=0.01, amsgrad=False, cuda_aware=False,
+                 coeff_beta=0.9, factor_max=4.0, factor_min=0.5,
+                 factor_threshold=0.1, **kwargs):
+        super().__init__(params, lr=lr, bias_correction=bias_correction,
+                         betas=betas, eps=eps, weight_decay=weight_decay,
+                         max_coeff=max_coeff, min_coeff=min_coeff)
+        self.freeze_step = freeze_step
+        self.deepspeed = deepspeed
+        self.coeff_beta = coeff_beta
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.factor_threshold = factor_threshold
+
+    def init_state(self, master_params):
+        base = super().init_state(master_params)
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+        ones = jax.tree_util.tree_map(
+            lambda p: jnp.ones((), jnp.float32), master_params)
+        return OnebitLambState(step=base.step, exp_avg=base.exp_avg,
+                               exp_avg_sq=base.exp_avg_sq,
+                               worker_error=zeros, frozen_scale=ones)
+
+    def update(self, grads, state, master_params, lr=None, axis_name=None):
+        group = self.param_groups[0]
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        weight_decay = group["weight_decay"]
+        max_coeff = group["max_coeff"]
+        min_coeff = group["min_coeff"]
+        lr = group["lr"] if lr is None else lr
+        step = state.step + 1
+        in_warmup = step <= self.freeze_step
+
+        def leaf(p, g, m, v, err, fs):
+            g = g.astype(jnp.float32)
+            p = p.astype(jnp.float32)
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = jnp.where(in_warmup,
+                              beta2 * v + (1 - beta2) * jnp.square(g), v)
+            if axis_name is not None:
+                m_comp, err_new = compressed_allreduce_dense(m_new, err,
+                                                             axis_name)
+                m_new = jnp.where(in_warmup, m_new, m_comp)
+                err = jnp.where(in_warmup, err, err_new)
+            update = m_new / (jnp.sqrt(v_new) + eps)
+            if weight_decay != 0.0:
+                update = update + weight_decay * p
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(update.reshape(-1))
+            trust = jnp.where((p_norm > 0) & (u_norm > 0),
+                              jnp.clip(p_norm / u_norm, min_coeff, max_coeff),
+                              1.0)
+            # Freeze trust scaling at the compression boundary; afterwards
+            # clamp drift within factor bounds (reference lamb.py scaling).
+            fs_new = jnp.where(in_warmup,
+                               self.coeff_beta * fs +
+                               (1 - self.coeff_beta) * trust, fs)
+            trust = jnp.where(
+                in_warmup, trust,
+                jnp.clip(trust, fs_new * self.factor_min,
+                         fs_new * self.factor_max))
+            return p - lr * trust * update, m_new, v_new, err, fs_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
+        flat = [treedef.flatten_up_to(t) for t in
+                (grads, state.exp_avg, state.exp_avg_sq, state.worker_error,
+                 state.frozen_scale)]
+        outs = [leaf(p, g, m, v, e, f) for p, g, m, v, e, f in
+                zip(flat_p, *flat)]
+        unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
+            treedef, [o[i] for o in outs])
+        return unf(0), OnebitLambState(step=step, exp_avg=unf(1),
+                                       exp_avg_sq=unf(2), worker_error=unf(3),
+                                       frozen_scale=unf(4))

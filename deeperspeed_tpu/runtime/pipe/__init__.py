@@ -1,0 +1,3 @@
+from .module import LayerSpec, PipelineModule, TiedLayerSpec
+from .schedule import (DataParallelSchedule, InferenceSchedule,
+                       PipeSchedule, TrainSchedule)

@@ -1,0 +1,310 @@
+"""Pipeline model description (reference:
+`deepspeed/runtime/pipe/module.py`).
+
+`PipelineModule` describes a model as a list of layers (via `LayerSpec` /
+`TiedLayerSpec`), partitions them into stages, and provides the pure
+forward function the engines compile.
+
+Layer protocol (flax.linen modules satisfy it directly):
+
+- ``layer.init(rng, x) -> params``
+- ``layer.apply(params, x, **kw) -> y``  (or the layer itself is a callable
+  taking ``(params, x)``)
+
+Plain callables (no params) are wrapped as `FnLayer`. Tied layers share one
+parameter subtree keyed by the tie name; because the whole pipeline forward
+is differentiated as one function, gradient contributions from every
+occurrence sum automatically — the reference's
+`allreduce_tied_weight_gradients` (`module.py:415`) exists only because
+torch autograd runs per-stage, and is a no-op here.
+"""
+
+import re
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+from ..utils import partition_balanced, partition_uniform
+
+
+class LayerSpec:
+    """Delayed layer construction (reference `module.py:23`): stores the
+    class + args so stages can build only what they own (on TPU we build
+    all layer objects — they are tiny descriptors; only *params* are big,
+    and those are sharded)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec only supports classes")
+
+    def __repr__(self):
+        from ..utils import call_to_str
+        return call_to_str(self.typename.__name__, *self.module_args,
+                           **self.module_kwargs)
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose parameters are shared with every other TiedLayerSpec
+    of the same `key` (reference `module.py:72`; e.g. input/output
+    embeddings)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class FnLayer:
+    """Adapter for parameterless callables used as layers."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def init(self, rng, x):
+        return {}
+
+    def apply(self, params, x, **kwargs):
+        return self.fn(x)
+
+
+def _as_layer(obj):
+    if isinstance(obj, LayerSpec):
+        return obj.build()
+    if hasattr(obj, "init") and (hasattr(obj, "apply") or callable(obj)):
+        return obj
+    if callable(obj):
+        return FnLayer(obj)
+    raise TypeError(f"cannot interpret {obj!r} as a pipeline layer")
+
+
+def _apply_layer(layer, params, x, rng=None):
+    kwargs = {}
+    apply_fn = getattr(layer, "apply", None)
+    if apply_fn is None:
+        return layer(params, x)
+    try:
+        return apply_fn(params, x, rng=rng)
+    except TypeError:
+        return apply_fn(params, x)
+
+
+class PipelineModule:
+    """Layer-list model partitioned into pipeline stages.
+
+    Args:
+        layers: iterable of LayerSpec / layer objects / callables.
+        num_stages: pipeline depth (or derive from topology).
+        topology: optional ProcessTopology with a 'pipe' axis.
+        loss_fn: ``loss_fn(outputs, labels) -> scalar``.
+        partition_method: 'parameters' | 'uniform' | 'type:regex'.
+        activation_checkpoint_interval: remat every N layers
+            (`jax.checkpoint`, the reference's Megatron-derived
+            checkpointing with RNG tracking comes free from JAX's purity).
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seed_layers=False, seed_fn=None,
+                 base_seed=1234, partition_method="parameters",
+                 activation_checkpoint_interval=0,
+                 activation_checkpoint_func=None,
+                 checkpointable_layers=None):
+        if num_stages is None and topology is None:
+            raise RuntimeError("must provide num_stages or topology")
+        self._topo = topology
+        if num_stages is None:
+            num_stages = topology.get_dim("pipe") or 1
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.seed_fn = seed_fn
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.checkpointable_layers = checkpointable_layers
+
+        self._layer_specs = list(layers)
+        self.forward_funcs = []
+        self.tied_modules: Dict[str, Any] = {}
+        self._tied_keys_per_layer = []
+        self._build_layers()
+        self.parts = None  # stage boundaries, filled by _partition_layers
+
+        self._partition_layers()
+
+    # -- construction ------------------------------------------------------
+
+    def _build_layers(self):
+        self.layers = []
+        for spec in self._layer_specs:
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in self.tied_modules:
+                    self.tied_modules[spec.key] = spec.build()
+                self.layers.append(self.tied_modules[spec.key])
+                self._tied_keys_per_layer.append(spec.key)
+            else:
+                self.layers.append(_as_layer(spec))
+                self._tied_keys_per_layer.append(None)
+
+    def _count_layer_params(self, params):
+        counts = []
+        for lp in params["layers"]:
+            counts.append(sum(int(np.prod(l.shape))
+                              for l in jax.tree_util.tree_leaves(lp)))
+        return counts
+
+    def _partition_layers(self, params=None):
+        """Assign layers to stages (reference `module.py:358`)."""
+        num_layers = len(self.layers)
+        method = self.partition_method.lower()
+        if method == "uniform":
+            self.parts = partition_uniform(num_items=num_layers,
+                                           num_parts=self.num_stages)
+        elif method == "parameters":
+            if params is None:
+                # Until params exist, fall back to uniform; re-partitioned
+                # at init_params time with real counts.
+                self.parts = partition_uniform(num_items=num_layers,
+                                               num_parts=self.num_stages)
+            else:
+                weights = self._count_layer_params(params)
+                self.parts = partition_balanced(weights=weights,
+                                                num_parts=self.num_stages)
+        elif method.startswith("type:"):
+            layer_type = method.split(":", 1)[1]
+            binary_weights = [0] * num_layers
+            for idx, layer in enumerate(self.layers):
+                if regex_matches_layer(layer, layer_type):
+                    binary_weights[idx] = 1
+            self.parts = partition_balanced(weights=binary_weights,
+                                            num_parts=self.num_stages)
+        elif method == "profile":
+            raise NotImplementedError(
+                "profile-based partitioning is not implemented")
+        else:
+            raise NotImplementedError(
+                f"Partitioning method {method} not implemented")
+
+    def stage_of_layer(self, layer_idx):
+        for stage in range(self.num_stages):
+            if self.parts[stage] <= layer_idx < self.parts[stage + 1]:
+                return stage
+        raise IndexError(layer_idx)
+
+    def stage_layers(self, stage_id):
+        return list(range(self.parts[stage_id], self.parts[stage_id + 1]))
+
+    def topology(self):
+        return self._topo
+
+    def mpu(self):
+        return self._topo
+
+    def num_pipeline_stages(self):
+        return self.num_stages
+
+    # -- parameters --------------------------------------------------------
+
+    def init_params(self, rng, example_input=None):
+        """Initialize every layer's params by shape propagation. Tied layers
+        get one shared subtree under params['tied'][key]."""
+        if example_input is None:
+            raise ValueError("init_params requires an example_input")
+        x = jnp.asarray(example_input)
+        layer_params = []
+        tied_params = {}
+        for idx, layer in enumerate(self.layers):
+            lrng = jax.random.fold_in(rng, idx) if not self.seed_layers \
+                else jax.random.PRNGKey(self.base_seed + idx)
+            tied_key = self._tied_keys_per_layer[idx]
+            if tied_key is not None and tied_key in tied_params:
+                params = tied_params[tied_key]
+                layer_params.append({})
+            else:
+                params = layer.init(lrng, x)
+                if tied_key is not None:
+                    tied_params[tied_key] = params
+                    layer_params.append({})
+                else:
+                    layer_params.append(params)
+            x = jax.eval_shape(
+                lambda p, xx, layer=layer: _apply_layer(layer, p, xx),
+                params, x)
+            x = jnp.zeros(x.shape, x.dtype) if hasattr(x, "shape") else x
+        params = {"layers": layer_params, "tied": tied_params}
+        if self.partition_method.lower() == "parameters":
+            self._partition_layers(params)
+        return params
+
+    def _layer_param(self, params, idx):
+        tied_key = self._tied_keys_per_layer[idx]
+        if tied_key is not None:
+            return params["tied"][tied_key]
+        return params["layers"][idx]
+
+    # -- forward -----------------------------------------------------------
+
+    def forward_range(self, params, x, start, stop, rng=None):
+        """Run layers [start, stop) — one stage's compute (reference
+        `exec_range_func`, `module.py:302`)."""
+        interval = self.activation_checkpoint_interval
+
+        def run_span(x, lo, hi):
+            for idx in range(lo, hi):
+                layer = self.layers[idx]
+                lrng = jax.random.fold_in(rng, idx) if rng is not None \
+                    else None
+                x = _apply_layer(layer, self._layer_param(params, idx), x,
+                                 rng=lrng)
+            return x
+
+        if interval and interval > 0:
+            lo = start
+            while lo < stop:
+                hi = min(lo + interval, stop)
+                x = jax.checkpoint(
+                    lambda xx, lo=lo, hi=hi: run_span(xx, lo, hi))(x)
+                lo = hi
+            return x
+        return run_span(x, start, stop)
+
+    def forward(self, params, x, rng=None):
+        return self.forward_range(params, x, 0, len(self.layers), rng=rng)
+
+    def loss(self, params, batch, rng=None):
+        """Full-model loss: forward + loss_fn (non-pipelined path and the
+        reference loss for pipeline parity tests)."""
+        inputs, labels = batch
+        outputs = self.forward(params, inputs, rng=rng)
+        if self.loss_fn is not None:
+            return self.loss_fn(outputs, labels)
+        return outputs
+
+    loss_fn_named = loss
+
+    def allreduce_tied_weight_gradients(self):
+        """No-op: tied-weight grads sum inside jax.grad (see module
+        docstring)."""
+
+    def num_layers(self):
+        return len(self.layers)
+
+
+def regex_matches_layer(layer, pattern):
+    name = type(layer).__name__
+    if hasattr(layer, "fn"):
+        name = getattr(layer.fn, "__name__", name)
+    return re.search(pattern, name, re.IGNORECASE) is not None

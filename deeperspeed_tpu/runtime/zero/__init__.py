@@ -1,0 +1,5 @@
+from .config import DeepSpeedZeroConfig
+from .partition_parameters import (GatheredParameters, Init,
+                                   ZeroShardingRules,
+                                   register_external_parameter,
+                                   unregister_external_parameter)

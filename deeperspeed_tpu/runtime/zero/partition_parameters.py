@@ -1,0 +1,219 @@
+"""ZeRO partitioning as GSPMD sharding specs.
+
+The reference implements ZeRO with hand-rolled machinery: construction-time
+parameter scattering via monkeypatched `nn.Module.__init__`
+(`zero/partition_parameters.py:265`), backward-hook gradient bucketing
+(`zero/stage2.py:563`), and per-submodule gather/release hooks
+(`zero/stage3.py:390-531`). On TPU all of that collapses into *where each
+array lives on the mesh*:
+
+- stage >= 1: fp32 master params + optimizer moments sharded over ``data``.
+- stage >= 2: gradients constrained to the same sharding — XLA lowers the
+  batch-mean + constraint into a reduce-scatter instead of an all-reduce.
+- stage == 3: the compute (bf16/fp16) params are *also* sharded at rest;
+  XLA all-gathers each layer's weights just before use and frees them
+  after, which is exactly fetch_sub_module/release_sub_module
+  (`stage3.py:390/448`) done by the compiler.
+
+`param_persistence_threshold` maps directly: params smaller than the
+threshold stay replicated (the reference keeps them persisted to avoid
+latency-bound gathers — same trade-off).
+"""
+
+import contextlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...parallel.mesh import DATA_AXIS
+from ..config_utils import DeepSpeedConfigError
+
+
+def _shardable_dim(shape, world, threshold_numel=0):
+    """Pick the dimension to shard over the data axis: the largest dim that
+    divides evenly by `world`, else the largest dim; None for scalars or
+    params under the persistence threshold."""
+    numel = int(np.prod(shape)) if shape else 1
+    if not shape or numel < max(threshold_numel, world):
+        return None
+    divisible = [d for d in range(len(shape)) if shape[d] % world == 0]
+    if divisible:
+        return max(divisible, key=lambda d: shape[d])
+    # GSPMD pads uneven shards; still profitable for large params.
+    return int(np.argmax(shape))
+
+
+class ZeroShardingRules:
+    """Derives PartitionSpecs for params/grads/optimizer state per stage."""
+
+    def __init__(self, stage, mesh, param_persistence_threshold=100_000,
+                 data_axis=DATA_AXIS):
+        if not 0 <= stage <= 3:
+            raise DeepSpeedConfigError(f"invalid ZeRO stage {stage}")
+        self.stage = stage
+        self.mesh = mesh
+        self.data_axis = data_axis if data_axis in mesh.axis_names else None
+        self.param_persistence_threshold = param_persistence_threshold
+
+    @property
+    def dp_world(self):
+        if self.data_axis is None:
+            return 1
+        return self.mesh.shape[self.data_axis]
+
+    def _spec(self, shape, threshold=0):
+        if self.data_axis is None or self.dp_world == 1:
+            return PartitionSpec()
+        dim = _shardable_dim(shape, self.dp_world, threshold)
+        if dim is None:
+            return PartitionSpec()
+        spec = [None] * len(shape)
+        spec[dim] = self.data_axis
+        return PartitionSpec(*spec)
+
+    # -- per-array spec selection -----------------------------------------
+
+    def param_spec(self, shape):
+        """Compute-dtype params: sharded at rest only at stage 3."""
+        if self.stage >= 3:
+            return self._spec(shape, self.param_persistence_threshold)
+        return PartitionSpec()
+
+    def master_spec(self, shape):
+        """fp32 master params + optimizer moments: sharded from stage 1."""
+        if self.stage >= 1:
+            return self._spec(shape)
+        return PartitionSpec()
+
+    def grad_spec(self, shape):
+        """Gradients: reduce-scattered from stage 2."""
+        if self.stage >= 2:
+            return self._spec(shape)
+        return PartitionSpec()
+
+    # -- pytree helpers ----------------------------------------------------
+
+    def _tree_shardings(self, params, spec_fn):
+        return jax.tree_util.tree_map(
+            lambda p: NamedSharding(self.mesh, spec_fn(p.shape)), params)
+
+    def param_shardings(self, params):
+        return self._tree_shardings(params, self.param_spec)
+
+    def master_shardings(self, params):
+        return self._tree_shardings(params, self.master_spec)
+
+    def grad_shardings(self, params):
+        return self._tree_shardings(params, self.grad_spec)
+
+    def constrain_grads(self, grads):
+        """Apply grad sharding constraints inside a jitted step (this is
+        what turns the DP all-reduce into ZeRO-2's reduce-scatter)."""
+        if self.stage < 2 or self.data_axis is None:
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh, self.grad_spec(g.shape))), grads)
+
+    def place(self, params, spec_fn=None):
+        """device_put a pytree with per-leaf ZeRO shardings."""
+        spec_fn = spec_fn or self.param_spec
+        return jax.tree_util.tree_map(
+            lambda p: jax.device_put(
+                p, NamedSharding(self.mesh, spec_fn(p.shape))), params)
+
+
+# ---------------------------------------------------------------------------
+# zero.Init / GatheredParameters API compat
+# ---------------------------------------------------------------------------
+
+_CURRENT_INIT = None
+
+
+class Init:
+    """Context manager for constructing huge models directly sharded
+    (reference `zero/partition_parameters.py:265`).
+
+    The reference monkeypatches tensor construction so each parameter is
+    scattered the moment it is created. The JAX-native equivalent: run the
+    initializer under `jax.jit` with sharded `out_shardings`, so every
+    device materializes only its shard and the full model never exists in
+    one HBM. Usage:
+
+        with zero.Init(mesh=mesh, config=ds_config):
+            params = zero.Init.materialize(init_fn, rng)
+    """
+
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear=True, remote_device=None,
+                 pin_memory=False, config=None, enabled=True, mesh=None,
+                 stage=3, param_persistence_threshold=100_000):
+        if mesh is None:
+            mesh = Mesh(np.asarray(jax.devices()), (DATA_AXIS,))
+        if config is not None and hasattr(config, "zero_config"):
+            stage = config.zero_config.stage
+            param_persistence_threshold = \
+                config.zero_config.param_persistence_threshold
+        self.enabled = enabled
+        self.rules = ZeroShardingRules(
+            stage=stage if enabled else 0, mesh=mesh,
+            param_persistence_threshold=param_persistence_threshold)
+
+    def __enter__(self):
+        global _CURRENT_INIT
+        self._prev = _CURRENT_INIT
+        _CURRENT_INIT = self
+        return self
+
+    def __exit__(self, *exc):
+        global _CURRENT_INIT
+        _CURRENT_INIT = self._prev
+        return False
+
+    def materialize(self, init_fn, *args):
+        """Run `init_fn(*args) -> params` jitted with sharded outputs."""
+        shapes = jax.eval_shape(init_fn, *args)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.rules.mesh,
+                                    self.rules.param_spec(s.shape)), shapes)
+        return jax.jit(init_fn, out_shardings=shardings)(*args)
+
+
+def current_init_context():
+    return _CURRENT_INIT
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank=None, fwd_module=None,
+                       enabled=True):
+    """Yield fully-replicated host-side views of (possibly sharded) params
+    (reference `partition_parameters.py:1002`). Mutations inside the
+    context are NOT written back automatically (JAX arrays are immutable);
+    use the yielded list's `.result()`-style replacement instead."""
+    if not enabled:
+        yield params
+        return
+    gathered = jax.tree_util.tree_map(lambda p: np.asarray(jax.device_get(p)),
+                                      params)
+    yield gathered
+
+
+# External-parameter registry (reference `partition_parameters.py:56`): in
+# the reference, cross-module parameter access defeats the hook-based
+# gather so users must register such params. With compiler-managed
+# gathering there is nothing to defeat; the registry is a no-op kept for
+# API compatibility.
+_EXTERNAL_PARAMS = {}
+
+
+def register_external_parameter(module, parameter):
+    _EXTERNAL_PARAMS.setdefault(id(module), []).append(parameter)
+
+
+def unregister_external_parameter(module, parameter):
+    params = _EXTERNAL_PARAMS.get(id(module), [])
+    if parameter in params:
+        params.remove(parameter)

@@ -1,0 +1,3 @@
+from .distributed import init_distributed, mpi_discovery
+from .logging import log_dist, logger
+from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,78 @@
+"""Distributed init (reference: `deepspeed/utils/distributed.py:12`).
+
+`torch.distributed.init_process_group` becomes
+`jax.distributed.initialize`: one process per host, all chips addressed
+through the mesh. Rendezvous from env vars (MASTER_ADDR/PORT, RANK,
+WORLD_SIZE — same names the reference launcher exports) or MPI discovery
+via mpi4py when requested.
+"""
+
+import os
+
+import jax
+
+from .logging import logger
+
+_initialized = False
+
+
+def init_distributed(dist_backend="xla", auto_mpi_discovery=True,
+                     distributed_port=29500, verbose=True,
+                     timeout=None, init_method=None):
+    """Join the multi-host world if env/MPI rendezvous info is present;
+    single-host runs are a no-op (all local chips already visible)."""
+    global _initialized
+    if _initialized:
+        return
+
+    required_env = ["RANK", "WORLD_SIZE", "MASTER_ADDR"]
+    if auto_mpi_discovery and \
+            not all(v in os.environ for v in required_env) and \
+            "OMPI_COMM_WORLD_SIZE" in os.environ:
+        mpi_discovery(distributed_port=distributed_port, verbose=verbose)
+
+    world_size = int(os.environ.get("WORLD_SIZE", "1"))
+    if world_size <= 1:
+        _initialized = True
+        return
+
+    rank = int(os.environ.get("RANK", "0"))
+    addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+    port = os.environ.get("MASTER_PORT", str(distributed_port))
+    if verbose:
+        logger.info(
+            f"Initializing jax.distributed: rank={rank}, "
+            f"world_size={world_size}, coordinator={addr}:{port}")
+    jax.distributed.initialize(
+        coordinator_address=f"{addr}:{port}",
+        num_processes=world_size,
+        process_id=rank)
+    _initialized = True
+
+
+def mpi_discovery(distributed_port=29500, verbose=True):
+    """Discover rank/world/master from MPI and export the standard env vars
+    (reference `distributed.py:54`)."""
+    from mpi4py import MPI
+
+    comm = MPI.COMM_WORLD
+    rank = comm.Get_rank()
+    world_size = comm.Get_size()
+
+    import socket
+    master_addr = None
+    if rank == 0:
+        master_addr = socket.gethostbyname(socket.gethostname())
+    master_addr = comm.bcast(master_addr, root=0)
+
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(distributed_port)
+    os.environ["LOCAL_RANK"] = str(
+        comm.Split_type(MPI.COMM_TYPE_SHARED).Get_rank())
+
+    if verbose:
+        logger.info(
+            f"MPI discovery: rank={rank}, world_size={world_size}, "
+            f"master_addr={master_addr}, master_port={distributed_port}")

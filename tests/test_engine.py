@@ -1,0 +1,189 @@
+"""Engine tests: config→engine→step across precisions and ZeRO stages
+(parity with reference `tests/unit/test_fp16.py` / `test_zero.py`
+semantics: each configuration must actually train)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deeperspeed_tpu
+from tests.simple_model import SimpleModel, random_batches
+
+HIDDEN = 16
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def make_engine(config, model=None, seed=0):
+    model = model or SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    engine, optimizer, _, scheduler = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=config)
+    return engine
+
+
+def train_losses(engine, n_steps=10, batch_size=8, seed=0):
+    losses = []
+    gas = engine.gradient_accumulation_steps()
+    batches = random_batches(n_steps * gas, batch_size // 8 * 8 //
+                             max(1, 1), HIDDEN, seed=seed)
+    # train_batch pulls gas micro-batches per call
+    it = iter(batches)
+    for _ in range(n_steps):
+        loss = engine.train_batch(data_iter=it)
+        losses.append(float(loss))
+    return losses
+
+
+def test_fp32_training_decreases_loss():
+    engine = make_engine(base_config())
+    losses = train_losses(engine, n_steps=15)
+    assert losses[-1] < losses[0]
+    assert engine.global_steps == 15
+
+
+def test_bf16_training():
+    engine = make_engine(base_config(
+        fp16={"enabled": True, "type": "bfloat16"}))
+    assert engine.bfloat16_enabled()
+    assert engine.state.params["linear_0"]["w"].dtype == jnp.bfloat16
+    assert engine.state.master is not None
+    losses = train_losses(engine, n_steps=15)
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_training_with_loss_scaling():
+    engine = make_engine(base_config(fp16={"enabled": True}))
+    assert engine.fp16_enabled()
+    assert engine.loss_scale == 2.0 ** 32
+    losses = train_losses(engine, n_steps=20)
+    assert losses[-1] < losses[0]
+    # Dynamic scaler must have backed off from 2**32 (fp16 grads overflow)
+    # or trained cleanly; either way steps were not all skipped.
+    assert engine.global_steps > 0
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_train(stage):
+    engine = make_engine(base_config(
+        zero_optimization={"stage": stage},
+        fp16={"enabled": True, "type": "bfloat16"}))
+    assert engine.zero_optimization_stage() == stage
+    losses = train_losses(engine, n_steps=10)
+    assert losses[-1] < losses[0]
+
+
+def test_zero_stages_match_stage0():
+    """ZeRO is a memory optimization: all stages must produce identical
+    training trajectories (reference test_zero.py correctness semantics)."""
+    results = {}
+    for stage in [0, 1, 2, 3]:
+        engine = make_engine(base_config(
+            zero_optimization={"stage": stage}), seed=3)
+        results[stage] = train_losses(engine, n_steps=8, seed=11)
+    for stage in [1, 2, 3]:
+        np.testing.assert_allclose(results[stage], results[0], rtol=2e-4,
+                                   err_msg=f"stage {stage} diverged")
+
+
+def test_zero_state_is_sharded(devices):
+    engine = make_engine(base_config(
+        zero_optimization={"stage": 3,
+                           "stage3_param_persistence_threshold": 0},
+        fp16={"enabled": True, "type": "bfloat16"}))
+    # Parameters must actually be sharded over the data axis at stage 3.
+    # (With the default persistence threshold these tiny params would stay
+    # replicated — the reference keeps small params persisted too.)
+    w = engine.state.params["linear_0"]["w"]
+    shard_shapes = {s.data.shape for s in w.addressable_shards}
+    assert all(s != w.shape for s in shard_shapes), \
+        "stage-3 params should not be replicated"
+    m = engine.state.master["linear_0"]["w"]
+    assert all(s.data.shape != m.shape for s in m.addressable_shards), \
+        "masters should be sharded from stage 1"
+
+
+def test_forward_backward_step_api():
+    """torch-style engine(batch) → backward → step must work too."""
+    engine = make_engine(base_config(gradient_accumulation_steps=2,
+                                     train_batch_size=16))
+    it = random_batches(8, 8, HIDDEN)
+    first_loss = None
+    for i, batch in enumerate(it):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        if first_loss is None:
+            first_loss = float(loss)
+    assert engine.global_steps == 4  # 8 micro / 2 gas
+    assert engine.micro_steps == 8
+
+
+def test_gradient_accumulation_equivalence():
+    """gas=2 with half micro-batch == gas=1 full batch (same math)."""
+    cfg1 = base_config(train_batch_size=16, gradient_accumulation_steps=1)
+    cfg2 = base_config(train_batch_size=16, gradient_accumulation_steps=2)
+
+    model = SimpleModel(hidden_dim=HIDDEN)
+    e1 = make_engine(cfg1, model=model, seed=5)
+    e2 = make_engine(cfg2, model=model, seed=5)
+
+    rng = np.random.default_rng(42)
+    batch16 = (rng.normal(size=(16, HIDDEN)).astype(np.float32),
+               rng.normal(size=(16, HIDDEN)).astype(np.float32))
+    l1 = e1.train_batch(batch=jax.tree_util.tree_map(
+        lambda x: x[None], batch16))
+    micro = jax.tree_util.tree_map(
+        lambda x: x.reshape(2, 8, HIDDEN), batch16)
+    l2 = e2.train_batch(batch=micro)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(e1.state.params["linear_0"]["w"]),
+        np.asarray(e2.state.params["linear_0"]["w"]), rtol=1e-5)
+
+
+def test_scheduler_from_config():
+    engine = make_engine(base_config(
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                              "warmup_num_steps": 5}}))
+    assert engine.lr_scheduler is not None
+    train_losses(engine, n_steps=6)
+    assert engine.get_lr()[0] == pytest.approx(0.01)
+
+
+def test_lamb_optimizer():
+    engine = make_engine(base_config(
+        optimizer={"type": "Lamb", "params": {"lr": 0.01}}))
+    losses = train_losses(engine, n_steps=10)
+    assert losses[-1] < losses[0]
+
+
+def test_gradient_clipping_applied():
+    engine = make_engine(base_config(gradient_clipping=1e-6))
+    w_before = np.asarray(engine.state.params["linear_0"]["w"])
+    train_losses(engine, n_steps=1)
+    w_after = np.asarray(engine.state.params["linear_0"]["w"])
+    # Tiny clip → essentially only weight-decay-free Adam step of ~lr size;
+    # update magnitude must be bounded by lr.
+    assert np.abs(w_after - w_before).max() <= 0.011
+
+
+def test_train_micro_batch_size_accessors():
+    engine = make_engine(base_config(train_batch_size=32,
+                                     gradient_accumulation_steps=2))
+    assert engine.train_batch_size() == 32
+    assert engine.gradient_accumulation_steps() == 2
+    assert engine.train_micro_batch_size_per_gpu() * 2 * \
+        engine.dp_world_size == 32
