@@ -1,0 +1,425 @@
+"""GPT-NeoX family model, TPU-first.
+
+This is the flagship model the reference stack exists to train (DeeperSpeed
+is GPT-NeoX's training engine). Architecture follows GPT-NeoX: rotary
+position embeddings on a fraction of head dims, parallel attention+MLP
+residual, untied final layernorm + output projection.
+
+TPU-first choices:
+- bf16 activations, fp32 layernorm/softmax accumulation (MXU-friendly).
+- Tensor-parallel PartitionSpecs over the ``model`` mesh axis following the
+  Megatron pattern: QKV/MLP-in column-sharded, attn-out/MLP-out
+  row-sharded, embeddings vocab-sharded — collectives ride ICI via GSPMD.
+- Static shapes; attention via a fused Pallas flash-attention kernel when
+  available (`deeperspeed_tpu.ops.pallas.flash_attention`), XLA fallback
+  otherwise.
+- `jax.checkpoint`-friendly block structure (the engine's activation-
+  checkpoint interval remats whole blocks).
+
+Layer factories for pipeline parallelism (`to_layer_specs`) mirror the
+reference's GPT-NeoX pipelined topology: embedding → N blocks → final
+norm → (tied or untied) output head.
+"""
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 2048
+    rotary_pct: float = 0.25
+    rotary_emb_base: int = 10000
+    intermediate_mult: int = 4
+    layernorm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    tie_word_embeddings: bool = False
+    param_dtype: object = jnp.float32
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def intermediate_size(self):
+        return self.intermediate_mult * self.hidden_size
+
+    def num_params(self):
+        h, v, L = self.hidden_size, self.vocab_size, self.num_layers
+        per_layer = 4 * h * h + 3 * h + h + \
+            2 * h * self.intermediate_size + self.intermediate_size + h + \
+            4 * h  # qkv+out + biases + ln scales/biases + mlp
+        embed = v * h * (1 if self.tie_word_embeddings else 2)
+        return embed + L * per_layer + 2 * h
+
+    # ---- presets mirroring the config ladder (BASELINE.md) -------------
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                   max_seq_len=128, **kw)
+
+    @classmethod
+    def small(cls, **kw):  # GPT-2 small scale
+        return cls(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+    @classmethod
+    def xl_1_5b(cls, **kw):  # Megatron-GPT2 1.5B rung
+        return cls(hidden_size=1600, num_layers=48, num_heads=25, **kw)
+
+    @classmethod
+    def neox_20b(cls, **kw):  # GPT-NeoX-20B rung
+        return cls(vocab_size=50432, hidden_size=6144, num_layers=44,
+                   num_heads=64, rotary_pct=0.25, **kw)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def _dense_init(key, shape, dtype, scale=0.02):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_block_params(cfg, key):
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    keys = jax.random.split(key, 4)
+    out_scale = 0.02 / math.sqrt(2 * cfg.num_layers)
+    dt = cfg.param_dtype
+    return {
+        "ln_attn": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+        "ln_mlp": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+        "attn": {
+            "qkv_w": _dense_init(keys[0], (h, 3 * h), dt),
+            "qkv_b": jnp.zeros((3 * h,), dt),
+            "out_w": _dense_init(keys[1], (h, h), dt, scale=out_scale),
+            "out_b": jnp.zeros((h,), dt),
+        },
+        "mlp": {
+            "in_w": _dense_init(keys[2], (h, i), dt),
+            "in_b": jnp.zeros((i,), dt),
+            "out_w": _dense_init(keys[3], (i, h), dt, scale=out_scale),
+            "out_b": jnp.zeros((h,), dt),
+        },
+    }
+
+
+def init_params(cfg, rng):
+    keys = jax.random.split(rng, cfg.num_layers + 2)
+    dt = cfg.param_dtype
+    params = {
+        "embed": {"wte": _dense_init(keys[0], (cfg.vocab_size,
+                                               cfg.hidden_size), dt)},
+        "blocks": [init_block_params(cfg, keys[i + 1])
+                   for i in range(cfg.num_layers)],
+        "final_ln": {"scale": jnp.ones((cfg.hidden_size,), dt),
+                     "bias": jnp.zeros((cfg.hidden_size,), dt)},
+    }
+    if not cfg.tie_word_embeddings:
+        params["embed_out"] = {
+            "wte": _dense_init(keys[-1], (cfg.vocab_size, cfg.hidden_size),
+                               dt)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel specs (Megatron pattern over the 'model' axis)
+# ---------------------------------------------------------------------------
+
+def block_param_specs():
+    return {
+        "ln_attn": {"scale": P(), "bias": P()},
+        "ln_mlp": {"scale": P(), "bias": P()},
+        "attn": {
+            "qkv_w": P(None, MODEL_AXIS),   # column parallel
+            "qkv_b": P(MODEL_AXIS),
+            "out_w": P(MODEL_AXIS, None),   # row parallel
+            "out_b": P(),
+        },
+        "mlp": {
+            "in_w": P(None, MODEL_AXIS),
+            "in_b": P(MODEL_AXIS),
+            "out_w": P(MODEL_AXIS, None),
+            "out_b": P(),
+        },
+    }
+
+
+def param_specs(cfg, params):
+    specs = {
+        "embed": {"wte": P(MODEL_AXIS, None)},  # vocab-sharded
+        "blocks": [block_param_specs() for _ in range(cfg.num_layers)],
+        "final_ln": {"scale": P(), "bias": P()},
+    }
+    if "embed_out" in params:
+        specs["embed_out"] = {"wte": P(MODEL_AXIS, None)}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rotary_cache(cfg, seq_len, dtype=jnp.float32):
+    rot_dim = int(cfg.head_dim * cfg.rotary_pct)
+    rot_dim -= rot_dim % 2
+    inv_freq = 1.0 / (cfg.rotary_emb_base **
+                      (np.arange(0, rot_dim, 2, dtype=np.float32) / rot_dim))
+    t = np.arange(seq_len, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)                      # [S, rot/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)      # [S, rot]
+    return (jnp.asarray(np.cos(emb), dtype),
+            jnp.asarray(np.sin(emb), dtype), rot_dim)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(q, k, cos, sin, rot_dim):
+    """Rotary embedding on the first rot_dim dims of q/k [B, S, H, D]."""
+    q_rot, q_pass = q[..., :rot_dim], q[..., rot_dim:]
+    k_rot, k_pass = k[..., :rot_dim], k[..., rot_dim:]
+    cos = cos[None, :, None, :].astype(q.dtype)
+    sin = sin[None, :, None, :].astype(q.dtype)
+    q_rot = q_rot * cos + _rotate_half(q_rot) * sin
+    k_rot = k_rot * cos + _rotate_half(k_rot) * sin
+    return (jnp.concatenate([q_rot, q_pass], axis=-1),
+            jnp.concatenate([k_rot, k_pass], axis=-1))
+
+
+def causal_attention(q, k, v, use_pallas=True):
+    """Causal MHA core on [B, S, H, D]; fp32 softmax accumulation.
+
+    Uses the Pallas flash-attention kernel on TPU when shapes allow;
+    XLA-fused fallback otherwise (the fallback still fuses well — softmax
+    and the PV matmul land on the MXU)."""
+    if use_pallas:
+        try:
+            from ..ops.pallas.flash_attention import flash_attention_supported
+            from ..ops.pallas.flash_attention import flash_attention
+            if flash_attention_supported(q.shape):
+                return flash_attention(q, k, v, causal=True)
+        except ImportError:
+            pass
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    logits = jnp.where(mask[None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
+                  use_pallas=True):
+    """One GPT-NeoX block with parallel residual:
+    x + attn(ln1(x)) + mlp(ln2(x))."""
+    B, S, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    cos, sin, rot_dim = cos_sin
+
+    ln1 = layer_norm(x, params["ln_attn"]["scale"], params["ln_attn"]["bias"],
+                     cfg.layernorm_eps)
+    qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
+        params["attn"]["qkv_b"].astype(x.dtype)
+    qkv = qkv.reshape(B, S, nh, 3 * hd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k = apply_rotary(q, k, cos, sin, rot_dim)
+    attn = causal_attention(q, k, v, use_pallas=use_pallas)
+    attn = attn.reshape(B, S, h)
+    attn_out = attn @ params["attn"]["out_w"].astype(x.dtype) + \
+        params["attn"]["out_b"].astype(x.dtype)
+
+    if cfg.use_parallel_residual:
+        ln2_in = x
+    else:
+        ln2_in = x + attn_out
+    ln2 = layer_norm(ln2_in, params["ln_mlp"]["scale"],
+                     params["ln_mlp"]["bias"], cfg.layernorm_eps)
+    hmid = ln2 @ params["mlp"]["in_w"].astype(x.dtype) + \
+        params["mlp"]["in_b"].astype(x.dtype)
+    hmid = jax.nn.gelu(hmid)
+    mlp_out = hmid @ params["mlp"]["out_w"].astype(x.dtype) + \
+        params["mlp"]["out_b"].astype(x.dtype)
+
+    if cfg.use_parallel_residual:
+        return x + attn_out + mlp_out
+    return ln2_in + mlp_out
+
+
+def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    compute_dtype = params["embed"]["wte"].dtype
+    x = params["embed"]["wte"][tokens]
+    cos_sin = _rotary_cache(cfg, tokens.shape[1])
+
+    block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
+    if remat_blocks:
+        block_fn = jax.checkpoint(block_fn, static_argnums=())
+    for bp in params["blocks"]:
+        x = block_fn(bp, x, cos_sin)
+
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
+                   cfg.layernorm_eps)
+    out_embed = params.get("embed_out", params["embed"])["wte"]
+    logits = jnp.einsum("bsh,vh->bsv", x, out_embed.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def lm_loss(logits, labels, ignore_index=-100):
+    """Next-token cross entropy; labels already shifted or == tokens (we
+    shift internally when labels is tokens)."""
+    logits = logits[:, :-1, :]
+    targets = labels[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = targets != ignore_index
+    safe_targets = jnp.where(valid, targets, 0)
+    ll = jnp.take_along_axis(logp, safe_targets[..., None],
+                             axis=-1).squeeze(-1)
+    return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+class GPTNeoX:
+    """Engine-protocol wrapper: loss_fn / init_params / param_specs."""
+
+    def __init__(self, config=None, use_pallas=True, remat_blocks=False,
+                 **kwargs):
+        self.config = config or GPTNeoXConfig(**kwargs)
+        self.use_pallas = use_pallas
+        self.remat_blocks = remat_blocks
+
+    def init_params(self, rng):
+        return init_params(self.config, rng)
+
+    def param_specs(self, params, mesh):
+        if MODEL_AXIS not in mesh.axis_names or \
+                mesh.shape[MODEL_AXIS] == 1:
+            return jax.tree_util.tree_map(lambda p: P(), params)
+        return param_specs(self.config, params)
+
+    def apply(self, params, tokens):
+        return forward(self.config, params, tokens,
+                       use_pallas=self.use_pallas,
+                       remat_blocks=self.remat_blocks)
+
+    def loss_fn(self, params, batch, rng=None):
+        if isinstance(batch, (tuple, list)):
+            tokens, labels = batch
+        else:
+            tokens = labels = batch
+        logits = self.apply(params, tokens)
+        return lm_loss(logits, labels)
+
+
+# ---------------------------------------------------------------------------
+# pipeline layer factories
+# ---------------------------------------------------------------------------
+
+class EmbeddingPipe:
+    """Embedding as a pipeline layer: tokens [B,S] → hidden [B,S,H]."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def init(self, rng, x):
+        return {"wte": _dense_init(rng, (self.cfg.vocab_size,
+                                         self.cfg.hidden_size),
+                                   self.cfg.param_dtype)}
+
+    def apply(self, params, tokens, rng=None):
+        return params["wte"][tokens]
+
+
+class TransformerBlockPipe:
+    """One GPT-NeoX block as a pipeline layer."""
+
+    def __init__(self, cfg, use_pallas=True):
+        self.cfg = cfg
+        self.use_pallas = use_pallas
+
+    def init(self, rng, x):
+        return init_block_params(self.cfg, rng)
+
+    def apply(self, params, x, rng=None):
+        cos_sin = _rotary_cache(self.cfg, x.shape[1])
+        return block_forward(self.cfg, params, x, cos_sin,
+                             use_pallas=self.use_pallas)
+
+
+class FinalNormPipe:
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def init(self, rng, x):
+        h = self.cfg.hidden_size
+        return {"scale": jnp.ones((h,), self.cfg.param_dtype),
+                "bias": jnp.zeros((h,), self.cfg.param_dtype)}
+
+    def apply(self, params, x, rng=None):
+        return layer_norm(x, params["scale"], params["bias"],
+                          self.cfg.layernorm_eps)
+
+
+class OutputHeadPipe:
+    """Hidden → logits; usable as TiedLayerSpec('embed', ...) for tied
+    embeddings."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def init(self, rng, x):
+        return {"wte": _dense_init(rng, (self.cfg.vocab_size,
+                                         self.cfg.hidden_size),
+                                   self.cfg.param_dtype)}
+
+    def apply(self, params, x, rng=None):
+        return jnp.einsum("bsh,vh->bsv", x, params["wte"].astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+def to_layer_specs(cfg, use_pallas=True):
+    """LayerSpec list for PipelineModule (reference: GPT-NeoX's pipelined
+    model description)."""
+    from ..runtime.pipe import LayerSpec, TiedLayerSpec
+    specs = []
+    if cfg.tie_word_embeddings:
+        specs.append(TiedLayerSpec("embed", EmbeddingPipe, cfg,
+                                   tied_weight_attr="wte"))
+    else:
+        specs.append(LayerSpec(EmbeddingPipe, cfg))
+    for _ in range(cfg.num_layers):
+        specs.append(LayerSpec(TransformerBlockPipe, cfg, use_pallas))
+    specs.append(LayerSpec(FinalNormPipe, cfg))
+    if cfg.tie_word_embeddings:
+        specs.append(TiedLayerSpec("embed", OutputHeadPipe, cfg,
+                                   tied_weight_attr="wte"))
+    else:
+        specs.append(LayerSpec(OutputHeadPipe, cfg))
+    return specs

@@ -51,6 +51,25 @@ from .zero.partition_parameters import ZeroShardingRules
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
 
+def _place_opt_state(opt_state, master, master_sh, mesh):
+    """Shard optimizer-state fields that mirror the master pytree with the
+    master shardings; replicate scalar fields (e.g. the step counter)."""
+    master_def = jax.tree_util.tree_structure(master)
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def place_field(field):
+        try:
+            if jax.tree_util.tree_structure(field) == master_def:
+                return jax.tree_util.tree_map(
+                    lambda x, sh: jax.device_put(x, sh), field, master_sh)
+        except Exception:
+            pass
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, replicated), field)
+
+    return type(opt_state)(*[place_field(f) for f in opt_state])
+
+
 class EngineState(NamedTuple):
     """Device-resident training state; a pytree carried through jit."""
     params: Any               # compute-dtype params (ZeRO-3: sharded)
@@ -77,6 +96,7 @@ class DeepSpeedEngine:
                  collate_fn=None, config=None, config_params=None,
                  dont_change_device=False, mesh=None, rng=None):
         self.loss_fn = self._resolve_model(model)
+        self.module_obj = model
         self.client_optimizer = optimizer
         self.client_lr_scheduler = lr_scheduler
         self.collate_fn = collate_fn
@@ -294,31 +314,50 @@ class DeepSpeedEngine:
                  f"{self._config.scheduler_name}", ranks=[0])
         return sched
 
+    def _compute_shardings(self, model_parameters):
+        """Per-leaf NamedShardings for params/master/grads, merging the
+        model's tensor-parallel base specs (``model.param_specs``) with the
+        ZeRO data-axis sharding."""
+        rules = self.zero_rules
+        base = None
+        if hasattr(self.module_obj, "param_specs"):
+            base = self.module_obj.param_specs(model_parameters, self.mesh)
+
+        def tree_of(spec_fn):
+            if base is None:
+                return jax.tree_util.tree_map(
+                    lambda p: NamedSharding(self.mesh, spec_fn(p.shape)),
+                    model_parameters)
+            return jax.tree_util.tree_map(
+                lambda p, b: NamedSharding(self.mesh,
+                                           spec_fn(p.shape, base=b)),
+                model_parameters, base,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+        self._param_sh = tree_of(rules.param_spec)
+        self._master_sh = tree_of(rules.master_spec)
+        self._grad_sh = tree_of(rules.grad_spec)
+
     def _init_state(self, model_parameters):
         """Place params/master/opt-state on the mesh with ZeRO shardings."""
-        rules = self.zero_rules
+        self._compute_shardings(model_parameters)
 
         # copy=True: the engine's state buffers must never alias the
         # caller's arrays or each other — the jitted step donates state.
-        def to_master(p):
-            return jnp.array(p, dtype=jnp.float32, copy=True)
+        master = jax.tree_util.tree_map(
+            lambda p, sh: jax.device_put(
+                jnp.array(p, dtype=jnp.float32, copy=True), sh),
+            model_parameters, self._master_sh)
 
-        master = jax.tree_util.tree_map(to_master, model_parameters)
-        master = rules.place(master, rules.master_spec)
-
-        def to_compute(p):
-            return jnp.array(p, dtype=self.compute_dtype, copy=True)
-
-        params = jax.tree_util.tree_map(to_compute, master)
-        params = rules.place(params, rules.param_spec)
+        params = jax.tree_util.tree_map(
+            lambda p, sh: jax.device_put(
+                jnp.array(p, dtype=self.compute_dtype, copy=True), sh),
+            master, self._param_sh)
 
         opt_state = self.optimizer.init_state(master)
-        # Moments follow master sharding; step counter replicated.
-        opt_state = jax.tree_util.tree_map(
-            lambda x: jax.device_put(
-                x, NamedSharding(self.mesh,
-                                 rules.master_spec(x.shape)
-                                 if x.ndim else PartitionSpec())), opt_state)
+        # Moments follow master sharding; scalar fields stay replicated.
+        opt_state = _place_opt_state(opt_state, master, self._master_sh,
+                                     self.mesh)
 
         if not self.keep_master:
             master = None
@@ -353,7 +392,9 @@ class DeepSpeedEngine:
 
         (scaled, loss), grads = jax.value_and_grad(
             scaled_loss, has_aux=True)(params)
-        grads = self.zero_rules.constrain_grads(grads)
+        if self.zero_rules.stage >= 2:
+            grads = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, grads, self._grad_sh)
         return loss, grads
 
     def _apply_update(self, state, grads, lr):
@@ -390,11 +431,9 @@ class DeepSpeedEngine:
             lambda n, o: jnp.where(overflow, o, n), new_opt, state.opt_state)
 
         new_params = jax.tree_util.tree_map(
-            lambda m, p: jax.lax.with_sharding_constraint(
-                m.astype(self.compute_dtype),
-                NamedSharding(self.mesh,
-                              self.zero_rules.param_spec(p.shape))),
-            new_master, state.params)
+            lambda m, sh: jax.lax.with_sharding_constraint(
+                m.astype(self.compute_dtype), sh),
+            new_master, self._param_sh)
 
         if self.dynamic_loss_scale():
             args = cfg.dynamic_loss_scale_args or {}
@@ -446,7 +485,10 @@ class DeepSpeedEngine:
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            zero_grads = self.zero_rules.constrain_grads(zero_grads)
+            if self.zero_rules.stage >= 2:
+                zero_grads = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, zero_grads,
+                    self._grad_sh)
             rngs = jax.random.split(rng, accum_steps)
             (grads, loss_sum), _ = jax.lax.scan(
                 micro, (zero_grads, jnp.asarray(0.0, jnp.float32)),

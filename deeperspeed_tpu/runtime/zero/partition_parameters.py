@@ -64,35 +64,42 @@ class ZeroShardingRules:
             return 1
         return self.mesh.shape[self.data_axis]
 
-    def _spec(self, shape, threshold=0):
+    def _spec(self, shape, threshold=0, base=None):
+        """Add data-axis sharding to `base` (e.g. a model's tensor-parallel
+        spec) on a dim the base leaves unsharded."""
+        base_spec = list(base) + [None] * (len(shape) - len(base)) \
+            if base is not None else [None] * len(shape)
         if self.data_axis is None or self.dp_world == 1:
-            return PartitionSpec()
-        dim = _shardable_dim(shape, self.dp_world, threshold)
+            return PartitionSpec(*base_spec)
+        free_dims = [d for d in range(len(shape)) if base_spec[d] is None]
+        candidate_shape = tuple(shape[d] for d in free_dims)
+        dim = _shardable_dim(candidate_shape, self.dp_world, threshold)
         if dim is None:
-            return PartitionSpec()
-        spec = [None] * len(shape)
-        spec[dim] = self.data_axis
-        return PartitionSpec(*spec)
+            return PartitionSpec(*base_spec)
+        base_spec[free_dims[dim]] = self.data_axis
+        return PartitionSpec(*base_spec)
 
     # -- per-array spec selection -----------------------------------------
 
-    def param_spec(self, shape):
-        """Compute-dtype params: sharded at rest only at stage 3."""
+    def param_spec(self, shape, base=None):
+        """Compute-dtype params: sharded at rest only at stage 3 (tensor-
+        parallel base specs always apply)."""
         if self.stage >= 3:
-            return self._spec(shape, self.param_persistence_threshold)
-        return PartitionSpec()
+            return self._spec(shape, self.param_persistence_threshold,
+                              base=base)
+        return PartitionSpec(*base) if base is not None else PartitionSpec()
 
-    def master_spec(self, shape):
+    def master_spec(self, shape, base=None):
         """fp32 master params + optimizer moments: sharded from stage 1."""
         if self.stage >= 1:
-            return self._spec(shape)
-        return PartitionSpec()
+            return self._spec(shape, base=base)
+        return PartitionSpec(*base) if base is not None else PartitionSpec()
 
-    def grad_spec(self, shape):
+    def grad_spec(self, shape, base=None):
         """Gradients: reduce-scattered from stage 2."""
         if self.stage >= 2:
-            return self._spec(shape)
-        return PartitionSpec()
+            return self._spec(shape, base=base)
+        return PartitionSpec(*base) if base is not None else PartitionSpec()
 
     # -- pytree helpers ----------------------------------------------------
 

@@ -1,0 +1,133 @@
+"""GPT-NeoX model tests: forward shape, loss, engine training, TP specs,
+pipeline-spec equivalence."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deeperspeed_tpu
+from deeperspeed_tpu.models import gpt_neox
+from deeperspeed_tpu.parallel.mesh import build_mesh
+from deeperspeed_tpu.parallel.topology import ProcessTopology
+from deeperspeed_tpu.runtime.pipe import PipelineModule
+
+CFG = gpt_neox.GPTNeoXConfig.tiny()
+
+
+def token_batches(n, batch, seq, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        toks = rng.integers(0, vocab, size=(batch, seq), dtype=np.int32)
+        yield (toks, toks)
+
+
+def test_forward_shapes():
+    model = gpt_neox.GPTNeoX(CFG)
+    params = model.init_params(jax.random.PRNGKey(0))
+    toks = np.zeros((2, 32), np.int32)
+    logits = model.apply(params, toks)
+    assert logits.shape == (2, 32, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_loss_decreases_under_engine():
+    model = gpt_neox.GPTNeoX(CFG)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "fp16": {"enabled": True, "type": "bfloat16"},
+        })
+    fixed = next(token_batches(1, 8, 32, CFG.vocab_size))
+    stacked = jax.tree_util.tree_map(lambda x: x[None], fixed)
+    losses = [float(engine.train_batch(batch=stacked)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+    # Initial loss ≈ ln(vocab) for random init.
+    assert losses[0] == pytest.approx(np.log(CFG.vocab_size), rel=0.3)
+
+
+def test_param_specs_structure():
+    model = gpt_neox.GPTNeoX(CFG)
+    params = model.init_params(jax.random.PRNGKey(0))
+    topo = ProcessTopology(axes=["data", "model"], dims=[4, 2])
+    mesh = build_mesh(topo)
+    specs = model.param_specs(params, mesh)
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(
+            specs, is_leaf=lambda x: isinstance(x, P))
+    assert specs["blocks"][0]["attn"]["qkv_w"] == P(None, "model")
+    assert specs["blocks"][0]["attn"]["out_w"] == P("model", None)
+    assert specs["embed"]["wte"] == P("model", None)
+
+
+def test_tp_sharded_training(devices):
+    """Train on a data×model mesh: TP collectives must compile and the
+    loss must match single-axis training."""
+    model = gpt_neox.GPTNeoX(CFG)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    topo = ProcessTopology(axes=["data", "model"], dims=[4, 2])
+    mesh = build_mesh(topo, devices)
+    engine_tp, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh=mesh,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        })
+    engine_dp, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        })
+    assert engine_tp.dp_world_size == 4
+    # qkv must actually be sharded over 'model'.
+    qkv = engine_tp.state.params["blocks"][0]["attn"]["qkv_w"]
+    assert any(s.data.shape != qkv.shape for s in qkv.addressable_shards)
+
+    fixed = next(token_batches(1, 8, 32, CFG.vocab_size, seed=4))
+    stacked = jax.tree_util.tree_map(lambda x: x[None], fixed)
+    for _ in range(3):
+        l_tp = float(engine_tp.train_batch(batch=stacked))
+        l_dp = float(engine_dp.train_batch(batch=stacked))
+    np.testing.assert_allclose(l_tp, l_dp, rtol=1e-4)
+
+
+def test_pipeline_specs_match_monolithic():
+    specs = gpt_neox.to_layer_specs(CFG)
+    module = PipelineModule(layers=specs, num_stages=2,
+                            loss_fn=gpt_neox.lm_loss)
+    toks = np.zeros((2, 16), np.int32)
+    params = module.init_params(jax.random.PRNGKey(0), example_input=toks)
+
+    rng = np.random.default_rng(0)
+    batch_toks = rng.integers(0, CFG.vocab_size, size=(2, 16),
+                              dtype=np.int32)
+    loss_pipe = float(module.loss(params, (batch_toks, batch_toks)))
+    assert np.isfinite(loss_pipe)
+    assert loss_pipe == pytest.approx(np.log(CFG.vocab_size), rel=0.3)
+
+
+def test_tied_embeddings():
+    cfg = gpt_neox.GPTNeoXConfig.tiny(tie_word_embeddings=True)
+    model = gpt_neox.GPTNeoX(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    assert "embed_out" not in params
+    toks = np.zeros((2, 16), np.int32)
+    assert model.apply(params, toks).shape == (2, 16, cfg.vocab_size)
+
+
+def test_rotary_rotation_invariance():
+    """Rotary: relative positions only — shifting both q and k positions
+    must not change scores. Verified indirectly: cache values at pos p are
+    unit-norm rotations."""
+    cos, sin, rot_dim = gpt_neox._rotary_cache(CFG, 64)
+    np.testing.assert_allclose(np.asarray(cos[0]), np.ones(rot_dim),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cos) ** 2 + np.asarray(sin) ** 2,
+                               np.ones((64, rot_dim)), atol=1e-5)
