@@ -1,0 +1,113 @@
+"""Benchmark: GPT-NeoX training throughput on the attached TPU chip(s).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric is tokens/sec/chip for a bf16 GPT-NeoX training step (ZeRO-sharded
+over whatever devices are attached). ``vs_baseline`` is MFU / 0.40 — the
+BASELINE.md north-star is ≥40% MFU, so ≥1.0 means target hit.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip(device):
+    """bf16 peak TFLOPS by TPU generation (public spec sheet numbers)."""
+    kind = getattr(device, "device_kind", "") or str(device)
+    kind = kind.lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12,
+        "v6": 918e12, "v6e": 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12  # conservative default
+
+
+def main():
+    import jax
+
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    devices = jax.devices()
+    n_chips = len(devices)
+
+    # ~115M-param GPT-NeoX (GPT2-small scale), seq 1024.
+    cfg = GPTNeoXConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024)
+    seq = 1024
+    batch_per_chip = 8
+    batch = batch_per_chip * n_chips
+
+    model = GPTNeoX(cfg, use_pallas=True)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": batch,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 10_000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "fp16": {"enabled": True, "type": "bfloat16"},
+            "zero_optimization": {"stage": 2},
+        })
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                          dtype=np.int32)
+    stacked = (tokens, tokens)
+
+    # Warmup (compile) + 2 stabilization steps.
+    for _ in range(3):
+        loss = engine.train_batch(batch=stacked)
+    jax.block_until_ready(engine.state.params)
+
+    n_steps = 10
+    start = time.perf_counter()
+    for _ in range(n_steps):
+        loss = engine.train_batch(batch=stacked)
+    jax.block_until_ready(engine.state.params)
+    elapsed = time.perf_counter() - start
+
+    tokens_per_sec = batch * seq * n_steps / elapsed
+    tokens_per_sec_chip = tokens_per_sec / n_chips
+
+    n_params = cfg.num_params()
+    model_flops_per_token = 6 * n_params  # fwd+bwd dense transformer
+    # attention flops: 12 * L * h * s per token (qk + pv, fwd+bwd)
+    attn_flops_per_token = 12 * cfg.num_layers * cfg.hidden_size * seq
+    flops_per_token = model_flops_per_token + attn_flops_per_token
+    achieved = tokens_per_sec_chip * flops_per_token
+    peak = peak_flops_per_chip(devices[0])
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "gpt_neox_125m_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "chips": n_chips,
+            "device": str(devices[0]),
+            "mfu": round(mfu, 4),
+            "achieved_tflops_per_chip": round(achieved / 1e12, 2),
+            "params_m": round(n_params / 1e6, 1),
+            "final_loss": float(loss),
+            "seq": seq,
+            "batch_per_chip": batch_per_chip,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

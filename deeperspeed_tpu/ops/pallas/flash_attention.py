@@ -8,8 +8,9 @@ which is both the perf win (HBM bandwidth is the bottleneck) and the
 long-sequence enabler.
 
 Layout: [B, S, H, D] in, [B, S, H, D] out. Forward saves the per-row
-logsumexp; backward recomputes probabilities blockwise (no S×S residual).
-Block sizes default to 128×128 (MXU-shaped); fp32 accumulation.
+logsumexp (lane-broadcast to width 128, the TPU minor-dim tile); backward
+recomputes probabilities blockwise (no S×S residual). Block sizes default
+to 128×128 (MXU-shaped); fp32 accumulation throughout.
 
 On non-TPU backends the kernels run in interpreter mode (slow, test-only).
 """
@@ -24,6 +25,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 128
 BLOCK_K = 128
+LANES = 128  # TPU minor-dim tile; lse/delta are lane-broadcast to this
 NEG_INF = -1e30
 
 
@@ -38,6 +40,14 @@ def flash_attention_supported(shape, block_q=BLOCK_Q, block_k=BLOCK_K):
     b, s, h, d = shape
     return s % block_q == 0 and s % block_k == 0 and \
         d in (64, 128, 256) and s >= block_q
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + \
+        qi * block_q
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + \
+        ki * block_k
+    return jnp.where(rows >= cols, s, NEG_INF)
 
 
 # ---------------------------------------------------------------------------
@@ -69,38 +79,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [BQ, BK]
-
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 0) + \
-                qi * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 1) + \
-                ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
 
-        m_prev = m_scr[:, 0]                                  # [BQ]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        alpha = jnp.exp(m_prev - m_new)                       # [BQ]
-        p = jnp.exp(s - m_new[:, None])                       # [BQ, BK]
+        m_prev = m_scr[:, :1]                                 # [BQ, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                       # [BQ, 1]
+        p = jnp.exp(s - m_new)                                # [BQ, BK]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
 
-        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
-        m_scr[:, 0] = m_new
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [BQ, D]
-        acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
+        acc_scr[:] = acc_scr[:] * alpha + pv
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        l = l_scr[:, 0]
+        l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, 0] + jnp.log(l_safe)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0,
+                                                  l_scr[:]))
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
     b, s, h, d = q.shape
+
     # [B, S, H, D] → [B*H, S, D] for contiguous per-head tiles.
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
@@ -122,16 +130,17 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
-            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),       # out accumulator
         ],
         interpret=_interpret(),
     )(qb, kb, vb)
@@ -168,14 +177,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q * sm_scale, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [BQ, BK]
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 0) + \
-                qi * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 1) + \
-                ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])                 # [BQ, BK]
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])                   # [BQ, BK]
         do = do_ref[0].astype(jnp.float32)                   # [BQ, D]
         # dV += Pᵀ dO
         dv_scr[:] += jax.lax.dot_general(
@@ -185,7 +188,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [BQ, BK]
-        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
         # dK += dSᵀ Q
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -219,19 +222,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q * sm_scale, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 0) + \
-                qi * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 1) + \
-                ki * block_k
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -247,14 +244,14 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g):
     sm_scale = sm_scale_arg if sm_scale_arg is not None else \
         1.0 / math.sqrt(d)
 
-    b_times_h = bh
     # g arrives as [B, S, H, D]; reshape like the saved qb.
     bdim = g.shape[0]
     h = bh // bdim
     do = g.transpose(0, 2, 1, 3).reshape(bh, s, d)
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                                  # [BH, S]
+                    axis=-1, keepdims=True)                   # [BH, S, 1]
+    delta = jnp.broadcast_to(delta, (bh, s, LANES))
 
     n_q, n_k = s // block_q, s // block_k
 
@@ -263,14 +260,16 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g):
                                    block_k=block_k)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b_times_h, n_k, n_q),
+        grid=(bh, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, ki, qi: (bh, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
@@ -292,14 +291,16 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g):
                                   block_k=block_k)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b_times_h, n_q, n_k),
+        grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh, qi, ki: (bh, qi, 0)),
