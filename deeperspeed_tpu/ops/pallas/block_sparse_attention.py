@@ -1,0 +1,407 @@
+"""Block-sparse flash attention (splash-attention-style) Pallas kernels.
+
+TPU-native replacement for the reference's Triton block-sparse stack
+(`deepspeed/ops/sparse_attention/trsrc/{matmul.tr,softmax_*.tr}` driven by
+`matmul.py`/`softmax.py`): instead of materializing block-sparse score
+matrices through separate SDD-matmul → sparse-softmax → DSD-matmul passes,
+one fused kernel visits ONLY the active column blocks of each query-row
+block, carried by a scalar-prefetched LUT, with online softmax — compute
+and HBM traffic both scale with the number of active blocks.
+
+Layout comes from `SparsityConfig.make_layout(seq)` →
+[num_heads, nQ, nK] 0/1 (see `..sparse_attention.sparsity_config`).
+`causal=True` applies an element-level triangular mask inside diagonal
+blocks (unidirectional patterns).
+"""
+
+import functools
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import LANES, NEG_INF, _causal_mask, _interpret
+
+DEFAULT_BLOCK = 128
+
+
+def build_lut(layout):
+    """[H, nQ, nK] 0/1 layout → (lut [H, nQ, maxA] int32, sentinel).
+
+    lut[h, qi, :] lists the active column blocks for query-row block qi
+    (padded with `sentinel` = nK). For the backward dk/dv kernel call with
+    layout.transpose(0, 2, 1)."""
+    layout = np.asarray(layout)
+    h, n_q, n_k = layout.shape
+    counts = layout.sum(axis=2)
+    max_active = max(1, int(counts.max()))
+    lut = np.full((h, n_q, max_active), n_k, np.int32)
+    for hi in range(h):
+        for qi in range(n_q):
+            cols = np.nonzero(layout[hi, qi])[0]
+            lut[hi, qi, :len(cols)] = cols
+    return lut, n_k
+
+
+def _sparse_fwd_kernel(lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr,
+                       *, sm_scale, causal, block_q, block_k, num_heads,
+                       max_active, sentinel):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    ai = pl.program_id(2)
+
+    h = bh % num_heads
+    n_q = pl.num_programs(1)
+    ki = lut_ref[h * n_q * max_active + qi * max_active + ai]
+    active = ki < sentinel
+
+    @pl.when(ai == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(ai == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0,
+                                                  l_scr[:]))
+
+
+def _kv_col_index(lut_ref, bh, qi, ai, *, num_heads, max_active, n_q,
+                  sentinel):
+    """Column block for (bh, qi, ai); inactive slots prefetch block 0."""
+    h = bh % num_heads
+    ki = lut_ref[h * n_q * max_active + qi * max_active + ai]
+    return jax.lax.select(ki < sentinel, ki, 0)
+
+
+def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
+                         block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK):
+    b, s, h, d = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    n_q = s // block_q
+    n_k = s // block_k
+    max_active = lut.shape[-1]
+    lut_flat = jnp.asarray(lut.reshape(-1), jnp.int32)
+
+    kernel = functools.partial(
+        _sparse_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_heads=h,
+        max_active=max_active, sentinel=sentinel)
+
+    kv_map = functools.partial(_kv_col_index, num_heads=h,
+                               max_active=max_active, n_q=n_q,
+                               sentinel=sentinel)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, n_q, max_active),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, ai, lut_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ai, lut_ref:
+                         (bh, kv_map(lut_ref, bh, qi, ai), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ai, lut_ref:
+                         (bh, kv_map(lut_ref, bh, qi, ai), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, ai, lut_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qi, ai, lut_ref: (bh, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(lut_flat, qb, kb, vb)
+
+    out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out4, (qb, kb, vb, out, lse)
+
+
+def _sparse_dkv_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                       *, sm_scale, causal, block_q, block_k, num_heads,
+                       max_active, sentinel):
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    ai = pl.program_id(2)
+    h = bh % num_heads
+    n_kv = pl.num_programs(1)
+    qi = lut_ref[h * n_kv * max_active + ki * max_active + ai]
+    active = qi < sentinel
+
+    @pl.when(ai == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ai == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _sparse_dq_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dq_scr,
+                      *, sm_scale, causal, block_q, block_k, num_heads,
+                      max_active, sentinel):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    ai = pl.program_id(2)
+    h = bh % num_heads
+    n_q = pl.num_programs(1)
+    ki = lut_ref[h * n_q * max_active + qi * max_active + ai]
+    active = ki < sentinel
+
+    @pl.when(ai == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ai == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
+                         block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK):
+    qb, kb, vb, out, lse = res
+    bh, s, d = qb.shape
+    bdim = g.shape[0]
+    h = bh // bdim
+    do = g.transpose(0, 2, 1, 3).reshape(bh, s, d)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (bh, s, LANES))
+
+    n_q, n_k = s // block_q, s // block_k
+    max_a = lut.shape[-1]
+    max_at = lut_t.shape[-1]
+    lut_flat = jnp.asarray(lut.reshape(-1), jnp.int32)
+    lut_t_flat = jnp.asarray(lut_t.reshape(-1), jnp.int32)
+
+    # dk/dv: grid over column blocks; LUT lists the active row blocks.
+    row_map = functools.partial(_kv_col_index, num_heads=h,
+                                max_active=max_at, n_q=n_k,
+                                sentinel=sentinel)
+    dkv_kernel = functools.partial(
+        _sparse_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_heads=h, max_active=max_at,
+        sentinel=sentinel)
+    dkv_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_k, max_at),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, ki, ai, lref:
+                         (b, row_map(lref, b, ki, ai), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, ki, ai, lref: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, ki, ai, lref: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, ki, ai, lref:
+                         (b, row_map(lref, b, ki, ai), 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda b, ki, ai, lref:
+                         (b, row_map(lref, b, ki, ai), 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda b, ki, ai, lref:
+                         (b, row_map(lref, b, ki, ai), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, ki, ai, lref: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, ki, ai, lref: (b, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel, grid_spec=dkv_grid,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), vb.dtype),
+        ],
+        interpret=_interpret(),
+    )(lut_t_flat, qb, kb, vb, do, lse, delta)
+
+    col_map = functools.partial(_kv_col_index, num_heads=h,
+                                max_active=max_a, n_q=n_q,
+                                sentinel=sentinel)
+    dq_kernel = functools.partial(
+        _sparse_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_heads=h, max_active=max_a,
+        sentinel=sentinel)
+    dq_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_q, max_a),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, qi, ai, lref: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ai, lref:
+                         (b, col_map(lref, b, qi, ai), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, qi, ai, lref:
+                         (b, col_map(lref, b, qi, ai), 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, qi, ai, lref: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda b, qi, ai, lref: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda b, qi, ai, lref: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, qi, ai, lref: (b, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )
+    dq = pl.pallas_call(
+        dq_kernel, grid_spec=dq_grid,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
+        interpret=_interpret(),
+    )(lut_flat, qb, kb, vb, do, lse, delta)
+
+    def from_bh(x):
+        return x.reshape(bdim, h, s, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq), from_bh(dk), from_bh(dv)
+
+
+class BlockSparseAttention:
+    """Callable bound to one (layout, block, causal) configuration.
+
+    Precomputes forward/backward LUTs host-side once; the kernels are then
+    pure functions of (q, k, v) with a custom VJP.
+    """
+
+    def __init__(self, layout, block=DEFAULT_BLOCK, causal=False,
+                 sm_scale=None):
+        layout = np.asarray(layout)
+        self.layout = layout
+        self.block = block
+        self.causal = causal
+        self.sm_scale = sm_scale
+        self.lut, self.sentinel = build_lut(layout)
+        self.lut_t, _ = build_lut(layout.transpose(0, 2, 1))
+
+        @jax.custom_vjp
+        def attend(q, k, v):
+            scale = self.sm_scale or 1.0 / math.sqrt(q.shape[-1])
+            out, _ = sparse_attention_fwd(
+                q, k, v, self.lut, self.sentinel, self.causal, scale,
+                self.block, self.block)
+            return out
+
+        def fwd(q, k, v):
+            scale = self.sm_scale or 1.0 / math.sqrt(q.shape[-1])
+            return sparse_attention_fwd(
+                q, k, v, self.lut, self.sentinel, self.causal, scale,
+                self.block, self.block)
+
+        def bwd(res, g):
+            scale = self.sm_scale or 1.0 / math.sqrt(res[0].shape[-1])
+            return sparse_attention_bwd(
+                res, g, self.lut, self.lut_t, self.sentinel, self.causal,
+                scale, self.block, self.block)
+
+        attend.defvjp(fwd, bwd)
+        self._attend = attend
+
+    def __call__(self, q, k, v):
+        """q/k/v: [B, S, H, D] with H == layout heads, S == layout
+        seq (= nQ * block)."""
+        b, s, h, d = q.shape
+        if h != self.layout.shape[0]:
+            raise ValueError(
+                f"got {h} heads, layout has {self.layout.shape[0]}")
+        if s != self.layout.shape[1] * self.block:
+            raise ValueError(
+                f"seq {s} != layout blocks {self.layout.shape[1]} × block "
+                f"{self.block}")
+        return self._attend(q, k, v)

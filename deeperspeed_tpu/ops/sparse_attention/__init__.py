@@ -1,0 +1,7 @@
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import (BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              LocalSlidingWindowSparsityConfig,
+                              SparsityConfig, VariableSparsityConfig,
+                              sparsity_config_from_dict)

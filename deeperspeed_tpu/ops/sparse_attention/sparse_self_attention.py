@@ -1,0 +1,117 @@
+"""Sparse self-attention module (reference:
+`deepspeed/ops/sparse_attention/sparse_self_attention.py:174`).
+
+Applies a `SparsityConfig`-driven block-sparse attention to q/k/v. The
+reference composes three Triton ops (SDD matmul → block softmax → DSD
+matmul); here one fused Pallas kernel does all three
+(`..pallas.block_sparse_attention`), falling back to a dense masked XLA
+path for shapes the kernel doesn't cover.
+"""
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..pallas.block_sparse_attention import BlockSparseAttention
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+def layout_to_token_mask(layout, block):
+    """[H, nQ, nK] block layout → [H, S, S] boolean token mask."""
+    layout = np.asarray(layout, bool)
+    return np.repeat(np.repeat(layout, block, axis=1), block, axis=2)
+
+
+def dense_masked_attention(q, k, v, token_mask, causal, sm_scale=None):
+    """Reference/fallback path: dense attention with the block mask
+    applied elementwise. [B, S, H, D] layout."""
+    b, s, h, d = q.shape
+    scale = sm_scale or 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.asarray(token_mask)[None]  # [1, H, S, S]
+    if causal:
+        mask = jnp.logical_and(mask, jnp.tril(jnp.ones((s, s), bool)))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # Fully-masked rows produce uniform probs over -1e30 → NaN-free zeros.
+    probs = jnp.where(mask.any(axis=-1, keepdims=True), probs, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+class SparseSelfAttention:
+    """Layout-cached sparse attention, one instance per layer.
+
+    `forward(q, k, v)` takes [B, S, H, D] (the reference takes
+    [B, H, S, D]; use `transpose_inputs=True` for that layout).
+    """
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048,
+                 transpose_inputs=False):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(
+            num_heads=4)
+        if not isinstance(self.sparsity_config, SparsityConfig):
+            raise TypeError("sparsity_config must be a SparsityConfig")
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self.transpose_inputs = transpose_inputs
+        self._cache = {}
+
+    @property
+    def block(self):
+        return self.sparsity_config.block
+
+    def get_layout(self, seq_len):
+        if seq_len not in self._cache:
+            layout = self.sparsity_config.make_layout(seq_len)
+            causal = getattr(self.sparsity_config, "attention",
+                             "bidirectional") == "unidirectional"
+            kernel = None
+            block = self.block
+            if seq_len % 128 == 0 and block % 128 == 0:
+                # Kernel path uses 128-sized blocks; coarser layouts are
+                # refined to 128 granularity.
+                refine = block // 128
+                fine = np.repeat(np.repeat(layout, refine, axis=1),
+                                 refine, axis=2)
+                kernel = BlockSparseAttention(fine, block=128,
+                                              causal=causal)
+            self._cache[seq_len] = (layout, kernel, causal)
+        return self._cache[seq_len]
+
+    def forward(self, query, key, value, rpe=None, key_padding_mask=None,
+                attn_mask=None):
+        if self.transpose_inputs:
+            query, key, value = (x.transpose(0, 2, 1, 3)
+                                 for x in (query, key, value))
+        b, s, h, d = query.shape
+        if s % self.block != 0:
+            raise ValueError(
+                f"sequence length {s} must be divisible by block "
+                f"{self.block}")
+        layout, kernel, causal = self.get_layout(s)
+
+        use_kernel = (kernel is not None and d in (64, 128, 256)
+                      and rpe is None and key_padding_mask is None
+                      and attn_mask is None)
+        if use_kernel:
+            out = kernel(query, key, value)
+        else:
+            token_mask = layout_to_token_mask(layout, self.block)
+            if key_padding_mask is not None:
+                kpm = jnp.asarray(key_padding_mask, bool)  # [B, S], True=keep
+                token_mask = jnp.logical_and(token_mask[None],
+                                             kpm[:, None, None, :])
+            out = dense_masked_attention(query, key, value, token_mask,
+                                         causal)
+        if self.transpose_inputs:
+            out = out.transpose(0, 2, 1, 3)
+        return out
+
+    __call__ = forward

@@ -1,0 +1,203 @@
+"""Sparse attention tests (parity with reference
+`tests/unit/test_sparse_attention.py`: kernels vs dense reference)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeperspeed_tpu.ops.pallas.block_sparse_attention import (
+    BlockSparseAttention, build_lut)
+from deeperspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention, VariableSparsityConfig, sparsity_config_from_dict)
+from deeperspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    dense_masked_attention, layout_to_token_mask)
+
+BLOCK = 128
+SEQ = 512
+HEADS = 2
+DIM = 64
+
+
+# --- layout generation ----------------------------------------------------
+
+def test_dense_layout():
+    cfg = DenseSparsityConfig(num_heads=2, block=16)
+    layout = cfg.make_layout(64)
+    assert layout.shape == (2, 4, 4)
+    assert layout.all()
+
+
+def test_fixed_layout_bidirectional():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=4,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(16 * 8)
+    assert layout.shape == (2, 8, 8)
+    # Local windows dense:
+    assert layout[0, :4, :4].all()
+    assert layout[0, 4:, 4:].all()
+    # Global column (last block of each window, vertical, all rows):
+    assert layout[0, :, 3].all()
+    assert layout[0, :, 7].all()
+    # Heads identical without different_layout_per_head:
+    np.testing.assert_array_equal(layout[0], layout[1])
+
+
+def test_fixed_layout_unidirectional():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(16 * 8)
+    assert np.triu(layout[0], 1).sum() == 0  # nothing above diagonal
+
+
+def test_fixed_different_patterns_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                              num_global_blocks=1,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    layout = cfg.make_layout(16 * 8)
+    # Each head has a different global column within the window.
+    globals_per_head = [set(np.nonzero(layout[h].all(axis=0))[0].tolist())
+                        for h in range(4)]
+    assert len({frozenset(g) for g in globals_per_head}) == 4
+
+
+def test_variable_layout():
+    cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                 local_window_blocks=[2, 4],
+                                 global_block_indices=[0])
+    layout = cfg.make_layout(16 * 8)
+    assert layout[0, :2, :2].all()
+    assert layout[0, 2:6, 2:6].all()
+    assert layout[0, :, 0].all()  # global column
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    layout = cfg.make_layout(16 * 8)
+    assert layout[0, 0, :].all()  # global row
+    assert layout[0, :, 0].all()  # global col
+    for i in range(1, 7):
+        assert layout[0, i, i - 1:i + 2].all()  # sliding window
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(16 * 8)
+    assert layout[0, 0, :].all()
+    assert layout[0, :, 0].all()
+
+
+def test_sliding_window_layout():
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=16,
+                                           num_sliding_window_blocks=3,
+                                           attention="unidirectional")
+    layout = cfg.make_layout(16 * 8)
+    assert np.triu(layout[0], 1).sum() == 0
+    assert layout[0, 5, 4:6].all()
+    assert layout[0, 5, :3].sum() == 0  # outside window
+
+
+def test_config_from_dict():
+    cfg = sparsity_config_from_dict({
+        "mode": "bigbird", "num_heads": 4, "block": 32,
+        "num_random_blocks": 2})
+    assert isinstance(cfg, BigBirdSparsityConfig)
+    assert cfg.block == 32
+    assert cfg.num_random_blocks == 2
+
+
+def test_seq_not_divisible_raises():
+    cfg = DenseSparsityConfig(num_heads=1, block=16)
+    with pytest.raises(ValueError):
+        cfg.make_layout(100)
+
+
+# --- LUT ------------------------------------------------------------------
+
+def test_build_lut():
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 0, 0] = 1
+    layout[0, 2, 1] = 1
+    layout[0, 2, 3] = 1
+    lut, sentinel = build_lut(layout)
+    assert sentinel == 4
+    assert lut.shape == (1, 4, 2)
+    assert lut[0, 0].tolist() == [0, 4]
+    assert lut[0, 2].tolist() == [1, 3]
+    assert lut[0, 1].tolist() == [4, 4]  # empty row fully padded
+
+
+# --- kernel parity --------------------------------------------------------
+
+def make_qkv(seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (1, SEQ, HEADS, DIM)
+    return tuple(jax.random.normal(k, shape, dtype) * 0.5 for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_sparse_kernel_parity(causal):
+    rng = np.random.default_rng(0)
+    n = SEQ // BLOCK
+    layout = (rng.random((HEADS, n, n)) < 0.5).astype(np.int64)
+    if causal:
+        layout = np.tril(layout)
+    layout[:, 0, 0] = 1  # ensure no fully-empty first row
+    for i in range(n):
+        layout[:, i, i] = 1
+
+    q, k, v = make_qkv()
+    attn = BlockSparseAttention(layout, block=BLOCK, causal=causal)
+    out = attn(q, k, v)
+    ref = dense_masked_attention(q, k, v,
+                                 layout_to_token_mask(layout, BLOCK),
+                                 causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_block_sparse_kernel_backward_parity():
+    rng = np.random.default_rng(1)
+    n = SEQ // BLOCK
+    layout = (rng.random((HEADS, n, n)) < 0.6).astype(np.int64)
+    for i in range(n):
+        layout[:, i, i] = 1
+    q, k, v = make_qkv(seed=2)
+    attn = BlockSparseAttention(layout, block=BLOCK, causal=False)
+    mask = layout_to_token_mask(layout, BLOCK)
+
+    g1 = jax.grad(lambda q, k, v: jnp.sum(attn(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: jnp.sum(
+            dense_masked_attention(q, k, v, mask, False) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-3,
+                                   err_msg=f"d{name}")
+
+
+def test_sparse_self_attention_module():
+    cfg = BSLongformerSparsityConfig(num_heads=HEADS, block=BLOCK,
+                                     num_sliding_window_blocks=3)
+    ssa = SparseSelfAttention(sparsity_config=cfg)
+    q, k, v = make_qkv(seed=3)
+    out = ssa(q, k, v)
+    assert out.shape == q.shape
+    layout = cfg.make_layout(SEQ)
+    ref = dense_masked_attention(q, k, v,
+                                 layout_to_token_mask(layout, BLOCK),
+                                 False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
