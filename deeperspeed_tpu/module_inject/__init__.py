@@ -1,0 +1,2 @@
+from .replace_module import (extract_bert_layer_params,
+                             replace_transformer_layer)

@@ -1,0 +1,116 @@
+"""Model surgery: swap HuggingFace/Megatron BERT-style layers for the
+fused `DeepSpeedTransformerLayer` (reference:
+`deepspeed/module_inject/replace_module.py:5`, `inject.py`).
+
+The reference mutates a torch model in place, copying each `BertLayer`'s
+weights into the fused CUDA layer. Here the torch model is the *source*:
+weights are extracted host-side into the TPU layer's parameter pytree, and
+the result is a (layers, params, apply_fn) triple that runs the whole
+encoder stack as one jittable function.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.transformer import (DeepSpeedTransformerConfig,
+                               DeepSpeedTransformerLayer)
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def extract_bert_layer_params(bert_layer):
+    """HF `BertLayer` → DeepSpeedTransformerLayer parameter dict."""
+    attn = bert_layer.attention
+    selfattn = attn.self
+    qkv_w = np.concatenate([
+        _t(selfattn.query.weight).T,
+        _t(selfattn.key.weight).T,
+        _t(selfattn.value.weight).T,
+    ], axis=1)
+    qkv_b = np.concatenate([
+        _t(selfattn.query.bias),
+        _t(selfattn.key.bias),
+        _t(selfattn.value.bias),
+    ])
+    return {
+        "attn_qkvw": jnp.asarray(qkv_w),
+        "attn_qkvb": jnp.asarray(qkv_b),
+        "attn_ow": jnp.asarray(_t(attn.output.dense.weight).T),
+        "attn_ob": jnp.asarray(_t(attn.output.dense.bias)),
+        "attn_nw": jnp.asarray(_t(attn.output.LayerNorm.weight)),
+        "attn_nb": jnp.asarray(_t(attn.output.LayerNorm.bias)),
+        "inter_w": jnp.asarray(_t(bert_layer.intermediate.dense.weight).T),
+        "inter_b": jnp.asarray(_t(bert_layer.intermediate.dense.bias)),
+        "output_w": jnp.asarray(_t(bert_layer.output.dense.weight).T),
+        "output_b": jnp.asarray(_t(bert_layer.output.dense.bias)),
+        "norm_w": jnp.asarray(_t(bert_layer.output.LayerNorm.weight)),
+        "norm_b": jnp.asarray(_t(bert_layer.output.LayerNorm.bias)),
+    }
+
+
+def _find_bert_layers(model):
+    """Locate the list of BertLayer-like submodules in an HF model."""
+    for attr_chain in (("bert", "encoder", "layer"),
+                       ("encoder", "layer"), ("layer",)):
+        obj = model
+        ok = True
+        for attr in attr_chain:
+            if not hasattr(obj, attr):
+                ok = False
+                break
+            obj = getattr(obj, attr)
+        if ok:
+            return list(obj)
+    raise ValueError("could not find a BERT encoder layer list in model")
+
+
+def replace_transformer_layer(orig_layer_impl, model, micro_batch_size=-1,
+                              bert_config=None, seed=-1, max_seq_length=512,
+                              preln=False, fp16=True, huggingface=False,
+                              local_rank=-1, training=True):
+    """Build fused TPU layers from a torch BERT model's weights.
+
+    Returns (layers, params_list, encoder_fn) where
+    ``encoder_fn(params_list, hidden_states, attention_mask)`` runs the
+    full fused encoder stack (jittable).
+    """
+    bert_layers = _find_bert_layers(model)
+    hidden = bert_config.hidden_size
+    cfg = DeepSpeedTransformerConfig(
+        batch_size=micro_batch_size,
+        hidden_size=hidden,
+        intermediate_size=bert_config.intermediate_size,
+        heads=bert_config.num_attention_heads,
+        attn_dropout_ratio=bert_config.attention_probs_dropout_prob,
+        hidden_dropout_ratio=bert_config.hidden_dropout_prob,
+        num_hidden_layers=bert_config.num_hidden_layers,
+        initializer_range=bert_config.initializer_range,
+        layer_norm_eps=getattr(bert_config, "layer_norm_eps", 1e-12),
+        seed=seed,
+        fp16=fp16,
+        pre_layer_norm=preln,
+        huggingface=huggingface,
+        local_rank=local_rank,
+        training=training)
+
+    layers = []
+    params_list = []
+    for bert_layer in bert_layers:
+        layer = DeepSpeedTransformerLayer(cfg)
+        layers.append(layer)
+        params_list.append(extract_bert_layer_params(bert_layer))
+
+    def encoder_fn(params_list, hidden_states, attention_mask=None,
+                   rng=None, deterministic=True):
+        x = jnp.asarray(hidden_states)
+        for layer, params in zip(layers, params_list):
+            x = layer.apply(params, x, attention_mask=attention_mask,
+                            rng=rng, deterministic=deterministic)
+        return x
+
+    return layers, params_list, encoder_fn

@@ -1,0 +1,2 @@
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer, TransformerConfig)
