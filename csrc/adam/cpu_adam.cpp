@@ -1,0 +1,124 @@
+// Host-side Adam for the ZeRO-Offload tier.
+//
+// TPU-native equivalent of the reference's AVX CPU-Adam
+// (csrc/adam/cpu_adam.cpp, csrc/includes/cpu_adam.h): steps fp32 master
+// shards resident in host DRAM while the chips run the next microbatches.
+// The reference hand-writes AVX256/AVX512 intrinsics with 4x/8x unrolls;
+// this implementation uses OpenMP-style threading via C++ threads plus
+// compiler auto-vectorization (-O3 -march=native), which reaches memory-
+// bandwidth-bound throughput on the same loop shape. Exposed via C ABI for
+// ctypes (no pybind11 in this image).
+
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct AdamArgs {
+    float* params;
+    const float* grads;
+    float* exp_avg;
+    float* exp_avg_sq;
+    int64_t n;
+    float lr;
+    float beta1;
+    float beta2;
+    float eps;
+    float weight_decay;
+    float bias_corr1;
+    float bias_corr2;
+    bool adam_w;  // decoupled decay vs classic L2
+    // bf16 shadow copy of updated params, written in the same pass so the
+    // device upload needs no separate cast sweep (the reference overlaps
+    // the device copy similarly via Step_4/Step_8).
+    uint16_t* bf16_out;
+};
+
+inline uint16_t float_to_bf16(float value) {
+    uint32_t bits;
+    __builtin_memcpy(&bits, &value, sizeof(bits));
+    // round-to-nearest-even on the truncated mantissa
+    uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+    return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+void adam_span(const AdamArgs& a, int64_t begin, int64_t end) {
+    const float one_minus_b1 = 1.0f - a.beta1;
+    const float one_minus_b2 = 1.0f - a.beta2;
+    const float inv_bc1 = 1.0f / a.bias_corr1;
+    const float inv_bc2_sqrt = 1.0f / std::sqrt(a.bias_corr2);
+    for (int64_t i = begin; i < end; ++i) {
+        float g = a.grads[i];
+        float p = a.params[i];
+        if (a.weight_decay != 0.0f && !a.adam_w) g += a.weight_decay * p;
+        float m = a.beta1 * a.exp_avg[i] + one_minus_b1 * g;
+        float v = a.beta2 * a.exp_avg_sq[i] + one_minus_b2 * g * g;
+        a.exp_avg[i] = m;
+        a.exp_avg_sq[i] = v;
+        float update = (m * inv_bc1) /
+                       (std::sqrt(v) * inv_bc2_sqrt + a.eps);
+        if (a.weight_decay != 0.0f && a.adam_w) update += a.weight_decay * p;
+        p -= a.lr * update;
+        a.params[i] = p;
+        if (a.bf16_out != nullptr) a.bf16_out[i] = float_to_bf16(p);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// One fused Adam pass over a flat fp32 shard. step is 1-based.
+void ds_cpu_adam_step(float* params, const float* grads, float* exp_avg,
+                      float* exp_avg_sq, int64_t n, int step, float lr,
+                      float beta1, float beta2, float eps,
+                      float weight_decay, int adam_w_mode,
+                      int bias_correction, uint16_t* bf16_out,
+                      int num_threads) {
+    AdamArgs args;
+    args.params = params;
+    args.grads = grads;
+    args.exp_avg = exp_avg;
+    args.exp_avg_sq = exp_avg_sq;
+    args.n = n;
+    args.lr = lr;
+    args.beta1 = beta1;
+    args.beta2 = beta2;
+    args.eps = eps;
+    args.weight_decay = weight_decay;
+    args.adam_w = adam_w_mode != 0;
+    args.bf16_out = bf16_out;
+    if (bias_correction != 0) {
+        args.bias_corr1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+        args.bias_corr2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+    } else {
+        args.bias_corr1 = 1.0f;
+        args.bias_corr2 = 1.0f;
+    }
+
+    int threads = num_threads > 0
+                      ? num_threads
+                      : static_cast<int>(
+                            std::thread::hardware_concurrency());
+    if (threads <= 1 || n < (1 << 16)) {
+        adam_span(args, 0, n);
+        return;
+    }
+    std::vector<std::thread> pool;
+    int64_t chunk = (n + threads - 1) / threads;
+    // Align chunk starts to 16 floats to keep spans vector-friendly.
+    chunk = (chunk + 15) & ~int64_t(15);
+    for (int t = 0; t < threads; ++t) {
+        int64_t begin = t * chunk;
+        if (begin >= n) break;
+        int64_t end = std::min(n, begin + chunk);
+        pool.emplace_back([args, begin, end] {
+            adam_span(args, begin, end);
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

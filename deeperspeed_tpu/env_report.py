@@ -1,0 +1,81 @@
+"""`ds_report` environment report (reference: `deepspeed/env_report.py`).
+
+Reports the op/kernel availability matrix (Pallas kernels replace the JIT
+CUDA op builders) and the JAX/TPU environment instead of torch/CUDA.
+"""
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+SUCCESS = f"{GREEN}[YES]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+FAIL = f"{RED}[FAIL]{END}"
+OKAY = f"{GREEN}[OKAY]{END}"
+
+
+def op_report():
+    """Kernel/feature availability matrix."""
+    from .ops.compat import ALL_OPS
+
+    max_dots = 23
+    print("-" * 64)
+    print("DeeperSpeed-TPU op/kernel report")
+    print("-" * 64)
+    print("op name", "." * max_dots, "available")
+    print("-" * 64)
+    rows = []
+    for name, check in ALL_OPS.items():
+        try:
+            ok = check()
+        except Exception:
+            ok = False
+        status = OKAY if ok else FAIL
+        print(name, "." * (max_dots + 8 - len(name)), status)
+        rows.append((name, ok))
+    print("-" * 64)
+    return rows
+
+
+def debug_report():
+    import jax
+
+    import numpy as np
+
+    from .version import __version__
+
+    devices = jax.devices()
+    rows = [
+        ("deeperspeed_tpu version", __version__),
+        ("jax version", jax.__version__),
+        ("numpy version", np.__version__),
+        ("default backend", jax.default_backend()),
+        ("device count", len(devices)),
+        ("device kind", getattr(devices[0], "device_kind", "unknown")
+         if devices else "none"),
+        ("process count", jax.process_count()),
+    ]
+    try:
+        import flax
+        rows.append(("flax version", flax.__version__))
+    except ImportError:
+        pass
+    print("-" * 64)
+    print("DeeperSpeed-TPU general environment info:")
+    for name, value in rows:
+        print(f"{name} ................ {value}")
+    print("-" * 64)
+    return rows
+
+
+def main():
+    op_report()
+    debug_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

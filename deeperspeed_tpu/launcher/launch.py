@@ -1,0 +1,79 @@
+"""Per-node launcher (reference: `deepspeed/launcher/launch.py:69`).
+
+The reference spawns one subprocess per local GPU rank with
+RANK/LOCAL_RANK/WORLD_SIZE/MASTER_* env vars. On TPU one process drives
+every local chip, so this spawns ONE subprocess per node (rank ==
+node_rank) and exports the jax.distributed rendezvous env; ``DS_SLOTS``
+carries the chip count for the hostfile's slots= entry. Signal handling
+matches the reference: SIGINT/SIGTERM kill the child process group.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from ..utils.logging import logger
+from .runner import decode_world_info
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeeperSpeed-TPU per-node launcher")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--world_info", type=str, default="None",
+                        help="base64-encoded {hostname: slots} dict")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    if args.world_info == "None":
+        world_info = {"localhost": None}
+    else:
+        world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    world_size = len(hosts)
+    node_rank = args.node_rank
+    slots = world_info[hosts[min(node_rank, world_size - 1)]]
+
+    env = dict(os.environ)
+    env["RANK"] = str(node_rank)
+    env["LOCAL_RANK"] = "0"
+    env["WORLD_SIZE"] = str(world_size)
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    env["NODE_RANK"] = str(node_rank)
+    if slots is not None:
+        env["DS_SLOTS"] = str(slots)
+
+    cmd = [sys.executable, "-u", args.user_script] + args.user_args
+    logger.info(f"launching: {' '.join(cmd)} (rank {node_rank}/"
+                f"{world_size})")
+    process = subprocess.Popen(cmd, env=env)
+
+    def sig_handler(signum, frame):
+        logger.info(f"Received signal {signum}, killing child "
+                    f"{process.pid}")
+        try:
+            process.terminate()
+        except OSError:
+            pass
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+
+    process.wait()
+    if process.returncode != 0:
+        sys.exit(process.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""Multinode launcher backends (reference:
+`deepspeed/launcher/multinode_runner.py`): pdsh, OpenMPI, MVAPICH, Slurm
+(srun, fork addition) and MosaicML (fork addition).
+
+Each runner constructs the command line that starts the per-node launcher
+(`deeperspeed_tpu.launcher.launch`) on every host. One process per host
+(JAX addresses all local chips); the per-process env carries the
+jax.distributed rendezvous.
+"""
+
+import os
+import shutil
+import sys
+from abc import ABC, abstractmethod
+from shlex import split
+
+from ..utils.logging import logger
+
+
+class MultiNodeRunner(ABC):
+    def __init__(self, args, world_info_base64=None):
+        self.args = args
+        self.user_arguments = self.parse_user_args()
+        self.user_script = args.user_script
+        self.world_info_base64 = world_info_base64
+        self.exports = {}
+
+    @abstractmethod
+    def backend_exists(self):
+        ...
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        ...
+
+    def add_export(self, key, var):
+        self.exports[key.strip()] = var.strip()
+
+    def parse_user_args(self):
+        return self.args.user_args
+
+    @property
+    def name(self):
+        return self.__class__.__name__
+
+
+class PDSHRunner(MultiNodeRunner):
+    def __init__(self, args, world_info_base64=None):
+        super().__init__(args, world_info_base64)
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        active_workers = ",".join(active_resources.keys())
+        logger.info(f"Running on the following workers: {active_workers}")
+
+        pdsh_cmd = ["pdsh", "-f", "1024", "-w", active_workers]
+        exports = ""
+        for key, val in self.exports.items():
+            exports += f"export {key}={val}; "
+
+        from .runner import encode_world_info
+        world_info = encode_world_info(dict(active_resources))
+        deepspeed_launch = [
+            exports, f"cd {os.path.abspath('.')};",
+            sys.executable, "-u", "-m",
+            "deeperspeed_tpu.launcher.launch",
+            f"--world_info={world_info}",
+            "--node_rank=%n",
+            f"--master_addr={environment['MASTER_ADDR']}",
+            f"--master_port={environment['MASTER_PORT']}",
+        ]
+        return pdsh_cmd + deepspeed_launch + [self.user_script] + \
+            self.user_arguments
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    def __init__(self, args, world_info_base64=None, resource_pool=None):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self):
+        return shutil.which("ompi_info") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_processes = len(active_resources)  # one process per host
+        mpirun_cmd = [
+            "mpirun", "-n", f"{total_processes}",
+            "-hostfile", self.args.hostfile,
+            "--mca", "btl", "^openib",
+            "--mca", "btl_tcp_if_include", "eth0",
+        ] + split(self.args.launcher_args)
+        export_cmd = []
+        for key, val in self.exports.items():
+            export_cmd += ["-x", f"{key}={val}"]
+        python_exec = [sys.executable, "-u"]
+        return mpirun_cmd + export_cmd + python_exec + \
+            [self.user_script] + self.user_arguments
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    def __init__(self, args, world_info_base64=None, resource_pool=None):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+        # TPU hosts talk over standard TCP/IP; MVAPICH's InfiniBand-specific
+        # tuning from the reference is irrelevant here.
+        self.add_export("MV2_SMP_USE_CMA", "0")
+        self.add_export("MV2_DEBUG_SHOW_BACKTRACE", "1")
+
+    def backend_exists(self):
+        mpiname = shutil.which("mpiname")
+        if mpiname is None:
+            logger.warning("mpiname does not exist")
+            return False
+        import subprocess
+        results = subprocess.check_output(["mpiname"]).decode("utf-8")
+        return "MVAPICH2-GDR" in results or "MVAPICH" in results
+
+    def get_cmd(self, environment, active_resources):
+        devices_per_node = active_resources.values()
+        total_process_count = len(active_resources)
+        process_per_node = 1
+        if len(set(devices_per_node)) != 1:
+            logger.warning("mvapich requires same number of chips per node")
+
+        with open("hostfile", "w") as fd:
+            for host in active_resources.keys():
+                fd.write(f"{host}:{process_per_node}\n")
+
+        mpirun_cmd = [
+            "mpirun", "-np", f"{total_process_count}",
+            "-ppn", f"{process_per_node}",
+            "--hostfile", "hostfile",
+        ] + split(self.args.launcher_args)
+        export_cmd = []
+        for key, val in self.exports.items():
+            export_cmd += ["-env", f"{key}={val}"]
+        python_exec = [sys.executable, "-u"]
+        return mpirun_cmd + export_cmd + python_exec + \
+            [self.user_script] + self.user_arguments
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun-based launcher (fork addition: reference
+    `multinode_runner.py:124`, incl. `--comment` passthrough)."""
+
+    def __init__(self, args, world_info_base64=None, resource_pool=None):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self):
+        return shutil.which("sinfo") is not None
+
+    def get_cmd(self, environment, active_resources):
+        assert not getattr(self.args, "detect_nvlink_pairs", False), \
+            "slurm backend does not support remapping visible devices"
+        total_process_count = len(active_resources)
+        srun_cmd = [
+            "srun", "-n", f"{total_process_count}",
+        ] + split(self.args.launcher_args)
+
+        if getattr(self.args, "include", ""):
+            srun_cmd.append("--include")
+            srun_cmd.append(f"{self.args.include}")
+        if getattr(self.args, "exclude", ""):
+            srun_cmd.append("--exclude")
+            srun_cmd.append(f"{self.args.exclude}")
+        if getattr(self.args, "num_nodes", -1) > 0:
+            srun_cmd.append("--nodes")
+            srun_cmd.append(f"{self.args.num_nodes}")
+        if getattr(self.args, "comment", ""):
+            srun_cmd.append("--comment")
+            srun_cmd.append(f"{self.args.comment}")
+
+        exports = ""
+        for key, val in self.exports.items():
+            exports += f"{key}={val},"
+        if exports:
+            srun_cmd += ["--export", exports.rstrip(",")]
+
+        python_exec = [sys.executable, "-u"]
+        return srun_cmd + python_exec + [self.user_script] + \
+            self.user_arguments
+
+
+class MosaicMLRunner(MultiNodeRunner):
+    """MosaicML platform launcher (fork addition: reference
+    `multinode_runner.py:256`); rendezvous comes from the platform's env."""
+
+    def __init__(self, args, world_info_base64=None, resource_pool=None):
+        super().__init__(args, world_info_base64)
+        self.resource_pool = resource_pool
+
+    def backend_exists(self):
+        return os.environ.get("MOSAICML_PLATFORM", "") != ""
+
+    def get_cmd(self, environment, active_resources):
+        python_exec = [sys.executable, "-u", "-m",
+                       "deeperspeed_tpu.launcher.launch"]
+        from .runner import encode_world_info
+        world_info = encode_world_info(dict(active_resources))
+        launch_args = [
+            f"--world_info={world_info}",
+            f"--node_rank={os.environ.get('NODE_RANK', '0')}",
+            f"--master_addr={environment['MASTER_ADDR']}",
+            f"--master_port={environment['MASTER_PORT']}",
+        ]
+        return python_exec + launch_args + [self.user_script] + \
+            self.user_arguments

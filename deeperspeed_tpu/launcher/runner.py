@@ -1,0 +1,232 @@
+"""`deepspeed` CLI launcher (reference: `deepspeed/launcher/runner.py`).
+
+Same front-end contract: hostfile with ``hostname slots=N`` lines,
+``--include``/``--exclude`` resource filters, base64 world-info handoff,
+and a pluggable multinode backend (pdsh / OpenMPI / MVAPICH / Slurm /
+MosaicML — the fork's additions included).
+
+TPU semantics: a "slot" is a chip; the launcher starts ONE process per
+host (JAX addresses all local chips from one process) and exports
+``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/``MASTER_PORT`` for
+`jax.distributed.initialize` plus ``DS_SLOTS`` with the chip count. On
+TPU pods the pod runtime usually launches processes itself — then this CLI
+degenerates to the single-node exec path.
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import subprocess
+import sys
+from copy import deepcopy
+
+from ..utils.logging import logger
+from .multinode_runner import (MosaicMLRunner, MVAPICHRunner, OpenMPIRunner,
+                               PDSHRunner, SlurmRunner)
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["JAX", "XLA", "TPU", "PYTHON", "PATH", "LD_LIBRARY"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEEPSPEED_ENVIRONMENT_PATHS = [".", os.path.expanduser("~")]
+PDSH_MAX_FAN_OUT = 1024
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeeperSpeed-TPU distributed launcher")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path: lines of 'hostname slots=N'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Resources to include: "
+                        "NODE_SPEC[@NODE_SPEC ...], NODE_SPEC = "
+                        "NAME[:SLOT[,SLOT ...]]")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Resources to exclude (same syntax)")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        help="pdsh | openmpi | mvapich | slurm | mosaicml")
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--comment", type=str, default="",
+                        help="Run comment passed to the Slurm launcher "
+                        "(fork addition)")
+    parser.add_argument("--detect_nvlink_pairs", action="store_true",
+                        help="Accepted for CLI compat; no-op on TPU "
+                        "(ICI topology is fixed)")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse ``hostname slots=N`` lines → OrderedDict[host] = slots."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning("Unable to find hostfile, will proceed with training "
+                       "with local resources only.")
+        return None
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path, "r") as fd:
+        for line in fd:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                logger.error("Hostfile is not formatted correctly, unable "
+                             "to proceed with training.")
+                raise
+            if hostname in resource_pool:
+                raise ValueError(f"host {hostname} is already defined")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """Filter a hostfile dict by include/exclude strings
+    (NODE_SPEC[@NODE_SPEC ...], NODE_SPEC = NAME[:SLOT[,SLOT ...]])."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually "
+                         "exclusive.")
+    if not include_str and not exclude_str:
+        return host_info
+
+    filtered_hosts = {}
+    if include_str:
+        parse_str = include_str
+    else:
+        filtered_hosts = deepcopy(host_info)
+        parse_str = exclude_str
+
+    for node_config in parse_str.split("@"):
+        if ":" in node_config:
+            hostname, slots = node_config.split(":")
+            slots = [int(x) for x in slots.split(",")]
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not found in "
+                                 "hostfile")
+            for slot in slots:
+                if slot >= host_info[hostname]:
+                    raise ValueError(f"No slot '{slot}' specified on host "
+                                     f"'{hostname}'")
+            if include_str:
+                filtered_hosts.setdefault(hostname, 0)
+                filtered_hosts[hostname] += len(slots)
+            else:
+                filtered_hosts[hostname] -= len(slots)
+                if filtered_hosts[hostname] <= 0:
+                    del filtered_hosts[hostname]
+        else:
+            hostname = node_config
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not found in "
+                                 "hostfile")
+            if include_str:
+                filtered_hosts[hostname] = host_info[hostname]
+            elif hostname in filtered_hosts:
+                del filtered_hosts[hostname]
+
+    ordered = collections.OrderedDict(
+        (host, filtered_hosts[host]) for host in host_info
+        if host in filtered_hosts)
+    return ordered
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    active_resources = collections.OrderedDict()
+    for hostname, slots in resource_pool.items():
+        active_resources[hostname] = slots
+    return parse_resource_filter(active_resources, include_str=inclusion,
+                                 exclude_str=exclusion)
+
+
+def encode_world_info(world_info):
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded))
+
+
+def _ds_env_exports():
+    """Collect extra env exports from a .deepspeed_env file."""
+    exports = {}
+    for path in DEEPSPEED_ENVIRONMENT_PATHS:
+        env_file = os.path.join(path, DEEPSPEED_ENVIRONMENT_NAME)
+        if os.path.isfile(env_file):
+            with open(env_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and "=" in line:
+                        key, val = line.split("=", 1)
+                        exports[key] = val
+    return exports
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if not resource_pool:
+        # Single node: exec the per-node launcher in-process.
+        from .launch import main as launch_main
+        world_info = {"localhost": args.num_gpus if args.num_gpus > 0
+                      else None}
+        encoded = encode_world_info(world_info)
+        argv = ["--world_info", encoded,
+                "--master_port", str(args.master_port),
+                args.user_script] + args.user_args
+        return launch_main(argv)
+
+    active_resources = parse_inclusion_exclusion(resource_pool,
+                                                 args.include, args.exclude)
+    if args.num_nodes > 0:
+        active_resources = collections.OrderedDict(
+            list(active_resources.items())[:args.num_nodes])
+    if args.num_gpus > 0:
+        active_resources = collections.OrderedDict(
+            (k, min(v, args.num_gpus)) for k, v in active_resources.items())
+
+    master_addr = args.master_addr or list(active_resources.keys())[0]
+
+    runners = {
+        "pdsh": PDSHRunner,
+        "openmpi": OpenMPIRunner,
+        "mvapich": MVAPICHRunner,
+        "slurm": SlurmRunner,
+        "mosaicml": MosaicMLRunner,
+    }
+    if args.launcher.lower() not in runners:
+        raise NotImplementedError(
+            f"Unknown launcher {args.launcher}; valid: "
+            f"{sorted(runners)}")
+    runner = runners[args.launcher.lower()](args, active_resources)
+    if not runner.backend_exists():
+        raise RuntimeError(
+            f"launcher backend '{args.launcher}' not installed")
+
+    world_info = encode_world_info(dict(active_resources))
+    env = dict(os.environ)
+    env.update(_ds_env_exports())
+    env["MASTER_ADDR"] = master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+
+    cmd = runner.get_cmd(env, active_resources)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    if result.returncode > 0:
+        sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

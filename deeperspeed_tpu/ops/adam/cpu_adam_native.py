@@ -1,0 +1,102 @@
+"""ctypes binding + host optimizer around the C++ CPU Adam
+(`csrc/adam/cpu_adam.cpp`; reference wrapper:
+`deepspeed/ops/adam/cpu_adam.py`).
+
+Used by the ZeRO-Offload tier: fp32 masters + moments live in host DRAM as
+numpy arrays; each step runs the fused C++ kernel per flat shard and emits
+a bf16 shadow for upload, so the device only ever holds compute-dtype
+params.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+from ...utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "..", "csrc",
+                     "adam", "cpu_adam.cpp")
+_SO_PATH = os.path.join(tempfile.gettempdir(),
+                        "deeperspeed_tpu_cpu_adam.so")
+_lib = None
+_lock = threading.Lock()
+
+
+def _build_library():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.abspath(_CSRC)
+        if not os.path.isfile(_SO_PATH) or \
+                os.path.getmtime(_SO_PATH) < os.path.getmtime(src):
+            cmd = ["g++", "-O3", "-march=native", "-funroll-loops",
+                   "-shared", "-fPIC", "-std=c++17", "-pthread", src,
+                   "-o", _SO_PATH]
+            logger.info(f"building cpu adam: {' '.join(cmd)}")
+            subprocess.check_call(cmd)
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.ds_cpu_adam_step.restype = None
+        lib.ds_cpu_adam_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_int,
+        ]
+        _lib = lib
+        return lib
+
+
+def cpu_adam_available():
+    try:
+        _build_library()
+        return True
+    except Exception:
+        return False
+
+
+def _ptr(arr):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativeCPUAdam:
+    """Host-resident Adam over flat numpy shards.
+
+    state: dict with 'step' (int), and per-leaf flat fp32 arrays 'master',
+    'exp_avg', 'exp_avg_sq' stored in self — the caller owns only grads.
+    """
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, bias_correction=True, adam_w_mode=True,
+                 num_threads=0):
+        self._lib = _build_library()
+        self.param_groups = [{
+            "lr": lr, "betas": tuple(betas), "eps": eps,
+            "weight_decay": weight_decay,
+            "bias_correction": bias_correction,
+        }]
+        self.adam_w_mode = adam_w_mode
+        self.num_threads = num_threads
+        self.step_count = 0
+
+    def step_flat(self, master, grads, exp_avg, exp_avg_sq, lr=None,
+                  bf16_out=None):
+        """One in-place Adam step on a flat fp32 shard."""
+        g = self.param_groups[0]
+        self.step_count += 1
+        lr = float(g["lr"] if lr is None else lr)
+        master = np.ascontiguousarray(master, np.float32)
+        grads = np.ascontiguousarray(grads, np.float32)
+        assert master.shape == grads.shape == exp_avg.shape == \
+            exp_avg_sq.shape
+        bf16_ptr = _ptr(bf16_out) if bf16_out is not None else None
+        self._lib.ds_cpu_adam_step(
+            _ptr(master), _ptr(grads), _ptr(exp_avg), _ptr(exp_avg_sq),
+            master.size, self.step_count, lr, g["betas"][0], g["betas"][1],
+            g["eps"], g["weight_decay"], int(self.adam_w_mode),
+            int(g["bias_correction"]), bf16_ptr, self.num_threads)
+        return master

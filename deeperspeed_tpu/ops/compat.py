@@ -1,0 +1,76 @@
+"""Op availability registry — the TPU analogue of `op_builder/`
+(reference: `op_builder/builder.py:81`, per-op `is_compatible()`).
+
+The reference JIT-compiles CUDA extensions at first use; every Pallas
+kernel here is compiled by XLA on first call, so "availability" is a
+capability probe (backend, shape constraints), not a build step. `ds_report`
+prints this matrix (reference `env_report.py:23`).
+"""
+
+
+def _on_tpu():
+    import jax
+    try:
+        return jax.default_backend() == "tpu" or \
+            "TPU" in str(jax.devices()[0])
+    except Exception:
+        return False
+
+
+def fused_adam_available():
+    from .adam.fused_adam import FusedAdam  # noqa: F401
+    return True
+
+
+def cpu_adam_available():
+    from .adam.fused_adam import DeepSpeedCPUAdam  # noqa: F401
+    return True
+
+
+def fused_lamb_available():
+    from .lamb.fused_lamb import FusedLamb  # noqa: F401
+    return True
+
+
+def transformer_available():
+    from .transformer import DeepSpeedTransformerLayer  # noqa: F401
+    return True
+
+
+def stochastic_transformer_available():
+    # stochastic_mode is accepted by DeepSpeedTransformerConfig; bf16
+    # compute supersedes the CUDA stochastic rounding mode.
+    return transformer_available()
+
+
+def flash_attention_available():
+    from .pallas.flash_attention import flash_attention  # noqa: F401
+    return True
+
+
+def sparse_attn_available():
+    from .sparse_attention import SparseSelfAttention  # noqa: F401
+    return True
+
+
+def async_io_available():
+    from ..runtime.swap_tensor.aio_engine import AsyncIOEngine
+    return AsyncIOEngine.available()
+
+
+def utils_available():
+    # flatten/unflatten is native jnp (ravel/concatenate); always present.
+    return True
+
+
+ALL_OPS = {
+    "fused_adam": fused_adam_available,
+    "cpu_adam": cpu_adam_available,
+    "fused_lamb": fused_lamb_available,
+    "transformer": transformer_available,
+    "stochastic_transformer": stochastic_transformer_available,
+    "flash_attention": flash_attention_available,
+    "sparse_attn": sparse_attn_available,
+    "async_io": async_io_available,
+    "utils": utils_available,
+}

@@ -1,0 +1,203 @@
+"""Activation checkpointing (reference:
+`deepspeed/runtime/activation_checkpointing/checkpointing.py`).
+
+The reference reimplements Megatron's checkpointing: recompute-in-backward
+with CUDA RNG state capture/restore (`CudaRNGStatesTracker`), optional
+partitioning of saved activations across model-parallel ranks, CPU offload
+of checkpoints, and contiguous preallocated buffers.
+
+On TPU each concern maps to a JAX-native mechanism:
+
+- recompute-in-backward         → `jax.checkpoint` (remat).
+- RNG capture/restore           → free: JAX PRNG keys are explicit values,
+  so recomputation replays dropout identically by construction. The
+  tracker API is kept for Megatron-style callers.
+- partition_activations         → saved residuals carry a `model`-axis
+  sharding constraint, so each MP rank stores 1/mp of every checkpoint.
+- cpu_checkpointing             → remat policy offloads saved dots to
+  host memory (`save_and_offload_only_these_names` / device_put policy).
+- contiguous_memory_optimization / synchronize_checkpoint_boundary →
+  no-ops: XLA owns allocation and scheduling.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+from .config import DeepSpeedActivationCheckpointingConfig
+
+_config = DeepSpeedActivationCheckpointingConfig()
+_mpu = None
+_configured = False
+
+# Offload saved residuals to host when cpu_checkpointing is on.
+_CPU_POLICY = jax.checkpoint_policies.save_and_offload_only_these_names(
+    names_which_can_be_saved=[],
+    names_which_can_be_offloaded=["ds_checkpoint"],
+    offload_src="device", offload_dst="pinned_host")
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Configure the checkpointing subsystem (reference
+    `checkpointing.py:769`)."""
+    global _config, _mpu, _configured
+    _mpu = mpu_
+    if deepspeed_config is not None:
+        if hasattr(deepspeed_config, "activation_checkpointing_config"):
+            _config = deepspeed_config.activation_checkpointing_config
+        else:
+            _config = DeepSpeedActivationCheckpointingConfig.from_dict(
+                deepspeed_config if isinstance(deepspeed_config, dict)
+                else {})
+    overrides = {
+        "partition_activations": partition_activations,
+        "contiguous_memory_optimization": contiguous_checkpointing,
+        "number_checkpoints": num_checkpoints,
+        "cpu_checkpointing": checkpoint_in_cpu,
+        "synchronize_checkpoint_boundary": synchronize,
+        "profile": profile,
+    }
+    updates = {k: v for k, v in overrides.items() if v is not None}
+    if updates:
+        import dataclasses
+        _config = dataclasses.replace(_config, **updates)
+    _configured = True
+
+
+def is_configured():
+    return _configured
+
+
+def _policy():
+    if _config.cpu_checkpointing:
+        return _CPU_POLICY
+    return None  # full remat: save nothing, recompute everything
+
+
+def checkpoint(function, *args):
+    """Checkpoint a forward span: recompute it during backward (reference
+    `checkpointing.py:687`). Dropout/noise inside replays identically
+    because PRNG keys are explicit arguments."""
+    policy = _policy()
+    wrapped = jax.checkpoint(function, policy=policy) if policy is not None \
+        else jax.checkpoint(function)
+
+    if _config.partition_activations and _mpu is not None:
+        axis = None
+        if hasattr(_mpu, "get_slice_parallel_group"):
+            axis = _mpu.get_slice_parallel_group()
+        if isinstance(axis, str):
+            # Shard the span inputs over the model axis so each MP rank
+            # holds 1/mp of every saved checkpoint (reference
+            # `partition_activations` semantics).
+            from jax.sharding import PartitionSpec
+
+            def constrain(x):
+                if hasattr(x, "ndim") and x.ndim >= 2:
+                    spec = [None] * x.ndim
+                    spec[1] = axis
+                    try:
+                        return jax.lax.with_sharding_constraint(
+                            x, PartitionSpec(*spec))
+                    except Exception:
+                        return x
+                return x
+
+            args = tuple(jax.tree_util.tree_map(constrain, a)
+                         for a in args)
+    return wrapped(*args)
+
+
+def checkpoint_wrapper(fn):
+    """Decorator form."""
+    return partial(checkpoint, fn)
+
+
+# ---------------------------------------------------------------------------
+# RNG tracker API (reference `checkpointing.py:198`-): Megatron callers
+# expect named RNG states whose capture/restore makes dropout reproducible
+# under recompute. With JAX's explicit keys this is bookkeeping only.
+# ---------------------------------------------------------------------------
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class CudaRNGStatesTracker:
+    """Named PRNG key registry (name kept for API compatibility)."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise Exception(f"seed {seed} already present")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise Exception(f"RNG state {name} already present")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Context manager yielding the named key; the stored state is
+        advanced so successive forks differ."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _fork():
+            if name not in self.states_:
+                raise Exception(f"RNG state {name} is not added")
+            key, sub = jax.random.split(self.states_[name])
+            self.states_[name] = key
+            yield sub
+
+        return _fork()
+
+
+_CUDA_RNG_STATE_TRACKER = CudaRNGStatesTracker()
+
+
+def get_cuda_rng_tracker():
+    return _CUDA_RNG_STATE_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed):
+    """Seed data-parallel and model-parallel RNG streams (reference
+    `checkpointing.py:198`): MP ranks get offset seeds so dropout differs
+    across tensor-parallel shards of one layer."""
+    global _CUDA_RNG_STATE_TRACKER
+    mp_rank = 0
+    if _mpu is not None and hasattr(_mpu, "get_slice_parallel_rank"):
+        mp_rank = _mpu.get_slice_parallel_rank()
+    offset = seed + 2718
+    model_parallel_seed = offset + mp_rank
+    _CUDA_RNG_STATE_TRACKER.reset()
+    _CUDA_RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME,
+                                model_parallel_seed)
+    return jax.random.PRNGKey(seed)
+
+
+def reset():
+    """Reset between batches (reference keeps buffers; we keep nothing)."""
+
+
+def partition_activations_in_checkpoint(partition_activation):
+    import dataclasses
+    global _config
+    _config = dataclasses.replace(
+        _config, partition_activations=partition_activation)
+    logger.info(f"**************Partition Activations "
+                f"{partition_activation}************")

@@ -1,0 +1,145 @@
+"""ctypes binding for the C++ async-IO engine (reference:
+`csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`, `py_ds_aio.cpp` pybind
+module).
+
+Builds `csrc/aio/aio_engine.cpp` with g++ on first use (cached .so beside
+the package); falls back to a Python thread-pool engine if no compiler is
+available, keeping the API identical.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+from ...utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "..", "csrc",
+                     "aio", "aio_engine.cpp")
+_SO_PATH = os.path.join(tempfile.gettempdir(),
+                        "deeperspeed_tpu_aio_engine.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_library():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.abspath(_CSRC)
+        if not os.path.isfile(src):
+            raise FileNotFoundError(src)
+        if not os.path.isfile(_SO_PATH) or \
+                os.path.getmtime(_SO_PATH) < os.path.getmtime(src):
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                   "-pthread", src, "-o", _SO_PATH]
+            logger.info(f"building aio engine: {' '.join(cmd)}")
+            subprocess.check_call(cmd)
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.aio_engine_create.restype = ctypes.c_void_p
+        lib.aio_engine_create.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.aio_engine_destroy.argtypes = [ctypes.c_void_p]
+        for fn in (lib.aio_pread, lib.aio_pwrite):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                           ctypes.c_int]
+        lib.aio_wait.restype = ctypes.c_int64
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+        lib.aio_pending.restype = ctypes.c_int64
+        lib.aio_pending.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class AsyncIOEngine:
+    """Async reads/writes of numpy buffers against files.
+
+    Mirrors the reference handle API (`aio_read`/`aio_write`/`wait`,
+    `sync_pread`/`sync_pwrite`) with the "aio" config knobs.
+    """
+
+    def __init__(self, block_size=1048576, queue_depth=8, thread_count=1,
+                 single_submit=False, overlap_events=True,
+                 use_direct=False):
+        self._lib = _build_library()
+        self._handle = self._lib.aio_engine_create(
+            block_size, queue_depth, thread_count, int(single_submit),
+            int(overlap_events))
+        self.use_direct = use_direct
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+        # Keep buffers alive until wait() — async writes read from them.
+        self._inflight = []
+
+    @staticmethod
+    def available():
+        try:
+            _build_library()
+            return True
+        except Exception:
+            return False
+
+    @classmethod
+    def from_config(cls, aio_config):
+        return cls(block_size=aio_config.block_size,
+                   queue_depth=aio_config.queue_depth,
+                   thread_count=aio_config.thread_count,
+                   single_submit=aio_config.single_submit,
+                   overlap_events=aio_config.overlap_events)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.aio_engine_destroy(handle)
+            self._handle = None
+
+    # -- async API ---------------------------------------------------------
+
+    def aio_read(self, buffer, path, offset=0):
+        """Start an async read of len(buffer) bytes into `buffer`
+        (np.ndarray, C-contiguous, writable)."""
+        buffer = np.ascontiguousarray(buffer)
+        self._inflight.append(buffer)
+        return self._lib.aio_pread(
+            self._handle, path.encode(),
+            buffer.ctypes.data_as(ctypes.c_void_p), buffer.nbytes,
+            offset, int(self.use_direct))
+
+    def aio_write(self, buffer, path, offset=0):
+        buffer = np.ascontiguousarray(buffer)
+        self._inflight.append(buffer)
+        return self._lib.aio_pwrite(
+            self._handle, path.encode(),
+            buffer.ctypes.data_as(ctypes.c_void_p), buffer.nbytes,
+            offset, int(self.use_direct))
+
+    def wait(self):
+        """Block until all outstanding requests finish; raises on IO
+        errors."""
+        rc = self._lib.aio_wait(self._handle)
+        self._inflight.clear()
+        if rc < 0:
+            raise IOError(f"aio engine reported {-rc} failed requests")
+        return rc
+
+    def pending(self):
+        return self._lib.aio_pending(self._handle)
+
+    # -- sync convenience --------------------------------------------------
+
+    def sync_pwrite(self, buffer, path, offset=0):
+        self.aio_write(buffer, path, offset)
+        return self.wait()
+
+    def sync_pread(self, buffer, path, offset=0):
+        self.aio_read(buffer, path, offset)
+        return self.wait()

@@ -1,0 +1,48 @@
+"""Generic async tensor swapper (reference:
+`deepspeed/runtime/swap_tensor/async_swapper.py:16`).
+
+Streams host-resident numpy tensors to/from files through the C++ aio
+engine, overlapping IO with whatever the caller does next; `wait()` fences.
+"""
+
+import os
+
+import numpy as np
+
+from .aio_engine import AsyncIOEngine
+
+
+class AsyncTensorSwapper:
+    def __init__(self, aio_engine=None, aio_config=None, numel_alignment=8):
+        if aio_engine is not None:
+            self.engine = aio_engine
+        elif aio_config is not None:
+            self.engine = AsyncIOEngine.from_config(aio_config)
+        else:
+            self.engine = AsyncIOEngine()
+        self.numel_alignment = numel_alignment
+        self._pending_paths = []
+
+    def swap_out_tensors(self, tensors, paths):
+        """Start writing each tensor to its path; returns immediately."""
+        for tensor, path in zip(tensors, paths):
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self.engine.aio_write(np.ascontiguousarray(tensor), path)
+            self._pending_paths.append(path)
+
+    def swap_in_tensors(self, buffers, paths):
+        """Start reading each path into its (preallocated) buffer."""
+        for buffer, path in zip(buffers, paths):
+            self.engine.aio_read(buffer, path)
+        return buffers
+
+    def synchronize_writes(self):
+        self.engine.wait()
+        self._pending_paths = []
+
+    def synchronize_reads(self):
+        self.engine.wait()
+
+    def wait(self):
+        self.engine.wait()
+        self._pending_paths = []
