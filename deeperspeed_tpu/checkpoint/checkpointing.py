@@ -90,7 +90,8 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     save_obj(model_state, os.path.join(ckpt_dir, _model_states_name(0)))
 
     # --- zero partitions --------------------------------------------------
-    if engine.zero_optimization() or engine.keep_master:
+    if engine.zero_optimization() or engine.keep_master or \
+            getattr(engine, "host_offload", False):
         _save_zero_checkpoint(engine, ckpt_dir)
 
     if save_latest:
@@ -107,6 +108,9 @@ def _flat_arrays(tree):
 
 
 def _save_zero_checkpoint(engine, ckpt_dir):
+    if getattr(engine, "host_offload", False):
+        _save_host_offload_checkpoint(engine, ckpt_dir)
+        return
     state = engine.state
     rules = engine.zero_rules
     dp = engine.dp_world_size if rules.stage >= 1 else 1
@@ -150,6 +154,58 @@ def _save_zero_checkpoint(engine, ckpt_dir):
         save_obj(shard, os.path.join(ckpt_dir, _zero_ckpt_name(dp_rank, 0)))
 
 
+def _save_host_offload_checkpoint(engine, ckpt_dir):
+    """ZeRO-Offload: host-resident (or NVMe) masters/moments, one file."""
+    if engine._host_swapper is not None:
+        groups = {i: engine._host_swapper.load_group(i)
+                  for i in range(len(engine._host_shapes))}
+        masters = [groups[i]["master"] for i in range(len(groups))]
+        ms = [groups[i]["exp_avg"] for i in range(len(groups))]
+        vs = [groups[i]["exp_avg_sq"] for i in range(len(groups))]
+    else:
+        hs = engine._host_state
+        masters, ms, vs = hs["master"], hs["m"], hs["v"]
+    shard = {
+        "optimizer_state_dict": {
+            "host_offload": True,
+            "master": masters,
+            "exp_avg": ms,
+            "exp_avg_sq": vs,
+            "step": engine._host_opt.step_count,
+            "param_groups": [dict(g) for g in
+                             engine.optimizer.param_groups],
+        },
+        "fp32_master": None,
+        "zero_stage": engine.zero_rules.stage,
+        "partition_count": 1,
+        "dp_rank": 0,
+    }
+    save_obj(shard, os.path.join(ckpt_dir, _zero_ckpt_name(0, 0)))
+
+
+def _load_host_offload_checkpoint(engine, shard):
+    sd = shard["optimizer_state_dict"]
+    masters = [np.ascontiguousarray(m, np.float32) for m in sd["master"]]
+    ms = [np.ascontiguousarray(m, np.float32) for m in sd["exp_avg"]]
+    vs = [np.ascontiguousarray(m, np.float32) for m in sd["exp_avg_sq"]]
+    engine._host_opt.step_count = sd.get("step", 0)
+    engine.optimizer.param_groups = [dict(g) for g in sd["param_groups"]]
+    if engine._host_swapper is not None:
+        for i, (mast, m, v) in enumerate(zip(masters, ms, vs)):
+            engine._host_swapper.initialize_group(
+                i, {"master": mast, "exp_avg": m, "exp_avg_sq": v})
+    else:
+        engine._host_state = {"master": masters, "m": ms, "v": vs}
+    # Rebuild device params from the restored masters.
+    import jax.numpy as jnp
+    leaves = [jnp.asarray(m.reshape(s), engine.compute_dtype)
+              for m, s in zip(masters, engine._host_shapes)]
+    params = jax.tree_util.tree_unflatten(engine._host_treedef, leaves)
+    params = jax.tree_util.tree_map(
+        lambda p, sh: jax.device_put(p, sh), params, engine._param_sh)
+    return params
+
+
 def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                     load_lr_scheduler_states=True):
     if tag is None:
@@ -183,7 +239,12 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
 
     # --- optimizer --------------------------------------------------------
     if load_optimizer_states:
-        if engine.zero_optimization() or engine.keep_master:
+        if getattr(engine, "host_offload", False):
+            shard_path = os.path.join(ckpt_dir, _zero_ckpt_name(0, 0))
+            if os.path.isfile(shard_path):
+                params = _load_host_offload_checkpoint(
+                    engine, load_obj(shard_path))
+        elif engine.zero_optimization() or engine.keep_master:
             master, opt_state = _load_zero_checkpoint(engine, ckpt_dir)
         elif model_state.get("optimizer"):
             opt_np = state_dict_to_tree(model_state["optimizer"]["state"],

@@ -84,10 +84,17 @@ class NativeCPUAdam:
         self.step_count = 0
 
     def step_flat(self, master, grads, exp_avg, exp_avg_sq, lr=None,
-                  bf16_out=None):
-        """One in-place Adam step on a flat fp32 shard."""
+                  bf16_out=None, step=None):
+        """One in-place Adam step on a flat fp32 shard. `step` is the
+        1-based optimizer step for bias correction; when None the internal
+        counter advances (callers stepping multiple shards of the same
+        optimizer step must pass it explicitly)."""
         g = self.param_groups[0]
-        self.step_count += 1
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        else:
+            self.step_count = max(self.step_count, step)
         lr = float(g["lr"] if lr is None else lr)
         master = np.ascontiguousarray(master, np.float32)
         grads = np.ascontiguousarray(grads, np.float32)
@@ -96,7 +103,7 @@ class NativeCPUAdam:
         bf16_ptr = _ptr(bf16_out) if bf16_out is not None else None
         self._lib.ds_cpu_adam_step(
             _ptr(master), _ptr(grads), _ptr(exp_avg), _ptr(exp_avg_sq),
-            master.size, self.step_count, lr, g["betas"][0], g["betas"][1],
+            master.size, step, lr, g["betas"][0], g["betas"][1],
             g["eps"], g["weight_decay"], int(self.adam_w_mode),
             int(g["bias_correction"]), bf16_ptr, self.num_threads)
         return master

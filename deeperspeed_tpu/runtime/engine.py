@@ -51,6 +51,14 @@ from .zero.partition_parameters import ZeroShardingRules
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
 
+def math_sqrt_sum(flat_arrays):
+    """Global L2 norm of a list of flat numpy arrays."""
+    total = 0.0
+    for a in flat_arrays:
+        total += float(np.dot(a, a))
+    return float(np.sqrt(total))
+
+
 def _place_opt_state(opt_state, master, master_sh, mesh):
     """Shard optimizer-state fields that mirror the master pytree with the
     master shardings; replicate scalar fields (e.g. the step counter)."""
@@ -159,6 +167,14 @@ class DeepSpeedEngine:
         self.gradient_noise_scale = None
         self.store_gradients = self._config.store_gradients
         self.stored_gradients = None
+
+        # --- offload tier -------------------------------------------------
+        zc = self._config.zero_config
+        self.host_offload = (zc.offload_optimizer is not None)
+        self._nvme_offload = (zc.offload_optimizer is not None and
+                              zc.offload_optimizer.device == "nvme")
+        self._host_opt = None
+        self._host_state = None
 
         # --- state --------------------------------------------------------
         if model_parameters is None and hasattr(model, "init_params"):
@@ -338,9 +354,50 @@ class DeepSpeedEngine:
         self._master_sh = tree_of(rules.master_spec)
         self._grad_sh = tree_of(rules.grad_spec)
 
+    def _init_host_state(self, model_parameters):
+        """ZeRO-Offload: fp32 masters + moments live in host DRAM (numpy),
+        stepped by the native CPU Adam; optionally tiered to NVMe via the
+        pipelined optimizer swapper (reference `zero/stage2.py:304-320`,
+        `swap_tensor/*`)."""
+        from ..ops.adam.cpu_adam_native import NativeCPUAdam
+
+        leaves, treedef = jax.tree_util.tree_flatten(model_parameters)
+        self._host_treedef = treedef
+        self._host_shapes = [l.shape for l in leaves]
+        group = self.optimizer.param_groups[0]
+        self._host_opt = NativeCPUAdam(
+            lr=group["lr"], betas=group["betas"], eps=group["eps"],
+            weight_decay=group["weight_decay"],
+            bias_correction=group.get("bias_correction", True),
+            adam_w_mode=getattr(self.optimizer, "adam_w_mode", True))
+        masters = [np.ascontiguousarray(np.asarray(l).reshape(-1),
+                                        np.float32) for l in leaves]
+        moments_m = [np.zeros(m.shape, np.float32) for m in masters]
+        moments_v = [np.zeros(m.shape, np.float32) for m in masters]
+        self._host_state = {"master": masters, "m": moments_m,
+                            "v": moments_v}
+        self._host_swapper = None
+        if self._nvme_offload:
+            from .swap_tensor.optimizer_swappers import \
+                PipelinedOptimizerSwapper
+            nvme_path = self._config.zero_config.offload_optimizer.nvme_path
+            if nvme_path is None:
+                raise DeepSpeedConfigError(
+                    "offload_optimizer.device=nvme requires nvme_path")
+            self._host_swapper = PipelinedOptimizerSwapper(
+                nvme_path, aio_config=self._config.aio_config)
+            for i, (mast, m, v) in enumerate(zip(masters, moments_m,
+                                                 moments_v)):
+                self._host_swapper.initialize_group(
+                    i, {"master": mast, "exp_avg": m, "exp_avg_sq": v})
+            # NVMe holds the state; drop the DRAM copies.
+            self._host_state = None
+
     def _init_state(self, model_parameters):
         """Place params/master/opt-state on the mesh with ZeRO shardings."""
         self._compute_shardings(model_parameters)
+        if self.host_offload:
+            self._init_host_state(model_parameters)
 
         # copy=True: the engine's state buffers must never alias the
         # caller's arrays or each other — the jitted step donates state.
@@ -353,6 +410,21 @@ class DeepSpeedEngine:
             lambda p, sh: jax.device_put(
                 jnp.array(p, dtype=self.compute_dtype, copy=True), sh),
             master, self._param_sh)
+
+        if self.host_offload:
+            # Device holds only compute params; masters/moments are host-
+            # resident (see _init_host_state).
+            scale_state = init_loss_scale_state(
+                init_scale=(self._config.loss_scale or
+                            self._config.initial_dynamic_scale)
+                if self._config.loss_scaling_enabled else 1.0,
+                delayed_shift=(self._config.dynamic_loss_scale_args or
+                               {}).get("hysteresis", 1),
+                static=not self.dynamic_loss_scale())
+            return EngineState(params=params, master=None, opt_state=(),
+                               scale=scale_state,
+                               global_steps=jnp.asarray(0, jnp.int32),
+                               skipped_steps=jnp.asarray(0, jnp.int32))
 
         opt_state = self.optimizer.init_state(master)
         # Moments follow master sharding; scalar fields stay replicated.
@@ -501,6 +573,117 @@ class DeepSpeedEngine:
 
         return jax.jit(train_step, donate_argnums=(0,))
 
+    def _build_grads_step(self, accum_steps):
+        """Offload path: fused grad accumulation, no device update."""
+        def grads_step(params, batches, rng, scale):
+            def micro(carry, xs):
+                grads_acc, loss_acc = carry
+                mb, mb_rng = xs
+                loss, grads = self._loss_and_grads(params, mb, mb_rng,
+                                                   scale)
+                grads_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), grads_acc,
+                    grads)
+                return (grads_acc, loss_acc + loss.astype(jnp.float32)), None
+
+            zero_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            rngs = jax.random.split(rng, accum_steps)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zero_grads, jnp.asarray(0.0, jnp.float32)),
+                (batches, rngs))
+            grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+            return loss_sum / accum_steps, grads
+
+        return jax.jit(grads_step)
+
+    def _host_apply_update(self, grads):
+        """ZeRO-Offload update: unscale/clip/step on host DRAM (or NVMe via
+        the pipelined swapper), upload compute-dtype params."""
+        from .fp16.loss_scaler import update_loss_scale
+
+        scale = float(self.state.scale.cur_scale)
+        flat_grads = [np.asarray(jax.device_get(g), np.float32).reshape(-1)
+                      / scale
+                      for g in jax.tree_util.tree_leaves(grads)]
+        finite = all(np.isfinite(g).all() for g in flat_grads)
+        grad_norm = math_sqrt_sum(flat_grads)
+
+        if finite:
+            clip = self._config.gradient_clipping
+            if clip > 0 and grad_norm > clip:
+                coef = clip / (grad_norm + 1e-6)
+                flat_grads = [g * coef for g in flat_grads]
+            lr = float(self.optimizer.param_groups[0]["lr"])
+            use_bf16 = self.compute_dtype == jnp.bfloat16
+            new_leaves = []
+            # One optimizer step across all shards (bias correction).
+            opt_step = self._host_opt.step_count + 1
+
+            def step_leaf(i, master, m, v):
+                bf16 = np.empty(master.size, np.uint16) if use_bf16 else None
+                self._host_opt.step_flat(master, flat_grads[i], m, v,
+                                         lr=lr, bf16_out=bf16,
+                                         step=opt_step)
+                if use_bf16:
+                    leaf = jax.lax.bitcast_convert_type(
+                        jnp.asarray(bf16.reshape(self._host_shapes[i])),
+                        jnp.bfloat16)
+                else:
+                    leaf = jnp.asarray(
+                        master.reshape(self._host_shapes[i]),
+                        self.compute_dtype)
+                return leaf, master, m, v
+
+            if self._host_swapper is not None:
+                results = {}
+
+                def update_fn(gid, state):
+                    leaf, mast, m, v = step_leaf(
+                        gid, state["master"], state["exp_avg"],
+                        state["exp_avg_sq"])
+                    results[gid] = leaf
+                    return {"master": mast, "exp_avg": m, "exp_avg_sq": v}
+
+                self._host_swapper.step(range(len(flat_grads)), update_fn)
+                new_leaves = [results[i] for i in range(len(flat_grads))]
+            else:
+                hs = self._host_state
+                for i in range(len(flat_grads)):
+                    leaf, *_ = step_leaf(i, hs["master"][i], hs["m"][i],
+                                         hs["v"][i])
+                    new_leaves.append(leaf)
+
+            new_params = jax.tree_util.tree_unflatten(self._host_treedef,
+                                                      new_leaves)
+            new_params = jax.tree_util.tree_map(
+                lambda p, sh: jax.device_put(p, sh), new_params,
+                self._param_sh)
+        else:
+            new_params = self.state.params
+
+        overflow = not finite
+        if self.dynamic_loss_scale():
+            args = self._config.dynamic_loss_scale_args or {}
+            new_scale = update_loss_scale(
+                self.state.scale, overflow,
+                scale_window=args.get("loss_scale_window", 1000),
+                min_scale=args.get("min_loss_scale", 1),
+                delayed_shift=args.get("hysteresis", 1))
+        else:
+            new_scale = self.state.scale._replace(
+                cur_iter=self.state.scale.cur_iter + 1)
+
+        self.state = self.state._replace(
+            params=new_params, scale=new_scale,
+            global_steps=self.state.global_steps + (0 if overflow else 1),
+            skipped_steps=self.state.skipped_steps +
+            (1 if overflow else 0))
+        return StepMetrics(loss=jnp.asarray(0.0),
+                           grad_norm=jnp.asarray(grad_norm),
+                           overflow=jnp.asarray(overflow),
+                           loss_scale=jnp.asarray(scale))
+
     def _build_eval_fn(self):
         def eval_fn(params, batch, rng):
             return self.loss_fn(params, batch, rng)
@@ -590,14 +773,19 @@ class DeepSpeedEngine:
             return
         if self.wall_clock_breakdown():
             self.timers("step").start()
-        if self._compiled_update is None:
-            self._compiled_update = self._build_update_fn()
         grads = jax.tree_util.tree_map(
             lambda g: g / self._accum_count, self._accum_grads)
         self._accum_grads = None
         self._accum_count = 0
-        lr = jnp.asarray(self.optimizer.param_groups[0]["lr"], jnp.float32)
-        self.state, metrics = self._compiled_update(self.state, grads, lr)
+        if self.host_offload:
+            metrics = self._host_apply_update(grads)
+        else:
+            if self._compiled_update is None:
+                self._compiled_update = self._build_update_fn()
+            lr = jnp.asarray(self.optimizer.param_groups[0]["lr"],
+                             jnp.float32)
+            self.state, metrics = self._compiled_update(self.state, grads,
+                                                        lr)
         self._after_step(metrics)
         if self.wall_clock_breakdown():
             self.timers("step").stop()
@@ -635,16 +823,28 @@ class DeepSpeedEngine:
                 lambda *xs: np.stack(xs), *micro)
         self.tput_timer.start()
 
-        if gas not in self._compiled_train:
-            self._compiled_train[gas] = self._build_train_step(gas)
         sharded = jax.tree_util.tree_map(
             lambda x: jax.device_put(
                 np.asarray(x),
                 NamedSharding(self.mesh,
                               PartitionSpec(None, self.data_axis))), batch)
-        lr = jnp.asarray(self.optimizer.param_groups[0]["lr"], jnp.float32)
-        self.state, metrics = self._compiled_train[gas](
-            self.state, sharded, self._next_rng(), lr)
+
+        if self.host_offload:
+            key = ("grads", gas)
+            if key not in self._compiled_train:
+                self._compiled_train[key] = self._build_grads_step(gas)
+            loss, grads = self._compiled_train[key](
+                self.state.params, sharded, self._next_rng(),
+                self.state.scale.cur_scale)
+            metrics = self._host_apply_update(grads)
+            metrics = metrics._replace(loss=loss)
+        else:
+            if gas not in self._compiled_train:
+                self._compiled_train[gas] = self._build_train_step(gas)
+            lr = jnp.asarray(self.optimizer.param_groups[0]["lr"],
+                             jnp.float32)
+            self.state, metrics = self._compiled_train[gas](
+                self.state, sharded, self._next_rng(), lr)
         self.micro_steps += gas
         self._after_step(metrics)
         self.tput_timer.stop()

@@ -160,3 +160,105 @@ def test_native_cpu_adam_bf16_shadow():
     expected = np.asarray(jnp.asarray(master).astype(jnp.bfloat16)
                           .astype(jnp.float32))
     np.testing.assert_allclose(shadow, expected, rtol=1e-6, atol=1e-6)
+
+
+# --- engine integration ---------------------------------------------------
+
+@needs_cpu_adam
+def test_engine_cpu_offload_matches_device(tmp_path):
+    """ZeRO-Offload (cpu) must follow the same trajectory as the on-device
+    optimizer."""
+    import jax
+    import deeperspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+
+    model = SimpleModel(hidden_dim=16)
+    params = model.init_params(__import__("jax").random.PRNGKey(7))
+
+    def cfg(offload):
+        c = {
+            "train_batch_size": 8,
+            "steps_per_print": 100,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "zero_optimization": {"stage": 2},
+        }
+        if offload:
+            c["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+        return c
+
+    e_dev, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=cfg(False))
+    e_off, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=cfg(True))
+    assert e_off.host_offload
+
+    it1 = random_batches(12, 8, 16, seed=3)
+    it2 = random_batches(12, 8, 16, seed=3)
+    l_dev = [float(e_dev.train_batch(data_iter=it1)) for _ in range(5)]
+    l_off = [float(e_off.train_batch(data_iter=it2)) for _ in range(5)]
+    np.testing.assert_allclose(l_off, l_dev, rtol=1e-4)
+
+
+@needs_aio
+@needs_cpu_adam
+def test_engine_nvme_offload_trains(tmp_path):
+    import jax
+    import deeperspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+
+    model = SimpleModel(hidden_dim=16)
+    params = model.init_params(__import__("jax").random.PRNGKey(7))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params={
+            "train_batch_size": 8,
+            "steps_per_print": 100,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "zero_optimization": {
+                "stage": 2,
+                "offload_optimizer": {"device": "nvme",
+                                      "nvme_path": str(tmp_path)},
+            },
+        })
+    fixed = next(random_batches(1, 8, 16, seed=4))
+    stacked = {0: None}
+    import jax as _jax
+    batch = _jax.tree_util.tree_map(lambda x: x[None], fixed)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert os.listdir(tmp_path / "optimizer")
+
+
+@needs_cpu_adam
+def test_engine_cpu_offload_checkpoint_roundtrip(tmp_path):
+    import jax
+    import deeperspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+
+    def make(seed):
+        model = SimpleModel(hidden_dim=16)
+        params = model.init_params(__import__("jax").random.PRNGKey(seed))
+        engine, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params={
+                "train_batch_size": 8,
+                "steps_per_print": 100,
+                "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+                "zero_optimization": {
+                    "stage": 2,
+                    "offload_optimizer": {"device": "cpu"},
+                },
+            })
+        return engine
+
+    e1 = make(1)
+    it = random_batches(10, 8, 16, seed=5)
+    for _ in range(3):
+        e1.train_batch(data_iter=it)
+    e1.save_checkpoint(str(tmp_path), tag="off")
+
+    e2 = make(2)
+    e2.load_checkpoint(str(tmp_path), tag="off")
+    it1 = random_batches(6, 8, 16, seed=9)
+    it2 = random_batches(6, 8, 16, seed=9)
+    la = [float(e1.train_batch(data_iter=it1)) for _ in range(3)]
+    lb = [float(e2.train_batch(data_iter=it2)) for _ in range(3)]
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
