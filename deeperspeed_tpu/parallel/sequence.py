@@ -1,0 +1,143 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference scales sequence length with block-sparse attention (SURVEY
+§5.7) — v0.3.15 predates sequence parallelism. This module is the modern
+TPU-native long-context answer, first-class per the build goals:
+
+- **Ring attention** (`ring_attention`): q stays put; k/v chunks rotate
+  around the ``seq`` mesh axis via `ppermute` (ICI neighbor hops), with
+  online-softmax merging of per-chunk partials — memory per chip is
+  O(S/n · S/n) and the full sequence never materializes anywhere.
+- **Ulysses / all-to-all** (`ulysses_attention`): `all_to_all` swaps the
+  sharded axis from sequence to heads, runs ordinary (flash) attention on
+  full sequences for 1/n of the heads, and swaps back. Cheaper collectives
+  when heads ≥ chips.
+
+Both are pure functions usable inside `shard_map` over a mesh axis, and
+`SequenceParallel` wraps mesh plumbing for whole-array callers.
+"""
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
+                   axis_size=None):
+    """Ring attention inside shard_map: inputs are the local sequence
+    shard [B, S/n, H, D]; returns the local output shard.
+
+    Per step t, this chip holds the k/v chunk originating at ring position
+    (my_idx - t) mod n and folds its contribution into a running
+    flash-style (m, l, acc) online softmax; `ppermute` then forwards k/v
+    to the next neighbor. Unrolled over the (static) axis size so XLA
+    overlaps each hop with the previous step's matmuls.
+    """
+    n = axis_size
+    if not isinstance(n, int):
+        raise ValueError("ring_attention needs a static axis_size")
+    b, s_local, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    m_run = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l_run = jnp.zeros((b, h, s_local), jnp.float32)
+    acc = jnp.zeros((b, s_local, h, d), jnp.float32)
+
+    k_cur, v_cur = k, v
+    for step in range(n):
+        src = (my_idx - step) % n
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_cur.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        rows = jnp.arange(s_local)[:, None] + my_idx * s_local
+        cols = jnp.arange(s_local)[None, :] + src * s_local
+        if causal:
+            keep = rows >= cols
+        else:
+            keep = jnp.full((s_local, s_local), True)
+        logits = jnp.where(keep[None, None], logits, NEG_INF)
+
+        m_c = jnp.max(logits, axis=-1)                 # [B,H,Sq]
+        m_new = jnp.maximum(m_run, m_c)
+        p = jnp.exp(logits - m_new[..., None])         # masked → 0
+        alpha = jnp.exp(m_run - m_new)
+        l_run = l_run * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
+            jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        m_run = m_new
+        if step < n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    l_safe = jnp.maximum(l_run, 1e-30)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, attn_fn=None, causal=True,
+                      axis_size=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism inside
+    shard_map: swap sharding seq→heads, run full-sequence attention on
+    1/n of the heads, swap back. Requires num_heads % n == 0."""
+    n = axis_size
+    if not isinstance(n, int):
+        raise ValueError("ulysses_attention needs a static axis_size")
+    b, s_local, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"num heads {h} not divisible by axis size {n}")
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] → [B, S, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attn_fn is None:
+        from ..models.gpt_neox import causal_attention
+        attn_fn = partial(causal_attention, use_pallas=True) if causal \
+            else None
+    if attn_fn is None:
+        raise ValueError("non-causal ulysses needs an explicit attn_fn")
+    out = attn_fn(qh, kh, vh)
+    return heads_to_seq(out)
+
+
+class SequenceParallel:
+    """Whole-array wrapper: shards [B, S, H, D] over `axis` of `mesh` and
+    applies ring or Ulysses attention under shard_map."""
+
+    def __init__(self, mesh, axis="seq", mode="ring", causal=True):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no axis {axis!r}")
+        self.mesh = mesh
+        self.axis = axis
+        self.mode = mode
+        self.causal = causal
+        self.axis_size = int(mesh.shape[axis])
+
+    def __call__(self, q, k, v):
+        spec = P(None, self.axis, None, None)
+        if self.mode == "ring":
+            fn = partial(ring_attention, axis_name=self.axis,
+                         causal=self.causal, axis_size=self.axis_size)
+        elif self.mode == "ulysses":
+            fn = partial(ulysses_attention, axis_name=self.axis,
+                         causal=self.causal, axis_size=self.axis_size)
+        else:
+            raise ValueError(f"unknown mode {self.mode!r}")
+        mapped = shard_map(lambda q, k, v: fn(q, k, v), mesh=self.mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+        return mapped(q, k, v)
