@@ -1,0 +1,220 @@
+"""Compiled SPMD pipeline executor.
+
+This is the TPU lowering of the reference's pipeline engine
+(`deepspeed/runtime/pipe/engine.py` + `schedule.py`): instead of a host
+loop interpreting Send/Recv/Forward/Backward instructions per stage, the
+whole schedule becomes ONE jitted program under `shard_map` over the
+``pipe`` mesh axis:
+
+- every stage runs the same program on its shard of a stacked layer
+  parameter pytree (leaves [L, ...] sharded over ``pipe`` on dim 0);
+- micro-batches flow stage-to-stage via `ppermute` (XLA
+  collective-permute riding ICI/DCN);
+- the fill/steady/drain structure is a `lax.scan` over
+  ``n_micro + n_stages - 1`` ticks (GPipe-style; differentiating through
+  the scan yields the reverse-order backward schedule automatically, with
+  `jax.checkpoint` on the stage body bounding activation memory);
+- loss is computed by the last stage and broadcast with a masked psum —
+  the analogue of `_aggregate_total_loss` (`pipe/engine.py:559`).
+
+Use `pipeline_loss_fn` to build an engine-compatible loss from (embed_fn,
+stage_fn, head_fn) triples; `GPTNeoXPipeSPMD` wires it for the flagship
+model.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import PIPE_AXIS
+
+
+def spmd_pipeline(stage_fn, stage_params, x_micro, axis_name, n_stages,
+                  n_micro, remat=True):
+    """Run the pipeline body inside shard_map.
+
+    Args:
+      stage_fn: (stage_params, x) -> y; this stage's layer stack.
+      stage_params: pytree whose leaves lead with the local layer dim.
+      x_micro: [M, mb, ...] micro-batched stage-0 inputs (replicated).
+    Returns [M, mb, ...] outputs, valid on the LAST stage (others carry
+    bubble garbage — mask downstream).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    total_ticks = n_micro + n_stages - 1
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        idx = jnp.clip(t, 0, n_micro - 1)
+        inject = jax.lax.dynamic_index_in_dim(x_micro, idx, 0,
+                                              keepdims=False)
+        x = jnp.where(stage == 0, inject.astype(buf.dtype), buf)
+        y = body(stage_params, x)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        write = (t >= n_stages - 1).astype(y.dtype)
+        current = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                               keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, write * y + (1 - write) * current, out_idx, 0)
+        buf_next = jax.lax.ppermute(y, axis_name, perm)
+        return (buf_next, outputs), None
+
+    mb_shape = x_micro.shape[1:]
+    y_shape = jax.eval_shape(
+        lambda p, x: stage_fn(p, x), stage_params,
+        jax.ShapeDtypeStruct(mb_shape, x_micro.dtype))
+    buf0 = jnp.zeros(y_shape.shape, y_shape.dtype)
+    outputs0 = jnp.zeros((n_micro,) + y_shape.shape, y_shape.dtype)
+
+    (_, outputs), _ = jax.lax.scan(tick, (buf0, outputs0),
+                                   jnp.arange(total_ticks))
+    return outputs
+
+
+def last_stage_value(value, axis_name, n_stages):
+    """Broadcast a last-stage scalar/array to every stage (masked psum)."""
+    stage = jax.lax.axis_index(axis_name)
+    masked = jnp.where(stage == n_stages - 1, value,
+                       jnp.zeros_like(value))
+    return jax.lax.psum(masked, axis_name)
+
+
+def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
+                     axis_name=PIPE_AXIS, data_axis=None, remat=True):
+    """Build loss(params, batch, rng) running the block stack pipelined.
+
+    params = {"embed": ..., "blocks": stacked leaves [L, ...],
+              "head": ...}; blocks must be sharded over (axis_name,) on
+    dim 0 by the caller's param specs. batch = (tokens [B, S], labels).
+    The global batch splits into `n_micro` micro-batches along dim 0.
+    """
+    n_stages = int(mesh.shape[axis_name])
+
+    def loss_fn(params, batch, rng=None):
+        tokens, labels = batch
+
+        def inner(blocks_local, embed_params, head_params, tokens, labels):
+            b = tokens.shape[0]
+            mb = b // n_micro
+            tok_micro = tokens.reshape((n_micro, mb) + tokens.shape[1:])
+            lab_micro = labels.reshape((n_micro, mb) + labels.shape[1:])
+            # Embedding is cheap; every stage computes it replicated so
+            # stage 0's injections exist locally (no host scatter).
+            x_micro = jax.vmap(lambda t: embed_fn(embed_params, t))(
+                tok_micro)
+
+            outputs = spmd_pipeline(stage_fn, blocks_local, x_micro,
+                                    axis_name, n_stages, n_micro,
+                                    remat=remat)
+            losses = jax.vmap(
+                lambda h, l: head_loss_fn(head_params, h, l))(outputs,
+                                                              lab_micro)
+            loss = jnp.mean(losses)
+            return last_stage_value(loss, axis_name, n_stages)
+
+        # blocks enter sharded over pipe; everything else replicated over
+        # pipe (data sharding handled outside by the engine's jit).
+        blocks_spec = jax.tree_util.tree_map(
+            lambda _: P(axis_name), params["blocks"])
+        other = P()
+        mapped = shard_map(
+            inner, mesh=mesh,
+            in_specs=(blocks_spec, other, other, other, other),
+            out_specs=other,
+            check_vma=False)
+        return mapped(params["blocks"], params["embed"], params["head"],
+                      tokens, labels)
+
+    return loss_fn
+
+
+class GPTNeoXPipeSPMD:
+    """Flagship model wired through the SPMD pipeline executor.
+
+    Engine-protocol object (loss_fn / init_params / param_specs): blocks
+    are stacked [L, ...] and sharded over ``pipe``; embed/head replicated
+    over ``pipe`` and tensor-sharded over ``model`` when present.
+    """
+
+    def __init__(self, config, mesh, n_micro, remat=True):
+        from ..models import gpt_neox as M
+        self.cfg = config
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.n_stages = int(mesh.shape[PIPE_AXIS])
+        if config.num_layers % self.n_stages != 0:
+            raise ValueError(
+                f"num_layers {config.num_layers} must divide evenly over "
+                f"{self.n_stages} pipeline stages")
+        self._M = M
+
+        cos_sin = M._rotary_cache(config, config.max_seq_len)
+
+        def stage_fn(blocks_local, x):
+            # scan over this stage's layers (leading dim of each leaf).
+            def one(x, bp):
+                cs = (cos_sin[0][:x.shape[1]], cos_sin[1][:x.shape[1]],
+                      cos_sin[2])
+                return M.block_forward(config, bp, x, cs), None
+
+            y, _ = jax.lax.scan(one, x, blocks_local)
+            return y
+
+        def embed_fn(embed_params, tokens):
+            return embed_params["wte"][tokens]
+
+        def head_loss_fn(head_params, hidden, labels):
+            h = M.layer_norm(hidden, head_params["final_ln"]["scale"],
+                             head_params["final_ln"]["bias"],
+                             config.layernorm_eps)
+            logits = jnp.einsum(
+                "bsh,vh->bsv", h,
+                head_params["wte"].astype(h.dtype),
+                preferred_element_type=jnp.float32)
+            return M.lm_loss(logits, labels)
+
+        self.loss_fn = pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn,
+                                        mesh, n_micro, remat=remat)
+
+    def init_params(self, rng):
+        M, cfg = self._M, self.cfg
+        keys = jax.random.split(rng, cfg.num_layers + 2)
+        blocks = [M.init_block_params(cfg, keys[i + 1])
+                  for i in range(cfg.num_layers)]
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *blocks)
+        return {
+            "embed": {"wte": M._dense_init(keys[0], (cfg.vocab_size,
+                                                     cfg.hidden_size),
+                                           cfg.param_dtype)},
+            "blocks": stacked,
+            "head": {
+                "final_ln": {
+                    "scale": jnp.ones((cfg.hidden_size,), cfg.param_dtype),
+                    "bias": jnp.zeros((cfg.hidden_size,), cfg.param_dtype),
+                },
+                "wte": M._dense_init(keys[-1], (cfg.vocab_size,
+                                                cfg.hidden_size),
+                                     cfg.param_dtype),
+            },
+        }
+
+    def param_specs(self, params, mesh):
+        def blocks_spec(leaf):
+            return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
+
+        return {
+            "embed": jax.tree_util.tree_map(lambda _: P(),
+                                            params["embed"]),
+            "blocks": jax.tree_util.tree_map(blocks_spec,
+                                             params["blocks"]),
+            "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
+        }
