@@ -16,6 +16,7 @@ size merges the saved partitions and re-slices (`stage2.py:1825-1894`).
 """
 
 import os
+import shutil
 
 import numpy as np
 
@@ -94,6 +95,16 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
             getattr(engine, "host_offload", False):
         _save_zero_checkpoint(engine, ckpt_dir)
 
+    # Ship the recovery script with the checkpoint so fp32 weights can be
+    # reconstructed later without the framework (reference
+    # `engine.py:1800-1808` does the same with its zero_to_fp32.py).
+    try:
+        from ..utils import zero_to_fp32 as _z2f
+        shutil.copyfile(_z2f.__file__,
+                        os.path.join(ckpt_dir, "zero_to_fp32.py"))
+    except Exception:  # pragma: no cover
+        pass
+
     if save_latest:
         with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
             f.write(str(tag))
@@ -165,6 +176,11 @@ def _save_host_offload_checkpoint(engine, ckpt_dir):
     else:
         hs = engine._host_state
         masters, ms, vs = hs["master"], hs["m"], hs["v"]
+    # Path keys + shapes let the offline zero_to_fp32 script map the flat
+    # host masters back to named parameters without the engine.
+    from .serialization import _path_key
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.state.params)
+    param_paths = [_path_key(path) for path, _ in flat]
     shard = {
         "optimizer_state_dict": {
             "host_offload": True,
@@ -174,6 +190,8 @@ def _save_host_offload_checkpoint(engine, ckpt_dir):
             "step": engine._host_opt.step_count,
             "param_groups": [dict(g) for g in
                              engine.optimizer.param_groups],
+            "param_paths": param_paths,
+            "param_shapes": [tuple(s) for s in engine._host_shapes],
         },
         "fp32_master": None,
         "zero_stage": engine.zero_rules.stage,

@@ -1,0 +1,39 @@
+"""``ds_elastic`` CLI: inspect an elastic config and print the compatible
+(total batch, chip-count) combinations (capability of reference
+`bin/ds_elastic`, which drives `elasticity/elasticity.py:240`).
+"""
+
+import argparse
+import json
+
+from ..version import __version__
+from .elasticity import compute_elastic_config
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="DeeperSpeed-TPU elastic-training configuration helper")
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed config json with an 'elasticity' "
+                             "block")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="intended world size (chips); when given, also "
+                             "prints the resolved micro-batch per chip")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+
+    if args.world_size > 0:
+        batch, valid_chips, micro_per_chip = compute_elastic_config(
+            ds_config, __version__, world_size=args.world_size)
+        print(f"world_size={args.world_size}: train_batch_size={batch}, "
+              f"micro_batch_per_chip={micro_per_chip}")
+    else:
+        batch, valid_chips = compute_elastic_config(ds_config, __version__)
+        print(f"valid chip counts: {valid_chips}")
+        print(f"chosen max train_batch_size: {batch}")
+
+
+if __name__ == "__main__":
+    main()

@@ -44,17 +44,22 @@ def debug_report():
 
     from .version import __version__
 
-    devices = jax.devices()
     rows = [
         ("deeperspeed_tpu version", __version__),
         ("jax version", jax.__version__),
         ("numpy version", np.__version__),
-        ("default backend", jax.default_backend()),
-        ("device count", len(devices)),
-        ("device kind", getattr(devices[0], "device_kind", "unknown")
-         if devices else "none"),
-        ("process count", jax.process_count()),
     ]
+    try:
+        devices = jax.devices()
+        rows += [
+            ("default backend", jax.default_backend()),
+            ("device count", len(devices)),
+            ("device kind", getattr(devices[0], "device_kind", "unknown")
+             if devices else "none"),
+            ("process count", jax.process_count()),
+        ]
+    except RuntimeError as e:  # backend not initializable in this context
+        rows.append(("device backend", f"unavailable ({e})"))
     try:
         import flax
         rows.append(("flax version", flax.__version__))

@@ -1,0 +1,46 @@
+"""``ds_ssh``: run a shell command on every host of a hostfile over ssh
+(capability of reference `bin/ds_ssh`). On TPU pods the hostfile lists the
+TPU-VM workers; this is the quick "fan a command across the pod" helper.
+"""
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+from .runner import fetch_hostfile
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Run a command on all hosts in a hostfile via ssh")
+    parser.add_argument("-f", "--hostfile", default=DEFAULT_HOSTFILE)
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every host")
+    args = parser.parse_args(argv)
+
+    if not args.command:
+        parser.error("no command given")
+    cmd = shlex.join(args.command)
+
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        print(f"No hosts found in {args.hostfile}", file=sys.stderr)
+        return 1
+
+    procs = []
+    for host in resources:
+        procs.append((host, subprocess.Popen(["ssh", host, cmd])))
+    rc = 0
+    for host, proc in procs:
+        code = proc.wait()
+        if code != 0:
+            print(f"[{host}] exited with {code}", file=sys.stderr)
+            rc = rc or code
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

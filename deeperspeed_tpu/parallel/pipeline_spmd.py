@@ -27,7 +27,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from .mesh import PIPE_AXIS
 
@@ -88,7 +88,7 @@ def last_stage_value(value, axis_name, n_stages):
 
 
 def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
-                     axis_name=PIPE_AXIS, data_axis=None, remat=True):
+                     axis_name=PIPE_AXIS, remat=True):
     """Build loss(params, batch, rng) running the block stack pipelined.
 
     params = {"embed": ..., "blocks": stacked leaves [L, ...],

@@ -1,5 +1,7 @@
 from .config import DeepSpeedZeroConfig
+from .contiguous_memory_allocator import ContiguousMemoryAllocator
 from .partition_parameters import (GatheredParameters, Init,
                                    ZeroShardingRules,
                                    register_external_parameter,
                                    unregister_external_parameter)
+from .tiling import TiledLinear, memory_efficient_linear

@@ -1,0 +1,107 @@
+"""Contiguous host-buffer allocator with defragmentation.
+
+Capability parity with the reference's ``ContiguousMemoryAllocator``
+(`zero/contiguous_memory_allocator.py:9`), which hands out sub-tensors of
+one preallocated flat buffer and compacts live blocks when fragmentation
+blocks an allocation. On TPU the device side needs no such thing (XLA owns
+HBM layout), but the host offload tier does: the NVMe/DRAM swappers keep
+pinned staging buffers, and recycling them through one arena avoids both
+allocator churn and fragmentation of the pinned region.
+
+Blocks are addressed by integer id; ``get_tensor(id)`` returns the current
+numpy view (views move on defrag, so holders re-fetch by id — the torch
+reference instead mutates ``param.data`` in place via stored callbacks).
+"""
+
+import numpy as np
+
+
+class ContiguousMemoryAllocator:
+    def __init__(self, size, dtype=np.float32):
+        self.buffer = np.zeros(int(size), dtype=dtype)
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+        # offset -> length of free ranges; kept coalesced.
+        self._free = {0: self.size}
+        # block id -> (offset, length)
+        self._blocks = {}
+        self._next_id = 0
+        self.total_free = self.size
+        self.largest_contiguous = self.size
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _recompute_stats(self):
+        self.total_free = sum(self._free.values())
+        self.largest_contiguous = max(self._free.values(), default=0)
+
+    def _coalesce(self):
+        merged = {}
+        for off in sorted(self._free):
+            length = self._free[off]
+            if merged:
+                last_off = next(reversed(merged))
+                if last_off + merged[last_off] == off:
+                    merged[last_off] += length
+                    continue
+            merged[off] = length
+        self._free = merged
+        self._recompute_stats()
+
+    # -- public api --------------------------------------------------------
+
+    def allocate_tensor(self, numel):
+        """Allocate a block of ``numel`` elements; returns its id.
+
+        Defragments (compacts live blocks to the left) when no single free
+        range fits but the total free space does.
+        """
+        numel = int(numel)
+        if numel > self.total_free:
+            raise MemoryError(
+                f"arena exhausted: need {numel}, free {self.total_free}")
+        if numel > self.largest_contiguous:
+            self.defragment()
+        for off in sorted(self._free):
+            length = self._free[off]
+            if length >= numel:
+                del self._free[off]
+                if length > numel:
+                    self._free[off + numel] = length - numel
+                self._recompute_stats()
+                bid = self._next_id
+                self._next_id += 1
+                self._blocks[bid] = (off, numel)
+                return bid
+        raise MemoryError("defragmentation failed to produce a fit")
+
+    def get_tensor(self, block_id):
+        off, numel = self._blocks[block_id]
+        return self.buffer[off:off + numel]
+
+    def release_tensor(self, block_id):
+        off, numel = self._blocks.pop(block_id)
+        self._free[off] = numel
+        self._coalesce()
+
+    def defragment(self):
+        """Compact live blocks to the start of the buffer (stable order)."""
+        cursor = 0
+        for bid in sorted(self._blocks, key=lambda b: self._blocks[b][0]):
+            off, numel = self._blocks[bid]
+            if off != cursor:
+                # memmove semantics: ranges may overlap when shifting left.
+                self.buffer[cursor:cursor + numel] = \
+                    self.buffer[off:off + numel].copy()
+                self._blocks[bid] = (cursor, numel)
+            cursor += numel
+        self._free = {cursor: self.size - cursor} if cursor < self.size else {}
+        self._recompute_stats()
+
+    def allocated(self):
+        return self.size - self.total_free
+
+    def print_allocation(self):  # pragma: no cover - debug aid
+        live = {b: self._blocks[b] for b in sorted(self._blocks)}
+        print(f"arena size={self.size} free={self.total_free} "
+              f"largest_contiguous={self.largest_contiguous} blocks={live}")

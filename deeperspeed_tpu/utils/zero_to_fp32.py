@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Offline ZeRO-checkpoint → consolidated fp32 state-dict conversion.
+
+Capability parity with the reference's recovery script
+(`deepspeed/utils/zero_to_fp32.py`, copied into every checkpoint directory
+by `engine.save_checkpoint`, reference `engine.py:1800-1808`): a user can,
+at any later time and **without the framework installed**, turn a sharded
+ZeRO checkpoint into a single framework-free fp32 state dict.
+
+Layout consumed (written by `deeperspeed_tpu.checkpoint.checkpointing`):
+
+    {ckpt_dir}/mp_rank_{mp:02d}_model_states.pt        # params + counters
+    {ckpt_dir}/zero_pp_rank_{dp}_mp_rank_{mp:02d}_optim_states.pt
+
+The zero files carry per-dp-rank slices of the fp32 masters plus a
+``fp32_master_dims`` map saying which dim each leaf was sliced along
+(GSPMD convention: ceil-chunked, last shard may be short), so the merge is
+a plain concatenate — no flat-buffer offset math like the torch original
+needed.
+
+Usage::
+
+    python zero_to_fp32.py <checkpoint_dir> <output_file>
+
+Output is a ``{param_path: np.float32 ndarray}`` dict saved with torch
+(falls back to pickle), loadable anywhere.
+"""
+
+import argparse
+import glob
+import os
+import pickle
+import re
+
+import numpy as np
+
+try:
+    import torch
+    _HAVE_TORCH = True
+except Exception:  # pragma: no cover
+    _HAVE_TORCH = False
+
+
+def _load(path):
+    if _HAVE_TORCH:
+        return torch.load(path, map_location="cpu", weights_only=False)
+    with open(path, "rb") as f:  # pragma: no cover
+        return pickle.load(f)
+
+
+def _save(obj, path):
+    if _HAVE_TORCH:
+        torch.save(obj, path)
+    else:  # pragma: no cover
+        with open(path, "wb") as f:
+            pickle.dump(obj, f)
+
+
+def get_model_state_file(checkpoint_dir, mp_rank=0):
+    path = os.path.join(checkpoint_dir,
+                        f"mp_rank_{mp_rank:02d}_model_states.pt")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"can't find {path}")
+    return path
+
+
+def get_zero_files(checkpoint_dir, mp_rank=0):
+    """Zero shard files ordered by dp rank (numeric, not lexicographic)."""
+    pattern = os.path.join(
+        checkpoint_dir, f"zero_pp_rank_*_mp_rank_{mp_rank:02d}_optim_states.pt")
+    files = glob.glob(pattern)
+
+    def dp_rank(path):
+        m = re.search(r"zero_pp_rank_(\d+)_", os.path.basename(path))
+        return int(m.group(1)) if m else 0
+
+    return sorted(files, key=dp_rank)
+
+
+def _merge_sliced(per_rank, dims, saved_dp):
+    """Merge per-dp-rank {path: slice} dicts into full arrays."""
+    merged = {}
+    for key in per_rank[0]:
+        dim = dims.get(key) if dims else None
+        if dim is None or saved_dp == 1:
+            merged[key] = np.asarray(per_rank[0][key])
+        else:
+            merged[key] = np.concatenate(
+                [np.asarray(r[key]) for r in per_rank], axis=dim)
+    return merged
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, mp_rank=0):
+    """Return {param_path: fp32 ndarray} for the checkpoint.
+
+    Prefers the fp32 masters from the zero shards (exact optimizer view);
+    falls back to upcasting the bf16/fp16 module weights when the
+    checkpoint carries no masters (fp32 training without ZeRO).
+    """
+    zero_files = get_zero_files(checkpoint_dir, mp_rank)
+    if zero_files:
+        shards = [_load(f) for f in zero_files]
+        saved_dp = shards[0].get("partition_count", len(shards))
+        if saved_dp != len(shards):
+            raise RuntimeError(
+                f"incomplete checkpoint: found {len(shards)} zero shard "
+                f"files but the checkpoint was saved with "
+                f"partition_count={saved_dp}")
+        if shards[0].get("fp32_master") is not None:
+            masters = [s["fp32_master"] for s in shards]
+            dims = shards[0].get("fp32_master_dims", {}) or {}
+            merged = _merge_sliced(masters, dims, saved_dp)
+            return {k: np.asarray(v, np.float32) for k, v in merged.items()}
+        osd = shards[0].get("optimizer_state_dict", {})
+        if osd.get("host_offload"):
+            # ZeRO-Offload: flat host-resident masters + path/shape tables.
+            paths = osd.get("param_paths")
+            shapes = osd.get("param_shapes")
+            if paths is None or shapes is None:
+                raise RuntimeError(
+                    "host-offload checkpoint lacks param_paths/param_shapes "
+                    "tables; exact fp32 masters cannot be mapped to "
+                    "parameter names offline (re-save with a newer "
+                    "framework version)")
+            return {path: np.asarray(m, np.float32).reshape(shape)
+                    for path, m, shape in zip(paths, osd["master"], shapes)}
+
+    model_state = _load(get_model_state_file(checkpoint_dir, mp_rank))
+    arrays = model_state["module"]["arrays"]
+    return {k: np.asarray(v, np.float32) for k, v in arrays.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
+                                               mp_rank=0):
+    state_dict = get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir, mp_rank)
+    print(f"Saving fp32 state dict ({len(state_dict)} tensors, "
+          f"{sum(v.size for v in state_dict.values()):,} elements) "
+          f"to {output_file}")
+    _save(state_dict, output_file)
+    return state_dict
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Extract a consolidated fp32 state dict from a "
+                    "DeeperSpeed-TPU ZeRO checkpoint directory")
+    parser.add_argument("checkpoint_dir",
+                        help="checkpoint directory, e.g. global_step100")
+    parser.add_argument("output_file",
+                        help="where to save the consolidated fp32 state "
+                             "dict, e.g. model_fp32.bin")
+    parser.add_argument("--mp_rank", type=int, default=0,
+                        help="model-parallel rank to extract (default 0)")
+    args = parser.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, args.mp_rank)
+
+
+if __name__ == "__main__":
+    main()

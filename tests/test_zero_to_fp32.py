@@ -1,0 +1,89 @@
+"""zero_to_fp32 offline consolidation tests (parity with reference
+`utils/zero_to_fp32.py` + the script-shipping behavior of
+`engine.py:1800-1808`)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import jax
+
+import deeperspeed_tpu
+from deeperspeed_tpu.utils.zero_to_fp32 import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint)
+from tests.simple_model import SimpleModel, random_batches
+
+HIDDEN = 16
+
+
+def _train_and_save(tmp_path, zero_stage):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.PRNGKey(3))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "steps_per_print": 100,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "fp16": {"enabled": True, "type": "bfloat16"},
+            "zero_optimization": {"stage": zero_stage},
+        })
+    it = random_batches(20, 8, HIDDEN, seed=3)
+    for _ in range(4):
+        engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="global_step4")
+    return engine
+
+
+def test_consolidated_matches_master(tmp_path):
+    engine = _train_and_save(tmp_path, zero_stage=2)
+    ckpt_dir = os.path.join(str(tmp_path), "global_step4")
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir)
+    master_flat, _ = jax.tree_util.tree_flatten_with_path(
+        engine.state.master)
+    assert len(sd) == len(master_flat)
+    for path, leaf in master_flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        np.testing.assert_allclose(sd[key], np.asarray(leaf),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=f"mismatch at {key}")
+        assert sd[key].dtype == np.float32
+
+
+def test_script_shipped_and_runnable(tmp_path):
+    _train_and_save(tmp_path, zero_stage=1)
+    ckpt_dir = os.path.join(str(tmp_path), "global_step4")
+    script = os.path.join(ckpt_dir, "zero_to_fp32.py")
+    assert os.path.isfile(script), "recovery script not shipped with ckpt"
+
+    out = os.path.join(str(tmp_path), "fp32.bin")
+    # Run the *copied* script standalone, as a reference user would.
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run([sys.executable, script, ckpt_dir, out],
+                         capture_output=True, text=True, env=env)
+    assert res.returncode == 0, res.stderr
+    assert os.path.isfile(out)
+
+
+def test_fallback_without_zero(tmp_path):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.PRNGKey(5))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "steps_per_print": 100,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        })
+    it = random_batches(20, 8, HIDDEN, seed=5)
+    engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="s1")
+    sd = get_fp32_state_dict_from_zero_checkpoint(
+        os.path.join(str(tmp_path), "s1"))
+    p_flat, _ = jax.tree_util.tree_flatten(engine.state.params)
+    assert len(sd) == len(p_flat)
