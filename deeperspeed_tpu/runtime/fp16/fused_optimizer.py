@@ -1,0 +1,193 @@
+"""FP16 optimizer with a flat fp32 master copy
+(reference: `deepspeed/runtime/fp16/fused_optimizer.py:51`).
+
+The reference flattens every fp16 param group into one contiguous buffer
+(`_flatten_dense_tensors`) and keeps an fp32 master flat buffer per group;
+the fused CUDA Adam then steps each flat buffer in one kernel. The TPU
+analogue keeps the same structure — ONE fp32 master vector per param group,
+raveled+concatenated — so the optimizer update is a single fused elementwise
+kernel over one buffer, and overflow/clip are single reductions. Loss
+scaling, overflow-skip and dynamic-scale adjustment are the same state
+machine as the reference, but expressed branchlessly so the whole step can
+live under `jax.jit` (see `loss_scaler.py`).
+
+Usage (mirrors the reference's engine wiring, `engine.py:803-875`):
+
+    opt = FP16_Optimizer(FusedAdam(lr=1e-3), dynamic_loss_scale=True)
+    state = opt.init_state(params)                 # fp16/bf16 params
+    scaled_loss = opt.scale_loss(loss, state)      # == loss * cur_scale
+    state, info = opt.step(state, grads)           # grads of scaled loss
+    state.params                                   # updated compute params
+"""
+
+import math
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import clip_grad_norm_, global_norm
+from .loss_scaler import (LossScaleState, grads_finite,
+                          init_loss_scale_state, update_loss_scale)
+
+
+class FP16OptimizerState(NamedTuple):
+    """Carried through jit. ``flat_master`` is the single fp32 buffer the
+    reference calls ``fp32_groups_flat`` (fused_optimizer.py:77)."""
+    params: Any                # compute-dtype pytree (fp16/bf16)
+    flat_master: jnp.ndarray   # fp32 [total_numel]
+    opt_state: Any             # inner optimizer state over the flat buffer
+    scale: LossScaleState
+
+
+class StepInfo(NamedTuple):
+    overflow: jnp.ndarray
+    grad_norm: jnp.ndarray
+    loss_scale: jnp.ndarray
+
+
+def _flatten(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate([jnp.ravel(l).astype(jnp.float32)
+                            for l in leaves]) if leaves else jnp.zeros((0,))
+
+
+class FP16_Optimizer:
+    """Loss-scaled master-weight wrapper over a fused base optimizer.
+
+    The base optimizer must expose ``init_state(params)`` /
+    ``update(grads, state, params, lr=)`` and ``param_groups`` (FusedAdam,
+    FusedLamb). Masters are kept FLAT: the base optimizer sees a single
+    1-D fp32 tensor, as the reference's fused kernels do.
+    """
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 initial_dynamic_scale=2 ** 32, verbose=False, mpu=None,
+                 clip_grad=0.0, fused_adam_legacy=False):
+        self.optimizer = init_optimizer
+        self.clip_grad = clip_grad
+        self.dynamic = dynamic_loss_scale
+        args = dynamic_loss_args or {}
+        if dynamic_loss_scale:
+            self._init_scale = 2 ** args["init_scale_power"] \
+                if "init_scale_power" in args else \
+                args.get("init_scale", initial_dynamic_scale)
+        else:
+            self._init_scale = static_loss_scale
+        self.scale_window = args.get("scale_window", 1000)
+        self.min_scale = args.get("min_scale", 1)
+        self.delayed_shift = args.get("delayed_shift",
+                                      args.get("hysteresis", 1))
+        self.verbose = verbose
+        self.mpu = mpu
+        self._treedef = None
+        self._shapes = None
+        self._dtype = None
+
+    # -- torch-ish surface -------------------------------------------------
+
+    @property
+    def param_groups(self):
+        return self.optimizer.param_groups
+
+    @property
+    def loss_scale(self):
+        return self._init_scale
+
+    # -- functional core ---------------------------------------------------
+
+    def init_state(self, params):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        self._treedef = treedef
+        self._shapes = [l.shape for l in leaves]
+        self._dtype = leaves[0].dtype if leaves else jnp.float16
+        flat_master = _flatten(params)
+        opt_state = self.optimizer.init_state(flat_master)
+        scale = init_loss_scale_state(init_scale=self._init_scale,
+                                      delayed_shift=self.delayed_shift,
+                                      static=not self.dynamic)
+        return FP16OptimizerState(params=params, flat_master=flat_master,
+                                  opt_state=opt_state, scale=scale)
+
+    def scale_loss(self, loss, state):
+        """The reference's ``backward(loss)`` scaling half: the caller
+        differentiates scale_loss(...) instead of loss."""
+        return loss * state.scale.cur_scale.astype(loss.dtype)
+
+    def _unflatten(self, flat):
+        out, offset = [], 0
+        for shape in self._shapes:
+            n = math.prod(shape)
+            out.append(jnp.reshape(flat[offset:offset + n], shape))
+            offset += n
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def step(self, state, grads, lr=None):
+        """One update from grads of the SCALED loss. jit-safe; overflow
+        skips the update branchlessly (reference fused_optimizer.py:181)."""
+        flat_grads = _flatten(grads) / state.scale.cur_scale
+
+        finite = grads_finite(flat_grads)
+        overflow = jnp.logical_not(finite)
+        grad_norm = global_norm(flat_grads)
+        if self.clip_grad > 0:
+            flat_grads, _ = clip_grad_norm_(flat_grads, self.clip_grad,
+                                            norm=grad_norm)
+
+        new_master, new_opt = self.optimizer.update(
+            flat_grads, state.opt_state, state.flat_master, lr=lr)
+
+        new_master = jnp.where(overflow, state.flat_master, new_master)
+        new_opt = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(overflow, o, n), new_opt,
+            state.opt_state)
+        new_params = jax.tree_util.tree_map(
+            lambda p, n: n.astype(p.dtype), state.params,
+            self._unflatten(new_master))
+
+        if self.dynamic:
+            new_scale = update_loss_scale(
+                state.scale, overflow, scale_window=self.scale_window,
+                min_scale=self.min_scale, delayed_shift=self.delayed_shift)
+        else:
+            new_scale = state.scale._replace(
+                cur_iter=state.scale.cur_iter + 1)
+
+        return (FP16OptimizerState(params=new_params,
+                                   flat_master=new_master,
+                                   opt_state=new_opt, scale=new_scale),
+                StepInfo(overflow=overflow, grad_norm=grad_norm,
+                         loss_scale=state.scale.cur_scale))
+
+    # -- checkpoint surface (reference fused_optimizer.py:391-457) ---------
+
+    def state_dict(self, state):
+        return {
+            "dynamic_loss_scale": self.dynamic,
+            "cur_scale": float(state.scale.cur_scale),
+            "cur_iter": int(state.scale.cur_iter),
+            "last_overflow_iter": int(state.scale.last_overflow_iter),
+            "scale_window": self.scale_window,
+            "clip_grad": self.clip_grad,
+            "fp32_groups_flat": [jax.device_get(state.flat_master)],
+            "optimizer_state_dict": self.optimizer.state_dict(
+                state.opt_state),
+        }
+
+    def load_state_dict(self, state, sd, load_optimizer_states=True):
+        scale = state.scale._replace(
+            cur_scale=jnp.asarray(sd["cur_scale"], jnp.float32),
+            cur_iter=jnp.asarray(sd["cur_iter"], jnp.int32),
+            last_overflow_iter=jnp.asarray(sd["last_overflow_iter"],
+                                           jnp.int32))
+        flat = jnp.asarray(sd["fp32_groups_flat"][0], jnp.float32)
+        opt_state = state.opt_state
+        if load_optimizer_states:
+            opt_state = self.optimizer.load_state_dict(
+                sd["optimizer_state_dict"])
+        params = jax.tree_util.tree_map(
+            lambda p, n: n.astype(p.dtype), state.params,
+            self._unflatten(flat))
+        return FP16OptimizerState(params=params, flat_master=flat,
+                                  opt_state=opt_state, scale=scale)

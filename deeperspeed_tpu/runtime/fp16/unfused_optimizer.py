@@ -1,0 +1,148 @@
+"""FP16 optimizer with per-parameter fp32 masters
+(reference: `deepspeed/runtime/fp16/unfused_optimizer.py:21`).
+
+The reference's unfused variant (used for the LAMB path, which needs a
+per-tensor trust ratio and therefore cannot flatten groups) keeps one fp32
+master per parameter. Same here: masters mirror the param pytree leaf-for-
+leaf, so base optimizers that compute per-leaf statistics (FusedLamb's
+trust ratio) see real parameter boundaries.
+
+Differences from FP16_Optimizer: no flat buffer, and grad-norm clipping is
+applied leaf-wise against the global norm exactly as the reference does
+(unfused_optimizer.py:188).
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import clip_grad_norm_, global_norm
+from .loss_scaler import (LossScaleState, grads_finite,
+                          init_loss_scale_state, update_loss_scale)
+
+
+class FP16UnfusedState(NamedTuple):
+    params: Any            # compute-dtype pytree
+    master: Any            # fp32 pytree, same structure
+    opt_state: Any
+    scale: LossScaleState
+
+
+class StepInfo(NamedTuple):
+    overflow: jnp.ndarray
+    grad_norm: jnp.ndarray
+    loss_scale: jnp.ndarray
+
+
+class FP16_UnfusedOptimizer:
+    """Loss-scaled wrapper keeping per-leaf fp32 masters (LAMB path)."""
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False, mpu=None, clip_grad=0.0,
+                 fused_lamb_legacy=False):
+        self.optimizer = init_optimizer
+        self.clip_grad = clip_grad
+        self.dynamic = dynamic_loss_scale
+        args = dynamic_loss_args or {}
+        if dynamic_loss_scale:
+            self._init_scale = 2 ** args["init_scale_power"] \
+                if "init_scale_power" in args else \
+                args.get("init_scale", 2 ** 32)
+        else:
+            self._init_scale = static_loss_scale
+        self.scale_window = args.get("scale_window", 1000)
+        self.min_scale = args.get("min_scale", 1)
+        self.delayed_shift = args.get("delayed_shift",
+                                      args.get("hysteresis", 1))
+        self.verbose = verbose
+        self.mpu = mpu
+
+    @property
+    def param_groups(self):
+        return self.optimizer.param_groups
+
+    @property
+    def loss_scale(self):
+        return self._init_scale
+
+    def init_state(self, params):
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params)
+        opt_state = self.optimizer.init_state(master)
+        scale = init_loss_scale_state(init_scale=self._init_scale,
+                                      delayed_shift=self.delayed_shift,
+                                      static=not self.dynamic)
+        return FP16UnfusedState(params=params, master=master,
+                                opt_state=opt_state, scale=scale)
+
+    def scale_loss(self, loss, state):
+        return loss * state.scale.cur_scale.astype(loss.dtype)
+
+    def step(self, state, grads, lr=None):
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / state.scale.cur_scale, grads)
+
+        finite = grads_finite(grads)
+        overflow = jnp.logical_not(finite)
+        grad_norm = global_norm(grads)
+        if self.clip_grad > 0:
+            grads, _ = clip_grad_norm_(grads, self.clip_grad,
+                                       norm=grad_norm)
+
+        new_master, new_opt = self.optimizer.update(
+            grads, state.opt_state, state.master, lr=lr)
+
+        new_master = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(overflow, o, n), new_master,
+            state.master)
+        new_opt = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(overflow, o, n), new_opt,
+            state.opt_state)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: m.astype(p.dtype), state.params, new_master)
+
+        if self.dynamic:
+            new_scale = update_loss_scale(
+                state.scale, overflow, scale_window=self.scale_window,
+                min_scale=self.min_scale, delayed_shift=self.delayed_shift)
+        else:
+            new_scale = state.scale._replace(
+                cur_iter=state.scale.cur_iter + 1)
+
+        return (FP16UnfusedState(params=new_params, master=new_master,
+                                 opt_state=new_opt, scale=new_scale),
+                StepInfo(overflow=overflow, grad_norm=grad_norm,
+                         loss_scale=state.scale.cur_scale))
+
+    def state_dict(self, state):
+        return {
+            "dynamic_loss_scale": self.dynamic,
+            "cur_scale": float(state.scale.cur_scale),
+            "cur_iter": int(state.scale.cur_iter),
+            "last_overflow_iter": int(state.scale.last_overflow_iter),
+            "scale_window": self.scale_window,
+            "clip_grad": self.clip_grad,
+            "fp32_groups": jax.device_get(state.master),
+            "optimizer_state_dict": self.optimizer.state_dict(
+                state.opt_state),
+        }
+
+    def load_state_dict(self, state, sd, load_optimizer_states=True):
+        scale = state.scale._replace(
+            cur_scale=jnp.asarray(sd["cur_scale"], jnp.float32),
+            cur_iter=jnp.asarray(sd["cur_iter"], jnp.int32),
+            last_overflow_iter=jnp.asarray(sd["last_overflow_iter"],
+                                           jnp.int32))
+        master = jax.tree_util.tree_map(
+            lambda _, n: jnp.asarray(n, jnp.float32), state.master,
+            sd["fp32_groups"])
+        opt_state = state.opt_state
+        if load_optimizer_states:
+            opt_state = self.optimizer.load_state_dict(
+                sd["optimizer_state_dict"])
+        params = jax.tree_util.tree_map(
+            lambda p, m: m.astype(p.dtype), state.params, master)
+        return FP16UnfusedState(params=params, master=master,
+                                opt_state=opt_state, scale=scale)
