@@ -1,3 +1,5 @@
+from .bert_sparse_self_attention import BertSparseSelfAttention
+from .sparse_attention_utils import SparseAttentionUtils
 from .sparse_self_attention import SparseSelfAttention
 from .sparsity_config import (BigBirdSparsityConfig,
                               BSLongformerSparsityConfig,

@@ -1,0 +1,74 @@
+"""BERT-style self-attention over the block-sparse kernel
+(reference: `deepspeed/ops/sparse_attention/bert_sparse_self_attention.py:9`).
+
+The reference subclasses `nn.Module`, projects hidden states to q/k/v with
+three Linear layers and runs `SparseSelfAttention`. Functional equivalent:
+`init_params` makes the projection weights, `apply` runs
+proj → sparse attention → heads-merge. Drop-in for a BERT encoder layer's
+attention (used by `module_inject.replace_module` when a sparse config is
+supplied).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import FixedSparsityConfig
+
+
+class BertSparseSelfAttention:
+    """q/k/v projections + block-sparse scaled-dot-product attention."""
+
+    def __init__(self, config, sparsity_config=None, max_seq_length=2048):
+        """`config` needs `hidden_size` and `num_attention_heads`
+        (reference takes the HF BertConfig)."""
+        if config.hidden_size % config.num_attention_heads != 0:
+            raise ValueError(
+                f"hidden size {config.hidden_size} not a multiple of the "
+                f"number of attention heads {config.num_attention_heads}")
+        self.num_attention_heads = config.num_attention_heads
+        self.attention_head_size = (config.hidden_size //
+                                    config.num_attention_heads)
+        self.all_head_size = (self.num_attention_heads *
+                              self.attention_head_size)
+        self.sparse_self_attention = SparseSelfAttention(
+            sparsity_config or FixedSparsityConfig(
+                num_heads=config.num_attention_heads),
+            max_seq_length=max_seq_length)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        keys = jax.random.split(rng, 3)
+        h, a = self.all_head_size, self.all_head_size
+        scale = 1.0 / math.sqrt(h)
+
+        def dense(key):
+            return {
+                "kernel": jax.random.normal(key, (h, a), dtype) * scale,
+                "bias": jnp.zeros((a,), dtype),
+            }
+
+        return {"query": dense(keys[0]), "key": dense(keys[1]),
+                "value": dense(keys[2])}
+
+    def _split_heads(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_attention_heads,
+                         self.attention_head_size)
+
+    def apply(self, params, hidden_states, attention_mask=None):
+        """[B, S, H*D] → [B, S, H*D] context (reference forward,
+        bert_sparse_self_attention.py:52)."""
+        def proj(p, x):
+            return x @ p["kernel"] + p["bias"]
+
+        q = self._split_heads(proj(params["query"], hidden_states))
+        k = self._split_heads(proj(params["key"], hidden_states))
+        v = self._split_heads(proj(params["value"], hidden_states))
+        ctx = self.sparse_self_attention.forward(
+            q, k, v, key_padding_mask=attention_mask)
+        b, s = hidden_states.shape[:2]
+        return ctx.reshape(b, s, self.all_head_size)
+
+    __call__ = apply

@@ -1,0 +1,111 @@
+"""Utilities for integrating sparse attention into transformer models
+(reference: `deepspeed/ops/sparse_attention/sparse_attention_utils.py:13`).
+
+The reference mutates HF torch models in place (swap attention modules,
+resize position embeddings, pad inputs). Functionally here: params are
+pytrees, so "extend the position embedding" returns a new params tree and
+"pad to block size" returns padded arrays plus the pad length.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .bert_sparse_self_attention import BertSparseSelfAttention
+
+
+class SparseAttentionUtils:
+    """Static helpers, reference-named (sparse_attention_utils.py:13)."""
+
+    @staticmethod
+    def extend_position_embedding(position_embeddings, max_position):
+        """Tile an existing [P, H] position-embedding table out to
+        `max_position` rows (reference repeats the learned table to seed
+        longer-context finetuning, sparse_attention_utils.py:19-66)."""
+        pe = jnp.asarray(position_embeddings)
+        original, hidden = pe.shape
+        if max_position <= original:
+            return pe[:max_position]
+        reps = -(-max_position // original)  # ceil
+        extended = jnp.tile(pe, (reps, 1))[:max_position]
+        return extended
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Mirror of the reference helper: bump the tokenizer's model max
+        length (works on HF tokenizers, which are plain Python here)."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            config, sparsity_config, max_seq_length=2048):
+        """Build one `BertSparseSelfAttention` per layer for a BERT-style
+        `config` (reference walks `model.bert.encoder.layer`,
+        sparse_attention_utils.py:85-121; param copying is done by
+        `module_inject.replace_module`, which accepts these modules)."""
+        num_layers = getattr(config, "num_hidden_layers", None) or \
+            getattr(config, "num_layers")
+        return [BertSparseSelfAttention(config, sparsity_config,
+                                        max_seq_length=max_seq_length)
+                for _ in range(num_layers)]
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids=None, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0,
+                          model_embeddings=None):
+        """Pad sequence dim up to a multiple of `block_size` (reference
+        sparse_attention_utils.py:151-208). Returns
+        (pad_len, input_ids, attention_mask, token_type_ids, position_ids,
+        inputs_embeds); padded attention-mask positions are 0 so the
+        sparse kernel masks them out."""
+        ref = input_ids if input_ids is not None else inputs_embeds
+        if ref is None:
+            raise ValueError("provide input_ids or inputs_embeds")
+        seq_len = ref.shape[1]
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad_ids(x, value=0):
+            if x is None:
+                return None
+            pad = [(0, 0)] * x.ndim
+            pad[1] = (0, pad_len)
+            return jnp.pad(jnp.asarray(x), pad, constant_values=value)
+
+        input_ids = pad_ids(input_ids, pad_token_id)
+        attention_mask = pad_ids(attention_mask, 0)
+        token_type_ids = pad_ids(token_type_ids, 0)
+        if position_ids is not None:
+            # continue the position sequence into the pad region
+            tail = jnp.arange(seq_len, seq_len + pad_len)[None]
+            tail = jnp.broadcast_to(tail,
+                                    (position_ids.shape[0], pad_len))
+            position_ids = jnp.concatenate(
+                [jnp.asarray(position_ids), tail], axis=1)
+        if inputs_embeds is not None:
+            if model_embeddings is not None:
+                pad_tok = jnp.full((inputs_embeds.shape[0], pad_len),
+                                   pad_token_id, jnp.int32)
+                pad_emb = jnp.asarray(model_embeddings)[pad_tok]
+            else:
+                pad_emb = jnp.zeros(
+                    (inputs_embeds.shape[0], pad_len,
+                     inputs_embeds.shape[2]), inputs_embeds.dtype)
+            inputs_embeds = jnp.concatenate(
+                [jnp.asarray(inputs_embeds), pad_emb], axis=1)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Strip pad rows added by `pad_to_block_size` (reference
+        sparse_attention_utils.py:210)."""
+        if pad_len == 0:
+            return sequence_output
+        return sequence_output[:, :-pad_len]

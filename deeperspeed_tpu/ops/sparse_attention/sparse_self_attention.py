@@ -27,12 +27,15 @@ def layout_to_token_mask(layout, block):
 
 def dense_masked_attention(q, k, v, token_mask, causal, sm_scale=None):
     """Reference/fallback path: dense attention with the block mask
-    applied elementwise. [B, S, H, D] layout."""
+    applied elementwise. [B, S, H, D] layout; token_mask is [H, S, S]
+    (shared across batch) or [B, H, S, S] (e.g. with key padding)."""
     b, s, h, d = q.shape
     scale = sm_scale or 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
-    mask = jnp.asarray(token_mask)[None]  # [1, H, S, S]
+    mask = jnp.asarray(token_mask)
+    if mask.ndim == 3:
+        mask = mask[None]  # [1, H, S, S]
     if causal:
         mask = jnp.logical_and(mask, jnp.tril(jnp.ones((s, s), bool)))
     logits = jnp.where(mask, logits, -1e30)

@@ -33,18 +33,25 @@ from .mesh import PIPE_AXIS
 
 
 def spmd_pipeline(stage_fn, stage_params, x_micro, axis_name, n_stages,
-                  n_micro, remat=True):
+                  n_micro, remat=True, fp32_comm=None):
     """Run the pipeline body inside shard_map.
 
     Args:
       stage_fn: (stage_params, x) -> y; this stage's layer stack.
       stage_params: pytree whose leaves lead with the local layer dim.
       x_micro: [M, mb, ...] micro-batched stage-0 inputs (replicated).
+      fp32_comm: upcast bf16/fp16 activations to fp32 for the inter-stage
+        wire (fork feature, reference `pipe/p2p.py:31-62`); the backward
+        ppermute of the transposed program inherits the same precision.
+        None (default) defers to `p2p.configure(...)`'s module setting —
+        which `PipelineEngine.__init__` sets from the `fp32_allreduce`
+        config before the first compile.
     Returns [M, mb, ...] outputs, valid on the LAST stage (others carry
     bubble garbage — mask downstream).
     """
+    from ..runtime.pipe import p2p
+
     stage = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     total_ticks = n_micro + n_stages - 1
 
     body = stage_fn
@@ -64,7 +71,8 @@ def spmd_pipeline(stage_fn, stage_params, x_micro, axis_name, n_stages,
                                                keepdims=False)
         outputs = jax.lax.dynamic_update_index_in_dim(
             outputs, write * y + (1 - write) * current, out_idx, 0)
-        buf_next = jax.lax.ppermute(y, axis_name, perm)
+        buf_next = p2p.send_to_next(y, axis_name, n_stages,
+                                    fp32_comm=fp32_comm)
         return (buf_next, outputs), None
 
     mb_shape = x_micro.shape[1:]
@@ -88,7 +96,7 @@ def last_stage_value(value, axis_name, n_stages):
 
 
 def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
-                     axis_name=PIPE_AXIS, remat=True):
+                     axis_name=PIPE_AXIS, remat=True, fp32_comm=None):
     """Build loss(params, batch, rng) running the block stack pipelined.
 
     params = {"embed": ..., "blocks": stacked leaves [L, ...],
@@ -113,7 +121,7 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
 
             outputs = spmd_pipeline(stage_fn, blocks_local, x_micro,
                                     axis_name, n_stages, n_micro,
-                                    remat=remat)
+                                    remat=remat, fp32_comm=fp32_comm)
             losses = jax.vmap(
                 lambda h, l: head_loss_fn(head_params, h, l))(outputs,
                                                               lab_micro)
@@ -144,7 +152,7 @@ class GPTNeoXPipeSPMD:
     over ``pipe`` and tensor-sharded over ``model`` when present.
     """
 
-    def __init__(self, config, mesh, n_micro, remat=True):
+    def __init__(self, config, mesh, n_micro, remat=True, fp32_comm=None):
         from ..models import gpt_neox as M
         self.cfg = config
         self.mesh = mesh
@@ -182,7 +190,8 @@ class GPTNeoXPipeSPMD:
             return M.lm_loss(logits, labels)
 
         self.loss_fn = pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn,
-                                        mesh, n_micro, remat=remat)
+                                        mesh, n_micro, remat=remat,
+                                        fp32_comm=fp32_comm)
 
     def init_params(self, rng):
         M, cfg = self._M, self.cfg

@@ -134,7 +134,7 @@ class FlopsProfiler:
         import jax.numpy as jnp
         lr = jnp.asarray(eng.optimizer.param_groups[0]["lr"], jnp.float32)
         rng = jax.random.PRNGKey(0)
-        sharded = eng._shard_batch(batch)
+        sharded = eng._shard_stacked_batch(batch)
         results = profile_fn(
             lambda s, b, r, l: eng._compiled_train[gas](s, b, r, l),
             eng.state, sharded, rng, lr, n_timing_iters=1)

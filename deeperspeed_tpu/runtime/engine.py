@@ -209,6 +209,16 @@ class DeepSpeedEngine:
         self._compiled_eval = None
         self.warn_unscaled_loss = True
 
+        # Fork feature: fp32 inter-stage activation/gradient communication
+        # for bf16/fp16 runs (reference pipe/engine.py:958 passes
+        # allreduce_always_fp32() as fp32_comm into every p2p call). Set
+        # here — before any compile — so pipelined loss_fns built with
+        # fp32_comm=None (`parallel/pipeline_spmd.py`) pick it up at trace
+        # time regardless of which engine class drives them.
+        from .pipe import p2p
+        p2p.configure(fp32_comm=self.allreduce_always_fp32() and
+                      self.compute_dtype != jnp.float32)
+
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
 
@@ -255,6 +265,12 @@ class DeepSpeedEngine:
     def dynamic_loss_scale(self):
         return self._config.loss_scaling_enabled and \
             not (self._config.loss_scale and self._config.loss_scale > 0)
+
+    def allreduce_always_fp32(self):
+        """bf16 runs default to fp32-upcast reductions (fork:
+        engine.py:613-620); also drives pipeline fp32_comm
+        (pipe/engine.py:958)."""
+        return self._config.fp32_allreduce
 
     @property
     def loss_scale(self):
@@ -716,6 +732,16 @@ class DeepSpeedEngine:
 
         return jax.tree_util.tree_map(put, batch)
 
+    def _shard_stacked_batch(self, batch):
+        """Place an [accum, global_batch, ...] stacked batch: data axis on
+        dim 1 (dim 0 is the grad-accumulation scan). Shared by
+        `train_batch` and the flops profiler so both cost/benchmark the
+        same program."""
+        spec = PartitionSpec(None, self.data_axis)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x),
+                                     NamedSharding(self.mesh, spec)), batch)
+
     def _next_rng(self):
         # Deterministic per-micro-step stream.
         return jax.random.fold_in(jax.random.PRNGKey(1234), self.micro_steps)
@@ -823,11 +849,17 @@ class DeepSpeedEngine:
                 lambda *xs: np.stack(xs), *micro)
         self.tput_timer.start()
 
-        sharded = jax.tree_util.tree_map(
-            lambda x: jax.device_put(
-                np.asarray(x),
-                NamedSharding(self.mesh,
-                              PartitionSpec(None, self.data_axis))), batch)
+        # comms_timer (fork: engine.py:1164, zero/stage1.py:688): in-jit
+        # collectives are profiled via jax.profiler; the host-visible comm
+        # cost — batch upload over PCIe — is timed here.
+        if self.wall_clock_breakdown():
+            self.timers("comms").start()
+        sharded = self._shard_stacked_batch(batch)
+        if self.wall_clock_breakdown():
+            # device_put is async; wait for the upload so the timer
+            # measures the transfer, not the dispatch.
+            jax.block_until_ready(sharded)
+            self.timers("comms").stop()
 
         if self.host_offload:
             key = ("grads", gas)

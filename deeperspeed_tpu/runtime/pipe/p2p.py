@@ -1,0 +1,78 @@
+"""Adjacent-stage p2p communication over the ``pipe`` mesh axis
+(reference: `deepspeed/runtime/pipe/p2p.py:14-96`).
+
+The reference sends activations/gradients between pipeline stages with
+2-rank broadcast groups (an old-torch workaround for missing send/recv).
+The TPU-native primitive is `jax.lax.ppermute` inside `shard_map`: a
+single collective-permute over ICI moves every stage's tensor to its
+neighbour simultaneously — there is no per-pair process group to build,
+so `init_process_groups` is a no-op kept for API parity.
+
+Fork feature preserved: **fp32 activation/gradient communication**
+(`fp32_comm`, reference `pipe/p2p.py:31-62` and
+`activation_checkpointing/checkpointing.py:256`) — bf16 tensors are upcast
+to fp32 for the wire and cast back on arrival, trading 2x p2p bytes for
+exactness of inter-stage values. On TPU this matters for long pipelines
+where bf16 re-rounding at each hop compounds.
+
+These helpers are used by the compiled 1F1B executor
+(`parallel/pipeline_spmd.py`) when `pipeline.fp32_comm` is set in config.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_FP32_COMM = False
+
+
+def configure(fp32_comm=False):
+    """Set the module-level comm precision (mirrors the reference's
+    module-global wiring: every p2p call site reads a single engine-wide
+    flag, `pipe/engine.py:958`). `DeepSpeedEngine.__init__` calls this
+    before any compile; the value is read at TRACE time, so in the rare
+    case of two engines with different precisions in one process, pass
+    `fp32_comm=` explicitly to `spmd_pipeline`/`GPTNeoXPipeSPMD` instead
+    of relying on this global."""
+    global _FP32_COMM
+    _FP32_COMM = bool(fp32_comm)
+
+
+def fp32_comm_enabled():
+    return _FP32_COMM
+
+
+def init_process_groups(grid=None):
+    """No-op: ppermute needs no per-pair groups (reference p2p.py:14-19
+    builds a 2-rank group per adjacent stage pair)."""
+    return None
+
+
+def _maybe_upcast(tensor, fp32_comm):
+    fp32_comm = _FP32_COMM if fp32_comm is None else fp32_comm
+    if fp32_comm and tensor.dtype in (jnp.bfloat16, jnp.float16):
+        return tensor.astype(jnp.float32), tensor.dtype
+    return tensor, None
+
+
+def send_to_next(tensor, axis_name, n_stages, fp32_comm=None):
+    """Shift each stage's tensor to stage+1 (stage n-1's value wraps to
+    stage 0, where it is ignored by the fill/drain schedule). Must be
+    called inside `shard_map` over the pipe axis."""
+    tensor, orig = _maybe_upcast(tensor, fp32_comm)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    out = jax.lax.ppermute(tensor, axis_name, perm)
+    return out.astype(orig) if orig is not None else out
+
+
+def send_to_prev(tensor, axis_name, n_stages, fp32_comm=None):
+    """Shift to stage-1 — the gradient direction of the 1F1B schedule."""
+    tensor, orig = _maybe_upcast(tensor, fp32_comm)
+    perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    out = jax.lax.ppermute(tensor, axis_name, perm)
+    return out.astype(orig) if orig is not None else out
+
+
+# Reference-named aliases (p2p.py:31/47 send/recv pairs collapse into one
+# collective: the send IS the recv on the other side).
+send = send_to_next
+recv = send_to_prev

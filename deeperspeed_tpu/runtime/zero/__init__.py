@@ -1,4 +1,8 @@
 from .config import DeepSpeedZeroConfig
+from .stage1 import FP16_DeepSpeedZeroOptimizer_Stage1
+from .stage2 import (FP16_DeepSpeedZeroOptimizer,
+                     FP16_DeepSpeedZeroOptimizer_Stage2)
+from .stage3 import FP16_DeepSpeedZeroOptimizer_Stage3
 from .contiguous_memory_allocator import ContiguousMemoryAllocator
 from .partition_parameters import (GatheredParameters, Init,
                                    ZeroShardingRules,

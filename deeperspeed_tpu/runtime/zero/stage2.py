@@ -1,0 +1,55 @@
+"""ZeRO Stage 2 — gradient + optimizer-state sharding
+(reference: `deepspeed/runtime/zero/stage2.py:68`).
+
+The reference adds gradient partitioning to stage 1 with backward hooks
+that bucket gradients ("IPG" buckets, `stage2.py:563-653`), reduce each
+bucket to its owner rank as backward produces it (`reduce_ipg_grads`,
+`:953`), optionally on an overlap stream, and optionally offloading grads +
+optimizer state to pinned CPU memory stepped by the AVX CPU-Adam.
+
+On TPU every one of those mechanisms is a sharding decision inside one
+compiled step:
+
+- bucketing/overlap     → XLA's latency-hiding scheduler fuses and overlaps
+  the grad reduce-scatter with remaining backward compute automatically;
+- per-rank ownership    → `with_sharding_constraint(grads, data-sharded)`
+  lowers the batch-grad mean into `reduce-scatter` (not all-reduce);
+- cpu_offload           → the engine's host tier (`runtime/engine.py:600`,
+  `ops/adam/cpu_adam_native.py`) steps host-resident masters with the
+  native C++ Adam, mirroring `DeepSpeedCPUAdam`.
+
+The class below is stage 1 with the grad constraint enabled (`stage=2`
+makes `step()` constrain grads before the update); everything else —
+sub-partition math, elastic checkpointing, loss-scale machinery — is
+shared with stage 1.
+"""
+
+from .stage1 import (FP16_DeepSpeedZeroOptimizer_Stage1, StepInfo,
+                     ZeroOptimizerState, flat_sub_partitions,
+                     get_group_alignment_padding, sub_partition_bounds,
+                     sub_partition_sizes)
+
+__all__ = [
+    "FP16_DeepSpeedZeroOptimizer",
+    "FP16_DeepSpeedZeroOptimizer_Stage2",
+    "ZeroOptimizerState",
+    "StepInfo",
+    "flat_sub_partitions",
+    "get_group_alignment_padding",
+    "sub_partition_bounds",
+    "sub_partition_sizes",
+]
+
+
+class FP16_DeepSpeedZeroOptimizer_Stage2(FP16_DeepSpeedZeroOptimizer_Stage1):
+    """Gradient sharding on top of stage 1: `step()` constrains the grad
+    pytree to the data-axis sharding, so XLA reduce-scatters gradients to
+    their owning shard instead of all-reducing the full tensors — the
+    compiled form of `reduce_ipg_grads` + `average_tensor`
+    (`stage2.py:679-1006`)."""
+
+    stage = 2
+
+
+# The reference names its stage-2 class plain `FP16_DeepSpeedZeroOptimizer`.
+FP16_DeepSpeedZeroOptimizer = FP16_DeepSpeedZeroOptimizer_Stage2

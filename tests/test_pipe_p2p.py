@@ -1,0 +1,94 @@
+"""Pipeline p2p helpers: ppermute shifting + fp32_comm upcast-on-the-wire
+(fork feature, reference `deepspeed/runtime/pipe/p2p.py:31-62`).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeperspeed_tpu.parallel.pipeline_spmd import (last_stage_value,
+                                                    spmd_pipeline)
+from deeperspeed_tpu.runtime.pipe import p2p
+
+
+@pytest.fixture
+def pipe_mesh():
+    return Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+
+
+def test_send_to_next_shifts_by_one(pipe_mesh):
+    def body(x):
+        return p2p.send_to_next(x, "pipe", 4)
+
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1)
+    out = shard_map(body, mesh=pipe_mesh, in_specs=P("pipe"),
+                    out_specs=P("pipe"))(x)
+    # stage i's value lands on stage i+1 (mod 4)
+    np.testing.assert_array_equal(np.asarray(out).ravel(), [3, 0, 1, 2])
+
+
+def test_send_to_prev_shifts_back(pipe_mesh):
+    def body(x):
+        return p2p.send_to_prev(x, "pipe", 4)
+
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1)
+    out = shard_map(body, mesh=pipe_mesh, in_specs=P("pipe"),
+                    out_specs=P("pipe"))(x)
+    np.testing.assert_array_equal(np.asarray(out).ravel(), [1, 2, 3, 0])
+
+
+def test_fp32_comm_preserves_dtype(pipe_mesh):
+    """With fp32_comm the wire dtype is fp32 but the API returns the
+    original dtype (reference copies back into the bf16 buffer)."""
+    def body(x):
+        return p2p.send_to_next(x, "pipe", 4, fp32_comm=True)
+
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    out = shard_map(body, mesh=pipe_mesh, in_specs=P("pipe"),
+                    out_specs=P("pipe"))(x)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_configure_sets_module_default():
+    p2p.configure(fp32_comm=True)
+    assert p2p.fp32_comm_enabled()
+    p2p.configure(fp32_comm=False)
+    assert not p2p.fp32_comm_enabled()
+
+
+def test_spmd_pipeline_fp32_comm_matches(pipe_mesh):
+    """The pipelined result is identical with and without fp32_comm for
+    fp32 data, and still correct for bf16."""
+    n_stages, n_micro = 4, 4
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    rng = jax.random.PRNGKey(0)
+    ws = jax.random.normal(rng, (n_stages, 8, 8), jnp.float32) * 0.5
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro, 2, 8),
+                          jnp.float32)
+
+    def run(fp32_comm):
+        def body(ws_local, x_micro):
+            out = spmd_pipeline(
+                lambda w, h: stage_fn(w[0], h), ws_local, x_micro,
+                "pipe", n_stages, n_micro, fp32_comm=fp32_comm)
+            return last_stage_value(out, "pipe", n_stages)
+
+        out = shard_map(body, mesh=pipe_mesh,
+                        in_specs=(P("pipe"), P()), out_specs=P(),
+                        check_vma=False)(ws, x)
+        return np.asarray(out)
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+    # sequential reference
+    h = x
+    for s in range(n_stages):
+        h = jax.vmap(lambda mb: stage_fn(ws[s], mb))(h)
+    np.testing.assert_allclose(run(True), np.asarray(h), rtol=1e-5)
