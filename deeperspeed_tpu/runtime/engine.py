@@ -211,13 +211,14 @@ class DeepSpeedEngine:
 
         # Fork feature: fp32 inter-stage activation/gradient communication
         # for bf16/fp16 runs (reference pipe/engine.py:958 passes
-        # allreduce_always_fp32() as fp32_comm into every p2p call). Set
-        # here — before any compile — so pipelined loss_fns built with
-        # fp32_comm=None (`parallel/pipeline_spmd.py`) pick it up at trace
-        # time regardless of which engine class drives them.
-        from .pipe import p2p
-        p2p.configure(fp32_comm=self.allreduce_always_fp32() and
-                      self.compute_dtype != jnp.float32)
+        # allreduce_always_fp32() as fp32_comm into every p2p call). The
+        # module-level flag is read at TRACE time, so it is re-asserted at
+        # every step entry point (`_assert_comm_precision`) rather than only
+        # here — two engines with different precisions in one process would
+        # otherwise clobber each other's wire format.
+        self._fp32_comm = (self.allreduce_always_fp32() and
+                           self.compute_dtype != jnp.float32)
+        self._assert_comm_precision()
 
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
@@ -754,6 +755,7 @@ class DeepSpeedEngine:
         """Compute loss (and cache grads for the coming backward())."""
         if self.wall_clock_breakdown():
             self.timers("forward").start()
+        self._assert_comm_precision()
         if self._compiled_grad is None:
             self._compiled_grad = self._build_grad_fn()
         batch = self._shard_batch(batch)
@@ -847,6 +849,7 @@ class DeepSpeedEngine:
             micro = [next(data_iter) for _ in range(gas)]
             batch = jax.tree_util.tree_map(
                 lambda *xs: np.stack(xs), *micro)
+        self._assert_comm_precision()
         self.tput_timer.start()
 
         # comms_timer (fork: engine.py:1164, zero/stage1.py:688): in-jit
@@ -882,7 +885,15 @@ class DeepSpeedEngine:
         self.tput_timer.stop()
         return metrics.loss
 
+    def _assert_comm_precision(self):
+        """Pin the process-global p2p wire precision to THIS engine's value
+        before anything traces; a first jitted call traces lazily, so the
+        assignment must precede every compiled-fn invocation."""
+        from .pipe import p2p
+        p2p.configure(fp32_comm=self._fp32_comm)
+
     def eval_batch(self, batch, rng=None):
+        self._assert_comm_precision()
         if self._compiled_eval is None:
             self._compiled_eval = self._build_eval_fn()
         batch = self._shard_batch(batch)

@@ -72,7 +72,9 @@ def send_to_prev(tensor, axis_name, n_stages, fp32_comm=None):
     return out.astype(orig) if orig is not None else out
 
 
-# Reference-named aliases (p2p.py:31/47 send/recv pairs collapse into one
-# collective: the send IS the recv on the other side).
+# Reference-named alias (p2p.py:31 `send`): in the ppermute model the send
+# IS the recv on the other side, so the activation-direction `send` maps to
+# send_to_next. There is no `recv` alias — the reference's recv takes an
+# explicit source stage; callers here pick the direction explicitly via
+# send_to_next (activations) / send_to_prev (gradients).
 send = send_to_next
-recv = send_to_prev

@@ -36,10 +36,19 @@ def test_compressed_allreduce_error_feedback_identity():
                              in_specs=(P("data"), P("data")),
                              out_specs=(P("data"), P("data")))(x, err)
     assert out.shape == (8, 32)
-    assert np.isfinite(np.asarray(out)).all()
-    # error buffer captures exactly what quantization dropped locally
-    quant_plus_err_rowmean = np.asarray(new_err + (x - new_err) - x)
-    np.testing.assert_allclose(quant_plus_err_rowmean, 0.0, atol=1e-6)
+    x_np, out_np = np.asarray(x), np.asarray(out)
+    # Reconstruct each shard's quantized value from the identity
+    # q = (x + err) - new_err (err was zero here) and check it has the
+    # sign+scale form: per-shard constant magnitude = mean|x|, signs of x.
+    q = x_np - np.asarray(new_err)
+    for r in range(8):
+        np.testing.assert_allclose(np.abs(q[r]), np.abs(x_np[r]).mean(),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.sign(q[r]),
+                                      np.where(x_np[r] >= 0, 1.0, -1.0))
+    # The allreduced output is the cross-shard mean of the quantized values.
+    np.testing.assert_allclose(
+        out_np, np.broadcast_to(q.mean(axis=0), (8, 32)), rtol=1e-5)
 
 
 def test_onebit_adam_warmup_matches_fused_adam():
@@ -52,16 +61,18 @@ def test_onebit_adam_warmup_matches_fused_adam():
     ob_state = ob.init_state(params)
     ob_p, ob_state = ob.update(g, ob_state, params)
 
-    ref = FusedAdam(lr=1e-2, adam_w_mode=False)
+    # OnebitAdam's update is m / (sqrt(v) + eps) with no bias correction
+    # (reference onebit/adam.py applies the raw moments), so the matching
+    # dense reference is FusedAdam(bias_correction=False, classic L2).
+    ref = FusedAdam(lr=1e-2, adam_w_mode=False, bias_correction=False)
     ref_state = ref.init_state(params)
-    # OnebitAdam uses eps outside sqrt without bias correction in update
-    ref_p, _ = ref.update(g, ref_state, params)
+    ref_p, ref_state = ref.update(g, ref_state, params)
 
-    # same momentum accumulation
     np.testing.assert_allclose(np.asarray(ob_state.exp_avg["w"]),
-                               np.asarray(ref_state.exp_avg["w"]) * 0 +
-                               0.001, atol=1e-7)
-    assert np.isfinite(np.asarray(ob_p["w"])).all()
+                               np.asarray(ref_state.exp_avg["w"]),
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(ob_p["w"]),
+                               np.asarray(ref_p["w"]), atol=1e-7)
 
 
 @pytest.mark.parametrize("cls", [OnebitAdam, OnebitLamb])
