@@ -44,7 +44,7 @@ def main():
     cfg = GPTNeoXConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024)
     seq = 1024
-    batch_per_chip = 8
+    batch_per_chip = 16
     batch = batch_per_chip * n_chips
 
     model = GPTNeoX(cfg, use_pallas=True)
@@ -67,16 +67,22 @@ def main():
                           dtype=np.int32)
     stacked = (tokens, tokens)
 
+    def force(tree):
+        """Materialize on host: `block_until_ready` alone is not a reliable
+        fence on tunneled/remote backends — an actual transfer is."""
+        jax.block_until_ready(tree)
+        return np.asarray(jax.tree_util.tree_leaves(tree)[0])
+
     # Warmup (compile) + 2 stabilization steps.
     for _ in range(3):
         loss = engine.train_batch(batch=stacked)
-    jax.block_until_ready(engine.state.params)
+    force(engine.state.params)
 
     n_steps = 10
     start = time.perf_counter()
     for _ in range(n_steps):
         loss = engine.train_batch(batch=stacked)
-    jax.block_until_ready(engine.state.params)
+    force(engine.state.params)
     elapsed = time.perf_counter() - start
 
     tokens_per_sec = batch * seq * n_steps / elapsed

@@ -273,9 +273,8 @@ def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
     return ln2_in + mlp_out
 
 
-def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
-    """tokens [B, S] int32 → logits [B, S, V]."""
-    compute_dtype = params["embed"]["wte"].dtype
+def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False):
+    """tokens [B, S] int32 → final-norm hidden states [B, S, H]."""
     x = params["embed"]["wte"][tokens]
     cos_sin = _rotary_cache(cfg, tokens.shape[1])
 
@@ -285,12 +284,63 @@ def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
     for bp in params["blocks"]:
         x = block_fn(bp, x, cos_sin)
 
-    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
-                   cfg.layernorm_eps)
+    return layer_norm(x, params["final_ln"]["scale"],
+                      params["final_ln"]["bias"], cfg.layernorm_eps)
+
+
+def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    x = forward_hidden(cfg, params, tokens, use_pallas=use_pallas,
+                       remat_blocks=remat_blocks)
     out_embed = params.get("embed_out", params["embed"])["wte"]
     logits = jnp.einsum("bsh,vh->bsv", x, out_embed.astype(x.dtype),
                         preferred_element_type=jnp.float32)
     return logits
+
+
+def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=2048):
+    """Next-token cross entropy fused with the LM head, chunked over rows.
+
+    Never materializes the full [B, S, V] fp32 logits (6 GB at
+    batch 32 × seq 1024 × vocab 50k): each scan step computes one
+    [chunk, V] logits tile, reduces it to loss contributions, and
+    `jax.checkpoint` recomputes the tile in backward. This is the memory
+    behaviour of the reference's fused softmax-xent CUDA kernels
+    (`csrc/transformer/softmax_kernels.cu`), achieved as an XLA scan.
+
+    x: [B, S, H] final-norm hidden states; wte: [V, H]; labels: [B, S].
+    """
+    B, S, H = x.shape
+    xs = x[:, :-1, :].reshape(-1, H)
+    ts = labels[:, 1:].reshape(-1)
+    n = xs.shape[0]
+    n_pad = (-n) % chunk_rows
+    if n_pad:
+        xs = jnp.concatenate(
+            [xs, jnp.zeros((n_pad, H), xs.dtype)], axis=0)
+        ts = jnp.concatenate(
+            [ts, jnp.full((n_pad,), ignore_index, ts.dtype)], axis=0)
+    n_chunks = xs.shape[0] // chunk_rows
+    xs = xs.reshape(n_chunks, chunk_rows, H)
+    ts = ts.reshape(n_chunks, chunk_rows)
+
+    def body(carry, xt):
+        loss_sum, count = carry
+        xc, tc = xt
+        logits = jnp.einsum("ch,vh->cv", xc, wte.astype(xc.dtype),
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = tc != ignore_index
+        safe = jnp.where(valid, tc, 0)
+        picked = jnp.take_along_axis(logits, safe[:, None],
+                                     axis=-1).squeeze(-1)
+        ll = (picked - lse) * valid
+        return (loss_sum - jnp.sum(ll), count + jnp.sum(valid)), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (xs, ts))
+    return loss_sum / jnp.maximum(count, 1)
 
 
 def lm_loss(logits, labels, ignore_index=-100):
@@ -334,8 +384,11 @@ class GPTNeoX:
             tokens, labels = batch
         else:
             tokens = labels = batch
-        logits = self.apply(params, tokens)
-        return lm_loss(logits, labels)
+        hidden = forward_hidden(self.config, params, tokens,
+                                use_pallas=self.use_pallas,
+                                remat_blocks=self.remat_blocks)
+        out_embed = params.get("embed_out", params["embed"])["wte"]
+        return fused_lm_head_loss(hidden, out_embed, labels)
 
 
 # ---------------------------------------------------------------------------

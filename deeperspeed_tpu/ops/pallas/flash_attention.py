@@ -23,8 +23,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BLOCK_Q = 128
-BLOCK_K = 128
+BLOCK_Q = 512
+BLOCK_K = 512
 LANES = 128  # TPU minor-dim tile; lse/delta are lane-broadcast to this
 NEG_INF = -1e30
 
@@ -34,12 +34,21 @@ def _interpret():
         "TPU" not in str(jax.devices()[0])
 
 
+def _fit_block(block, s):
+    """Largest 128-multiple ≤ `block` that divides s (0 if none)."""
+    for cand in range(min(block, s), 127, -128):
+        if cand % 128 == 0 and s % cand == 0:
+            return cand
+    return 0
+
+
 def flash_attention_supported(shape, block_q=BLOCK_Q, block_k=BLOCK_K):
-    """Kernel constraints: seq divisible by block sizes, MXU-friendly head
-    dim. Callers fall back to the XLA path otherwise."""
+    """Kernel constraints: seq divisible by some 128-multiple block ≤ the
+    requested size, MXU-friendly head dim. Callers fall back to the XLA
+    path otherwise."""
     b, s, h, d = shape
-    return s % block_q == 0 and s % block_k == 0 and \
-        d in (64, 128, 256) and s >= block_q
+    return _fit_block(block_q, s) > 0 and _fit_block(block_k, s) > 0 and \
+        d in (64, 128, 256)
 
 
 def _causal_mask(s, qi, ki, block_q, block_k):
@@ -74,11 +83,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale          # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                      # [BK, D]
+        # Matmuls take the inputs' native dtype (bf16 → MXU-rate) and
+        # accumulate fp32; only the softmax math is explicitly fp32.
+        q = q_ref[0]                                          # [BQ, D]
+        k = k_ref[0]                                          # [BK, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [BQ, BK]
+            preferred_element_type=jnp.float32) * sm_scale    # [BQ, BK]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
 
@@ -108,6 +119,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
     b, s, h, d = q.shape
+    block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
 
     # [B, S, H, D] → [B*H, S, D] for contiguous per-head tiles.
     def to_bh(x):
@@ -146,7 +158,11 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
     )(qb, kb, vb)
 
     out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-    return out4, (qb, kb, vb, out, lse)
+    # Keep only one lane of the lane-broadcast lse as the bwd residual:
+    # [BH, S] instead of [BH, S, 128] — 128× less live memory between
+    # forward and backward (the kernel-shaped broadcast is rebuilt
+    # transiently in _bwd).
+    return out4, (qb, kb, vb, out, lse[..., 0])
 
 
 # ---------------------------------------------------------------------------
@@ -171,27 +187,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                     # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                     # [BK, D]
+        q = q_ref[0]                                         # [BQ, D] bf16
+        k = k_ref[0]                                         # [BK, D] bf16
         s = jax.lax.dot_general(
-            q * sm_scale, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [BQ, BK]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [BQ, BK]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])                   # [BQ, BK]
-        do = do_ref[0].astype(jnp.float32)                   # [BQ, D]
-        # dV += Pᵀ dO
+        p = jnp.exp(s - lse_ref[0][:, :1])                   # [BQ, BK] f32
+        do = do_ref[0]                                       # [BQ, D]
+        # dV += Pᵀ dO  (P quantized to the wire dtype for MXU rate,
+        # matching the reference's fp16 kernel precision)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # dS = P ∘ (dO Vᵀ − delta)
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [BQ, BK]
         ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
         # dK += dSᵀ Q
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == n_q - 1)
@@ -216,21 +233,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(
-            q * sm_scale, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0][:, :1])
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_k - 1)
@@ -241,6 +258,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd(causal, sm_scale_arg, block_q, block_k, res, g):
     qb, kb, vb, out, lse = res
     bh, s, d = qb.shape
+    block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
+    lse = jnp.broadcast_to(lse[..., None], (bh, s, LANES))
     sm_scale = sm_scale_arg if sm_scale_arg is not None else \
         1.0 / math.sqrt(d)
 

@@ -497,8 +497,15 @@ class DeepSpeedEngine:
             factor = cfg.gradient_predivide_factor
             grads = jax.tree_util.tree_map(lambda g: g / factor, grads)
 
-        finite = grads_finite(grads)
-        overflow = jnp.logical_not(finite)
+        # bf16/fp32 runs have no loss-scaling machinery (reference
+        # `engine.py:613-620`): skip the isfinite pass over every grad and
+        # keep `overflow` a static False so the host never has to fetch it
+        # (a per-step device→host read serializes async dispatch).
+        if self._config.loss_scaling_enabled:
+            finite = grads_finite(grads)
+            overflow = jnp.logical_not(finite)
+        else:
+            overflow = False
 
         grad_norm = global_norm(grads)
         if cfg.gradient_clipping > 0:
@@ -510,14 +517,20 @@ class DeepSpeedEngine:
                                                     masters, lr=lr)
 
         # Branchless skip: on overflow keep every moment/param unchanged.
+        # With overflow statically False the selects trace away entirely.
         def select(new, old):
+            if overflow is False:
+                return jax.tree_util.tree_map(
+                    lambda n, o: n.astype(o.dtype), new, old)
             return jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n.astype(o.dtype)),
                 new, old)
 
         new_master = select(new_master, masters)
-        new_opt = jax.tree_util.tree_map(
-            lambda n, o: jnp.where(overflow, o, n), new_opt, state.opt_state)
+        if overflow is not False:
+            new_opt = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new_opt,
+                state.opt_state)
 
         new_params = jax.tree_util.tree_map(
             lambda m, sh: jax.lax.with_sharding_constraint(
@@ -820,7 +833,15 @@ class DeepSpeedEngine:
         return metrics
 
     def _after_step(self, metrics):
-        overflow = bool(metrics.overflow)
+        # Only fp16 loss-scaled runs can skip steps; for bf16/fp32 the
+        # overflow flag is statically False — never touch the device value
+        # (a host read per step stalls the async dispatch pipeline). The
+        # host-offload path detects non-finite grads on the host regardless
+        # of precision, so its (already host-resident) flag is always read.
+        if self._config.loss_scaling_enabled or self.host_offload:
+            overflow = bool(metrics.overflow)
+        else:
+            overflow = False
         if overflow:
             self.skipped_steps += 1
             log_dist(f"OVERFLOW! Skipping step; loss scale now "
