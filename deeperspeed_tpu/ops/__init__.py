@@ -1,1 +1,8 @@
-from . import adam, lamb
+from . import adam, lamb, op_builder, pallas, sparse_attention, transformer
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer)
+from .sparse_attention import SparseSelfAttention
+
+__all__ = ["adam", "lamb", "op_builder", "pallas", "sparse_attention",
+           "transformer", "DeepSpeedTransformerConfig",
+           "DeepSpeedTransformerLayer", "SparseSelfAttention"]

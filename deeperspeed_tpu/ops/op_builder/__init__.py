@@ -1,0 +1,169 @@
+"""Op build system (reference: `op_builder/builder.py:81`,
+`op_builder/{fused_adam,fused_lamb,cpu_adam,transformer,
+stochastic_transformer,sparse_attn,async_io,utils}.py`).
+
+The reference JIT-compiles CUDA extensions through torch's cpp_extension
+(or prebuilds them under ``DS_BUILD_OPS=1``). The TPU-native split is:
+
+- **Pallas/XLA ops** (fused optimizers, transformer kernels, flash/sparse
+  attention): compiled by XLA at first trace — `load()` just returns the
+  Python module and `is_compatible()` probes backend/shape support.
+- **Host-native ops** (CPU Adam for the offload tier, the async-IO spool
+  engine): real C++ in `csrc/`, JIT-built with g++ on first `load()`
+  exactly like the reference's JIT path (ctypes in place of pybind11).
+
+`builder.load()` raises with the build log when a native op can't build;
+`ds_report` renders the availability matrix (reference `env_report.py`).
+"""
+
+__all__ = [
+    "OpBuilder", "FusedAdamBuilder", "FusedLambBuilder", "CPUAdamBuilder",
+    "TransformerBuilder", "StochasticTransformerBuilder",
+    "SparseAttnBuilder", "AsyncIOBuilder", "UtilsBuilder", "ALL_OPS",
+    "get_default_compute_capabilities",
+]
+
+
+class OpBuilder:
+    """Base builder: `name`, `is_compatible()`, `load()` (reference
+    `op_builder/builder.py:81`)."""
+
+    NAME = "op"
+
+    @property
+    def name(self):
+        return self.NAME
+
+    def absolute_name(self):
+        return f"deeperspeed_tpu.ops.{self.NAME}"
+
+    def sources(self):
+        """Native source files, [] for XLA-compiled ops."""
+        return []
+
+    def is_compatible(self):
+        try:
+            self.load()
+            return True
+        except Exception:
+            return False
+
+    def load(self):
+        raise NotImplementedError
+
+    def builder(self):  # reference API (returns the torch ext builder)
+        return self
+
+
+class FusedAdamBuilder(OpBuilder):
+    NAME = "fused_adam"
+
+    def load(self):
+        from ..adam import fused_adam
+        return fused_adam
+
+
+class FusedLambBuilder(OpBuilder):
+    NAME = "fused_lamb"
+
+    def load(self):
+        from ..lamb import fused_lamb
+        return fused_lamb
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["csrc/adam/cpu_adam.cpp"]
+
+    def load(self):
+        from ..adam import cpu_adam_native
+        cpu_adam_native._build_library()
+        return cpu_adam_native
+
+
+class TransformerBuilder(OpBuilder):
+    NAME = "transformer"
+
+    def load(self):
+        from ..transformer import transformer
+        return transformer
+
+
+class StochasticTransformerBuilder(TransformerBuilder):
+    NAME = "stochastic_transformer"
+
+
+class SparseAttnBuilder(OpBuilder):
+    NAME = "sparse_attn"
+
+    def load(self):
+        from .. import sparse_attention
+        return sparse_attention
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "async_io"
+
+    def sources(self):
+        return ["csrc/aio/aio_engine.cpp"]
+
+    def load(self):
+        from ...runtime.swap_tensor import aio_engine
+        if not aio_engine.AsyncIOEngine.available():
+            raise RuntimeError("async_io native engine unavailable "
+                               "(no g++? see build log)")
+        return aio_engine
+
+
+class _FlattenUtils:
+    """torch's flatten/unflatten_dense_tensors equivalents on array lists
+    (reference `csrc/utils/flatten_unflatten.cpp`, loaded via
+    `UtilsBuilder().load()` by the engine and every ZeRO stage)."""
+
+    @staticmethod
+    def flatten(tensors):
+        import numpy as np
+        import jax.numpy as jnp
+        if not tensors:
+            return jnp.zeros((0,), jnp.float32)
+        mod = jnp if any(hasattr(t, "devices") for t in tensors) else np
+        return mod.concatenate([mod.ravel(mod.asarray(t))
+                                for t in tensors])
+
+    @staticmethod
+    def unflatten(flat, tensors):
+        import numpy as np
+        sizes = [int(np.prod(np.shape(t))) for t in tensors]
+        out, off = [], 0
+        for t, n in zip(tensors, sizes):
+            out.append(flat[off:off + n].reshape(np.shape(t)))
+            off += n
+        return out
+
+
+class UtilsBuilder(OpBuilder):
+    NAME = "utils"
+
+    def load(self):
+        return _FlattenUtils()
+
+
+ALL_OPS = {
+    b.NAME: b for b in (
+        FusedAdamBuilder(), FusedLambBuilder(), CPUAdamBuilder(),
+        TransformerBuilder(), StochasticTransformerBuilder(),
+        SparseAttnBuilder(), AsyncIOBuilder(), UtilsBuilder())
+}
+
+
+def get_default_compute_capabilities():
+    """Reference returns CUDA compute capabilities; on TPU report the
+    attached device generation(s)."""
+    import jax
+    try:
+        return sorted({getattr(d, "device_kind", str(d))
+                       for d in jax.devices()})
+    except Exception:
+        return []

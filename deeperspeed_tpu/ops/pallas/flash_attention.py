@@ -8,9 +8,11 @@ which is both the perf win (HBM bandwidth is the bottleneck) and the
 long-sequence enabler.
 
 Layout: [B, S, H, D] in, [B, S, H, D] out. Forward saves the per-row
-logsumexp (lane-broadcast to width 128, the TPU minor-dim tile); backward
-recomputes probabilities blockwise (no S×S residual). Block sizes default
-to 128×128 (MXU-shaped); fp32 accumulation throughout.
+logsumexp ([BH, S] — one lane of the kernel's lane-broadcast working
+layout); backward recomputes probabilities blockwise (no S×S residual).
+Block sizes default to 512×512, auto-fitted down to the largest
+128-multiple dividing the sequence length. Matmuls run at the input dtype
+(bf16 → full MXU rate) with fp32 accumulation; softmax math is fp32.
 
 On non-TPU backends the kernels run in interpreter mode (slow, test-only).
 """

@@ -1,0 +1,7 @@
+from .compressed import (compressed_allreduce_dense,
+                         compressed_allreduce_host)
+from .nccl import NcclBackend
+from .mpi import MpiBackend
+
+__all__ = ["compressed_allreduce_dense", "compressed_allreduce_host",
+           "NcclBackend", "MpiBackend"]

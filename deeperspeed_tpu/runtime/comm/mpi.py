@@ -1,0 +1,21 @@
+"""`MpiBackend`-compatible compressed-allreduce backend.
+
+Reference: `deepspeed/runtime/comm/mpi.py:14` — the mpi4py variant of the
+1-bit compressed allreduce, with an optional CUDA-aware fast path. On TPU
+multi-host jobs the transport under `jax.distributed` is the same ICI/DCN
+fabric the NCCL-shaped backend uses, so this class shares the math with
+`NcclBackend` and exists for API parity (user code selects backends by
+name: `comm_backend_name: "mpi"`).
+"""
+
+from .nccl import NcclBackend
+
+
+class MpiBackend(NcclBackend):
+    """Same compressed-allreduce semantics; `cuda_aware` accepted and
+    ignored (no host staging distinction on TPU — transfers are DMA'd by
+    the runtime either way)."""
+
+    def __init__(self, cuda_aware=False, mpu=None, axis_name="data"):
+        super().__init__(mpu=mpu, axis_name=axis_name)
+        self.cuda_aware = cuda_aware

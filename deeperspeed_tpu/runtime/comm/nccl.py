@@ -1,0 +1,87 @@
+"""`NcclBackend`-compatible compressed-allreduce backend.
+
+Reference: `deepspeed/runtime/comm/nccl.py:14-186` — the two-phase
+error-compensated 1-bit allreduce used by 1-bit Adam/LAMB:
+
+    phase 1: each worker quantizes its (error-compensated) chunk to sign
+             bits + an L1 scale, all_to_all's chunks to their "server"
+             rank;
+    phase 2: each server averages its chunk, re-quantizes with its own
+             error feedback, and allgathers the result.
+
+On TPU the transport is the ICI mesh and the quantized payload travels as
+int8 signs + a per-chunk fp32 scale via `shard_map` collectives
+(`all_to_all` + `all_gather`) — same wire volume as the reference's
+cupy-packed bits to within the 8×-vs-1× sign packing, same numerics. A
+host (numpy) fallback runs the identical math in one process so the
+backend is testable and usable without a mesh.
+
+The class name/API is kept for drop-in parity with user code written
+against the reference (`NcclBackend(mpu).compressed_allreduce(...)`).
+"""
+
+import jax.numpy as jnp
+
+from .compressed import compressed_allreduce_dense
+
+
+class NcclBackend:
+    """Error-compensated compressed allreduce over the data-parallel axis.
+
+    Parameters mirror the reference (`nccl.py:14`): an optional
+    Megatron-style ``mpu`` restricts the reduction to its data-parallel
+    group; on TPU that is the mesh ``data`` axis.
+    """
+
+    def __init__(self, mpu=None, axis_name="data"):
+        self.mpu = mpu
+        self.axis_name = axis_name
+
+    # -- in-mesh (shard_map / pjit) path ------------------------------
+
+    def compressed_allreduce_in_mesh(self, x, worker_error):
+        """Usable inside shard_map: returns (averaged, new_worker_error)."""
+        return compressed_allreduce_dense(x, worker_error, self.axis_name)
+
+    # -- host path (single process or explicit buffers) ---------------
+
+    def compressed_allreduce(self, buffer_m, worker_error, server_error,
+                             local_rank=None):
+        """Reference-signature compressed allreduce (`nccl.py:47`).
+
+        ``buffer_m`` is this rank's flat momentum buffer; in a
+        single-process TPU program every rank's buffer lives in the same
+        process, so `buffer_m` may be a list of per-rank buffers. Returns
+        the updated buffer(s) and mutates nothing.
+        """
+        single = not isinstance(buffer_m, (list, tuple))
+        buffers = [buffer_m] if single else list(buffer_m)
+        errors = [worker_error] if single else list(worker_error)
+        world = len(buffers)
+
+        # phase 1: worker-side quantization with error feedback
+        quantized, new_worker_errors = [], []
+        for buf, err in zip(buffers, errors):
+            buf = jnp.asarray(buf, jnp.float32)
+            err = jnp.asarray(err, jnp.float32)
+            compensated = buf + err
+            scale = jnp.mean(jnp.abs(compensated))
+            signs = jnp.where(compensated >= 0, 1.0, -1.0)
+            q = signs * scale
+            quantized.append(q)
+            new_worker_errors.append(compensated - q)
+
+        # phase 2: server-side average + re-quantization with the server
+        # error buffer
+        mean = sum(quantized) / world
+        server_error = jnp.asarray(server_error, jnp.float32)
+        compensated = mean + server_error
+        scale2 = jnp.mean(jnp.abs(compensated))
+        signs2 = jnp.where(compensated >= 0, 1.0, -1.0)
+        out = signs2 * scale2
+        new_server_error = compensated - out
+
+        outs = [out for _ in buffers]
+        if single:
+            return outs[0], new_worker_errors[0], new_server_error
+        return outs, new_worker_errors, new_server_error

@@ -1,0 +1,3 @@
+from .cupy import CupyBackend
+
+__all__ = ["CupyBackend"]

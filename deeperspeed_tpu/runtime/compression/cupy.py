@@ -1,0 +1,37 @@
+"""Sign-bit packing backend (reference: `deepspeed/runtime/compression/
+cupy.py:10` — `CupyBackend.compress_by_chunk` et al.).
+
+The reference packs sign bits on the GPU with cupy so the 1-bit
+collectives move 1/32 of the fp32 volume. Here packing runs on the host
+with numpy (the in-mesh compressed collectives on TPU move int8 signs —
+the fabric makes bit-level packing a non-goal), but the class name and
+method surface are preserved so reference-facing code imports unchanged.
+"""
+
+import numpy as np
+
+
+class CupyBackend:
+    """numpy-backed bit packing with the reference's method names."""
+
+    def torch2cupy(self, tensor):
+        return np.asarray(tensor)
+
+    def cupy2torch(self, cupy_tensor):
+        return np.asarray(cupy_tensor)
+
+    def compress_by_chunk(self, dense_array, num_chunks):
+        """Pack the sign bits of `dense_array` in `num_chunks` chunks
+        (reference `cupy.py:24`): returns a list of uint8 arrays."""
+        arr = np.asarray(dense_array)
+        signs = (arr.reshape(-1) >= 0)
+        packed = np.packbits(signs)
+        return [np.ascontiguousarray(c) for c in
+                np.array_split(packed, num_chunks)]
+
+    def decompress(self, packed_chunks, numel, dtype=np.float32):
+        """Inverse of `compress_by_chunk`: ±1 array of length `numel`."""
+        packed = np.concatenate([np.asarray(c, np.uint8).reshape(-1)
+                                 for c in packed_chunks])
+        bits = np.unpackbits(packed)[:numel]
+        return (bits.astype(dtype) * 2 - 1)
