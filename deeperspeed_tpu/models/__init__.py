@@ -1,0 +1,8 @@
+from . import bert, gpt_neox
+from .bert import (BertConfig, BertForPreTraining,
+                   BertForQuestionAnswering, BertModel)
+from .gpt_neox import GPTNeoX, GPTNeoXConfig
+
+__all__ = ["bert", "gpt_neox", "BertConfig", "BertForPreTraining",
+           "BertForQuestionAnswering", "BertModel", "GPTNeoX",
+           "GPTNeoXConfig"]

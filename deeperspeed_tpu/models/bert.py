@@ -1,0 +1,449 @@
+"""BERT model family, TPU-first (reference fixtures:
+`tests/unit/modeling.py` / `modelingpreln.py`; tutorial workload:
+`docs/_tutorials/bert-pretraining.md` — the reference's headline
+benchmark is BERT-Large pretraining over its fused transformer kernels).
+
+The encoder stacks `DeepSpeedTransformerLayer`
+(`deeperspeed_tpu/ops/transformer`) — the same fused block
+`module_inject.replace_transformer_layer` swaps into HF models — so BERT
+pretraining here exercises exactly the kernel path the reference's
+`test_cuda_forward/backward.py` parity tests cover.
+
+Heads follow the reference fixtures: masked-LM transform + embedding-tied
+decoder, next-sentence pooler head (`BertForPreTraining`), and the SQuAD
+span head (`BertForQuestionAnswering`, the BingBertSquad e2e workload).
+
+TPU-first choices mirror gpt_neox.py: bf16 activations with fp32
+layernorm/softmax, Megatron-pattern tensor-parallel PartitionSpecs over
+the `model` axis, flash-attention kernel when the mask allows, remat via
+the transformer config's checkpoint knobs.
+"""
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.transformer import (DeepSpeedTransformerConfig,
+                               DeepSpeedTransformerLayer)
+from ..parallel.mesh import MODEL_AXIS
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528          # 30522 padded to a 64-multiple
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    layernorm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True      # reference kernels default preLN
+    param_dtype: object = jnp.float32
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        return cls(hidden_size=1024, num_layers=24, num_heads=16,
+                   intermediate_size=4096, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(vocab_size=512, hidden_size=64, num_layers=2,
+                   num_heads=4, intermediate_size=256,
+                   max_position_embeddings=128, **kw)
+
+    def num_params(self):
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * i + 9 * h + i
+        embed = (v + self.max_position_embeddings +
+                 self.type_vocab_size) * h + 2 * h
+        pooler = h * h + h
+        mlm = h * h + h + 2 * h + v      # transform + LN + decoder bias
+        nsp = h * 2 + 2
+        return embed + self.num_layers * per_layer + pooler + mlm + nsp
+
+    def transformer_config(self, training=True):
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_heads,
+            attn_dropout_ratio=self.attn_dropout,
+            hidden_dropout_ratio=self.hidden_dropout,
+            num_hidden_layers=self.num_layers,
+            initializer_range=self.initializer_range,
+            layer_norm_eps=self.layernorm_eps,
+            pre_layer_norm=self.pre_layer_norm,
+            training=training,
+            adjust_init_range=True)
+
+
+def _dense_init(key, shape, dtype, scale):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+class BertModel:
+    """Embeddings + encoder + pooler (reference `modeling.py` BertModel)."""
+
+    def __init__(self, config=None, **kw):
+        self.config = config or BertConfig(**kw)
+        self.layer = DeepSpeedTransformerLayer(
+            self.config.transformer_config())
+
+    # -- params -----------------------------------------------------------
+
+    def init_params(self, rng):
+        cfg = self.config
+        h = cfg.hidden_size
+        std = cfg.initializer_range
+        dt = cfg.param_dtype
+        keys = jax.random.split(rng, cfg.num_layers + 6)
+        params = {
+            "embeddings": {
+                "word": _dense_init(keys[0], (cfg.vocab_size, h), dt, std),
+                "position": _dense_init(
+                    keys[1], (cfg.max_position_embeddings, h), dt, std),
+                "token_type": _dense_init(
+                    keys[2], (cfg.type_vocab_size, h), dt, std),
+                "ln_scale": jnp.ones((h,), dt),
+                "ln_bias": jnp.zeros((h,), dt),
+            },
+            "layers": [self.layer.init(keys[3 + i])
+                       for i in range(cfg.num_layers)],
+            "pooler": {
+                "w": _dense_init(keys[-2], (h, h), dt, std),
+                "b": jnp.zeros((h,), dt),
+            },
+        }
+        return params
+
+    # -- forward ----------------------------------------------------------
+
+    def embed(self, params, input_ids, token_type_ids=None):
+        cfg = self.config
+        e = params["embeddings"]
+        S = input_ids.shape[1]
+        x = e["word"][input_ids]
+        x = x + e["position"][None, :S, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + e["token_type"][token_type_ids]
+        return _layer_norm(x, e["ln_scale"], e["ln_bias"],
+                           cfg.layernorm_eps)
+
+    def encode(self, params, input_ids, token_type_ids=None,
+               attention_mask=None, rng=None, deterministic=True):
+        x = self.embed(params, input_ids, token_type_ids)
+        rngs = (jax.random.split(rng, self.config.num_layers)
+                if rng is not None else [None] * self.config.num_layers)
+        for lp, r in zip(params["layers"], rngs):
+            x = self.layer.apply(lp, x, attention_mask=attention_mask,
+                                 rng=r, deterministic=deterministic)
+        return x
+
+    def pool(self, params, sequence_output):
+        first = sequence_output[:, 0, :]
+        return jnp.tanh(first @ params["pooler"]["w"].astype(first.dtype) +
+                        params["pooler"]["b"].astype(first.dtype))
+
+    # -- tensor-parallel specs -------------------------------------------
+
+    def layer_param_specs(self):
+        return {
+            "attn_qkvw": P(None, MODEL_AXIS), "attn_qkvb": P(MODEL_AXIS),
+            "attn_ow": P(MODEL_AXIS, None), "attn_ob": P(),
+            "attn_nw": P(), "attn_nb": P(),
+            "inter_w": P(None, MODEL_AXIS), "inter_b": P(MODEL_AXIS),
+            "output_w": P(MODEL_AXIS, None), "output_b": P(),
+            "norm_w": P(), "norm_b": P(),
+        }
+
+    def param_specs(self, params, mesh):
+        if MODEL_AXIS not in mesh.axis_names or \
+                mesh.shape[MODEL_AXIS] == 1:
+            return jax.tree_util.tree_map(lambda p: P(), params)
+        specs = jax.tree_util.tree_map(lambda p: P(), params)
+        specs["embeddings"]["word"] = P(MODEL_AXIS, None)
+        specs["layers"] = [self.layer_param_specs()
+                           for _ in params["layers"]]
+        return specs
+
+
+class BertForPreTraining:
+    """MLM + NSP pretraining heads (reference `modeling.py`
+    BertForPreTraining; the bert-pretraining tutorial workload).
+
+    Batch: (input_ids, token_type_ids, attention_mask, masked_lm_labels,
+    next_sentence_label); masked positions carry the label id, all other
+    positions -1 (ignored) — the reference convention.
+    """
+
+    def __init__(self, config=None, **kw):
+        self.bert = BertModel(config, **kw)
+        self.config = self.bert.config
+
+    def init_params(self, rng):
+        cfg = self.config
+        h = cfg.hidden_size
+        dt = cfg.param_dtype
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params = self.bert.init_params(k1)
+        params["cls"] = {
+            # MLM transform; decoder weight is tied to the word embedding
+            "transform_w": _dense_init(k2, (h, h), dt,
+                                       cfg.initializer_range),
+            "transform_b": jnp.zeros((h,), dt),
+            "ln_scale": jnp.ones((h,), dt),
+            "ln_bias": jnp.zeros((h,), dt),
+            "decoder_bias": jnp.zeros((cfg.vocab_size,), dt),
+            "nsp_w": _dense_init(k3, (h, 2), dt, cfg.initializer_range),
+            "nsp_b": jnp.zeros((2,), dt),
+        }
+        return params
+
+    def param_specs(self, params, mesh):
+        specs = self.bert.param_specs(params, mesh)
+        if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
+            specs["cls"]["decoder_bias"] = P(MODEL_AXIS)
+        return specs
+
+    def apply(self, params, input_ids, token_type_ids=None,
+              attention_mask=None, rng=None, deterministic=True):
+        cfg = self.config
+        seq = self.bert.encode(params, input_ids, token_type_ids,
+                               attention_mask, rng, deterministic)
+        c = params["cls"]
+        t = seq @ c["transform_w"].astype(seq.dtype) + \
+            c["transform_b"].astype(seq.dtype)
+        t = jax.nn.gelu(t, approximate=False)
+        t = _layer_norm(t, c["ln_scale"], c["ln_bias"], cfg.layernorm_eps)
+        # decoder tied to word embeddings (reference modeling.py ties
+        # cls.predictions.decoder.weight to word_embeddings.weight)
+        mlm_logits = jnp.einsum(
+            "bsh,vh->bsv", t,
+            params["embeddings"]["word"].astype(t.dtype),
+            preferred_element_type=jnp.float32) + \
+            c["decoder_bias"].astype(jnp.float32)
+        pooled = self.bert.pool(params, seq)
+        nsp_logits = pooled @ c["nsp_w"].astype(pooled.dtype) + \
+            c["nsp_b"].astype(pooled.dtype)
+        return mlm_logits, nsp_logits.astype(jnp.float32)
+
+    def loss_fn(self, params, batch, rng=None):
+        input_ids, token_type_ids, attention_mask, mlm_labels, nsp_label = \
+            self._unpack(batch)
+        mlm_logits, nsp_logits = self.apply(
+            params, input_ids, token_type_ids, attention_mask, rng,
+            deterministic=rng is None)
+        logp = jax.nn.log_softmax(mlm_logits, axis=-1)
+        valid = mlm_labels >= 0
+        safe = jnp.where(valid, mlm_labels, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None],
+                                     axis=-1).squeeze(-1)
+        mlm_loss = -jnp.sum(picked * valid) / jnp.maximum(
+            jnp.sum(valid), 1)
+        nsp_logp = jax.nn.log_softmax(nsp_logits, axis=-1)
+        nsp_loss = -jnp.mean(
+            jnp.take_along_axis(nsp_logp, nsp_label[:, None],
+                                axis=-1))
+        return mlm_loss + nsp_loss
+
+    @staticmethod
+    def _unpack(batch):
+        if isinstance(batch, dict):
+            return (batch["input_ids"], batch.get("token_type_ids"),
+                    batch.get("attention_mask"),
+                    batch["masked_lm_labels"],
+                    batch["next_sentence_label"])
+        return batch
+
+
+class BertForQuestionAnswering:
+    """SQuAD span head (reference `modeling.py` BertForQuestionAnswering;
+    the BingBertSquad e2e workload, `tests/model/BingBertSquad/`).
+
+    Batch: (input_ids, token_type_ids, attention_mask, start_positions,
+    end_positions).
+    """
+
+    def __init__(self, config=None, **kw):
+        self.bert = BertModel(config, **kw)
+        self.config = self.bert.config
+
+    def init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        params = self.bert.init_params(k1)
+        params["qa"] = {
+            "w": _dense_init(k2, (self.config.hidden_size, 2),
+                             self.config.param_dtype,
+                             self.config.initializer_range),
+            "b": jnp.zeros((2,), self.config.param_dtype),
+        }
+        return params
+
+    def param_specs(self, params, mesh):
+        return self.bert.param_specs(params, mesh)
+
+    def apply(self, params, input_ids, token_type_ids=None,
+              attention_mask=None, rng=None, deterministic=True):
+        seq = self.bert.encode(params, input_ids, token_type_ids,
+                               attention_mask, rng, deterministic)
+        logits = seq @ params["qa"]["w"].astype(seq.dtype) + \
+            params["qa"]["b"].astype(seq.dtype)
+        start, end = jnp.split(logits.astype(jnp.float32), 2, axis=-1)
+        return start.squeeze(-1), end.squeeze(-1)
+
+    def loss_fn(self, params, batch, rng=None):
+        input_ids, token_type_ids, attention_mask, start_pos, end_pos = \
+            batch if not isinstance(batch, dict) else (
+                batch["input_ids"], batch.get("token_type_ids"),
+                batch.get("attention_mask"), batch["start_positions"],
+                batch["end_positions"])
+        start_logits, end_logits = self.apply(
+            params, input_ids, token_type_ids, attention_mask, rng,
+            deterministic=rng is None)
+
+        def xent(logits, pos):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, pos[:, None],
+                                                 axis=-1))
+
+        return 0.5 * (xent(start_logits, start_pos) +
+                      xent(end_logits, end_pos))
+
+
+# ---------------------------------------------------------------------------
+# pipeline layer factories
+# ---------------------------------------------------------------------------
+#
+# Inter-stage activations are the tuple (hidden, attention_mask) so every
+# encoder stage masks padding exactly like the non-pipelined
+# `BertModel.encode`. The head stage holds its own decoder table: tying
+# across pipeline stages would replicate the [V, H] embedding on the last
+# stage and allreduce its grads (the reference's tied mechanism) — for
+# BERT the untied head is the standard pipeline trade.
+
+class BertEmbeddingsPipe:
+    """inputs: input_ids [B,S] or (input_ids, attention_mask) →
+    (hidden, attention_mask)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._model = BertModel(cfg)
+
+    def init(self, rng, x=None):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        dt = cfg.param_dtype
+        keys = jax.random.split(rng, 3)
+        std = cfg.initializer_range
+        return {
+            "word": _dense_init(keys[0], (cfg.vocab_size, h), dt, std),
+            "position": _dense_init(
+                keys[1], (cfg.max_position_embeddings, h), dt, std),
+            "token_type": _dense_init(
+                keys[2], (cfg.type_vocab_size, h), dt, std),
+            "ln_scale": jnp.ones((h,), dt),
+            "ln_bias": jnp.zeros((h,), dt),
+        }
+
+    def apply(self, params, inputs, rng=None):
+        if isinstance(inputs, (tuple, list)):
+            input_ids, mask = inputs
+        else:
+            input_ids, mask = inputs, None
+        x = self._model.embed({"embeddings": params}, input_ids)
+        return (x, mask)
+
+
+class BertLayerPipe:
+    """(hidden, attention_mask) → (hidden, attention_mask)."""
+
+    def __init__(self, cfg):
+        self.layer = DeepSpeedTransformerLayer(cfg.transformer_config())
+
+    def init(self, rng, x=None):
+        return self.layer.init(rng)
+
+    def apply(self, params, inputs, rng=None):
+        x, mask = inputs
+        x = self.layer.apply(params, x, attention_mask=mask, rng=rng)
+        return (x, mask)
+
+
+class BertMLMHeadPipe:
+    """(hidden, mask) → (mlm_logits, nsp_logits); untied decoder table."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def init(self, rng, x=None):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        dt = cfg.param_dtype
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        std = cfg.initializer_range
+        return {
+            "transform_w": _dense_init(k1, (h, h), dt, std),
+            "transform_b": jnp.zeros((h,), dt),
+            "ln_scale": jnp.ones((h,), dt),
+            "ln_bias": jnp.zeros((h,), dt),
+            "decoder": _dense_init(k2, (cfg.vocab_size, h), dt, std),
+            "decoder_bias": jnp.zeros((cfg.vocab_size,), dt),
+            "pooler_w": _dense_init(k3, (h, h), dt, std),
+            "pooler_b": jnp.zeros((h,), dt),
+            "nsp_w": _dense_init(k4, (h, 2), dt, std),
+            "nsp_b": jnp.zeros((2,), dt),
+        }
+
+    def apply(self, params, inputs, rng=None):
+        cfg = self.cfg
+        seq, _ = inputs
+        t = seq @ params["transform_w"].astype(seq.dtype) + \
+            params["transform_b"].astype(seq.dtype)
+        t = jax.nn.gelu(t, approximate=False)
+        t = _layer_norm(t, params["ln_scale"], params["ln_bias"],
+                        cfg.layernorm_eps)
+        mlm = jnp.einsum("bsh,vh->bsv", t,
+                         params["decoder"].astype(t.dtype),
+                         preferred_element_type=jnp.float32) + \
+            params["decoder_bias"].astype(jnp.float32)
+        first = seq[:, 0, :]
+        pooled = jnp.tanh(
+            first @ params["pooler_w"].astype(first.dtype) +
+            params["pooler_b"].astype(first.dtype))
+        nsp = pooled @ params["nsp_w"].astype(pooled.dtype) + \
+            params["nsp_b"].astype(pooled.dtype)
+        return mlm, nsp.astype(jnp.float32)
+
+
+def to_layer_specs(cfg, with_head=True):
+    """LayerSpec list for PipelineModule: embeddings → N encoder layers
+    [→ MLM/NSP head]."""
+    from ..runtime.pipe import LayerSpec
+    specs = [LayerSpec(BertEmbeddingsPipe, cfg)]
+    for _ in range(cfg.num_layers):
+        specs.append(LayerSpec(BertLayerPipe, cfg))
+    if with_head:
+        specs.append(LayerSpec(BertMLMHeadPipe, cfg))
+    return specs

@@ -457,6 +457,14 @@ class OutputHeadPipe:
                           preferred_element_type=jnp.float32)
 
 
+def _tied_logits_helper(module, params, x):
+    """forward_fn for the tied output site: the shared embedding table
+    used as the LM head (GPT-NeoX's `_logits_helper` pattern — the tied
+    module is the EmbeddingPipe, the computation is the projection)."""
+    return jnp.einsum("bsh,vh->bsv", x, params["wte"].astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
 def to_layer_specs(cfg, use_pallas=True):
     """LayerSpec list for PipelineModule (reference: GPT-NeoX's pipelined
     model description)."""
@@ -471,7 +479,8 @@ def to_layer_specs(cfg, use_pallas=True):
         specs.append(LayerSpec(TransformerBlockPipe, cfg, use_pallas))
     specs.append(LayerSpec(FinalNormPipe, cfg))
     if cfg.tie_word_embeddings:
-        specs.append(TiedLayerSpec("embed", OutputHeadPipe, cfg,
+        specs.append(TiedLayerSpec("embed", EmbeddingPipe, cfg,
+                                   forward_fn=_tied_logits_helper,
                                    tied_weight_attr="wte"))
     else:
         specs.append(LayerSpec(OutputHeadPipe, cfg))

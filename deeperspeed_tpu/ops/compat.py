@@ -63,14 +63,20 @@ def utils_available():
     return True
 
 
-ALL_OPS = {
-    "fused_adam": fused_adam_available,
-    "cpu_adam": cpu_adam_available,
-    "fused_lamb": fused_lamb_available,
-    "transformer": transformer_available,
-    "stochastic_transformer": stochastic_transformer_available,
-    "flash_attention": flash_attention_available,
-    "sparse_attn": sparse_attn_available,
-    "async_io": async_io_available,
-    "utils": utils_available,
-}
+def _builder_checks():
+    """One registry: the op_builder builders are the source of truth
+    (`ds_report` renders this dict); flash_attention is a kernel-level
+    probe with no reference builder, so it is appended here."""
+    from .op_builder import ALL_OPS as BUILDERS
+    checks = {name: builder.is_compatible
+              for name, builder in BUILDERS.items()}
+    # keep flash_attention between the transformer and sparse_attn rows
+    ordered = {}
+    for name in checks:
+        ordered[name] = checks[name]
+        if name == "stochastic_transformer":
+            ordered["flash_attention"] = flash_attention_available
+    return ordered
+
+
+ALL_OPS = _builder_checks()

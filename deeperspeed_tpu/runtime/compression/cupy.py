@@ -22,16 +22,23 @@ class CupyBackend:
 
     def compress_by_chunk(self, dense_array, num_chunks):
         """Pack the sign bits of `dense_array` in `num_chunks` chunks
-        (reference `cupy.py:24`): returns a list of uint8 arrays."""
+        (reference `cupy.py:24`): the *elements* are chunked first, then
+        each chunk is packed independently, so a server rank can
+        decompress its own chunk without the others."""
         arr = np.asarray(dense_array)
         signs = (arr.reshape(-1) >= 0)
-        packed = np.packbits(signs)
-        return [np.ascontiguousarray(c) for c in
-                np.array_split(packed, num_chunks)]
+        return [np.ascontiguousarray(np.packbits(c))
+                for c in np.array_split(signs, num_chunks)]
 
     def decompress(self, packed_chunks, numel, dtype=np.float32):
-        """Inverse of `compress_by_chunk`: ±1 array of length `numel`."""
-        packed = np.concatenate([np.asarray(c, np.uint8).reshape(-1)
-                                 for c in packed_chunks])
-        bits = np.unpackbits(packed)[:numel]
-        return (bits.astype(dtype) * 2 - 1)
+        """Inverse of `compress_by_chunk` for a chunk list covering
+        `numel` total elements: ±1 array of length `numel`. Each chunk is
+        unpacked independently (chunks are byte-padded separately)."""
+        counts = [len(c) for c in
+                  np.array_split(np.empty(numel, np.bool_),
+                                 len(packed_chunks))]
+        outs = []
+        for packed, n in zip(packed_chunks, counts):
+            bits = np.unpackbits(np.asarray(packed, np.uint8))[:n]
+            outs.append(bits.astype(dtype) * 2 - 1)
+        return np.concatenate(outs) if outs else np.zeros(0, dtype)

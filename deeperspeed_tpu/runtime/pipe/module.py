@@ -91,8 +91,13 @@ def _as_layer(obj):
     raise TypeError(f"cannot interpret {obj!r} as a pipeline layer")
 
 
-def _apply_layer(layer, params, x, rng=None):
-    kwargs = {}
+def _apply_layer(layer, params, x, rng=None, forward_fn=None):
+    if forward_fn is not None:
+        # TiedLayerSpec.forward_fn (reference `module.py:72`): same tied
+        # module/params, alternate computation at this site (e.g. the
+        # embedding table used as the output projection — GPT-NeoX's
+        # `_logits_helper` pattern).
+        return forward_fn(layer, params, x)
     apply_fn = getattr(layer, "apply", None)
     if apply_fn is None:
         return layer(params, x)
@@ -155,9 +160,11 @@ class PipelineModule:
                     self.tied_modules[spec.key] = spec.build()
                 self.layers.append(self.tied_modules[spec.key])
                 self._tied_keys_per_layer.append(spec.key)
+                self.forward_funcs.append(spec.forward_fn)
             else:
                 self.layers.append(_as_layer(spec))
                 self._tied_keys_per_layer.append(None)
+                self.forward_funcs.append(None)
 
     def _count_layer_params(self, params):
         counts = []
@@ -241,7 +248,8 @@ class PipelineModule:
                 else:
                     layer_params.append(params)
             x = jax.eval_shape(
-                lambda p, xx, layer=layer: _apply_layer(layer, p, xx),
+                lambda p, xx, layer=layer, idx=idx: _apply_layer(
+                    layer, p, xx, forward_fn=self.forward_funcs[idx]),
                 params, x)
             x = jnp.zeros(x.shape, x.dtype) if hasattr(x, "shape") else x
         params = {"layers": layer_params, "tied": tied_params}
@@ -268,7 +276,8 @@ class PipelineModule:
                 lrng = jax.random.fold_in(rng, idx) if rng is not None \
                     else None
                 x = _apply_layer(layer, self._layer_param(params, idx), x,
-                                 rng=lrng)
+                                 rng=lrng,
+                                 forward_fn=self.forward_funcs[idx])
             return x
 
         if interval and interval > 0:
