@@ -1,0 +1,5 @@
+from .profiler import (FlopsProfiler, duration_to_string,
+                       flops_to_string, params_to_string, profile_fn)
+
+__all__ = ["FlopsProfiler", "duration_to_string", "flops_to_string",
+           "params_to_string", "profile_fn"]

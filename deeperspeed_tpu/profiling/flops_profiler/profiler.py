@@ -98,21 +98,8 @@ class FlopsProfiler:
 
     # -- results -----------------------------------------------------------
 
-    def _compiled_step(self):
-        eng = self.engine
-        if eng is None:
-            return None
-        compiled = getattr(eng, "_compiled_train", None)
-        if compiled:
-            return next(iter(compiled.values()))
-        return None
-
     def get_total_flops(self, as_string=False):
         flops = self._results.get("flops", 0.0)
-        if not flops and self.engine is not None:
-            fn = self._compiled_step()
-            if fn is not None and getattr(fn, "_cache_size", lambda: 0)():
-                pass
         return flops_to_string(flops) if as_string else flops
 
     def get_total_duration(self, as_string=False):
@@ -126,18 +113,29 @@ class FlopsProfiler:
         return params_to_string(n) if as_string else n
 
     def profile_train_step(self, batch):
-        """Cost-analyze the engine's fused train step on `batch`."""
+        """Cost-analyze the engine's train step on `batch`.
+
+        Uses an undonated build of the step (the engine's production step
+        donates its state buffers — executing it here would invalidate
+        `engine.state`); host-offload engines profile their grads-step,
+        which is what their device program actually is.
+        """
         eng = self.engine
         gas = eng.gradient_accumulation_steps()
-        if gas not in eng._compiled_train:
-            eng._compiled_train[gas] = eng._build_train_step(gas)
         import jax.numpy as jnp
-        lr = jnp.asarray(eng.optimizer.param_groups[0]["lr"], jnp.float32)
         rng = jax.random.PRNGKey(0)
         sharded = eng._shard_stacked_batch(batch)
-        results = profile_fn(
-            lambda s, b, r, l: eng._compiled_train[gas](s, b, r, l),
-            eng.state, sharded, rng, lr, n_timing_iters=1)
+        if eng.host_offload:
+            results = profile_fn(
+                eng._build_grads_step(gas).__wrapped__,
+                eng.state.params, sharded, rng, eng.state.scale.cur_scale,
+                n_timing_iters=1)
+        else:
+            lr = jnp.asarray(eng.optimizer.param_groups[0]["lr"],
+                             jnp.float32)
+            results = profile_fn(
+                eng._build_train_step(gas, donate=False).__wrapped__,
+                eng.state, sharded, rng, lr, n_timing_iters=1)
         self._results.update(results)
         return results
 

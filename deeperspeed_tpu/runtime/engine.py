@@ -168,6 +168,15 @@ class DeepSpeedEngine:
         self.store_gradients = self._config.store_gradients
         self.stored_gradients = None
 
+        # Flops profiler auto-hook (reference `engine.py:966-1019`): at
+        # `profile_step` the jitted train step is cost-analyzed and the
+        # report printed.
+        self.flops_profiler = None
+        self._flops_profiled = False
+        if self._config.flops_profiler_config.enabled:
+            from ..profiling.flops_profiler.profiler import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(engine=self)
+
         # --- offload tier -------------------------------------------------
         zc = self._config.zero_config
         self.host_offload = (zc.offload_optimizer is not None)
@@ -570,9 +579,11 @@ class DeepSpeedEngine:
             return self._apply_update(state, grads, lr)
         return jax.jit(update_fn, donate_argnums=(0, 1))
 
-    def _build_train_step(self, accum_steps):
+    def _build_train_step(self, accum_steps, donate=True):
         """Fused step: scan over [accum, batch, ...] micro-batches, mean the
-        grads, apply the update — one compilation, zero host round-trips."""
+        grads, apply the update — one compilation, zero host round-trips.
+        `donate=False` builds an undonated variant (profiling) that leaves
+        the caller's state buffers intact."""
         def train_step(state, batches, rng, lr):
             scale = state.scale.cur_scale
 
@@ -601,7 +612,7 @@ class DeepSpeedEngine:
             new_state, metrics = self._apply_update(state, grads, lr)
             return new_state, metrics._replace(loss=mean_loss)
 
-        return jax.jit(train_step, donate_argnums=(0,))
+        return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
     def _build_grads_step(self, accum_steps):
         """Offload path: fused grad accumulation, no device update."""
@@ -871,6 +882,22 @@ class DeepSpeedEngine:
             batch = jax.tree_util.tree_map(
                 lambda *xs: np.stack(xs), *micro)
         self._assert_comm_precision()
+
+        fp_cfg = self._config.flops_profiler_config
+        if self.flops_profiler is not None and \
+                not self._flops_profiled and \
+                self.global_steps >= fp_cfg.profile_step:
+            # >= plus the flag: profiles exactly once even if the step at
+            # profile_step is skipped by an fp16 overflow (global_steps
+            # does not advance on skipped steps).
+            self._flops_profiled = True
+            self.flops_profiler.profile_train_step(batch)
+            self.flops_profiler.print_model_profile(
+                profile_step=fp_cfg.profile_step,
+                module_depth=fp_cfg.module_depth,
+                top_modules=fp_cfg.top_modules,
+                detailed=fp_cfg.detailed)
+
         self.tput_timer.start()
 
         # comms_timer (fork: engine.py:1164, zero/stage1.py:688): in-jit

@@ -53,3 +53,25 @@ def test_string_helpers():
     assert flops_to_string(2e12) == "2.0 TFLOPS"
     assert params_to_string(1.5e6) == "1.5 M"
     assert "ms" in duration_to_string(0.005)
+
+
+def test_engine_auto_profiles_at_profile_step():
+    model = SimpleModel(hidden_dim=8, num_layers=1)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(
+            jax.random.PRNGKey(0)),
+        config_params={"train_batch_size": 8 * jax.device_count(),
+                       "optimizer": {"type": "Adam",
+                                     "params": {"lr": 1e-3}},
+                       "flops_profiler": {"enabled": True,
+                                          "profile_step": 1},
+                       "steps_per_print": 100})
+    assert engine.flops_profiler is not None
+    x = np.ones((1, 8 * jax.device_count(), 8), np.float32)
+    batch = (x, x)
+    engine.train_batch(batch=batch)   # step 0 → global_steps 1
+    engine.train_batch(batch=batch)   # profiles at global_steps == 1
+    # the auto-hook ran the cost analysis and cached the results
+    assert engine.flops_profiler.get_total_flops() > 0
+    report = engine.flops_profiler.print_model_profile()
+    assert "Flops Profiler" in report and "params" in report
