@@ -273,6 +273,80 @@ def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
     return ln2_in + mlp_out
 
 
+def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
+                     use_pallas=True):
+    """`block_forward` with explicit Megatron tensor parallelism for use
+    inside `shard_map`: params arrive pre-sliced over `model_axis` (qkv/
+    mlp-in column-sharded → local heads, attn-out/mlp-out row-sharded →
+    partial sums), and ONE `psum` per block combines the attention and
+    MLP partials (the parallel-residual form needs a single collective —
+    the fusion Megatron gets from its row-parallel allreduce).
+
+    x is replicated over `model_axis`; mp = mesh size of that axis.
+    """
+    B, S, h = x.shape
+    nh_local = cfg.num_heads // mp
+    hd = cfg.head_dim
+    cos, sin, rot_dim = cos_sin
+
+    ln1 = layer_norm(x, params["ln_attn"]["scale"], params["ln_attn"]["bias"],
+                     cfg.layernorm_eps)
+    # qkv_w local: [h, 3h/mp] (column parallel) → local heads
+    qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
+        params["attn"]["qkv_b"].astype(x.dtype)
+    qkv = qkv.reshape(B, S, nh_local, 3 * hd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k = apply_rotary(q, k, cos, sin, rot_dim)
+    attn = causal_attention(q, k, v, use_pallas=use_pallas)
+    attn = attn.reshape(B, S, h // mp)
+    # out_w local: [h/mp, h] (row parallel) → partial sum over model
+    attn_partial = attn @ params["attn"]["out_w"].astype(x.dtype)
+
+    if cfg.use_parallel_residual:
+        ln2_in = x
+    else:
+        attn_out = jax.lax.psum(attn_partial, model_axis) + \
+            params["attn"]["out_b"].astype(x.dtype)
+        ln2_in = x + attn_out
+    ln2 = layer_norm(ln2_in, params["ln_mlp"]["scale"],
+                     params["ln_mlp"]["bias"], cfg.layernorm_eps)
+    hmid = ln2 @ params["mlp"]["in_w"].astype(x.dtype) + \
+        params["mlp"]["in_b"].astype(x.dtype)
+    hmid = jax.nn.gelu(hmid)
+    mlp_partial = hmid @ params["mlp"]["out_w"].astype(x.dtype)
+
+    if cfg.use_parallel_residual:
+        combined = jax.lax.psum(attn_partial + mlp_partial, model_axis)
+        return x + combined + \
+            params["attn"]["out_b"].astype(x.dtype) + \
+            params["mlp"]["out_b"].astype(x.dtype)
+    mlp_out = jax.lax.psum(mlp_partial, model_axis) + \
+        params["mlp"]["out_b"].astype(x.dtype)
+    return ln2_in + mlp_out
+
+
+def block_param_specs_tp(pipe_axis=None):
+    """Per-leaf PartitionSpecs for TP-sliced block params inside
+    shard_map; `pipe_axis` prepends the stacked-layer dim sharding."""
+    lead = (pipe_axis,) if pipe_axis is not None else ()
+    return {
+        "ln_attn": {"scale": P(*lead), "bias": P(*lead)},
+        "ln_mlp": {"scale": P(*lead), "bias": P(*lead)},
+        "attn": {
+            "qkv_w": P(*lead, None, MODEL_AXIS),
+            "qkv_b": P(*lead, MODEL_AXIS),
+            "out_w": P(*lead, MODEL_AXIS, None),
+            "out_b": P(*lead),
+        },
+        "mlp": {
+            "in_w": P(*lead, None, MODEL_AXIS),
+            "in_b": P(*lead, MODEL_AXIS),
+            "out_w": P(*lead, MODEL_AXIS, None),
+            "out_b": P(*lead),
+        },
+    }
+
+
 def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False):
     """tokens [B, S] int32 → final-norm hidden states [B, S, H]."""
     x = params["embed"]["wte"][tokens]

@@ -96,15 +96,26 @@ def last_stage_value(value, axis_name, n_stages):
 
 
 def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
-                     axis_name=PIPE_AXIS, remat=True, fp32_comm=None):
+                     axis_name=PIPE_AXIS, remat=True, fp32_comm=None,
+                     data_axis=None, blocks_specs=None, embed_specs=None,
+                     head_specs=None):
     """Build loss(params, batch, rng) running the block stack pipelined.
 
     params = {"embed": ..., "blocks": stacked leaves [L, ...],
-              "head": ...}; blocks must be sharded over (axis_name,) on
-    dim 0 by the caller's param specs. batch = (tokens [B, S], labels).
-    The global batch splits into `n_micro` micro-batches along dim 0.
+              "head": ...}; blocks are sharded over (axis_name,) on dim 0
+    — or per `blocks_specs` (a matching pytree of PartitionSpecs, e.g.
+    `block_param_specs_tp` for tensor-parallel slices). batch =
+    (tokens [B, S], labels). The global batch splits into `n_micro`
+    micro-batches along dim 0.
+
+    With `data_axis` set (and present in the mesh), the batch is consumed
+    sharded over that axis and the loss is the data-parallel mean — a
+    full dp×pp(×tp) step in one program; shard_map's transpose inserts
+    the gradient psums over every axis a parameter is replicated on.
     """
     n_stages = int(mesh.shape[axis_name])
+    dp_active = (data_axis is not None and data_axis in mesh.axis_names
+                 and int(mesh.shape[data_axis]) > 1)
 
     def loss_fn(params, batch, rng=None):
         tokens, labels = batch
@@ -126,16 +137,25 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
                 lambda h, l: head_loss_fn(head_params, h, l))(outputs,
                                                               lab_micro)
             loss = jnp.mean(losses)
-            return last_stage_value(loss, axis_name, n_stages)
+            loss = last_stage_value(loss, axis_name, n_stages)
+            if dp_active:
+                loss = jax.lax.pmean(loss, data_axis)
+            return loss
 
-        # blocks enter sharded over pipe; everything else replicated over
-        # pipe (data sharding handled outside by the engine's jit).
-        blocks_spec = jax.tree_util.tree_map(
-            lambda _: P(axis_name), params["blocks"])
+        if blocks_specs is None:
+            bspecs = jax.tree_util.tree_map(
+                lambda _: P(axis_name), params["blocks"])
+        else:
+            bspecs = blocks_specs
         other = P()
+        especs = embed_specs if embed_specs is not None else \
+            jax.tree_util.tree_map(lambda _: other, params["embed"])
+        hspecs = head_specs if head_specs is not None else \
+            jax.tree_util.tree_map(lambda _: other, params["head"])
+        batch_spec = P(data_axis) if dp_active else P()
         mapped = shard_map(
             inner, mesh=mesh,
-            in_specs=(blocks_spec, other, other, other, other),
+            in_specs=(bspecs, especs, hspecs, batch_spec, batch_spec),
             out_specs=other,
             check_vma=False)
         return mapped(params["blocks"], params["embed"], params["head"],
@@ -152,46 +172,114 @@ class GPTNeoXPipeSPMD:
     over ``pipe`` and tensor-sharded over ``model`` when present.
     """
 
-    def __init__(self, config, mesh, n_micro, remat=True, fp32_comm=None):
+    def __init__(self, config, mesh, n_micro, remat=True, fp32_comm=None,
+                 use_pallas=True):
         from ..models import gpt_neox as M
+        from .mesh import DATA_AXIS, MODEL_AXIS
         self.cfg = config
         self.mesh = mesh
         self.n_micro = n_micro
         self.n_stages = int(mesh.shape[PIPE_AXIS])
+        self.mp = int(mesh.shape[MODEL_AXIS]) \
+            if MODEL_AXIS in mesh.axis_names else 1
         if config.num_layers % self.n_stages != 0:
             raise ValueError(
                 f"num_layers {config.num_layers} must divide evenly over "
                 f"{self.n_stages} pipeline stages")
+        if self.mp > 1 and config.num_heads % self.mp != 0:
+            raise ValueError(
+                f"num_heads {config.num_heads} must divide over "
+                f"model-parallel size {self.mp}")
         self._M = M
 
         cos_sin = M._rotary_cache(config, config.max_seq_len)
+        mp = self.mp
 
         def stage_fn(blocks_local, x):
             # scan over this stage's layers (leading dim of each leaf).
             def one(x, bp):
                 cs = (cos_sin[0][:x.shape[1]], cos_sin[1][:x.shape[1]],
                       cos_sin[2])
-                return M.block_forward(config, bp, x, cs), None
+                if mp > 1:
+                    return M.block_forward_tp(config, bp, x, cs,
+                                              MODEL_AXIS, mp,
+                                              use_pallas=use_pallas), None
+                return M.block_forward(config, bp, x, cs,
+                                       use_pallas=use_pallas), None
 
             y, _ = jax.lax.scan(one, x, blocks_local)
             return y
 
+        if mp > 1 and config.vocab_size % mp != 0:
+            raise ValueError(
+                f"vocab_size {config.vocab_size} must divide over "
+                f"model-parallel size {mp}")
+
         def embed_fn(embed_params, tokens):
-            return embed_params["wte"][tokens]
+            wte = embed_params["wte"]
+            if mp == 1:
+                return wte[tokens]
+            # Megatron VocabParallelEmbedding: each model rank holds a
+            # contiguous vocab slice; out-of-range tokens contribute
+            # zero, psum assembles the full embedding.
+            v_local = wte.shape[0]
+            start = jax.lax.axis_index(MODEL_AXIS) * v_local
+            offset = tokens - start
+            in_range = (offset >= 0) & (offset < v_local)
+            safe = jnp.clip(offset, 0, v_local - 1)
+            x = wte[safe] * in_range[..., None].astype(wte.dtype)
+            return jax.lax.psum(x, MODEL_AXIS)
 
         def head_loss_fn(head_params, hidden, labels):
             h = M.layer_norm(hidden, head_params["final_ln"]["scale"],
                              head_params["final_ln"]["bias"],
                              config.layernorm_eps)
+            wte = head_params["wte"]
             logits = jnp.einsum(
-                "bsh,vh->bsv", h,
-                head_params["wte"].astype(h.dtype),
+                "bsh,vh->bsv", h, wte.astype(h.dtype),
                 preferred_element_type=jnp.float32)
-            return M.lm_loss(logits, labels)
+            if mp == 1:
+                return M.lm_loss(logits, labels)
+            # Megatron vocab-parallel cross entropy: the [*, V/mp] logits
+            # shard never leaves its rank — softmax stats and the target
+            # logit travel as two scalars-per-token psums.
+            logits = logits[:, :-1, :]
+            targets = labels[:, 1:]
+            v_local = wte.shape[0]
+            start = jax.lax.axis_index(MODEL_AXIS) * v_local
+            # the max shift is a pure stabilizer (lse is invariant to it),
+            # so stop_gradient is exact; the cross-rank max goes through
+            # all_gather because pmax has no differentiation rule
+            local_max = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+            m = jnp.max(jax.lax.all_gather(local_max, MODEL_AXIS), axis=0)
+            z = jax.lax.psum(
+                jnp.sum(jnp.exp(logits - m[..., None]), axis=-1),
+                MODEL_AXIS)
+            lse = jnp.log(z) + m
+            valid = targets != -100
+            offset = jnp.where(valid, targets, 0) - start
+            in_range = (offset >= 0) & (offset < v_local)
+            safe = jnp.clip(offset, 0, v_local - 1)
+            picked_local = jnp.take_along_axis(
+                logits, safe[..., None], axis=-1).squeeze(-1)
+            picked = jax.lax.psum(
+                picked_local * in_range.astype(jnp.float32), MODEL_AXIS)
+            nll = (lse - picked) * valid
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
 
+        blocks_specs = embed_specs = head_specs = None
+        if mp > 1:
+            blocks_specs = M.block_param_specs_tp(pipe_axis=PIPE_AXIS)
+            embed_specs = {"wte": P(MODEL_AXIS, None)}
+            head_specs = {"final_ln": {"scale": P(), "bias": P()},
+                          "wte": P(MODEL_AXIS, None)}
         self.loss_fn = pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn,
                                         mesh, n_micro, remat=remat,
-                                        fp32_comm=fp32_comm)
+                                        fp32_comm=fp32_comm,
+                                        data_axis=DATA_AXIS,
+                                        blocks_specs=blocks_specs,
+                                        embed_specs=embed_specs,
+                                        head_specs=head_specs)
 
     def init_params(self, rng):
         M, cfg = self._M, self.cfg
@@ -217,9 +305,18 @@ class GPTNeoXPipeSPMD:
         }
 
     def param_specs(self, params, mesh):
+        from .mesh import MODEL_AXIS
+        if self.mp > 1:
+            blocks = self._M.block_param_specs_tp(pipe_axis=PIPE_AXIS)
+            return {
+                "embed": {"wte": P(MODEL_AXIS, None)},   # vocab-sharded
+                "blocks": blocks,
+                "head": {"final_ln": {"scale": P(), "bias": P()},
+                         "wte": P(MODEL_AXIS, None)},
+            }
+
         def blocks_spec(leaf):
             return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
-
         return {
             "embed": jax.tree_util.tree_map(lambda _: P(),
                                             params["embed"]),

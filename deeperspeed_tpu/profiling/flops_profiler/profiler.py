@@ -112,16 +112,19 @@ class FlopsProfiler:
             n = params_count(self.engine.state.params)
         return params_to_string(n) if as_string else n
 
-    def profile_train_step(self, batch):
-        """Cost-analyze the engine's train step on `batch`.
+    def profile_train_step(self, batch, accum_steps=None):
+        """Cost-analyze the engine's train step on `batch` (stacked
+        [accum, global_batch, ...]).
 
-        Uses an undonated build of the step (the engine's production step
-        donates its state buffers — executing it here would invalidate
-        `engine.state`); host-offload engines profile their grads-step,
-        which is what their device program actually is.
+        The step body is profiled through `profile_fn`'s own donation-free
+        jit (executing the engine's production step would donate — and so
+        invalidate — `engine.state`'s buffers); host-offload engines
+        profile their grads-step, which is what their device program
+        actually is.
         """
         eng = self.engine
-        gas = eng.gradient_accumulation_steps()
+        gas = accum_steps if accum_steps is not None else \
+            eng.gradient_accumulation_steps()
         import jax.numpy as jnp
         rng = jax.random.PRNGKey(0)
         sharded = eng._shard_stacked_batch(batch)
@@ -134,7 +137,7 @@ class FlopsProfiler:
             lr = jnp.asarray(eng.optimizer.param_groups[0]["lr"],
                              jnp.float32)
             results = profile_fn(
-                eng._build_train_step(gas, donate=False).__wrapped__,
+                eng._build_train_step(gas).__wrapped__,
                 eng.state, sharded, rng, lr, n_timing_iters=1)
         self._results.update(results)
         return results

@@ -579,11 +579,9 @@ class DeepSpeedEngine:
             return self._apply_update(state, grads, lr)
         return jax.jit(update_fn, donate_argnums=(0, 1))
 
-    def _build_train_step(self, accum_steps, donate=True):
+    def _build_train_step(self, accum_steps):
         """Fused step: scan over [accum, batch, ...] micro-batches, mean the
-        grads, apply the update — one compilation, zero host round-trips.
-        `donate=False` builds an undonated variant (profiling) that leaves
-        the caller's state buffers intact."""
+        grads, apply the update — one compilation, zero host round-trips."""
         def train_step(state, batches, rng, lr):
             scale = state.scale.cur_scale
 
@@ -612,7 +610,7 @@ class DeepSpeedEngine:
             new_state, metrics = self._apply_update(state, grads, lr)
             return new_state, metrics._replace(loss=mean_loss)
 
-        return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+        return jax.jit(train_step, donate_argnums=(0,))
 
     def _build_grads_step(self, accum_steps):
         """Offload path: fused grad accumulation, no device update."""
@@ -780,6 +778,11 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         self._assert_comm_precision()
+        if self.flops_profiler is not None and not self._flops_profiled:
+            # legacy forward/backward/step path: profile one micro-batch
+            stacked = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[None], batch)
+            self._maybe_profile_flops(stacked, accum_steps=1)
         if self._compiled_grad is None:
             self._compiled_grad = self._build_grad_fn()
         batch = self._shard_batch(batch)
@@ -843,6 +846,26 @@ class DeepSpeedEngine:
             self.timers("step").stop()
         return metrics
 
+    def _maybe_profile_flops(self, stacked_batch, accum_steps=None):
+        """Run the flops profiler at `profile_step` (reference
+        `engine.py:966-1019`), exactly once — `>=` plus the flag keeps it
+        from re-firing every batch when the step at profile_step is
+        skipped by an fp16 overflow (global_steps does not advance on
+        skipped steps)."""
+        if self.flops_profiler is None or self._flops_profiled:
+            return
+        fp_cfg = self._config.flops_profiler_config
+        if self.global_steps < fp_cfg.profile_step:
+            return
+        self._flops_profiled = True
+        self.flops_profiler.profile_train_step(stacked_batch,
+                                               accum_steps=accum_steps)
+        self.flops_profiler.print_model_profile(
+            profile_step=fp_cfg.profile_step,
+            module_depth=fp_cfg.module_depth,
+            top_modules=fp_cfg.top_modules,
+            detailed=fp_cfg.detailed)
+
     def _after_step(self, metrics):
         # Only fp16 loss-scaled runs can skip steps; for bf16/fp32 the
         # overflow flag is statically False — never touch the device value
@@ -883,20 +906,7 @@ class DeepSpeedEngine:
                 lambda *xs: np.stack(xs), *micro)
         self._assert_comm_precision()
 
-        fp_cfg = self._config.flops_profiler_config
-        if self.flops_profiler is not None and \
-                not self._flops_profiled and \
-                self.global_steps >= fp_cfg.profile_step:
-            # >= plus the flag: profiles exactly once even if the step at
-            # profile_step is skipped by an fp16 overflow (global_steps
-            # does not advance on skipped steps).
-            self._flops_profiled = True
-            self.flops_profiler.profile_train_step(batch)
-            self.flops_profiler.print_model_profile(
-                profile_step=fp_cfg.profile_step,
-                module_depth=fp_cfg.module_depth,
-                top_modules=fp_cfg.top_modules,
-                detailed=fp_cfg.detailed)
+        self._maybe_profile_flops(batch)
 
         self.tput_timer.start()
 
