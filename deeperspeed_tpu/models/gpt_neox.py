@@ -236,41 +236,54 @@ def causal_attention(q, k, v, use_pallas=True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
-                  use_pallas=True):
-    """One GPT-NeoX block with parallel residual:
-    x + attn(ln1(x)) + mlp(ln2(x))."""
+def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn):
+    """Shared block body: `mp == 1` with identity `reduce_fn` is the
+    dense block; TP callers pass pre-sliced params (column/row parallel)
+    and a psum reduce — one implementation, so the two paths cannot
+    drift. Biases of row-parallel matmuls are added after the reduce
+    (algebraically identical in the dense case)."""
     B, S, h = x.shape
-    nh, hd = cfg.num_heads, cfg.head_dim
+    nh_local = cfg.num_heads // mp
+    hd = cfg.head_dim
     cos, sin, rot_dim = cos_sin
+    out_b = params["attn"]["out_b"].astype(x.dtype)
+    mlp_b = params["mlp"]["out_b"].astype(x.dtype)
 
     ln1 = layer_norm(x, params["ln_attn"]["scale"], params["ln_attn"]["bias"],
                      cfg.layernorm_eps)
     qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
         params["attn"]["qkv_b"].astype(x.dtype)
-    qkv = qkv.reshape(B, S, nh, 3 * hd)
+    qkv = qkv.reshape(B, S, nh_local, 3 * hd)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k = apply_rotary(q, k, cos, sin, rot_dim)
     attn = causal_attention(q, k, v, use_pallas=use_pallas)
-    attn = attn.reshape(B, S, h)
-    attn_out = attn @ params["attn"]["out_w"].astype(x.dtype) + \
-        params["attn"]["out_b"].astype(x.dtype)
+    attn = attn.reshape(B, S, h // mp)
+    attn_partial = attn @ params["attn"]["out_w"].astype(x.dtype)
 
     if cfg.use_parallel_residual:
         ln2_in = x
     else:
+        attn_out = reduce_fn(attn_partial) + out_b
         ln2_in = x + attn_out
     ln2 = layer_norm(ln2_in, params["ln_mlp"]["scale"],
                      params["ln_mlp"]["bias"], cfg.layernorm_eps)
     hmid = ln2 @ params["mlp"]["in_w"].astype(x.dtype) + \
         params["mlp"]["in_b"].astype(x.dtype)
     hmid = jax.nn.gelu(hmid)
-    mlp_out = hmid @ params["mlp"]["out_w"].astype(x.dtype) + \
-        params["mlp"]["out_b"].astype(x.dtype)
+    mlp_partial = hmid @ params["mlp"]["out_w"].astype(x.dtype)
 
     if cfg.use_parallel_residual:
-        return x + attn_out + mlp_out
-    return ln2_in + mlp_out
+        # one reduce for both partials (the Megatron fusion win)
+        return x + reduce_fn(attn_partial + mlp_partial) + out_b + mlp_b
+    return ln2_in + reduce_fn(mlp_partial) + mlp_b
+
+
+def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
+                  use_pallas=True):
+    """One GPT-NeoX block with parallel residual:
+    x + attn(ln1(x)) + mlp(ln2(x))."""
+    return _block_core(cfg, params, x, cos_sin, use_pallas, mp=1,
+                       reduce_fn=lambda t: t)
 
 
 def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
@@ -284,67 +297,17 @@ def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
 
     x is replicated over `model_axis`; mp = mesh size of that axis.
     """
-    B, S, h = x.shape
-    nh_local = cfg.num_heads // mp
-    hd = cfg.head_dim
-    cos, sin, rot_dim = cos_sin
-
-    ln1 = layer_norm(x, params["ln_attn"]["scale"], params["ln_attn"]["bias"],
-                     cfg.layernorm_eps)
-    # qkv_w local: [h, 3h/mp] (column parallel) → local heads
-    qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
-        params["attn"]["qkv_b"].astype(x.dtype)
-    qkv = qkv.reshape(B, S, nh_local, 3 * hd)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q, k = apply_rotary(q, k, cos, sin, rot_dim)
-    attn = causal_attention(q, k, v, use_pallas=use_pallas)
-    attn = attn.reshape(B, S, h // mp)
-    # out_w local: [h/mp, h] (row parallel) → partial sum over model
-    attn_partial = attn @ params["attn"]["out_w"].astype(x.dtype)
-
-    if cfg.use_parallel_residual:
-        ln2_in = x
-    else:
-        attn_out = jax.lax.psum(attn_partial, model_axis) + \
-            params["attn"]["out_b"].astype(x.dtype)
-        ln2_in = x + attn_out
-    ln2 = layer_norm(ln2_in, params["ln_mlp"]["scale"],
-                     params["ln_mlp"]["bias"], cfg.layernorm_eps)
-    hmid = ln2 @ params["mlp"]["in_w"].astype(x.dtype) + \
-        params["mlp"]["in_b"].astype(x.dtype)
-    hmid = jax.nn.gelu(hmid)
-    mlp_partial = hmid @ params["mlp"]["out_w"].astype(x.dtype)
-
-    if cfg.use_parallel_residual:
-        combined = jax.lax.psum(attn_partial + mlp_partial, model_axis)
-        return x + combined + \
-            params["attn"]["out_b"].astype(x.dtype) + \
-            params["mlp"]["out_b"].astype(x.dtype)
-    mlp_out = jax.lax.psum(mlp_partial, model_axis) + \
-        params["mlp"]["out_b"].astype(x.dtype)
-    return ln2_in + mlp_out
+    return _block_core(cfg, params, x, cos_sin, use_pallas, mp=mp,
+                       reduce_fn=lambda t: jax.lax.psum(t, model_axis))
 
 
 def block_param_specs_tp(pipe_axis=None):
-    """Per-leaf PartitionSpecs for TP-sliced block params inside
-    shard_map; `pipe_axis` prepends the stacked-layer dim sharding."""
+    """`block_param_specs` with an optional leading stacked-layer dim
+    sharding (for [L, ...]-stacked pipeline params inside shard_map)."""
     lead = (pipe_axis,) if pipe_axis is not None else ()
-    return {
-        "ln_attn": {"scale": P(*lead), "bias": P(*lead)},
-        "ln_mlp": {"scale": P(*lead), "bias": P(*lead)},
-        "attn": {
-            "qkv_w": P(*lead, None, MODEL_AXIS),
-            "qkv_b": P(*lead, MODEL_AXIS),
-            "out_w": P(*lead, MODEL_AXIS, None),
-            "out_b": P(*lead),
-        },
-        "mlp": {
-            "in_w": P(*lead, None, MODEL_AXIS),
-            "in_b": P(*lead, MODEL_AXIS),
-            "out_w": P(*lead, MODEL_AXIS, None),
-            "out_b": P(*lead),
-        },
-    }
+    return jax.tree_util.tree_map(lambda s: P(*lead, *s),
+                                  block_param_specs(),
+                                  is_leaf=lambda x: isinstance(x, P))
 
 
 def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False):
@@ -463,6 +426,29 @@ class GPTNeoX:
                                 remat_blocks=self.remat_blocks)
         out_embed = params.get("embed_out", params["embed"])["wte"]
         return fused_lm_head_loss(hidden, out_embed, labels)
+
+    # -- layer-activation capture (engine.set_layers_to_hook) ------------
+
+    def layer_names(self):
+        return ["embedding"] + \
+            ["transformerlayer"] * self.config.num_layers + ["final_ln"]
+
+    def hidden_states(self, params, batch, rng=None):
+        """Per-layer outputs for the engine's activation-capture hooks
+        (fork: `engine.py:222-254` forward hooks)."""
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        cfg = self.config
+        x = params["embed"]["wte"][tokens]
+        outs = [x]
+        cos_sin = _rotary_cache(cfg, tokens.shape[1])
+        for bp in params["blocks"]:
+            x = block_forward(cfg, bp, x, cos_sin,
+                              use_pallas=self.use_pallas)
+            outs.append(x)
+        outs.append(layer_norm(x, params["final_ln"]["scale"],
+                               params["final_ln"]["bias"],
+                               cfg.layernorm_eps))
+        return outs
 
 
 # ---------------------------------------------------------------------------

@@ -122,6 +122,11 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
 
         def inner(blocks_local, embed_params, head_params, tokens, labels):
             b = tokens.shape[0]
+            if b % n_micro != 0 or b < n_micro:
+                raise ValueError(
+                    f"per-data-rank batch {b} must split into n_micro="
+                    f"{n_micro} micro-batches (global batch / dp size "
+                    f"must be a multiple of n_micro)")
             mb = b // n_micro
             tok_micro = tokens.reshape((n_micro, mb) + tokens.shape[1:])
             lab_micro = labels.reshape((n_micro, mb) + labels.shape[1:])
@@ -186,10 +191,15 @@ class GPTNeoXPipeSPMD:
             raise ValueError(
                 f"num_layers {config.num_layers} must divide evenly over "
                 f"{self.n_stages} pipeline stages")
-        if self.mp > 1 and config.num_heads % self.mp != 0:
-            raise ValueError(
-                f"num_heads {config.num_heads} must divide over "
-                f"model-parallel size {self.mp}")
+        if self.mp > 1:
+            for name, dim in (("num_heads", config.num_heads),
+                              ("hidden_size", config.hidden_size),
+                              ("intermediate_size",
+                               config.intermediate_size)):
+                if dim % self.mp != 0:
+                    raise ValueError(
+                        f"{name} {dim} must divide over model-parallel "
+                        f"size {self.mp}")
         self._M = M
 
         cos_sin = M._rotary_cache(config, config.max_seq_len)
@@ -267,19 +277,23 @@ class GPTNeoXPipeSPMD:
             nll = (lse - picked) * valid
             return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
 
-        blocks_specs = embed_specs = head_specs = None
+        # One spec tree shared by the shard_map in_specs and the engine's
+        # GSPMD placement (param_specs) so they can never drift.
         if mp > 1:
-            blocks_specs = M.block_param_specs_tp(pipe_axis=PIPE_AXIS)
-            embed_specs = {"wte": P(MODEL_AXIS, None)}
-            head_specs = {"final_ln": {"scale": P(), "bias": P()},
-                          "wte": P(MODEL_AXIS, None)}
-        self.loss_fn = pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn,
-                                        mesh, n_micro, remat=remat,
-                                        fp32_comm=fp32_comm,
-                                        data_axis=DATA_AXIS,
-                                        blocks_specs=blocks_specs,
-                                        embed_specs=embed_specs,
-                                        head_specs=head_specs)
+            self._tp_specs = {
+                "embed": {"wte": P(MODEL_AXIS, None)},   # vocab-sharded
+                "blocks": M.block_param_specs_tp(pipe_axis=PIPE_AXIS),
+                "head": {"final_ln": {"scale": P(), "bias": P()},
+                         "wte": P(MODEL_AXIS, None)},
+            }
+        else:
+            self._tp_specs = None
+        self.loss_fn = pipeline_loss_fn(
+            embed_fn, stage_fn, head_loss_fn, mesh, n_micro, remat=remat,
+            fp32_comm=fp32_comm, data_axis=DATA_AXIS,
+            blocks_specs=self._tp_specs["blocks"] if mp > 1 else None,
+            embed_specs=self._tp_specs["embed"] if mp > 1 else None,
+            head_specs=self._tp_specs["head"] if mp > 1 else None)
 
     def init_params(self, rng):
         M, cfg = self._M, self.cfg
@@ -305,15 +319,8 @@ class GPTNeoXPipeSPMD:
         }
 
     def param_specs(self, params, mesh):
-        from .mesh import MODEL_AXIS
         if self.mp > 1:
-            blocks = self._M.block_param_specs_tp(pipe_axis=PIPE_AXIS)
-            return {
-                "embed": {"wte": P(MODEL_AXIS, None)},   # vocab-sharded
-                "blocks": blocks,
-                "head": {"final_ln": {"scale": P(), "bias": P()},
-                         "wte": P(MODEL_AXIS, None)},
-            }
+            return self._tp_specs
 
         def blocks_spec(leaf):
             return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))

@@ -127,6 +127,28 @@ def _parse_sparse_attention(param_dict):
     return common
 
 
+class DeepSpeedConfigWriter:
+    """In-memory config builder that serializes to the JSON schema
+    (reference `config.py:519`)."""
+
+    def __init__(self, data=None):
+        self.data = {} if data is None else data
+
+    def add_config(self, key, value):
+        self.data[key] = value
+
+    def load_config(self, filename):
+        import json
+        with open(filename, "r") as f:
+            self.data = json.load(f)
+        return self.data
+
+    def write_config(self, filename):
+        import json
+        with open(filename, "w") as f:
+            json.dump(self.data, f, indent=4)
+
+
 class DeepSpeedConfig:
     """Parsed, validated DeepSpeed config.
 

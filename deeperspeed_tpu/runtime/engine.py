@@ -159,10 +159,20 @@ class DeepSpeedEngine:
                 **self._config.batch_size_schedule_params)
 
         self.progressive_layer_drop = None
+        self._pld_in_loss = False
         if self._config.pld_enabled:
             theta = self._config.pld_params["theta"]
             gamma = self._config.pld_params["gamma"]
             self.progressive_layer_drop = ProgressiveLayerDrop(theta, gamma)
+            # theta(t) reaches the model only if its loss_fn declares the
+            # kwarg (reference injects it as a forward kwarg,
+            # `progressive_layer_drop.py` + engine.forward)
+            import inspect
+            try:
+                self._pld_in_loss = "pld_theta" in \
+                    inspect.signature(self.loss_fn).parameters
+            except (TypeError, ValueError):
+                self._pld_in_loss = False
 
         self.gradient_noise_scale = None
         self.store_gradients = self._config.store_gradients
@@ -216,6 +226,9 @@ class DeepSpeedEngine:
         self._compiled_update = None
         self._compiled_train = {}
         self._compiled_eval = None
+        self._compiled_capture = None
+        self._layers_to_hook = []
+        self.hooked_activations = {}
         self.warn_unscaled_loss = True
 
         # Fork feature: fp32 inter-stage activation/gradient communication
@@ -482,10 +495,14 @@ class DeepSpeedEngine:
     # jitted step builders
     # ------------------------------------------------------------------
 
-    def _loss_and_grads(self, params, batch, rng, scale):
+    def _loss_and_grads(self, params, batch, rng, scale, pld_theta=None):
         """(scaled loss grads, unscaled loss); grads constrained for ZeRO-2."""
+        kw = {}
+        if pld_theta is not None and self._pld_in_loss:
+            kw["pld_theta"] = pld_theta
+
         def scaled_loss(p):
-            loss = self.loss_fn(p, batch, rng)
+            loss = self.loss_fn(p, batch, rng, **kw)
             return loss * scale.astype(loss.dtype), loss
 
         (scaled, loss), grads = jax.value_and_grad(
@@ -572,7 +589,24 @@ class DeepSpeedEngine:
     def _build_grad_fn(self):
         def grad_fn(params, batch, rng, scale):
             return self._loss_and_grads(params, batch, rng, scale)
-        return jax.jit(grad_fn)
+
+        def grad_fn_pld(params, batch, rng, scale, global_steps):
+            theta = self._pld_theta_in_jit(global_steps)
+            return self._loss_and_grads(params, batch, rng, scale,
+                                        pld_theta=theta)
+
+        return jax.jit(grad_fn_pld if self._pld_in_loss else grad_fn)
+
+    def _pld_theta_in_jit(self, global_steps):
+        """theta(t) = (1-p)·e^{-γt} + p computed on-device from the step
+        counter — no per-step host value, so the jitted step never
+        recompiles as the schedule decays."""
+        if not self._pld_in_loss:
+            return None
+        p = self._config.pld_params["theta"]
+        gamma = self._config.pld_params["gamma"]
+        t = global_steps.astype(jnp.float32)
+        return (1.0 - p) * jnp.exp(-gamma * t) + p
 
     def _build_update_fn(self):
         def update_fn(state, grads, lr):
@@ -584,12 +618,13 @@ class DeepSpeedEngine:
         grads, apply the update — one compilation, zero host round-trips."""
         def train_step(state, batches, rng, lr):
             scale = state.scale.cur_scale
+            theta = self._pld_theta_in_jit(state.global_steps)
 
             def micro(carry, xs):
                 grads_acc, loss_acc = carry
                 mb, mb_rng = xs
                 loss, grads = self._loss_and_grads(state.params, mb, mb_rng,
-                                                   scale)
+                                                   scale, pld_theta=theta)
                 grads_acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
                 return (grads_acc, loss_acc + loss.astype(jnp.float32)), None
@@ -778,17 +813,21 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         self._assert_comm_precision()
-        if self.flops_profiler is not None and not self._flops_profiled:
-            # legacy forward/backward/step path: profile one micro-batch
-            stacked = jax.tree_util.tree_map(
-                lambda x: np.asarray(x)[None], batch)
-            self._maybe_profile_flops(stacked, accum_steps=1)
+        # legacy forward/backward/step path: profile one micro-batch
+        self._maybe_profile_flops(batch, accum_steps=1, stacked=False)
         if self._compiled_grad is None:
             self._compiled_grad = self._build_grad_fn()
         batch = self._shard_batch(batch)
         rng = rng if rng is not None else self._next_rng()
-        loss, grads = self._compiled_grad(self.state.params, batch, rng,
-                                          self.state.scale.cur_scale)
+        if self._layers_to_hook:
+            self._capture_activations(batch, rng)
+        if self._pld_in_loss:
+            loss, grads = self._compiled_grad(
+                self.state.params, batch, rng, self.state.scale.cur_scale,
+                self.state.global_steps)
+        else:
+            loss, grads = self._compiled_grad(
+                self.state.params, batch, rng, self.state.scale.cur_scale)
         self._cached = (loss, grads)
         if self.wall_clock_breakdown():
             self.timers("forward").stop()
@@ -846,19 +885,66 @@ class DeepSpeedEngine:
             self.timers("step").stop()
         return metrics
 
-    def _maybe_profile_flops(self, stacked_batch, accum_steps=None):
+    # ------------------------------------------------------------------
+    # layer-activation capture (fork: engine.py:222-254 registers forward
+    # hooks on submodules matched by index or regex like
+    # "transformerlayer"; here the model exposes `hidden_states()` and the
+    # engine runs a jitted capture pass — hooks cannot reach inside a
+    # compiled XLA program)
+    # ------------------------------------------------------------------
+
+    def set_layers_to_hook(self, layers_to_hook):
+        """Capture the listed layer outputs (indices or regexes matched
+        against the model's `layer_names()`) on the next batch."""
+        self._layers_to_hook = layers_to_hook or []
+        self.hooked_activations = {}
+
+    def get_hooked_activations(self):
+        return self.hooked_activations
+
+    def _capture_activations(self, batch, rng):
+        hs_fn = getattr(self.module_obj, "hidden_states", None)
+        if hs_fn is None or not self._layers_to_hook:
+            return
+        import re
+        names = list(getattr(self.module_obj, "layer_names", lambda: [])())
+        if self._compiled_capture is None:
+            self._compiled_capture = jax.jit(
+                lambda p, b, r: hs_fn(p, b, r))
+        outs = self._compiled_capture(self.state.params, batch, rng)
+        if not names:
+            names = [str(i) for i in range(len(outs))]
+        wanted = set()
+        for item in self._layers_to_hook:
+            if isinstance(item, int):
+                wanted.add(item)
+            else:
+                pat = re.compile(str(item).lower())
+                wanted.update(i for i, n in enumerate(names)
+                              if pat.search(n.lower()))
+        self.hooked_activations = {i: outs[i] for i in sorted(wanted)
+                                   if 0 <= i < len(outs)}
+        # One-shot: the capture pass is a full extra forward — re-arm per
+        # batch via set_layers_to_hook / the layers_to_hook kwarg.
+        self._layers_to_hook = []
+
+    def _maybe_profile_flops(self, batch, accum_steps=None, stacked=True):
         """Run the flops profiler at `profile_step` (reference
         `engine.py:966-1019`), exactly once — `>=` plus the flag keeps it
         from re-firing every batch when the step at profile_step is
         skipped by an fp16 overflow (global_steps does not advance on
-        skipped steps)."""
+        skipped steps). Any batch copying happens after the guards so the
+        steps before profile_step pay nothing."""
         if self.flops_profiler is None or self._flops_profiled:
             return
         fp_cfg = self._config.flops_profiler_config
         if self.global_steps < fp_cfg.profile_step:
             return
         self._flops_profiled = True
-        self.flops_profiler.profile_train_step(stacked_batch,
+        if not stacked:
+            batch = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[None], batch)
+        self.flops_profiler.profile_train_step(batch,
                                                accum_steps=accum_steps)
         self.flops_profiler.print_model_profile(
             profile_step=fp_cfg.profile_step,
@@ -893,12 +979,16 @@ class DeepSpeedEngine:
                 self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
 
-    def train_batch(self, data_iter=None, batch=None):
+    def train_batch(self, data_iter=None, batch=None, layers_to_hook=None):
         """Fused fast path: one jitted call per effective batch.
 
         `data_iter` yields micro-batches; `batch` may instead carry a
-        pre-stacked [accum_steps, batch, ...] pytree.
+        pre-stacked [accum_steps, batch, ...] pytree. `layers_to_hook`
+        captures those layers' activations for this batch (fork:
+        `pipe/engine.py:264`'s kwarg, here on the base engine too).
         """
+        if layers_to_hook is not None:
+            self.set_layers_to_hook(layers_to_hook)
         gas = self.gradient_accumulation_steps()
         if batch is None:
             micro = [next(data_iter) for _ in range(gas)]
@@ -921,6 +1011,10 @@ class DeepSpeedEngine:
             # measures the transfer, not the dispatch.
             jax.block_until_ready(sharded)
             self.timers("comms").stop()
+
+        if self._layers_to_hook:
+            first_micro = jax.tree_util.tree_map(lambda x: x[0], sharded)
+            self._capture_activations(first_micro, self._next_rng())
 
         if self.host_offload:
             key = ("grads", gas)

@@ -25,6 +25,8 @@ def init_distributed(dist_backend="xla", auto_mpi_discovery=True,
     if _initialized:
         return
 
+    _patch_azureml_env(verbose=verbose)
+
     required_env = ["RANK", "WORLD_SIZE", "MASTER_ADDR"]
     if auto_mpi_discovery and \
             not all(v in os.environ for v in required_env) and \
@@ -48,6 +50,31 @@ def init_distributed(dist_backend="xla", auto_mpi_discovery=True,
         num_processes=world_size,
         process_id=rank)
     _initialized = True
+
+
+def _patch_azureml_env(verbose=True):
+    """Map AzureML's OpenMPI env vars onto the standard rendezvous vars
+    (reference `distributed.py`'s in_aml()/patch_aml_env path)."""
+    if "AZUREML_EXPERIMENT_ID" not in os.environ:
+        return
+    if "OMPI_COMM_WORLD_RANK" not in os.environ:
+        return
+    os.environ.setdefault("RANK", os.environ["OMPI_COMM_WORLD_RANK"])
+    os.environ.setdefault("WORLD_SIZE",
+                          os.environ.get("OMPI_COMM_WORLD_SIZE", "1"))
+    os.environ.setdefault("LOCAL_RANK",
+                          os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK", "0"))
+    if int(os.environ["WORLD_SIZE"]) == 1:
+        os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
+    else:
+        master = os.environ.get("AZ_BATCH_MASTER_NODE", "127.0.0.1:29500")
+        addr, _, port = master.partition(":")
+        os.environ.setdefault("MASTER_ADDR", addr)
+        if port:
+            os.environ.setdefault("MASTER_PORT", port)
+    if verbose:
+        logger.info("Detected AzureML environment; patched rendezvous "
+                    "env vars from OMPI settings")
 
 
 def mpi_discovery(distributed_port=29500, verbose=True):

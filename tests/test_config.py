@@ -291,3 +291,18 @@ def test_elasticity_rejects_explicit_batch():
                 "version": 0.1,
             },
         }, world_size=64)
+
+
+def test_config_writer_roundtrip(tmp_path):
+    from deeperspeed_tpu.runtime.config import DeepSpeedConfigWriter
+
+    w = DeepSpeedConfigWriter()
+    w.add_config("train_batch_size", 8)
+    w.add_config("optimizer", {"type": "Adam", "params": {"lr": 1e-3}})
+    p = str(tmp_path / "ds_config.json")
+    w.write_config(p)
+
+    r = DeepSpeedConfigWriter()
+    data = r.load_config(p)
+    assert data["train_batch_size"] == 8
+    assert data["optimizer"]["type"] == "Adam"

@@ -72,3 +72,23 @@ def test_sharded_array_placement():
     assert len(arr.addressable_shards) == 8
     assert arr.addressable_shards[0].data.shape == (1, 8)
     np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_azureml_env_patch(monkeypatch):
+    from deeperspeed_tpu.utils.distributed import _patch_azureml_env
+
+    for var in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_ADDR",
+                "MASTER_PORT"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("AZUREML_EXPERIMENT_ID", "exp-1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")
+    monkeypatch.setenv("AZ_BATCH_MASTER_NODE", "10.0.0.9:6000")
+    import os
+    _patch_azureml_env(verbose=False)
+    assert os.environ["RANK"] == "3"
+    assert os.environ["WORLD_SIZE"] == "4"
+    assert os.environ["LOCAL_RANK"] == "1"
+    assert os.environ["MASTER_ADDR"] == "10.0.0.9"
+    assert os.environ["MASTER_PORT"] == "6000"

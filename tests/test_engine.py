@@ -187,3 +187,72 @@ def test_train_micro_batch_size_accessors():
     assert engine.gradient_accumulation_steps() == 2
     assert engine.train_micro_batch_size_per_gpu() * 2 * \
         engine.dp_world_size == 32
+
+
+def test_pld_theta_reaches_loss_fn():
+    """Progressive layer drop: theta(t) decays on-device and reaches a
+    loss_fn that declares the kwarg (reference injects it as a forward
+    kwarg)."""
+    import jax.numpy as jnp
+
+    seen = []
+
+    class PldModel:
+        def init_params(self, rng):
+            return {"w": jnp.ones((4, 4))}
+
+        def loss_fn(self, params, batch, rng=None, pld_theta=None):
+            x, y = batch
+            assert pld_theta is not None
+            seen.append(True)
+            pred = x @ params["w"] * pld_theta
+            return jnp.mean((pred - y) ** 2)
+
+    model = PldModel()
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(
+            jax.random.PRNGKey(0)),
+        config_params={"train_batch_size": 8 * jax.device_count() // 8,
+                       "optimizer": {"type": "Adam",
+                                     "params": {"lr": 1e-2}},
+                       "progressive_layer_drop": {"enabled": True,
+                                                  "theta": 0.5,
+                                                  "gamma": 0.1},
+                       "steps_per_print": 100})
+    assert engine._pld_in_loss
+    x = np.ones((1, 8, 4), np.float32)
+    losses = [float(engine.train_batch(batch=(x, x))) for _ in range(3)]
+    assert np.isfinite(losses).all()
+    assert seen  # loss_fn traced with the kwarg
+    # host-side schedule mirrors the on-device one
+    assert engine.progressive_layer_drop.get_theta() < 1.0
+
+
+def test_layer_activation_capture():
+    """Fork feature: layers_to_hook captures per-layer activations
+    (reference engine.py:222-254 register_forward_hook)."""
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    cfg = GPTNeoXConfig.tiny()
+    model = GPTNeoX(cfg, use_pallas=False)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={"train_batch_size": 8,
+                       "optimizer": {"type": "Adam",
+                                     "params": {"lr": 1e-3}},
+                       "steps_per_print": 100})
+    tok = np.zeros((1, 8, 16), np.int32)
+    engine.train_batch(batch=(tok, tok),
+                       layers_to_hook=["transformerlayer"])
+    acts = engine.get_hooked_activations()
+    # cfg.tiny has 2 blocks at indices 1, 2 (0 is the embedding)
+    assert sorted(acts) == [1, 2]
+    assert acts[1].shape == (8, 16, cfg.hidden_size)
+
+    # index-based hooks on the legacy forward path
+    engine.set_layers_to_hook([0])
+    loss = engine.forward((tok[0], tok[0]))
+    engine.backward(loss)
+    engine.step()
+    assert list(engine.get_hooked_activations()) == [0]

@@ -1,0 +1,128 @@
+"""Launcher unit tests (reference: `tests/unit/test_ds_arguments.py` and
+the runner/multinode_runner surfaces — hostfile parsing, resource
+filters, world-info encoding, backend command construction)."""
+
+import argparse
+import sys
+
+import pytest
+
+from deeperspeed_tpu.launcher.runner import (decode_world_info,
+                                             encode_world_info,
+                                             fetch_hostfile,
+                                             parse_resource_filter)
+from deeperspeed_tpu.launcher.multinode_runner import (MosaicMLRunner,
+                                                       OpenMPIRunner,
+                                                       PDSHRunner,
+                                                       SlurmRunner)
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    pool = fetch_hostfile(_hostfile(
+        tmp_path, "worker-0 slots=4\nworker-1 slots=8\n\n"))
+    assert pool == {"worker-0": 4, "worker-1": 8}
+    assert list(pool) == ["worker-0", "worker-1"]  # order preserved
+
+
+def test_fetch_hostfile_missing_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_malformed_raises(tmp_path):
+    with pytest.raises(ValueError):
+        fetch_hostfile(_hostfile(tmp_path, "worker-0\n"))
+
+
+def test_fetch_hostfile_duplicate_raises(tmp_path):
+    with pytest.raises(ValueError):
+        fetch_hostfile(_hostfile(
+            tmp_path, "worker-0 slots=4\nworker-0 slots=4\n"))
+
+
+def test_resource_filter_include_host():
+    pool = {"a": 4, "b": 4, "c": 4}
+    assert parse_resource_filter(pool, include_str="a@c") == \
+        {"a": 4, "c": 4}
+
+
+def test_resource_filter_include_slots():
+    pool = {"a": 4, "b": 4}
+    out = parse_resource_filter(pool, include_str="a:0,1")
+    assert out == {"a": 2}    # two slots selected on host a
+
+
+def test_resource_filter_exclude():
+    pool = {"a": 4, "b": 4, "c": 4}
+    assert parse_resource_filter(pool, exclude_str="b") == {"a": 4, "c": 4}
+
+
+def test_resource_filter_mutual_exclusion():
+    with pytest.raises(ValueError):
+        parse_resource_filter({"a": 4}, include_str="a", exclude_str="a")
+
+
+def test_world_info_roundtrip():
+    info = {"worker-0": 4, "worker-1": 8}
+    assert decode_world_info(encode_world_info(info)) == info
+
+
+def _args(**kw):
+    ns = argparse.Namespace(
+        user_script="train.py", user_args=["--foo", "1"],
+        launcher_args="", include="", exclude="", num_nodes=-1,
+        num_gpus=-1, comment="", detect_nvlink_pairs=False,
+        hostfile="/job/hostfile",
+        master_addr="10.0.0.1", master_port=29500)
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_pdsh_runner_cmd():
+    runner = PDSHRunner(_args(), world_info_base64="unused")
+    runner.add_export("PYTHONPATH", "/repo")
+    env = {"MASTER_ADDR": "10.0.0.1", "MASTER_PORT": "29500"}
+    cmd = runner.get_cmd(env, {"worker-0": 4, "worker-1": 4})
+    flat = " ".join(cmd)
+    assert cmd[:2] == ["pdsh", "-f"]
+    assert "worker-0,worker-1" in flat
+    assert "deeperspeed_tpu.launcher.launch" in flat
+    assert "--node_rank=%n" in flat
+    assert "export PYTHONPATH=/repo" in flat
+    assert cmd[-3:] == ["train.py", "--foo", "1"]
+    assert env["PDSH_RCMD_TYPE"] == "ssh"
+
+
+def test_slurm_runner_cmd_with_comment():
+    runner = SlurmRunner(_args(comment="neox-run"), "unused",
+                         resource_pool={"a": 1, "b": 1})
+    runner.add_export("FOO", "bar")
+    cmd = runner.get_cmd({"MASTER_ADDR": "x", "MASTER_PORT": "1"},
+                         {"a": 1, "b": 1})
+    flat = " ".join(cmd)
+    assert cmd[:3] == ["srun", "-n", "2"]
+    assert "--comment neox-run" in flat      # fork addition
+    assert "--export FOO=bar" in flat
+    assert cmd[-3:] == ["train.py", "--foo", "1"]
+
+
+def test_openmpi_runner_cmd():
+    runner = OpenMPIRunner(_args(), "unused", {"a": 2, "b": 2})
+    cmd = runner.get_cmd({"MASTER_ADDR": "x", "MASTER_PORT": "1"},
+                         {"a": 2, "b": 2})
+    flat = " ".join(cmd)
+    assert cmd[0] == "mpirun"
+    assert "train.py" in flat
+
+
+def test_mosaicml_runner_cmd():
+    runner = MosaicMLRunner(_args(), "unused")
+    cmd = runner.get_cmd({"MASTER_ADDR": "x", "MASTER_PORT": "1"},
+                         {"a": 1})
+    assert any("train.py" in c for c in cmd)

@@ -155,3 +155,16 @@ def test_dataloader_rank_strided():
     dl1 = DeepSpeedDataLoader(data, batch_size=2, num_replicas=2, rank=1)
     seen = np.concatenate(list(dl0) + list(dl1))
     assert sorted(seen.tolist()) == [float(i) for i in range(8)]
+
+
+def test_see_memory_usage_logs():
+    from unittest import mock
+
+    from deeperspeed_tpu.runtime import utils as U
+
+    with mock.patch.object(U.logger, "info") as info:
+        U.see_memory_usage("after init", force=True)
+        U.see_memory_usage("skipped", force=False)
+    text = " ".join(str(c.args[0]) for c in info.call_args_list)
+    assert "after init" in text
+    assert "skipped" not in text
