@@ -236,7 +236,8 @@ def causal_attention(q, k, v, use_pallas=True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn):
+def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
+                return_kv=False):
     """Shared block body: `mp == 1` with identity `reduce_fn` is the
     dense block; TP callers pass pre-sliced params (column/row parallel)
     and a psum reduce — one implementation, so the two paths cannot
@@ -274,8 +275,12 @@ def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn):
 
     if cfg.use_parallel_residual:
         # one reduce for both partials (the Megatron fusion win)
-        return x + reduce_fn(attn_partial + mlp_partial) + out_b + mlp_b
-    return ln2_in + reduce_fn(mlp_partial) + mlp_b
+        out = x + reduce_fn(attn_partial + mlp_partial) + out_b + mlp_b
+    else:
+        out = ln2_in + reduce_fn(mlp_partial) + mlp_b
+    if return_kv:
+        return out, (k, v)
+    return out
 
 
 def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
@@ -427,6 +432,13 @@ class GPTNeoX:
         out_embed = params.get("embed_out", params["embed"])["wte"]
         return fused_lm_head_loss(hidden, out_embed, labels)
 
+    def generate(self, params, prompt, max_new_tokens, temperature=0.0,
+                 rng=None):
+        """KV-cached autoregressive generation (jittable)."""
+        return generate(self.config, params, prompt, max_new_tokens,
+                        temperature=temperature, rng=rng,
+                        use_pallas=self.use_pallas)
+
     # -- layer-activation capture (engine.set_layers_to_hook) ------------
 
     def layer_names(self):
@@ -449,6 +461,127 @@ class GPTNeoX:
                                params["final_ln"]["bias"],
                                cfg.layernorm_eps))
         return outs
+
+
+# ---------------------------------------------------------------------------
+# autoregressive generation (KV cache; single jitted prefill + scan decode)
+# ---------------------------------------------------------------------------
+
+def _block_decode(cfg, bp, x, kv, pos, cos_sin):
+    """One block for one new position. x [B, 1, H]; kv = (k_cache,
+    v_cache) [B, S_max, nh, hd]; pos: scalar int32 index being written."""
+    B = x.shape[0]
+    nh, hd = cfg.num_heads, cfg.head_dim
+    cos_full, sin_full, rot_dim = cos_sin
+    k_cache, v_cache = kv
+
+    ln1 = layer_norm(x, bp["ln_attn"]["scale"], bp["ln_attn"]["bias"],
+                     cfg.layernorm_eps)
+    qkv = ln1 @ bp["attn"]["qkv_w"].astype(x.dtype) + \
+        bp["attn"]["qkv_b"].astype(x.dtype)
+    qkv = qkv.reshape(B, 1, nh, 3 * hd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
+    q, k = apply_rotary(q, k, cos, sin, rot_dim)
+
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, k.astype(k_cache.dtype), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, v.astype(v_cache.dtype), pos, axis=1)
+
+    S_max = k_cache.shape[1]
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(S_max)[None, None, None, :] <= pos
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    attn = attn.reshape(B, 1, cfg.hidden_size)
+    attn_out = attn @ bp["attn"]["out_w"].astype(x.dtype) + \
+        bp["attn"]["out_b"].astype(x.dtype)
+
+    ln2_in = x if cfg.use_parallel_residual else x + attn_out
+    ln2 = layer_norm(ln2_in, bp["ln_mlp"]["scale"], bp["ln_mlp"]["bias"],
+                     cfg.layernorm_eps)
+    hmid = jax.nn.gelu(ln2 @ bp["mlp"]["in_w"].astype(x.dtype) +
+                       bp["mlp"]["in_b"].astype(x.dtype))
+    mlp_out = hmid @ bp["mlp"]["out_w"].astype(x.dtype) + \
+        bp["mlp"]["out_b"].astype(x.dtype)
+    out = x + attn_out + mlp_out if cfg.use_parallel_residual \
+        else ln2_in + mlp_out
+    return out, (k_cache, v_cache)
+
+
+def _prefill(cfg, params, tokens, s_max, use_pallas=True):
+    """Run the prompt through the model, filling KV caches sized s_max.
+    Returns (last-position hidden [B, 1, H], caches per layer)."""
+    B, S_p = tokens.shape
+    x = params["embed"]["wte"][tokens]
+    cos_sin = _rotary_cache(cfg, S_p)
+    caches = []
+    for bp in params["blocks"]:
+        x, (k, v) = _block_core(cfg, bp, x, cos_sin, use_pallas, mp=1,
+                                reduce_fn=lambda t: t, return_kv=True)
+        pad = [(0, 0), (0, s_max - S_p), (0, 0), (0, 0)]
+        caches.append((jnp.pad(k, pad), jnp.pad(v, pad)))
+    return x[:, -1:, :], caches
+
+
+def generate(cfg, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None, use_pallas=True):
+    """Greedy / temperature sampling with a KV cache: one jittable
+    function — prefill, then `lax.scan` over decode steps (static
+    shapes; cache updated in-place via dynamic_update_slice).
+
+    prompt [B, S_p] int32 → generated tokens [B, max_new_tokens].
+    """
+    B, S_p = prompt.shape
+    s_max = S_p + max_new_tokens
+    if s_max > cfg.max_seq_len:
+        raise ValueError(f"prompt + max_new_tokens = {s_max} exceeds "
+                         f"max_seq_len {cfg.max_seq_len}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    hidden, caches = _prefill(cfg, params, prompt, s_max,
+                              use_pallas=use_pallas)
+    cos_sin = _rotary_cache(cfg, s_max)
+    out_embed = params.get("embed_out", params["embed"])["wte"]
+
+    def logits_of(x):
+        h = layer_norm(x, params["final_ln"]["scale"],
+                       params["final_ln"]["bias"], cfg.layernorm_eps)
+        return jnp.einsum("bsh,vh->bsv", h, out_embed.astype(h.dtype),
+                          preferred_element_type=jnp.float32)[:, 0, :]
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    first_tok = sample(logits_of(hidden), rng)
+
+    def step(carry, key):
+        tok, caches, pos = carry
+        x = params["embed"]["wte"][tok[:, None]]
+        new_caches = []
+        for bp, kv in zip(params["blocks"], caches):
+            x, kv = _block_decode(cfg, bp, x, kv, pos, cos_sin)
+            new_caches.append(kv)
+        nxt = sample(logits_of(x), key)
+        return (nxt, new_caches, pos + 1), nxt
+
+    # max_new_tokens - 1 decode steps, each emitting the token it samples;
+    # the prefill already produced the first token, so nothing is wasted.
+    keys = jax.random.split(jax.random.fold_in(rng, 1),
+                            max(max_new_tokens - 1, 0))
+    (_, _, _), toks = jax.lax.scan(
+        step, (first_tok, caches, jnp.asarray(S_p, jnp.int32)), keys)
+    toks = jnp.concatenate([first_tok[None], toks], axis=0)
+    return jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
 
 
 # ---------------------------------------------------------------------------

@@ -132,7 +132,7 @@ class FlopsProfiler:
             results = profile_fn(
                 eng._build_grads_step(gas).__wrapped__,
                 eng.state.params, sharded, rng, eng.state.scale.cur_scale,
-                n_timing_iters=1)
+                eng.state.global_steps, n_timing_iters=1)
         else:
             lr = jnp.asarray(eng.optimizer.param_groups[0]["lr"],
                              jnp.float32)

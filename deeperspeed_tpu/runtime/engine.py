@@ -648,13 +648,16 @@ class DeepSpeedEngine:
         return jax.jit(train_step, donate_argnums=(0,))
 
     def _build_grads_step(self, accum_steps):
-        """Offload path: fused grad accumulation, no device update."""
-        def grads_step(params, batches, rng, scale):
+        """Offload path: fused grad accumulation, no device update.
+        `global_steps` feeds the PLD schedule (unused otherwise)."""
+        def grads_step(params, batches, rng, scale, global_steps):
+            theta = self._pld_theta_in_jit(global_steps)
+
             def micro(carry, xs):
                 grads_acc, loss_acc = carry
                 mb, mb_rng = xs
                 loss, grads = self._loss_and_grads(params, mb, mb_rng,
-                                                   scale)
+                                                   scale, pld_theta=theta)
                 grads_acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc,
                     grads)
@@ -1022,7 +1025,7 @@ class DeepSpeedEngine:
                 self._compiled_train[key] = self._build_grads_step(gas)
             loss, grads = self._compiled_train[key](
                 self.state.params, sharded, self._next_rng(),
-                self.state.scale.cur_scale)
+                self.state.scale.cur_scale, self.state.global_steps)
             metrics = self._host_apply_update(grads)
             metrics = metrics._replace(loss=loss)
         else:

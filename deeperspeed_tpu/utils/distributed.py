@@ -67,7 +67,14 @@ def _patch_azureml_env(verbose=True):
     if int(os.environ["WORLD_SIZE"]) == 1:
         os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
     else:
-        master = os.environ.get("AZ_BATCH_MASTER_NODE", "127.0.0.1:29500")
+        master = os.environ.get("AZ_BATCH_MASTER_NODE") or \
+            os.environ.get("AZ_BATCHAI_MPI_MASTER_NODE")
+        if not master:
+            raise RuntimeError(
+                "AzureML multi-node job but neither AZ_BATCH_MASTER_NODE "
+                "nor AZ_BATCHAI_MPI_MASTER_NODE is set — cannot determine "
+                "the rendezvous address (a localhost default would make "
+                "every node rendezvous with itself)")
         addr, _, port = master.partition(":")
         os.environ.setdefault("MASTER_ADDR", addr)
         if port:

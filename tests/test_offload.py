@@ -262,3 +262,39 @@ def test_engine_cpu_offload_checkpoint_roundtrip(tmp_path):
     la = [float(e1.train_batch(data_iter=it1)) for _ in range(3)]
     lb = [float(e2.train_batch(data_iter=it2)) for _ in range(3)]
     np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_pld_theta_reaches_loss_fn_with_offload():
+    """The host-offload grads path must thread pld_theta too."""
+    import jax
+    import jax.numpy as jnp
+
+    import deeperspeed_tpu
+
+    class PldModel:
+        def init_params(self, rng):
+            return {"w": jnp.ones((8, 8))}
+
+        def loss_fn(self, params, batch, rng=None, pld_theta=None):
+            x, y = batch
+            assert pld_theta is not None
+            return jnp.mean((x @ params["w"] * pld_theta - y) ** 2)
+
+    model = PldModel()
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(
+            jax.random.PRNGKey(0)),
+        config_params={"train_batch_size": 8,
+                       "optimizer": {"type": "Adam",
+                                     "params": {"lr": 1e-2}},
+                       "zero_optimization": {
+                           "stage": 2,
+                           "offload_optimizer": {"device": "cpu"}},
+                       "progressive_layer_drop": {"enabled": True,
+                                                  "theta": 0.5,
+                                                  "gamma": 0.1},
+                       "steps_per_print": 100})
+    assert engine.host_offload and engine._pld_in_loss
+    x = np.ones((1, 8, 8), np.float32)
+    losses = [float(engine.train_batch(batch=(x, x))) for _ in range(3)]
+    assert np.isfinite(losses).all()
