@@ -277,6 +277,22 @@ class BertForPreTraining:
                     batch["next_sentence_label"])
         return batch
 
+    # -- layer-activation capture (engine.set_layers_to_hook) ------------
+
+    def layer_names(self):
+        return ["embedding"] + \
+            ["transformerlayer"] * self.config.num_layers
+
+    def hidden_states(self, params, batch, rng=None):
+        input_ids, token_type_ids, attention_mask, *_ = self._unpack(batch)
+        x = self.bert.embed(params, input_ids, token_type_ids)
+        outs = [x]
+        for lp in params["layers"]:
+            x = self.bert.layer.apply(lp, x, attention_mask=attention_mask,
+                                      deterministic=True)
+            outs.append(x)
+        return outs
+
 
 class BertForQuestionAnswering:
     """SQuAD span head (reference `modeling.py` BertForQuestionAnswering;

@@ -236,30 +236,26 @@ def causal_attention(q, k, v, use_pallas=True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
-                return_kv=False):
-    """Shared block body: `mp == 1` with identity `reduce_fn` is the
-    dense block; TP callers pass pre-sliced params (column/row parallel)
-    and a psum reduce — one implementation, so the two paths cannot
-    drift. Biases of row-parallel matmuls are added after the reduce
-    (algebraically identical in the dense case)."""
-    B, S, h = x.shape
-    nh_local = cfg.num_heads // mp
-    hd = cfg.head_dim
-    cos, sin, rot_dim = cos_sin
-    out_b = params["attn"]["out_b"].astype(x.dtype)
-    mlp_b = params["mlp"]["out_b"].astype(x.dtype)
-
+def _block_qkv(cfg, params, x, cos, sin, rot_dim, nh_local):
+    """ln1 + QKV projection + rotary; shared by training and decode."""
+    B, S, _ = x.shape
     ln1 = layer_norm(x, params["ln_attn"]["scale"], params["ln_attn"]["bias"],
                      cfg.layernorm_eps)
     qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
         params["attn"]["qkv_b"].astype(x.dtype)
-    qkv = qkv.reshape(B, S, nh_local, 3 * hd)
+    qkv = qkv.reshape(B, S, nh_local, 3 * cfg.head_dim)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k = apply_rotary(q, k, cos, sin, rot_dim)
-    attn = causal_attention(q, k, v, use_pallas=use_pallas)
-    attn = attn.reshape(B, S, h // mp)
-    attn_partial = attn @ params["attn"]["out_w"].astype(x.dtype)
+    return q, k, v
+
+
+def _block_post_attn(cfg, params, x, attn_flat, reduce_fn):
+    """Everything after the attention core: out projection, residuals,
+    ln2, MLP — shared by training and decode. `attn_flat` is the
+    flattened [B, S, h/mp] attention output."""
+    out_b = params["attn"]["out_b"].astype(x.dtype)
+    mlp_b = params["mlp"]["out_b"].astype(x.dtype)
+    attn_partial = attn_flat @ params["attn"]["out_w"].astype(x.dtype)
 
     if cfg.use_parallel_residual:
         ln2_in = x
@@ -275,9 +271,25 @@ def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
 
     if cfg.use_parallel_residual:
         # one reduce for both partials (the Megatron fusion win)
-        out = x + reduce_fn(attn_partial + mlp_partial) + out_b + mlp_b
-    else:
-        out = ln2_in + reduce_fn(mlp_partial) + mlp_b
+        return x + reduce_fn(attn_partial + mlp_partial) + out_b + mlp_b
+    return ln2_in + reduce_fn(mlp_partial) + mlp_b
+
+
+def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
+                return_kv=False):
+    """Shared block body: `mp == 1` with identity `reduce_fn` is the
+    dense block; TP callers pass pre-sliced params (column/row parallel)
+    and a psum reduce; the KV-cached decode step reuses the same
+    `_block_qkv`/`_block_post_attn` pieces — one implementation, so the
+    paths cannot drift. Biases of row-parallel matmuls are added after
+    the reduce (algebraically identical in the dense case)."""
+    B, S, h = x.shape
+    cos, sin, rot_dim = cos_sin
+    q, k, v = _block_qkv(cfg, params, x, cos, sin, rot_dim,
+                         cfg.num_heads // mp)
+    attn = causal_attention(q, k, v, use_pallas=use_pallas)
+    out = _block_post_attn(cfg, params, x, attn.reshape(B, S, h // mp),
+                           reduce_fn)
     if return_kv:
         return out, (k, v)
     return out
@@ -468,22 +480,17 @@ class GPTNeoX:
 # ---------------------------------------------------------------------------
 
 def _block_decode(cfg, bp, x, kv, pos, cos_sin):
-    """One block for one new position. x [B, 1, H]; kv = (k_cache,
-    v_cache) [B, S_max, nh, hd]; pos: scalar int32 index being written."""
+    """One block for one new position: `_block_qkv` with the rotary
+    slice at `pos`, cached attention over [0, pos], then the shared
+    `_block_post_attn`. x [B, 1, H]; kv = (k_cache, v_cache)
+    [B, S_max, nh, hd]."""
     B = x.shape[0]
-    nh, hd = cfg.num_heads, cfg.head_dim
     cos_full, sin_full, rot_dim = cos_sin
     k_cache, v_cache = kv
 
-    ln1 = layer_norm(x, bp["ln_attn"]["scale"], bp["ln_attn"]["bias"],
-                     cfg.layernorm_eps)
-    qkv = ln1 @ bp["attn"]["qkv_w"].astype(x.dtype) + \
-        bp["attn"]["qkv_b"].astype(x.dtype)
-    qkv = qkv.reshape(B, 1, nh, 3 * hd)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
     cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, 1, 0)
     sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, 1, 0)
-    q, k = apply_rotary(q, k, cos, sin, rot_dim)
+    q, k, v = _block_qkv(cfg, bp, x, cos, sin, rot_dim, cfg.num_heads)
 
     k_cache = jax.lax.dynamic_update_slice_in_dim(
         k_cache, k.astype(k_cache.dtype), pos, axis=1)
@@ -491,26 +498,16 @@ def _block_decode(cfg, bp, x, kv, pos, cos_sin):
         v_cache, v.astype(v_cache.dtype), pos, axis=1)
 
     S_max = k_cache.shape[1]
-    scale = 1.0 / math.sqrt(hd)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                         preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(S_max)[None, None, None, :] <= pos
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
-    attn = attn.reshape(B, 1, cfg.hidden_size)
-    attn_out = attn @ bp["attn"]["out_w"].astype(x.dtype) + \
-        bp["attn"]["out_b"].astype(x.dtype)
 
-    ln2_in = x if cfg.use_parallel_residual else x + attn_out
-    ln2 = layer_norm(ln2_in, bp["ln_mlp"]["scale"], bp["ln_mlp"]["bias"],
-                     cfg.layernorm_eps)
-    hmid = jax.nn.gelu(ln2 @ bp["mlp"]["in_w"].astype(x.dtype) +
-                       bp["mlp"]["in_b"].astype(x.dtype))
-    mlp_out = hmid @ bp["mlp"]["out_w"].astype(x.dtype) + \
-        bp["mlp"]["out_b"].astype(x.dtype)
-    out = x + attn_out + mlp_out if cfg.use_parallel_residual \
-        else ln2_in + mlp_out
+    out = _block_post_attn(cfg, bp, x, attn.reshape(B, 1, cfg.hidden_size),
+                           reduce_fn=lambda t: t)
     return out, (k_cache, v_cache)
 
 
@@ -538,6 +535,8 @@ def generate(cfg, params, prompt, max_new_tokens, temperature=0.0,
     prompt [B, S_p] int32 → generated tokens [B, max_new_tokens].
     """
     B, S_p = prompt.shape
+    if max_new_tokens <= 0:
+        return jnp.zeros((B, 0), jnp.int32)
     s_max = S_p + max_new_tokens
     if s_max > cfg.max_seq_len:
         raise ValueError(f"prompt + max_new_tokens = {s_max} exceeds "

@@ -168,3 +168,21 @@ def test_gpt_neox_tied_pipeline_head_uses_embedding():
                              module.num_layers()).astype(jnp.float32)))(
         params)
     assert np.abs(np.asarray(g["tied"]["embed"]["wte"])).sum() > 0
+
+
+def test_bert_activation_capture_through_engine():
+    cfg = BertConfig.tiny()
+    model = BertForPreTraining(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={"train_batch_size": 4 * jax.device_count(),
+                       "optimizer": {"type": "Adam",
+                                     "params": {"lr": 1e-3}},
+                       "steps_per_print": 1000})
+    batch = _pretrain_batch(cfg, bs=4 * jax.device_count())
+    stacked = tuple(np.expand_dims(b, 0) for b in batch)
+    engine.train_batch(batch=stacked, layers_to_hook=["transformerlayer"])
+    acts = engine.get_hooked_activations()
+    assert sorted(acts) == [1, 2]
+    assert acts[1].shape[-1] == cfg.hidden_size
