@@ -152,13 +152,21 @@ class BertModel:
                            cfg.layernorm_eps)
 
     def encode(self, params, input_ids, token_type_ids=None,
-               attention_mask=None, rng=None, deterministic=True):
+               attention_mask=None, rng=None, deterministic=True,
+               collect_hidden=False):
+        """Run embeddings + encoder; with `collect_hidden` also return
+        the per-layer outputs (the activation-capture path shares this
+        exact forward)."""
         x = self.embed(params, input_ids, token_type_ids)
+        hidden = [x]
         rngs = (jax.random.split(rng, self.config.num_layers)
                 if rng is not None else [None] * self.config.num_layers)
         for lp, r in zip(params["layers"], rngs):
             x = self.layer.apply(lp, x, attention_mask=attention_mask,
                                  rng=r, deterministic=deterministic)
+            hidden.append(x)
+        if collect_hidden:
+            return x, hidden
         return x
 
     def pool(self, params, sequence_output):
@@ -285,12 +293,12 @@ class BertForPreTraining:
 
     def hidden_states(self, params, batch, rng=None):
         input_ids, token_type_ids, attention_mask, *_ = self._unpack(batch)
-        x = self.bert.embed(params, input_ids, token_type_ids)
-        outs = [x]
-        for lp in params["layers"]:
-            x = self.bert.layer.apply(lp, x, attention_mask=attention_mask,
-                                      deterministic=True)
-            outs.append(x)
+        # same forward as training (shared encode, same rng → same
+        # dropout masks as the step being debugged)
+        _, outs = self.bert.encode(params, input_ids, token_type_ids,
+                                   attention_mask, rng=rng,
+                                   deterministic=rng is None,
+                                   collect_hidden=True)
         return outs
 
 

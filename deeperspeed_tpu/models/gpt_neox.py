@@ -327,19 +327,27 @@ def block_param_specs_tp(pipe_axis=None):
                                   is_leaf=lambda x: isinstance(x, P))
 
 
-def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False):
-    """tokens [B, S] int32 → final-norm hidden states [B, S, H]."""
+def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
+                   collect_hidden=False):
+    """tokens [B, S] int32 → final-norm hidden states [B, S, H]; with
+    `collect_hidden` also returns [embed, block outputs..., final norm]
+    (the activation-capture path shares this exact forward)."""
     x = params["embed"]["wte"][tokens]
     cos_sin = _rotary_cache(cfg, tokens.shape[1])
+    hidden = [x]
 
     block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
     if remat_blocks:
         block_fn = jax.checkpoint(block_fn, static_argnums=())
     for bp in params["blocks"]:
         x = block_fn(bp, x, cos_sin)
+        hidden.append(x)
 
-    return layer_norm(x, params["final_ln"]["scale"],
-                      params["final_ln"]["bias"], cfg.layernorm_eps)
+    out = layer_norm(x, params["final_ln"]["scale"],
+                     params["final_ln"]["bias"], cfg.layernorm_eps)
+    if collect_hidden:
+        return out, hidden + [out]
+    return out
 
 
 def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
@@ -459,19 +467,13 @@ class GPTNeoX:
 
     def hidden_states(self, params, batch, rng=None):
         """Per-layer outputs for the engine's activation-capture hooks
-        (fork: `engine.py:222-254` forward hooks)."""
+        (fork: `engine.py:222-254` forward hooks); shares
+        `forward_hidden` so the capture can never drift from the real
+        forward."""
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        cfg = self.config
-        x = params["embed"]["wte"][tokens]
-        outs = [x]
-        cos_sin = _rotary_cache(cfg, tokens.shape[1])
-        for bp in params["blocks"]:
-            x = block_forward(cfg, bp, x, cos_sin,
-                              use_pallas=self.use_pallas)
-            outs.append(x)
-        outs.append(layer_norm(x, params["final_ln"]["scale"],
-                               params["final_ln"]["bias"],
-                               cfg.layernorm_eps))
+        _, outs = forward_hidden(self.config, params, tokens,
+                                 use_pallas=self.use_pallas,
+                                 collect_hidden=True)
         return outs
 
 

@@ -258,6 +258,12 @@ class DeepSpeedEngine:
     def gradient_accumulation_steps(self):
         return self._config.gradient_accumulation_steps
 
+    def sparse_attention_config(self):
+        """The parsed "sparse_attention" block (reference engine
+        accessor); build the pattern object with
+        `ops.sparse_attention.sparsity_config_from_dict`."""
+        return self._config.sparse_attention
+
     def zero_optimization(self):
         return self._config.zero_enabled
 

@@ -201,3 +201,26 @@ def test_sparse_self_attention_module():
                                  False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=3e-5, rtol=3e-5)
+
+
+def test_engine_sparse_attention_config_accessor():
+    import deeperspeed_tpu
+    from tests.simple_model import SimpleModel
+    from deeperspeed_tpu.ops.sparse_attention import (
+        FixedSparsityConfig, sparsity_config_from_dict)
+
+    model = SimpleModel(hidden_dim=8)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(
+            jax.random.PRNGKey(0)),
+        config_params={"train_batch_size": 8,
+                       "optimizer": {"type": "Adam",
+                                     "params": {"lr": 1e-3}},
+                       "sparse_attention": {"mode": "fixed", "block": 16,
+                                            "num_local_blocks": 4},
+                       "steps_per_print": 100})
+    sa = engine.sparse_attention_config()
+    assert sa["mode"] == "fixed" and sa["block"] == 16
+    cfg_obj = sparsity_config_from_dict({**sa, "num_heads": 4})
+    assert isinstance(cfg_obj, FixedSparsityConfig)
+    assert cfg_obj.block == 16
