@@ -9,7 +9,7 @@ underneath is JAX/XLA/pjit/Pallas over a `jax.sharding.Mesh`.
 
 import argparse
 
-from . import ops  # noqa: F401
+from . import moe, ops  # noqa: F401
 from .elasticity import compute_elastic_config, elasticity_enabled
 from .parallel.mesh import PipelineParallelGrid
 from .parallel.topology import (PipeDataParallelTopology,

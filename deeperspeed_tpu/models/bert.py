@@ -158,13 +158,14 @@ class BertModel:
         the per-layer outputs (the activation-capture path shares this
         exact forward)."""
         x = self.embed(params, input_ids, token_type_ids)
-        hidden = [x]
+        hidden = [x] if collect_hidden else None
         rngs = (jax.random.split(rng, self.config.num_layers)
                 if rng is not None else [None] * self.config.num_layers)
         for lp, r in zip(params["layers"], rngs):
             x = self.layer.apply(lp, x, attention_mask=attention_mask,
                                  rng=r, deterministic=deterministic)
-            hidden.append(x)
+            if collect_hidden:
+                hidden.append(x)
         if collect_hidden:
             return x, hidden
         return x
@@ -293,11 +294,12 @@ class BertForPreTraining:
 
     def hidden_states(self, params, batch, rng=None):
         input_ids, token_type_ids, attention_mask, *_ = self._unpack(batch)
-        # same forward as training (shared encode, same rng → same
-        # dropout masks as the step being debugged)
+        # Shared encode = same code as training; the capture itself is
+        # deterministic (dropout off) — the fused step's per-micro rng
+        # splits make exact mask reproduction meaningless here.
         _, outs = self.bert.encode(params, input_ids, token_type_ids,
-                                   attention_mask, rng=rng,
-                                   deterministic=rng is None,
+                                   attention_mask, rng=None,
+                                   deterministic=True,
                                    collect_hidden=True)
         return outs
 

@@ -334,14 +334,15 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
     (the activation-capture path shares this exact forward)."""
     x = params["embed"]["wte"][tokens]
     cos_sin = _rotary_cache(cfg, tokens.shape[1])
-    hidden = [x]
+    hidden = [x] if collect_hidden else None
 
     block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
     if remat_blocks:
         block_fn = jax.checkpoint(block_fn, static_argnums=())
     for bp in params["blocks"]:
         x = block_fn(bp, x, cos_sin)
-        hidden.append(x)
+        if collect_hidden:
+            hidden.append(x)
 
     out = layer_norm(x, params["final_ln"]["scale"],
                      params["final_ln"]["bias"], cfg.layernorm_eps)

@@ -1,0 +1,3 @@
+from .layer import MoELayer, moe_ffn_dense, moe_ffn_expert_parallel
+
+__all__ = ["MoELayer", "moe_ffn_dense", "moe_ffn_expert_parallel"]

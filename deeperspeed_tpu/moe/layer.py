@@ -1,0 +1,172 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+The reference (DeepSpeed v0.3.15) predates MoE; this layer exists so the
+framework covers the modern 4th parallel axis alongside dp/pp/tp/sp. The
+design is the GShard/Switch pattern, TPU-first:
+
+- **top-1 gating with capacity**: each token routes to its argmax expert;
+  an expert accepts at most `capacity` tokens (position-ordered).
+  Overflow tokens combine to an exact-zero output — the surrounding
+  transformer block's residual connection is what carries them through
+  unchanged (standard Switch/GShard usage; this layer does NOT add the
+  residual itself). Static shapes — the dispatch is a dense [T, E, C]
+  one-hot combine/dispatch pair, exactly the formulation GShard lowers
+  to XLA.
+- **expert parallelism**: experts shard over an ``expert`` mesh axis
+  inside `shard_map`; token shards are exchanged with `all_to_all`
+  (dispatch) and returned (combine), both riding ICI.
+- Gate math in fp32; an auxiliary load-balancing loss (mean_prob ×
+  mean_assignment per expert, scaled by E) is returned for the trainer.
+
+`moe_ffn_dense` is the single-device reference semantics;
+`moe_ffn_expert_parallel` runs inside `shard_map` and matches it
+exactly (tested on the 8-device mesh).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot_dispatch(gate_logits, capacity):
+    """Top-1 capacity routing.
+
+    gate_logits [T, E] fp32 → (dispatch [T, E, C] bool-ish float,
+    combine [T, E, C] float = gate prob on the kept slot, aux_loss).
+    """
+    T, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None],
+                               axis=-1)[:, 0]               # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)   # [T, E]
+
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot               # [T, E]
+    pos_in_expert = jnp.sum(pos, axis=-1) - 1.0             # [T]
+    keep = pos_in_expert < capacity                         # [T]
+
+    slot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)                # [T, C]
+    dispatch = onehot[:, :, None] * slot[:, None, :] * \
+        keep[:, None, None]                                 # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    # GShard aux loss: E * sum_e mean(prob_e) * mean(assigned_e)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(onehot, axis=0)
+    aux = E * jnp.sum(me * ce)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(w_in, b_in, w_out, b_out, x):
+    """One expert's FFN on [C, H] (weights [H, I]/[I, H])."""
+    h = jax.nn.gelu(x @ w_in.astype(x.dtype) + b_in.astype(x.dtype))
+    return h @ w_out.astype(x.dtype) + b_out.astype(x.dtype)
+
+
+def moe_ffn_dense(params, x, capacity_factor=1.25):
+    """Reference semantics on one device. params: stacked expert weights
+    {"w_in" [E, H, I], "b_in" [E, I], "w_out" [E, I, H], "b_out" [E, H],
+    "gate" [H, E]}; x [T, H] → (y [T, H], aux_loss)."""
+    T, H = x.shape
+    E = params["w_in"].shape[0]
+    capacity = max(1, int(capacity_factor * T / E))
+    logits = (x @ params["gate"].astype(x.dtype)).astype(jnp.float32)
+    dispatch, combine, aux = _one_hot_dispatch(logits, capacity)
+
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
+    expert_out = jax.vmap(_expert_ffn)(
+        params["w_in"], params["b_in"], params["w_out"], params["b_out"],
+        expert_in)                                          # [E, C, H]
+    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+    return y, aux
+
+
+def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25):
+    """Inside shard_map: x is this rank's token shard [T_local, H];
+    params carry this rank's experts ({"w_in" [E/ep, H, I], ...}) with
+    the gate replicated. all_to_all exchanges expert-major token blocks
+    so each rank runs only its own experts; a second all_to_all returns
+    the outputs. Matches `moe_ffn_dense` run per-shard exactly."""
+    T, H = x.shape
+    e_local = params["w_in"].shape[0]
+    E = e_local * ep
+    capacity = max(1, int(capacity_factor * T / E))
+    logits = (x @ params["gate"].astype(x.dtype)).astype(jnp.float32)
+    dispatch, combine, aux = _one_hot_dispatch(logits, capacity)
+
+    # [T, E, C] → [E, C, H] expert-major buffers, then exchange:
+    # split E = ep × e_local; all_to_all gives [ep, e_local, C, H] where
+    # dim 0 is the source rank.
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
+    expert_in = expert_in.reshape(ep, e_local, capacity, H)
+    expert_in = jax.lax.all_to_all(expert_in, axis_name, 0, 0,
+                                   tiled=False)             # [ep, eL, C, H]
+
+    flat_in = jnp.moveaxis(expert_in, 0, 1).reshape(
+        e_local, ep * capacity, H)
+    expert_out = jax.vmap(_expert_ffn)(
+        params["w_in"], params["b_in"], params["w_out"], params["b_out"],
+        flat_in)                                            # [eL, ep*C, H]
+    expert_out = jnp.moveaxis(
+        expert_out.reshape(e_local, ep, capacity, H), 1, 0)
+
+    expert_out = jax.lax.all_to_all(expert_out, axis_name, 0, 0,
+                                    tiled=False)            # [ep, eL, C, H]
+    expert_out = expert_out.reshape(E, capacity, H)
+    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+    # aux is per-shard; average over the expert(-data) axis
+    return y, jax.lax.pmean(aux, axis_name)
+
+
+class MoELayer:
+    """Engine-protocol MoE FFN layer (init/apply), expert-parallel when a
+    mesh with an ``expert`` axis is supplied."""
+
+    def __init__(self, hidden_size, intermediate_size, num_experts,
+                 capacity_factor=1.25, mesh=None, axis_name="expert",
+                 param_dtype=jnp.float32):
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.axis_name = axis_name
+        self.ep = int(mesh.shape[axis_name]) \
+            if mesh is not None and axis_name in mesh.axis_names else 1
+        if num_experts % max(self.ep, 1) != 0:
+            raise ValueError(f"num_experts {num_experts} must divide over "
+                             f"expert-parallel size {self.ep}")
+        self.param_dtype = param_dtype
+
+    def init(self, rng, x=None):
+        E, H, I = self.num_experts, self.hidden_size, self.intermediate_size
+        k1, k2, k3 = jax.random.split(rng, 3)
+        dt = self.param_dtype
+        return {
+            "gate": (jax.random.normal(k1, (H, E)) * 0.02).astype(dt),
+            "w_in": (jax.random.normal(k2, (E, H, I)) * 0.02).astype(dt),
+            "b_in": jnp.zeros((E, I), dt),
+            "w_out": (jax.random.normal(k3, (E, I, H)) * 0.02).astype(dt),
+            "b_out": jnp.zeros((E, H), dt),
+        }
+
+    def param_specs(self):
+        """Expert dim sharded over the expert axis; gate replicated."""
+        from jax.sharding import PartitionSpec as P
+        ax = self.axis_name if self.ep > 1 else None
+        return {"gate": P(), "w_in": P(ax), "b_in": P(ax),
+                "w_out": P(ax), "b_out": P(ax)}
+
+    def apply(self, params, x, rng=None):
+        """x [..., H] → (y [..., H], aux_loss); dense or inside
+        shard_map depending on construction."""
+        lead = x.shape[:-1]
+        flat = x.reshape(-1, self.hidden_size)
+        if self.ep > 1:
+            y, aux = moe_ffn_expert_parallel(
+                params, flat, self.axis_name, self.ep,
+                capacity_factor=self.capacity_factor)
+        else:
+            y, aux = moe_ffn_dense(params, flat,
+                                   capacity_factor=self.capacity_factor)
+        return y.reshape(*lead, self.hidden_size), aux
