@@ -1,4 +1,6 @@
 from .bert_sparse_self_attention import BertSparseSelfAttention
+from .matmul import MatMul, dense_to_sparse, sparse_to_dense
+from .softmax import Softmax
 from .sparse_attention_utils import SparseAttentionUtils
 from .sparse_self_attention import SparseSelfAttention
 from .sparsity_config import (BigBirdSparsityConfig,

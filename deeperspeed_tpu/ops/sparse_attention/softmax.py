@@ -1,0 +1,122 @@
+"""Standalone block-sparse Softmax op (reference:
+`deepspeed/ops/sparse_attention/softmax.py:230` — Triton kernel
+`trsrc/softmax_fwd.tr`).
+
+Normalizes each *row* of the logical [H, nQ*B, nK*B] sparse matrix across
+all of that row's active blocks, in the reference's sparse tensor format
+`[Z, nnz, block, block]` (row-major (head, row-block, col-block) block
+order — see `matmul._layout_indices`).
+
+TPU-native design: the Triton kernel walks a per-row LUT; here the
+cross-block row reduction is a `segment_max`/`segment_sum` over the block
+axis grouped by (head, row-block), which XLA vectorizes over the lane
+dimension. Autodiff supplies the backward pass (the reference hand-writes
+`softmax_bwd.tr`).
+
+Mask semantics match `softmax_fwd.tr` exactly: x*scale → +rpe →
++key-padding-mask → +attn-mask, where a "mul"-mode mask contributes
+-inf where the mask is 0 and 0 elsewhere, and an "add"-mode mask is
+added verbatim.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .matmul import _layout_indices
+
+_NEG = -1e30  # finite -inf stand-in: keeps fully-masked rows NaN-free
+
+
+def _mask_term(mask, mode):
+    mask = mask.astype(jnp.float32)
+    if mode == "mul":
+        return jnp.where(mask == 0, _NEG, 0.0)
+    if mode == "add":
+        return mask
+    raise ValueError(f"mask mode must be 'add' or 'mul', got {mode!r}")
+
+
+class Softmax:
+    """Block-sparse softmax with the reference's class API
+    (`softmax.py:230-318`). Construct once per (layout, block); call on a
+    sparse tensor. Pure/functional — unlike the reference it does NOT
+    mutate x in place — and safe under `jit` and `grad`."""
+
+    def __init__(self, layout, block, bench=False):
+        layout = np.asarray(layout)
+        self.layout = layout
+        self.block = int(block)
+        self.spdims = layout.shape
+        self.num_blocks = int(layout.sum())
+        self.bench = bench
+        self.h_idx, self.mi_idx, self.ni_idx = _layout_indices(layout)
+        h, n_q, n_k = layout.shape
+        # Row-group id per block: all blocks of one (head, row-block) pool
+        # their columns into a single softmax domain.
+        self.seg = self.h_idx.astype(np.int64) * n_q + self.mi_idx
+        self.num_segments = h * n_q
+
+    def __call__(self, x, scale=1.0, rpe=None, key_padding_mask=None,
+                 attn_mask=None, key_padding_mask_mode="add",
+                 attn_mask_mode="add"):
+        """x: sparse [Z, nnz, B, B] (or [nnz, B, B]); rpe: dense
+        [Z|1, H, S, S]; key_padding_mask: [Z, S]; attn_mask: [S, S]."""
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        z, nnz, bsz, _ = x.shape
+        if nnz != len(self.h_idx):
+            raise ValueError(
+                f"expected {len(self.h_idx)} blocks, got {nnz}")
+        seg = jnp.asarray(self.seg)
+
+        f = x.astype(jnp.float32) * scale
+        if rpe is not None:
+            rpe = jnp.asarray(rpe)
+            if rpe.ndim != 4:
+                raise ValueError("rpe must be [Z|1, H, S, S]")
+            # one combined gather straight to [Z|1, nnz, B, B] — chaining
+            # per-axis gathers would materialize [Z, nnz, S, S]
+            rows = self.mi_idx[:, None] * bsz + np.arange(bsz)[None]
+            cols = self.ni_idx[:, None] * bsz + np.arange(bsz)[None]
+            blk = rpe[:, jnp.asarray(self.h_idx)[:, None, None],
+                      jnp.asarray(rows)[:, :, None],
+                      jnp.asarray(cols)[:, None, :]]
+            f = f + blk.astype(jnp.float32)
+        if key_padding_mask is not None:
+            kpm = _mask_term(jnp.asarray(key_padding_mask),
+                             key_padding_mask_mode)      # [Z, S]
+            cols = (self.ni_idx[:, None] * bsz
+                    + np.arange(bsz)[None]).reshape(-1)   # [nnz*B]
+            blk = jnp.take(kpm, jnp.asarray(cols), axis=1)
+            f = f + blk.reshape(z, nnz, 1, bsz)
+        if attn_mask is not None:
+            am = _mask_term(jnp.asarray(attn_mask), attn_mask_mode)  # [S,S]
+            rows = self.mi_idx[:, None] * bsz + np.arange(bsz)[None]
+            cols = self.ni_idx[:, None] * bsz + np.arange(bsz)[None]
+            blk = am[jnp.asarray(rows)[:, :, None],
+                     jnp.asarray(cols)[:, None, :]]       # [nnz, B, B]
+            f = f + blk[None]
+
+        # Row-wise max/sum across every active block of the row.
+        row_max = jnp.moveaxis(f.max(axis=-1), 1, 0)      # [nnz, Z, B]
+        g_max = jax.ops.segment_max(row_max, seg,
+                                    num_segments=self.num_segments)
+        # Rows whose every active entry is masked to ~-inf emit zeros (the
+        # dense fallback's convention; the Triton kernel emits NaN there).
+        dead = g_max <= _NEG / 2                           # [nseg, Z, B]
+        g_max = jnp.maximum(g_max, _NEG)  # keep exp() finite on dead rows
+        m = jnp.moveaxis(jnp.take(g_max, seg, axis=0), 0, 1)  # [Z, nnz, B]
+        e = jnp.exp(f - m[..., None])
+        row_sum = jnp.moveaxis(e.sum(axis=-1), 1, 0)
+        g_sum = jax.ops.segment_sum(row_sum, seg,
+                                    num_segments=self.num_segments)
+        s = jnp.moveaxis(jnp.take(g_sum, seg, axis=0), 0, 1)
+        alive = ~jnp.moveaxis(jnp.take(dead, seg, axis=0), 0, 1)
+        y = jnp.where(alive[..., None] & (s[..., None] > 0),
+                      e / jnp.maximum(s[..., None], 1e-30),
+                      0.0).astype(x.dtype)
+        return y[0] if squeeze else y
