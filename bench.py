@@ -44,7 +44,7 @@ def main():
     cfg = GPTNeoXConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024)
     seq = 1024
-    batch_per_chip = 16
+    batch_per_chip = 32
     batch = batch_per_chip * n_chips
 
     model = GPTNeoX(cfg, use_pallas=True)

@@ -1,0 +1,3 @@
+from .block_sparse_attention import BlockSparseAttention, build_lut
+from .flash_attention import flash_attention, flash_attention_supported
+from .optimizer import adam_flat_reference, fused_adam_flat

@@ -158,6 +158,8 @@ def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lut_flat, qb, kb, vb)
 
@@ -308,6 +310,8 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
             jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
             jax.ShapeDtypeStruct((bh, s, d), vb.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lut_t_flat, qb, kb, vb, do, lse, delta)
 
@@ -344,6 +348,8 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
     dq = pl.pallas_call(
         dq_kernel, grid_spec=dq_grid,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lut_flat, qb, kb, vb, do, lse, delta)
 

@@ -523,8 +523,17 @@ class DeepSpeedEngine:
         cfg = self._config
         scale = state.scale.cur_scale
 
-        grads = jax.tree_util.tree_map(
-            lambda g: g.astype(jnp.float32) / scale, grads)
+        # Without loss scaling, scale is statically 1 — skip the full
+        # unscale pass over the gradient tree (one HBM round-trip saved;
+        # the optimizer casts each leaf to fp32 inside its fused update).
+        # Clipping/prescale still need fp32 grads: the clipped result
+        # would otherwise round back through bf16 before the update.
+        if self._config.loss_scaling_enabled:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / scale, grads)
+        elif cfg.prescale_gradients or cfg.gradient_clipping > 0:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
         if cfg.prescale_gradients and cfg.gradient_predivide_factor != 1.0:
             factor = cfg.gradient_predivide_factor
             grads = jax.tree_util.tree_map(lambda g: g / factor, grads)
@@ -539,7 +548,12 @@ class DeepSpeedEngine:
         else:
             overflow = False
 
-        grad_norm = global_norm(grads)
+        # The norm is a full read pass over the gradient tree; skip it
+        # unless something consumes it (clipping, or monitor logging).
+        if cfg.gradient_clipping > 0 or self._monitor_wants_grad_norm:
+            grad_norm = global_norm(grads)
+        else:
+            grad_norm = jnp.asarray(0.0, jnp.float32)
         if cfg.gradient_clipping > 0:
             grads, _ = clip_grad_norm_(grads, cfg.gradient_clipping,
                                        norm=grad_norm)
@@ -622,9 +636,49 @@ class DeepSpeedEngine:
     def _build_train_step(self, accum_steps):
         """Fused step: scan over [accum, batch, ...] micro-batches, mean the
         grads, apply the update — one compilation, zero host round-trips."""
+        return jax.jit(self._train_step_body(accum_steps),
+                       donate_argnums=(0,))
+
+    def _build_train_window(self, accum_steps, n_steps):
+        """Fused multi-step window: `lax.scan` over WHOLE training steps.
+
+        Dispatching one jit per step costs a fixed host/runtime latency
+        that the window pays once. Worth it on pod runtimes with real
+        per-dispatch cost and device-resident data pipelines; on
+        single-chip/tunneled backends XLA's async dispatch already
+        pipelines per-step launches, and the much larger scan program can
+        compile slowly — benchmark before adopting. The LR is frozen for
+        the window (the in-jit schedules — loss scale, PLD theta — still
+        advance per step)."""
+        step = self._train_step_body(accum_steps)
+
+        def window(state, all_batches, rng, lr):
+            def body(st, xs):
+                step_batches, step_rng = xs
+                new_st, metrics = step(st, step_batches, step_rng, lr)
+                return new_st, metrics.loss
+
+            rngs = jax.random.split(rng, n_steps)
+            state, losses = jax.lax.scan(body, state, (all_batches, rngs))
+            return state, losses
+
+        return jax.jit(window, donate_argnums=(0,))
+
+    def _train_step_body(self, accum_steps):
         def train_step(state, batches, rng, lr):
             scale = state.scale.cur_scale
             theta = self._pld_theta_in_jit(state.global_steps)
+
+            if accum_steps == 1:
+                # no accumulation: skip the zeros-init/add/divide passes
+                # over the gradient tree (the optimizer casts to fp32
+                # inside its own fused update)
+                mb = jax.tree_util.tree_map(lambda b: b[0], batches)
+                loss, grads = self._loss_and_grads(state.params, mb, rng,
+                                                   scale, pld_theta=theta)
+                new_state, metrics = self._apply_update(state, grads, lr)
+                return new_state, metrics._replace(
+                    loss=loss.astype(jnp.float32))
 
             def micro(carry, xs):
                 grads_acc, loss_acc = carry
@@ -651,7 +705,7 @@ class DeepSpeedEngine:
             new_state, metrics = self._apply_update(state, grads, lr)
             return new_state, metrics._replace(loss=mean_loss)
 
-        return jax.jit(train_step, donate_argnums=(0,))
+        return train_step
 
     def _build_grads_step(self, accum_steps):
         """Offload path: fused grad accumulation, no device update.
@@ -975,9 +1029,17 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
             log_dist(f"OVERFLOW! Skipping step; loss scale now "
                      f"{float(self.state.scale.cur_scale)}", ranks=[0])
+            self._advance_host_schedules(taken=0)
         else:
-            self.global_steps += 1
-            self.global_samples += self.train_batch_size()
+            self._advance_host_schedules(taken=1)
+
+    def _advance_host_schedules(self, taken, skipped=0):
+        """Advance the host-side per-step machinery after `taken` device
+        steps (shared by `train_batch` and the `train_steps` window)."""
+        self.global_steps += taken
+        self.skipped_steps += skipped
+        self.global_samples += self.train_batch_size() * taken
+        for _ in range(taken):
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
             if self.batch_size_scheduler is not None:
@@ -1046,6 +1108,59 @@ class DeepSpeedEngine:
         self.tput_timer.stop()
         return metrics.loss
 
+    def train_steps(self, batches):
+        """Fused multi-step window: run N whole optimizer steps in ONE
+        jitted call (`lax.scan` over steps) — the TPU-idiomatic device
+        loop. `batches`: pytree with leading dims [n_steps, accum_steps,
+        micro_batch, ...]. Returns per-step losses [n_steps].
+
+        Host-side per-step machinery is batched: the LR is frozen at its
+        current value for the window, LR/batch-size schedulers advance
+        n_steps afterwards, and progress printing happens once. In-jit
+        state (loss scale, PLD theta, step counters) advances per step
+        exactly as under `train_batch`. Not available with host-offload
+        tiers or activation-capture hooks (those need the host between
+        steps); the flops profiler likewise only fires on the
+        `train_batch` path."""
+        if self.host_offload:
+            raise RuntimeError("train_steps: host-offload optimizers step "
+                               "on the host between device steps; use "
+                               "train_batch")
+        if self._layers_to_hook:
+            raise RuntimeError("train_steps: activation capture needs a "
+                               "host hop per step; use train_batch")
+        gas = self.gradient_accumulation_steps()
+        lead = jax.tree_util.tree_leaves(batches)[0].shape
+        n_steps = lead[0]
+        if len(lead) < 2 or lead[1] != gas:
+            raise ValueError(
+                f"batches must be [n_steps, accum={gas}, micro, ...], "
+                f"got leading {lead[:2]}")
+        self._assert_comm_precision()
+        self.tput_timer.start()
+        # data axis on dim 2: dims 0/1 are the step and grad-accum scans
+        window_spec = PartitionSpec(None, None, self.data_axis)
+        sharded = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                np.asarray(x), NamedSharding(self.mesh, window_spec)),
+            batches)
+        key = ("window", gas, n_steps)
+        if key not in self._compiled_train:
+            self._compiled_train[key] = self._build_train_window(gas,
+                                                                 n_steps)
+        lr = jnp.asarray(self.optimizer.param_groups[0]["lr"], jnp.float32)
+        self.state, losses = self._compiled_train[key](
+            self.state, sharded, self._next_rng(), lr)
+        self.micro_steps += gas * n_steps
+        if self._config.loss_scaling_enabled:
+            # dynamic scale may have skipped steps; sync from device
+            taken = int(self.state.global_steps) - self.global_steps
+        else:
+            taken = n_steps
+        self._advance_host_schedules(taken=taken, skipped=n_steps - taken)
+        self.tput_timer.stop()
+        return losses
+
     def _assert_comm_precision(self):
         """Pin the process-global p2p wire precision to THIS engine's value
         before anything traces; a first jitted call traces lazily, so the
@@ -1075,7 +1190,17 @@ class DeepSpeedEngine:
         self.gradient_noise_scale = GradientNoiseScale(
             batch_size_small=self.train_micro_batch_size_per_gpu(),
             n_batches=n_batches, beta=beta)
+        # the fused steps specialize on whether grad_norm is consumed
+        self._compiled_train = {}
+        self._compiled_update = None
         return self.gradient_noise_scale
+
+    @property
+    def _monitor_wants_grad_norm(self):
+        """grad_norm costs a full read pass over the gradient tree inside
+        the jitted step — compute it only when something reports it."""
+        return (self._config.tensorboard_enabled
+                or self.gradient_noise_scale is not None)
 
     # ------------------------------------------------------------------
     # checkpointing (layout parity; see deeperspeed_tpu/checkpoint)
