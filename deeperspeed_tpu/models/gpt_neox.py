@@ -223,6 +223,15 @@ def causal_attention(q, k, v, use_pallas=True):
             from ..ops.pallas.flash_attention import flash_attention_supported
             from ..ops.pallas.flash_attention import flash_attention
             if flash_attention_supported(q.shape):
+                from ..ops.autotune import autotune_enabled
+                from ..ops.autotune import tuned_flash_blocks
+                if autotune_enabled():
+                    # measure-once block pick (reference gemm_test.h
+                    # contract); cached per shape/device
+                    bq, bk = tuned_flash_blocks(q.shape, q.dtype, True)
+                    return flash_attention(q, k, v, causal=True,
+                                           sm_scale=None, block_q=bq,
+                                           block_k=bk)
                 return flash_attention(q, k, v, causal=True)
         except ImportError:
             pass

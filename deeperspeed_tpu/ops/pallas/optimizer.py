@@ -110,7 +110,7 @@ def fused_adam_flat(p, g, m, v, lr, step, *, beta1=0.9, beta2=0.999,
             in_specs=[spec] * 4, out_specs=[spec] * 3),
         out_shape=out_shapes,
         interpret=_interpret(),
-    )(scalars, flat2d(p), flat2d(g, jnp.float32), flat2d(m), flat2d(v))
+    )(scalars, flat2d(p), flat2d(g), flat2d(m), flat2d(v))
     return (new_p.reshape(-1)[:n], new_m.reshape(-1)[:n],
             new_v.reshape(-1)[:n])
 

@@ -33,9 +33,12 @@ class BertSparseSelfAttention:
                                     config.num_attention_heads)
         self.all_head_size = (self.num_attention_heads *
                               self.attention_head_size)
+        # "mul" mode: this wrapper takes a raw 0/1 keep-mask (HF user
+        # convention), not the pre-additivized -10000 form
         self.sparse_self_attention = SparseSelfAttention(
             sparsity_config or FixedSparsityConfig(
                 num_heads=config.num_attention_heads),
+            key_padding_mask_mode="mul",
             max_seq_length=max_seq_length)
 
     def init_params(self, rng, dtype=jnp.float32):

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from ..pallas.block_sparse_attention import BlockSparseAttention
+from .matmul import MatMul
+from .softmax import Softmax
 from .sparsity_config import FixedSparsityConfig, SparsityConfig
 
 
@@ -85,7 +87,13 @@ class SparseSelfAttention:
                                  refine, axis=2)
                 kernel = BlockSparseAttention(fine, block=128,
                                               causal=causal)
-            self._cache[seq_len] = (layout, kernel, causal)
+            # Mid-tier for masked/rpe calls: the reference's own
+            # three-op pipeline (sdd → block softmax → dsd) — compute
+            # still scales with active blocks, unlike the dense fallback.
+            ops = (MatMul(layout, block, "sdd", trans_b=True),
+                   Softmax(layout, block),
+                   MatMul(layout, block, "dsd"))
+            self._cache[seq_len] = (layout, kernel, causal, ops)
         return self._cache[seq_len]
 
     def forward(self, query, key, value, rpe=None, key_padding_mask=None,
@@ -98,7 +106,7 @@ class SparseSelfAttention:
             raise ValueError(
                 f"sequence length {s} must be divisible by block "
                 f"{self.block}")
-        layout, kernel, causal = self.get_layout(s)
+        layout, kernel, causal, (sdd, softmax, dsd) = self.get_layout(s)
 
         use_kernel = (kernel is not None and d in (64, 128, 256)
                       and rpe is None and key_padding_mask is None
@@ -106,13 +114,38 @@ class SparseSelfAttention:
         if use_kernel:
             out = kernel(query, key, value)
         else:
-            token_mask = layout_to_token_mask(layout, self.block)
-            if key_padding_mask is not None:
-                kpm = jnp.asarray(key_padding_mask, bool)  # [B, S], True=keep
-                token_mask = jnp.logical_and(token_mask[None],
-                                             kpm[:, None, None, :])
-            out = dense_masked_attention(query, key, value, token_mask,
-                                         causal)
+            # The reference's own three-op pipeline (sdd → block softmax
+            # → dsd, `sparse_self_attention.py:150-170`): compute scales
+            # with active blocks and every mask/rpe option applies.
+            qh, kh, vh = (x.transpose(0, 2, 1, 3)
+                          for x in (query, key, value))     # [B, H, S, D]
+            scores = sdd(qh, kh)
+            am, am_mode = attn_mask, self.attn_mask_mode
+            if causal:
+                # unidirectional patterns leave intra-block causality to
+                # the attention mask (block layouts are block-granular);
+                # fold the triangular mask into any user mask additively
+                from .softmax import _NEG, _mask_term
+                tril = jnp.where(
+                    jnp.tril(jnp.ones((s, s), jnp.bool_)), 0.0, _NEG)
+                if am is not None:
+                    am = _mask_term(jnp.asarray(am), am_mode) + tril
+                else:
+                    am = tril
+                am_mode = "add"
+            if (key_padding_mask is not None
+                    and self.key_padding_mask_mode == "add"
+                    and jnp.asarray(key_padding_mask).dtype == jnp.bool_):
+                raise ValueError(
+                    "boolean key_padding_mask with mode 'add': pass an "
+                    "additive float mask, or use "
+                    "key_padding_mask_mode='mul' for keep-masks")
+            probs = softmax(
+                scores, scale=1.0 / math.sqrt(d), rpe=rpe,
+                key_padding_mask=key_padding_mask, attn_mask=am,
+                key_padding_mask_mode=self.key_padding_mask_mode,
+                attn_mask_mode=am_mode)
+            out = dsd(probs, vh).transpose(0, 2, 1, 3).astype(query.dtype)
         if self.transpose_inputs:
             out = out.transpose(0, 2, 1, 3)
         return out

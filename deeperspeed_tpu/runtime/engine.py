@@ -853,12 +853,12 @@ class DeepSpeedEngine:
 
         return jax.tree_util.tree_map(put, batch)
 
-    def _shard_stacked_batch(self, batch):
-        """Place an [accum, global_batch, ...] stacked batch: data axis on
-        dim 1 (dim 0 is the grad-accumulation scan). Shared by
-        `train_batch` and the flops profiler so both cost/benchmark the
-        same program."""
-        spec = PartitionSpec(None, self.data_axis)
+    def _shard_stacked_batch(self, batch, n_scan_dims=1):
+        """Place a scan-stacked batch: the data axis follows `n_scan_dims`
+        leading scan dims (grad accumulation; plus the step dim for
+        `train_steps` windows). Shared by `train_batch`, `train_steps`,
+        and the flops profiler so all cost/benchmark the same program."""
+        spec = PartitionSpec(*([None] * n_scan_dims), self.data_axis)
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(np.asarray(x),
                                      NamedSharding(self.mesh, spec)), batch)
@@ -1139,11 +1139,7 @@ class DeepSpeedEngine:
         self._assert_comm_precision()
         self.tput_timer.start()
         # data axis on dim 2: dims 0/1 are the step and grad-accum scans
-        window_spec = PartitionSpec(None, None, self.data_axis)
-        sharded = jax.tree_util.tree_map(
-            lambda x: jax.device_put(
-                np.asarray(x), NamedSharding(self.mesh, window_spec)),
-            batches)
+        sharded = self._shard_stacked_batch(batches, n_scan_dims=2)
         key = ("window", gas, n_steps)
         if key not in self._compiled_train:
             self._compiled_train[key] = self._build_train_window(gas,

@@ -1,0 +1,62 @@
+"""Kernel autotuner (reference `csrc/includes/gemm_test.h` semantics:
+measure candidates once, cache the winner, skip invalid ones)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeperspeed_tpu.ops.autotune import (Autotuner, FLASH_BLOCK_CANDIDATES,
+                                          autotune_enabled,
+                                          tuned_flash_blocks)
+
+
+def test_picks_fastest_and_caches():
+    clock = {"t": 0.0}
+
+    def timer():
+        return clock["t"]
+
+    tuner = Autotuner(warmup=0, iters=1, timer=timer)
+    runs = []
+    cost = {"a": 5.0, "b": 1.0, "c": 3.0}
+
+    def run(c):
+        runs.append(c)
+        clock["t"] += cost[c]
+        return jnp.zeros(())
+
+    assert tuner.pick("k", ["a", "b", "c"], run) == "b"
+    n_runs = len(runs)
+    # second call: cached, no new runs
+    assert tuner.pick("k", ["a", "b", "c"], run) == "b"
+    assert len(runs) == n_runs
+
+
+def test_failing_candidates_skipped():
+    tuner = Autotuner(warmup=0, iters=1)
+
+    def run(c):
+        if c != "ok":
+            raise RuntimeError("mosaic rejected")
+        return jnp.zeros(())
+
+    assert tuner.pick("k2", ["bad1", "ok", "bad2"], run) == "ok"
+    with pytest.raises(RuntimeError):
+        tuner.pick("k3", ["bad1", "bad2"], run)
+
+
+def test_tuned_flash_blocks_returns_valid_pair():
+    shape = (1, 256, 2, 64)
+    tuner = Autotuner(warmup=0, iters=1)
+    bq, bk = tuned_flash_blocks(shape, jnp.float32, True, tuner=tuner)
+    assert (bq, bk) in FLASH_BLOCK_CANDIDATES
+    assert 256 % np.gcd(bq, 256) == 0
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DS_TPU_AUTOTUNE", raising=False)
+    assert not autotune_enabled()
+    monkeypatch.setenv("DS_TPU_AUTOTUNE", "1")
+    assert autotune_enabled()

@@ -224,3 +224,34 @@ def test_engine_sparse_attention_config_accessor():
     cfg_obj = sparsity_config_from_dict({**sa, "num_heads": 4})
     assert isinstance(cfg_obj, FixedSparsityConfig)
     assert cfg_obj.block == 16
+
+
+def test_causal_preserved_with_user_attn_mask():
+    """Unidirectional config + user attn_mask: the causal triangle must be
+    folded into the user mask, not replaced by it (regression: future keys
+    leaked whenever a mask was supplied)."""
+    from deeperspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                      SparseSelfAttention)
+    ssa = SparseSelfAttention(FixedSparsityConfig(
+        num_heads=2, block=16, attention="unidirectional",
+        different_layout_per_head=False))
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 64, 2, 16), dtype=np.float32))
+    user_mask = jnp.ones((64, 64), jnp.float32)  # mul-mask keeping all
+
+    out = ssa(q, q, q, attn_mask=user_mask)
+    q_future = q.at[:, 32:].add(50.0)
+    out2 = ssa(q_future, q_future, q_future, attn_mask=user_mask)
+    # earlier positions must not see the perturbed future tokens
+    np.testing.assert_allclose(np.asarray(out[:, :32]),
+                               np.asarray(out2[:, :32]), atol=1e-4)
+
+
+def test_bool_keep_mask_in_add_mode_rejected():
+    from deeperspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                      SparseSelfAttention)
+    ssa = SparseSelfAttention(FixedSparsityConfig(num_heads=2, block=16))
+    q = jnp.zeros((1, 64, 2, 16), jnp.float32)
+    kpm = jnp.ones((1, 64), jnp.bool_)
+    with pytest.raises(ValueError, match="mul"):
+        ssa(q, q, q, key_padding_mask=kpm)
