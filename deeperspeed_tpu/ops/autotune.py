@@ -125,6 +125,11 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
     hit = tuner.cached(key)
     if hit is not None:
         return hit
+    # Multi-host SPMD: per-host wall-clock picks can disagree, lowering
+    # DIFFERENT programs per host → deadlock at the first collective.
+    # Take the deterministic default instead of measuring.
+    if jax.process_count() > 1:
+        return candidates[0]
     itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
     if b * s * h * d * itemsize * 4 > _MAX_TUNE_BYTES:
         return candidates[0]

@@ -100,7 +100,7 @@ class MatMul:
     (the LUT index arrays are compile-time constants)."""
 
     def __init__(self, layout, block, mode, trans_a=False, trans_b=False,
-                 bench=False):
+                 bench=False, out_dtype=None):
         if mode not in ("sdd", "dsd", "dds"):
             raise NotImplementedError("Supported modes are: sdd, dsd, dds")
         layout = np.asarray(layout)
@@ -111,6 +111,9 @@ class MatMul:
         self.trans_b = bool(trans_b)
         self.spdims = layout.shape
         self.bench = bench  # accepted for API compat; timing via jax profiler
+        # out_dtype=float32 keeps the fp32 accumulation in the output
+        # (attention scores feeding a softmax shouldn't round to bf16)
+        self.out_dtype = out_dtype
         self.h_idx, self.mi_idx, self.ni_idx = _layout_indices(layout)
         self.nnz = self.h_idx.size
 
@@ -132,8 +135,10 @@ class MatMul:
         b_blocks = jnp.swapaxes(b, -1, -2).reshape(z, h * n_k, bsz, k)
         a_sel = _take_blocks(a_blocks, self.h_idx * n_q + self.mi_idx)
         b_sel = _take_blocks(b_blocks, self.h_idx * n_k + self.ni_idx)
-        return jnp.einsum("znik,znjk->znij", a_sel, b_sel,
-                          preferred_element_type=jnp.float32).astype(a.dtype)
+        return jnp.einsum(
+            "znik,znjk->znij", a_sel, b_sel,
+            preferred_element_type=jnp.float32).astype(
+                self.out_dtype or a.dtype)
 
     def _dsd(self, a, b):
         """sparse a @ dense b → dense. Logical a is [Z,H,nQ*B,nK*B] (or its
@@ -165,7 +170,8 @@ class MatMul:
                           preferred_element_type=jnp.float32)
         out = _seg_reduce(prod, self.h_idx * out_blocks + out_idx,
                           h * out_blocks)
-        return out.reshape(z, h, out_blocks * bsz, n).astype(b.dtype)
+        return out.reshape(z, h, out_blocks * bsz, n).astype(
+            self.out_dtype or b.dtype)
 
     def _dds(self, a, b):
         """dense a @ sparse b → dense. Logical b is [Z,H,nQ*B,nK*B] (or its
@@ -199,7 +205,7 @@ class MatMul:
         # [Z, H*out_blocks, M, B] → [Z, H, M, out_blocks*B]
         out = out.reshape(z, h, out_blocks, m, bsz)
         out = jnp.moveaxis(out, 2, 3).reshape(z, h, m, out_blocks * bsz)
-        return out.astype(a.dtype)
+        return out.astype(self.out_dtype or a.dtype)
 
     def __call__(self, a, b):
         """Applies block-sparse matmul (reference `matmul.py:695`)."""

@@ -90,7 +90,10 @@ class SparseSelfAttention:
             # Mid-tier for masked/rpe calls: the reference's own
             # three-op pipeline (sdd → block softmax → dsd) — compute
             # still scales with active blocks, unlike the dense fallback.
-            ops = (MatMul(layout, block, "sdd", trans_b=True),
+            # fp32 scores into the softmax: parity with the fused-kernel
+            # path's fp32 accumulation (don't round logits to bf16)
+            ops = (MatMul(layout, block, "sdd", trans_b=True,
+                          out_dtype=jnp.float32),
                    Softmax(layout, block),
                    MatMul(layout, block, "dsd"))
             self._cache[seq_len] = (layout, kernel, causal, ops)
@@ -135,10 +138,13 @@ class SparseSelfAttention:
                 am_mode = "add"
             if (key_padding_mask is not None
                     and self.key_padding_mask_mode == "add"
-                    and jnp.asarray(key_padding_mask).dtype == jnp.bool_):
+                    and not jnp.issubdtype(
+                        jnp.asarray(key_padding_mask).dtype,
+                        jnp.floating)):
                 raise ValueError(
-                    "boolean key_padding_mask with mode 'add': pass an "
-                    "additive float mask, or use "
+                    "bool/int key_padding_mask with mode 'add' looks like "
+                    "a 0/1 keep-mask: pass an additive float mask (e.g. "
+                    "-1e4 on padded keys), or use "
                     "key_padding_mask_mode='mul' for keep-masks")
             probs = softmax(
                 scores, scale=1.0 / math.sqrt(d), rpe=rpe,

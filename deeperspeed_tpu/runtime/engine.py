@@ -864,8 +864,26 @@ class DeepSpeedEngine:
                                      NamedSharding(self.mesh, spec)), batch)
 
     def _next_rng(self):
-        # Deterministic per-micro-step stream.
-        return jax.random.fold_in(jax.random.PRNGKey(1234), self.micro_steps)
+        """Deterministic per-micro-step stream. The base key is cached and
+        the step counter uploaded EXPLICITLY — the hot loop stays clean
+        under `jax.transfer_guard('disallow')` (implicit transfers stall
+        async dispatch; tests/test_transfer_discipline.py pins this)."""
+        if not hasattr(self, "_base_rng"):
+            self._base_rng = jax.random.PRNGKey(1234)
+        step = jax.device_put(np.uint32(self.micro_steps))
+        return jax.device_put(jax.random.fold_in(self._base_rng, step),
+                              self._replicated_sharding)
+
+    @property
+    def _replicated_sharding(self):
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _current_lr(self):
+        """Current LR as an explicitly-placed, mesh-replicated device
+        scalar (see `_next_rng` on transfer discipline)."""
+        return jax.device_put(
+            np.float32(self.optimizer.param_groups[0]["lr"]),
+            self._replicated_sharding)
 
     # ------------------------------------------------------------------
     # training API
@@ -939,8 +957,7 @@ class DeepSpeedEngine:
         else:
             if self._compiled_update is None:
                 self._compiled_update = self._build_update_fn()
-            lr = jnp.asarray(self.optimizer.param_groups[0]["lr"],
-                             jnp.float32)
+            lr = self._current_lr()
             self.state, metrics = self._compiled_update(self.state, grads,
                                                         lr)
         self._after_step(metrics)
@@ -1099,8 +1116,7 @@ class DeepSpeedEngine:
         else:
             if gas not in self._compiled_train:
                 self._compiled_train[gas] = self._build_train_step(gas)
-            lr = jnp.asarray(self.optimizer.param_groups[0]["lr"],
-                             jnp.float32)
+            lr = self._current_lr()
             self.state, metrics = self._compiled_train[gas](
                 self.state, sharded, self._next_rng(), lr)
         self.micro_steps += gas
@@ -1144,7 +1160,7 @@ class DeepSpeedEngine:
         if key not in self._compiled_train:
             self._compiled_train[key] = self._build_train_window(gas,
                                                                  n_steps)
-        lr = jnp.asarray(self.optimizer.param_groups[0]["lr"], jnp.float32)
+        lr = self._current_lr()
         self.state, losses = self._compiled_train[key](
             self.state, sharded, self._next_rng(), lr)
         self.micro_steps += gas * n_steps

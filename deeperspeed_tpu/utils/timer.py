@@ -6,6 +6,7 @@
 skip.
 """
 
+import contextlib
 import time
 
 import psutil
@@ -159,3 +160,24 @@ class ThroughputTimer:
             avg_time_per_step = self.total_elapsed_time / total_step_offset
             return samples / avg_time_per_step
         return float("-inf")
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir, create_perfetto_trace=False):
+    """XProf/TensorBoard trace of everything dispatched inside the block
+    (the TPU-native face of the reference's `wall_clock_breakdown` CUDA
+    timers, SURVEY §5.1): per-kernel device timelines, HLO cost
+    attribution, host/device overlap.
+
+    with profiler_trace("/tmp/trace"):
+        engine.train_batch(batch=...)
+    # then: tensorboard --logdir /tmp/trace (or xprof)
+    """
+    import jax
+
+    jax.profiler.start_trace(
+        logdir, create_perfetto_trace=create_perfetto_trace)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

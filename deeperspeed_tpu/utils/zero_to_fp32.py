@@ -141,6 +141,12 @@ def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
     return state_dict
 
 
+# Reference-spelled alias (`utils/zero_to_fp32.py:70` names it
+# convert_zero_chkpt_to_fp32_consolid_state_dict).
+convert_zero_chkpt_to_fp32_consolid_state_dict = \
+    convert_zero_checkpoint_to_fp32_state_dict
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Extract a consolidated fp32 state dict from a "

@@ -1,0 +1,89 @@
+"""BERT-Large fused-transformer-layer throughput (the reference's headline
+kernel benchmark: `docs/_tutorials/bert-pretraining.md:387` — 64 TFLOPS at
+seq 128 and 53 TFLOPS at seq 512 on one V100).
+
+Measures `DeepSpeedTransformerLayer` forward and forward+backward TFLOPS at
+BERT-Large dimensions on the attached TPU chip(s). 12 layers are chained
+inside one jit (like a real encoder stack) so per-dispatch latency doesn't
+pollute the kernel number.
+
+Run: PYTHONPATH=. python tests/perf/transformer_kernel_bench.py
+Prints one JSON line per (seq, batch) point.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeperspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                             DeepSpeedTransformerLayer)
+
+LAYERS = 12  # chained per measured call
+
+
+def layer_flops_per_token(h, interm, seq):
+    """fwd flops/token for one encoder layer: QKV+out projections (4h²),
+    MLP (2·h·i), attention score+context matmuls (4·s·h)."""
+    return 2 * (4 * h * h + 2 * h * interm) + 4 * seq * h
+
+
+def bench(seq, batch, hidden=1024, heads=16, interm=4096,
+          dtype=jnp.bfloat16, n=8):
+    cfg = DeepSpeedTransformerConfig(
+        batch_size=batch, hidden_size=hidden, heads=heads,
+        intermediate_size=interm,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0)
+    layer = DeepSpeedTransformerLayer(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = [jax.tree_util.tree_map(
+        lambda a: a.astype(dtype),
+        layer.init(jax.random.fold_in(rng, i))) for i in range(LAYERS)]
+    x = jax.random.normal(jax.random.fold_in(rng, 99),
+                          (batch, seq, hidden), dtype)
+
+    def stack(params, x):
+        for p in params:
+            x = layer.apply(p, x)
+        return x
+
+    fwd = jax.jit(stack)
+
+    def loss(params, x):
+        return stack(params, x).astype(jnp.float32).mean()
+
+    bwd = jax.jit(jax.grad(loss))
+
+    def timed(fn, *args):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(
+            jax.block_until_ready(out))[0].ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(
+            jax.block_until_ready(out))[0].ravel()[:1])
+        return (time.perf_counter() - t0) / n
+
+    t_fwd = timed(fwd, params, x)
+    t_bwd = timed(bwd, params, x)
+
+    tokens = batch * seq
+    fl_tok = layer_flops_per_token(hidden, interm, seq) * LAYERS
+    print(json.dumps({
+        "bench": "bert_large_kernel", "seq": seq, "batch": batch,
+        "fwd_tflops": round(tokens * fl_tok / t_fwd / 1e12, 1),
+        "fwdbwd_tflops": round(tokens * fl_tok * 3 / t_bwd / 1e12, 1),
+        "fwd_ms": round(t_fwd * 1e3, 1),
+        "fwdbwd_ms": round(t_bwd * 1e3, 1),
+        "samples_per_sec": round(batch / t_bwd * (LAYERS / 24), 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    # reference points: seq 128 (their 64 TF) and seq 512 (their 53 TF)
+    bench(seq=128, batch=256)
+    bench(seq=512, batch=64)
