@@ -1,8 +1,9 @@
-from . import bert, gpt_neox
+from . import bert, gpt2, gpt_neox
 from .bert import (BertConfig, BertForPreTraining,
                    BertForQuestionAnswering, BertModel)
+from .gpt2 import GPT2, GPT2Config
 from .gpt_neox import GPTNeoX, GPTNeoXConfig
 
-__all__ = ["bert", "gpt_neox", "BertConfig", "BertForPreTraining",
-           "BertForQuestionAnswering", "BertModel", "GPTNeoX",
-           "GPTNeoXConfig"]
+__all__ = ["bert", "gpt2", "gpt_neox", "BertConfig", "BertForPreTraining",
+           "BertForQuestionAnswering", "BertModel", "GPT2", "GPT2Config",
+           "GPTNeoX", "GPTNeoXConfig"]

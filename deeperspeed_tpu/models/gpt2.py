@@ -1,0 +1,194 @@
+"""Megatron-GPT2 model family (reference integration target:
+`tests/model/Megatron_GPT2/` — the reference's func/perf/checkpoint tests
+all drive Megatron-LM GPT-2 under DeepSpeed).
+
+Differences from GPT-NeoX (`models/gpt_neox.py`), matching Megatron GPT-2:
+learned absolute position embeddings instead of rotary, sequential
+residual (x + attn; then + mlp) instead of parallel, tied input/output
+embeddings, pre-LN blocks. Attention/LN/loss machinery is shared with the
+NeoX implementation — one flash-attention path, one fused LM-head loss.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import MODEL_AXIS
+from .gpt_neox import causal_attention, fused_lm_head_loss, layer_norm
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_mult: int = 4
+    layernorm_eps: float = 1e-5
+    param_dtype: object = jnp.float32
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def intermediate_size(self):
+        return self.intermediate_mult * self.hidden_size
+
+    def num_params(self):
+        h, v, L = self.hidden_size, self.vocab_size, self.num_layers
+        per_layer = (4 * h * h + 3 * h + h            # qkv (+bias), out w+b
+                     + 2 * h * self.intermediate_size
+                     + self.intermediate_size + h     # mlp w+b
+                     + 4 * h)                         # 2x LN scale+bias
+        return v * h + self.max_seq_len * h + L * per_layer + 2 * h
+
+    # presets: the reference's Megatron_GPT2 test/perf configs
+    @classmethod
+    def small(cls, **kw):            # GPT-2 117M / Megatron "345M" shape
+        return cls(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+    @classmethod
+    def megatron_345m(cls, **kw):
+        return cls(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+    @classmethod
+    def megatron_1_5b(cls, **kw):    # the ZeRO-1 memory-demo model
+        return cls(hidden_size=1600, num_layers=48, num_heads=25, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 64)
+        return cls(hidden_size=32, num_layers=2, num_heads=2, **kw)
+
+
+def init_params(cfg, rng):
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    dt = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 4 * cfg.num_layers + 3))
+    std = 0.02
+
+    def norm(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(dt)
+
+    def ln():
+        return {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)}
+
+    blocks = []
+    out_std = std / np.sqrt(2.0 * cfg.num_layers)
+    for _ in range(cfg.num_layers):
+        blocks.append({
+            "ln_attn": ln(),
+            "attn": {"qkv_w": norm(next(keys), (h, 3 * h)),
+                     "qkv_b": jnp.zeros((3 * h,), dt),
+                     "out_w": norm(next(keys), (h, h), out_std),
+                     "out_b": jnp.zeros((h,), dt)},
+            "ln_mlp": ln(),
+            "mlp": {"in_w": norm(next(keys), (h, inter)),
+                    "in_b": jnp.zeros((inter,), dt),
+                    "out_w": norm(next(keys), (inter, h), out_std),
+                    "out_b": jnp.zeros((h,), dt)},
+        })
+    return {
+        "embed": {"wte": norm(next(keys), (cfg.vocab_size, h)),
+                  "wpe": norm(next(keys), (cfg.max_seq_len, h), 0.01)},
+        "blocks": blocks,
+        "final_ln": ln(),
+    }
+
+
+def block_forward(cfg, params, x, use_pallas=True):
+    """Pre-LN GPT-2 block with sequential residuals."""
+    B, S, h = x.shape
+    ln1 = layer_norm(x, params["ln_attn"]["scale"],
+                     params["ln_attn"]["bias"], cfg.layernorm_eps)
+    qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
+        params["attn"]["qkv_b"].astype(x.dtype)
+    qkv = qkv.reshape(B, S, cfg.num_heads, 3 * cfg.head_dim)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = causal_attention(q, k, v, use_pallas=use_pallas)
+    attn = attn.reshape(B, S, h)
+    x = x + attn @ params["attn"]["out_w"].astype(x.dtype) + \
+        params["attn"]["out_b"].astype(x.dtype)
+
+    ln2 = layer_norm(x, params["ln_mlp"]["scale"],
+                     params["ln_mlp"]["bias"], cfg.layernorm_eps)
+    hmid = jax.nn.gelu(ln2 @ params["mlp"]["in_w"].astype(x.dtype) +
+                       params["mlp"]["in_b"].astype(x.dtype))
+    return x + hmid @ params["mlp"]["out_w"].astype(x.dtype) + \
+        params["mlp"]["out_b"].astype(x.dtype)
+
+
+def forward_hidden(cfg, params, tokens, use_pallas=True,
+                   remat_blocks=False):
+    """tokens [B, S] → final-norm hidden [B, S, H]."""
+    S = tokens.shape[1]
+    x = params["embed"]["wte"][tokens] + \
+        params["embed"]["wpe"][:S][None]
+    block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
+    if remat_blocks:
+        block_fn = jax.checkpoint(block_fn)
+    for bp in params["blocks"]:
+        x = block_fn(bp, x)
+    return layer_norm(x, params["final_ln"]["scale"],
+                      params["final_ln"]["bias"], cfg.layernorm_eps)
+
+
+def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
+    """tokens [B, S] → logits [B, S, V] (tied embeddings)."""
+    x = forward_hidden(cfg, params, tokens, use_pallas=use_pallas,
+                       remat_blocks=remat_blocks)
+    return jnp.einsum("bsh,vh->bsv", x,
+                      params["embed"]["wte"].astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def param_specs(cfg, params):
+    """Megatron TP shardings: the block scheme is shared with GPT-NeoX
+    (`gpt_neox.block_param_specs` — identical qkv/mlp column/row split);
+    embeddings vocab-sharded, wpe replicated."""
+    from .gpt_neox import block_param_specs
+    return {
+        "embed": {"wte": P(MODEL_AXIS, None), "wpe": P()},
+        "blocks": [block_param_specs() for _ in params["blocks"]],
+        "final_ln": {"scale": P(), "bias": P()},
+    }
+
+
+class GPT2:
+    """Engine-protocol wrapper: loss_fn / init_params / param_specs."""
+
+    def __init__(self, config=None, use_pallas=True, remat_blocks=False,
+                 **kwargs):
+        self.config = config or GPT2Config(**kwargs)
+        self.use_pallas = use_pallas
+        self.remat_blocks = remat_blocks
+
+    def init_params(self, rng):
+        return init_params(self.config, rng)
+
+    def param_specs(self, params, mesh):
+        if MODEL_AXIS not in mesh.axis_names or \
+                mesh.shape[MODEL_AXIS] == 1:
+            return jax.tree_util.tree_map(lambda p: P(), params)
+        return param_specs(self.config, params)
+
+    def apply(self, params, tokens):
+        return forward(self.config, params, tokens,
+                       use_pallas=self.use_pallas,
+                       remat_blocks=self.remat_blocks)
+
+    def loss_fn(self, params, batch, rng=None):
+        tokens, labels = batch if isinstance(batch, (tuple, list)) \
+            else (batch, batch)
+        hidden = forward_hidden(self.config, params, tokens,
+                                use_pallas=self.use_pallas,
+                                remat_blocks=self.remat_blocks)
+        return fused_lm_head_loss(hidden, params["embed"]["wte"], labels)
