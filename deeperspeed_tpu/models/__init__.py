@@ -1,9 +1,11 @@
-from . import bert, gpt2, gpt_neox
+from . import bert, gpt2, gpt_neox, vision
 from .bert import (BertConfig, BertForPreTraining,
                    BertForQuestionAnswering, BertModel)
 from .gpt2 import GPT2, GPT2Config
 from .gpt_neox import GPTNeoX, GPTNeoXConfig
+from .vision import AlexNet, alexnet_pipe
 
-__all__ = ["bert", "gpt2", "gpt_neox", "BertConfig", "BertForPreTraining",
-           "BertForQuestionAnswering", "BertModel", "GPT2", "GPT2Config",
-           "GPTNeoX", "GPTNeoXConfig"]
+__all__ = ["bert", "gpt2", "gpt_neox", "vision", "BertConfig",
+           "BertForPreTraining", "BertForQuestionAnswering", "BertModel",
+           "GPT2", "GPT2Config", "GPTNeoX", "GPTNeoXConfig", "AlexNet",
+           "alexnet_pipe"]

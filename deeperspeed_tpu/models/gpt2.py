@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import MODEL_AXIS
-from .gpt_neox import causal_attention, fused_lm_head_loss, layer_norm
+from .gpt_neox import fused_lm_head_loss, layer_norm
 
 
 @dataclass
@@ -32,6 +32,11 @@ class GPT2Config:
     intermediate_mult: int = 4
     layernorm_eps: float = 1e-5
     param_dtype: object = jnp.float32
+    # fixed for GPT-2 but consumed by the shared NeoX block body:
+    # sequential residuals, no rotary (order comes from wpe)
+    use_parallel_residual: bool = False
+    rotary_pct: float = 0.0
+    rotary_emb_base: int = 10000
 
     @property
     def head_dim(self):
@@ -105,25 +110,15 @@ def init_params(cfg, rng):
 
 
 def block_forward(cfg, params, x, use_pallas=True):
-    """Pre-LN GPT-2 block with sequential residuals."""
-    B, S, h = x.shape
-    ln1 = layer_norm(x, params["ln_attn"]["scale"],
-                     params["ln_attn"]["bias"], cfg.layernorm_eps)
-    qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
-        params["attn"]["qkv_b"].astype(x.dtype)
-    qkv = qkv.reshape(B, S, cfg.num_heads, 3 * cfg.head_dim)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    attn = causal_attention(q, k, v, use_pallas=use_pallas)
-    attn = attn.reshape(B, S, h)
-    x = x + attn @ params["attn"]["out_w"].astype(x.dtype) + \
-        params["attn"]["out_b"].astype(x.dtype)
-
-    ln2 = layer_norm(x, params["ln_mlp"]["scale"],
-                     params["ln_mlp"]["bias"], cfg.layernorm_eps)
-    hmid = jax.nn.gelu(ln2 @ params["mlp"]["in_w"].astype(x.dtype) +
-                       params["mlp"]["in_b"].astype(x.dtype))
-    return x + hmid @ params["mlp"]["out_w"].astype(x.dtype) + \
-        params["mlp"]["out_b"].astype(x.dtype)
+    """Pre-LN GPT-2 block with sequential residuals — the shared NeoX
+    block body (`gpt_neox._block_core`, one implementation for dense/TP/
+    decode) with `use_parallel_residual=False` and a zero rotary dim."""
+    from .gpt_neox import _block_core
+    s = x.shape[1]
+    cos_sin = (jnp.zeros((s, 0), jnp.float32),
+               jnp.zeros((s, 0), jnp.float32), 0)
+    return _block_core(cfg, params, x, cos_sin, use_pallas, mp=1,
+                       reduce_fn=lambda t: t)
 
 
 def forward_hidden(cfg, params, tokens, use_pallas=True,

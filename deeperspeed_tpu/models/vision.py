@@ -1,0 +1,131 @@
+"""Vision model family: AlexNet as a pipeline layer list (reference:
+`tests/unit/test_pipe.py:30` trains torchvision AlexNet-as-pipeline on
+CIFAR-10 and asserts loss parity with the data-parallel baseline — the
+first rung of the BASELINE.md config ladder).
+
+Layers are expressed in the `LayerSpec` protocol (init/apply objects), so
+the same definitions drive `PipelineModule` partitioning and the plain DP
+engine. Convs run NHWC through `lax.conv_general_dilated` — XLA lowers
+them onto the MXU directly.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ConvLayer:
+    """3x3 (or kxk) conv + ReLU, NHWC."""
+
+    def __init__(self, in_ch, out_ch, kernel=3, stride=1, relu=True):
+        self.in_ch, self.out_ch = in_ch, out_ch
+        self.kernel, self.stride, self.relu = kernel, stride, relu
+
+    def init(self, rng, x=None):
+        k = self.kernel
+        fan_in = k * k * self.in_ch
+        w = jax.random.normal(rng, (k, k, self.in_ch, self.out_ch),
+                              jnp.float32) * np.sqrt(2.0 / fan_in)
+        return {"w": w, "b": jnp.zeros((self.out_ch,), jnp.float32)}
+
+    def apply(self, params, x, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["w"].astype(x.dtype),
+            window_strides=(self.stride, self.stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = y + params["b"].astype(x.dtype)
+        return jax.nn.relu(y) if self.relu else y
+
+
+class MaxPool:
+    def __init__(self, window=2):
+        self.window = window
+
+    def init(self, rng, x=None):
+        return {}
+
+    def apply(self, params, x, rng=None):
+        w = self.window
+        return lax.reduce_window(x, -jnp.inf, lax.max, (1, w, w, 1),
+                                 (1, w, w, 1), "VALID")
+
+
+class Flatten:
+    def init(self, rng, x=None):
+        return {}
+
+    def apply(self, params, x, rng=None):
+        return x.reshape(x.shape[0], -1)
+
+
+class DenseLayer:
+    def __init__(self, in_dim, out_dim, relu=False):
+        self.in_dim, self.out_dim, self.relu = in_dim, out_dim, relu
+
+    def init(self, rng, x=None):
+        w = jax.random.normal(rng, (self.in_dim, self.out_dim),
+                              jnp.float32) * np.sqrt(1.0 / self.in_dim)
+        return {"w": w, "b": jnp.zeros((self.out_dim,), jnp.float32)}
+
+    def apply(self, params, x, rng=None):
+        y = x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
+        return jax.nn.relu(y) if self.relu else y
+
+
+def alexnet_layer_specs(num_classes=10):
+    """CIFAR-sized AlexNet as (cls, args) LayerSpec tuples."""
+    from ..runtime.pipe.module import LayerSpec
+    return [
+        LayerSpec(ConvLayer, 3, 64, 3, 2),     # 32→16
+        LayerSpec(MaxPool, 2),                 # 16→8
+        LayerSpec(ConvLayer, 64, 192),
+        LayerSpec(MaxPool, 2),                 # 8→4
+        LayerSpec(ConvLayer, 192, 384),
+        LayerSpec(ConvLayer, 384, 256),
+        LayerSpec(ConvLayer, 256, 256),
+        LayerSpec(MaxPool, 2),                 # 4→2
+        LayerSpec(Flatten),
+        LayerSpec(DenseLayer, 256 * 2 * 2, num_classes),
+    ]
+
+
+def xent_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(
+        logp, labels[:, None].astype(jnp.int32), axis=-1))
+
+
+def alexnet_pipe(num_classes=10, num_stages=2, **kwargs):
+    """The reference's AlexNetPipe fixture: PipelineModule over the conv
+    stack with cross-entropy loss, parameter-balanced partitioning."""
+    from ..runtime.pipe.module import PipelineModule
+    return PipelineModule(layers=alexnet_layer_specs(num_classes),
+                          num_stages=num_stages, loss_fn=xent_loss,
+                          **kwargs)
+
+
+class AlexNet:
+    """Plain (non-pipelined) engine-protocol AlexNet — the DP baseline the
+    pipeline run must match."""
+
+    def __init__(self, num_classes=10):
+        self.num_classes = num_classes
+        self.layers = [spec.build() for spec
+                       in alexnet_layer_specs(num_classes)]
+
+    def init_params(self, rng, example_input=None):
+        params = []
+        for i, layer in enumerate(self.layers):
+            params.append(layer.init(jax.random.fold_in(rng, i)))
+        return params
+
+    def apply(self, params, x):
+        for p, layer in zip(params, self.layers):
+            x = layer.apply(p, x)
+        return x
+
+    def loss_fn(self, params, batch, rng=None):
+        x, y = batch
+        return xent_loss(self.apply(params, x), y)

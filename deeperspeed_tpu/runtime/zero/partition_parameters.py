@@ -33,17 +33,22 @@ from ..config_utils import DeepSpeedConfigError
 
 
 def _shardable_dim(shape, world, threshold_numel=0):
-    """Pick the dimension to shard over the data axis: the largest dim that
-    divides evenly by `world`, else the largest dim; None for scalars or
-    params under the persistence threshold."""
+    """Pick the dimension to shard over the data axis: the largest dim
+    that divides evenly by `world`; None (replicate) for scalars, params
+    under the persistence threshold, or shapes with no evenly-divisible
+    dim. Large ragged params (rare: vocabs are conventionally padded to
+    the dp world, e.g. 50304) currently forfeit sharding — a
+    pad-the-master scheme could lift that."""
     numel = int(np.prod(shape)) if shape else 1
     if not shape or numel < max(threshold_numel, world):
         return None
     divisible = [d for d in range(len(shape)) if shape[d] % world == 0]
     if divisible:
         return max(divisible, key=lambda d: shape[d])
-    # GSPMD pads uneven shards; still profitable for large params.
-    return int(np.argmax(shape))
+    # No dim divides the dp world (e.g. a 10-class head over 8 ranks):
+    # replicate. `device_put` with a NamedSharding requires even shards —
+    # GSPMD's padding only applies to in-program sharding constraints.
+    return None
 
 
 class ZeroShardingRules:

@@ -212,3 +212,17 @@ def test_overflow_skips_and_rescales():
     assert float(state.scale.cur_scale) == 2 ** 9
     for a, b in zip(before, jax.tree_util.tree_leaves(state.master)):
         np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_zero_shards_replicate_ragged_dims():
+    """Params with no dim divisible by the dp world (e.g. a 10-class head
+    over 8 ranks) must replicate, not crash device_put (regression)."""
+    from deeperspeed_tpu.runtime.zero.partition_parameters import (
+        ZeroShardingRules)
+
+    rules = ZeroShardingRules(stage=2, mesh=data_mesh(), data_axis="data")
+    spec = rules.master_spec((10,))
+    # PartitionSpec(None) ≡ PartitionSpec(): fully replicated
+    assert all(ax is None for ax in spec)
+    spec2 = rules.master_spec((10, 16))  # dim 1 divides: shard there
+    assert spec2 == jax.sharding.PartitionSpec(None, "data")
