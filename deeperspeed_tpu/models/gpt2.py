@@ -32,11 +32,9 @@ class GPT2Config:
     intermediate_mult: int = 4
     layernorm_eps: float = 1e-5
     param_dtype: object = jnp.float32
-    # fixed for GPT-2 but consumed by the shared NeoX block body:
-    # sequential residuals, no rotary (order comes from wpe)
+    # consumed by the shared NeoX block body: sequential residuals
+    # (rotary is structurally absent — order comes from wpe)
     use_parallel_residual: bool = False
-    rotary_pct: float = 0.0
-    rotary_emb_base: int = 10000
 
     @property
     def head_dim(self):

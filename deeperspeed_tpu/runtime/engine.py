@@ -1232,3 +1232,16 @@ class DeepSpeedEngine:
         return _load(self, load_dir, tag=tag,
                      load_optimizer_states=load_optimizer_states,
                      load_lr_scheduler_states=load_lr_scheduler_states)
+
+    def _zero3_consolidated_fp16_state_dict(self):
+        """Gather ZeRO-3-sharded params into one host state dict in the
+        compute precision (reference `engine.py:1820-1915`, which walks
+        modules doing rank-0 gathers; with GSPMD the all-gather is just
+        host materialization of each sharded array)."""
+        if self.zero_optimization_stage() != 3:
+            raise ValueError(
+                "this function only works for ZeRO-3; use "
+                "engine.state.params / module_state_dict otherwise")
+        from .zero.stage3 import consolidate_params
+        return consolidate_params(self.state.params,
+                                  dtype=self.compute_dtype)

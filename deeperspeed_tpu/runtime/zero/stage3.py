@@ -36,6 +36,18 @@ import jax.numpy as jnp
 from .stage1 import StepInfo, ZeroOptimizerState
 from .stage2 import FP16_DeepSpeedZeroOptimizer_Stage2
 
+
+def consolidate_params(params, dtype=None):
+    """Gather (possibly sharded) params into full host arrays, optionally
+    cast — the one consolidation path shared by the standalone stage-3
+    optimizer and `engine._zero3_consolidated_fp16_state_dict`."""
+    def pull(p):
+        if dtype is not None:
+            p = p.astype(dtype)
+        return np.asarray(jax.device_get(p))
+
+    return jax.tree_util.tree_map(pull, params)
+
 __all__ = ["FP16_DeepSpeedZeroOptimizer_Stage3", "ZeroOptimizerState",
            "StepInfo"]
 
@@ -60,13 +72,13 @@ class FP16_DeepSpeedZeroOptimizer_Stage3(FP16_DeepSpeedZeroOptimizer_Stage2):
             *args, param_persistence_threshold=param_persistence_threshold,
             **kwargs)
 
-    def consolidated_fp16_state_dict(self, state):
+    def consolidated_fp16_state_dict(self, state, dtype=None):
         """Gather the sharded compute params into full host arrays
         (reference `engine._zero3_consolidated_fp16_state_dict`,
         `engine.py:1820-1915`): every leaf is device_get — which
-        all-gathers its shards — and returned as one {path: array} dict."""
-        return jax.tree_util.tree_map(
-            lambda p: np.asarray(jax.device_get(p)), state.params)
+        all-gathers its shards — and returned as one {path: array} dict.
+        `dtype` optionally casts (the engine passes its compute dtype)."""
+        return consolidate_params(state.params, dtype=dtype)
 
     def estimate_state_bytes(self, params):
         """Per-device bytes for params/master/moments under stage 3 — the

@@ -60,13 +60,21 @@ def test_alexnet_partition_balanced():
     head on different stages (real counts only exist after init_params
     — before that PipelineModule falls back to uniform)."""
     pipe = alexnet_pipe(num_stages=2)
-    pipe.init_params(jax.random.PRNGKey(0),
-                     example_input=np.zeros((1, 32, 32, 3), np.float32))
+    params = pipe.init_params(jax.random.PRNGKey(0),
+                              example_input=np.zeros((1, 32, 32, 3),
+                                                     np.float32))
     assert len(pipe.parts) == 3  # boundaries for 2 stages
     boundary = pipe.parts[1]
     assert 0 < boundary < len(pipe.forward_funcs)
-    # the balanced split must not dump everything on one stage: both
-    # sides own at least one parameterized layer
-    counts = [pipe.parts[1] - pipe.parts[0],
-              pipe.parts[2] - pipe.parts[1]]
-    assert min(counts) >= 1
+
+    def numel(layer_params):
+        return sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(layer_params))
+
+    per_layer = [numel(p) for p in params["layers"]]
+    stage_params = [sum(per_layer[:boundary]), sum(per_layer[boundary:])]
+    # PARAMETER-balanced, not layer-count-balanced: a uniform 5/5 layer
+    # split puts ~97% of AlexNet's params on stage 0 (convs 0-4 dwarf
+    # nothing — the dense head is big); balanced must do better than 75/25
+    assert min(stage_params) > 0
+    assert min(stage_params) / sum(stage_params) > 0.25, stage_params

@@ -165,3 +165,37 @@ def test_elastic_resharding_smaller_world(tmp_path):
     # optimizer state survived the merge: training continues from it
     loss = e4.train_batch(batch=(np.repeat(x, 1, axis=0), x * 0.1))
     assert np.isfinite(float(loss))
+
+
+def test_zero3_consolidated_fp16_state_dict():
+    """Reference `engine.py:1820`: every rank gets the full gathered
+    params in compute precision; non-ZeRO-3 engines refuse."""
+    import pytest
+    from tests.simple_model import SimpleModel
+
+    model = SimpleModel(hidden_dim=16)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config_params={"train_batch_size": 16, "steps_per_print": 1000,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                       "fp16": {"enabled": True, "type": "bfloat16"},
+                       "zero_optimization": {"stage": 3}})
+    sd = engine._zero3_consolidated_fp16_state_dict()
+    leaves = jax.tree_util.tree_leaves(sd)
+    assert all(isinstance(l, np.ndarray) for l in leaves)
+    assert leaves[0].dtype == np.dtype("bfloat16") or \
+        str(leaves[0].dtype) == "bfloat16"
+    # full (unsharded) shapes
+    ref = model.init_params(jax.random.PRNGKey(0))
+    for a, b in zip(leaves, jax.tree_util.tree_leaves(ref)):
+        assert a.shape == b.shape
+
+    engine0, *_ = deeperspeed_tpu.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config_params={"train_batch_size": 16, "steps_per_print": 1000,
+                       "optimizer": {"type": "Adam",
+                                     "params": {"lr": 1e-3}}})
+    with pytest.raises(ValueError):
+        engine0._zero3_consolidated_fp16_state_dict()
