@@ -370,7 +370,7 @@ def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
     return logits
 
 
-def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=2048):
+def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=4096):
     """Next-token cross entropy fused with the LM head, chunked over rows.
 
     Never materializes the full [B, S, V] fp32 logits (6 GB at

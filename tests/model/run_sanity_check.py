@@ -5,8 +5,13 @@ config matrix, comparing losses against the baseline config).
 Runs on whatever devices are attached (a real TPU chip, or the 8-device
 CPU mesh under `JAX_PLATFORMS=cpu
 XLA_FLAGS=--xla_force_host_platform_device_count=8`). Exit code 0 iff
-every config trains and every fp32 config matches the baseline loss
-trajectory.
+every config trains, the pure-device fp32 configs match the baseline
+trajectory exactly, and the offload config matches within the native
+C++ optimizer's rounding tolerance.
+
+Deliberately self-contained (duplicates the tiny-model harness from
+tests/test_zero_parity.py): this script must run on a pod with nothing
+but the package installed — no pytest, no test fixtures.
 
 Usage: PYTHONPATH=. python tests/model/run_sanity_check.py [--steps N]
 """
@@ -29,6 +34,7 @@ CONFIGS = {
                    "zero_optimization": {"stage": 2}},
 }
 EXACT = {"zero1", "zero2", "zero3", "gas2"}  # must match baseline to fp32 tol
+CLOSE = {"zero2-offload": 5e-4}  # native C++ Adam rounds differently
 
 
 def run_config(name, overrides, steps, model_family):
@@ -86,11 +92,12 @@ def main(argv=None):
         decreasing = losses[-1] < losses[0]
         status = "ok" if decreasing else "FLAT"
         detail = ""
-        if name in EXACT:
+        if name in EXACT or name in CLOSE:
             if baseline is None:
                 detail = "  (no baseline)"  # baseline config failed
             else:
-                match = np.allclose(losses, baseline, rtol=2e-4, atol=2e-4)
+                tol = CLOSE.get(name, 2e-4)
+                match = np.allclose(losses, baseline, rtol=tol, atol=tol)
                 detail = "  (= baseline)" if match else "  (DIVERGES)"
                 if not match:
                     failures.append(name)
