@@ -107,7 +107,11 @@ class AsyncIOEngine:
     def aio_read(self, buffer, path, offset=0):
         """Start an async read of len(buffer) bytes into `buffer`
         (np.ndarray, C-contiguous, writable)."""
-        buffer = np.ascontiguousarray(buffer)
+        if not (buffer.flags["C_CONTIGUOUS"] and buffer.flags["WRITEABLE"]):
+            # ascontiguousarray would read into a silent COPY and the
+            # caller's buffer would stay stale — refuse instead
+            raise ValueError(
+                "aio_read requires a writable C-contiguous buffer")
         self._inflight.append(buffer)
         return self._lib.aio_pread(
             self._handle, path.encode(),

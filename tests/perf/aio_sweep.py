@@ -1,0 +1,50 @@
+"""Async-IO spool throughput sweep (reference: `csrc/aio/py_test/
+run_read_sweep.sh` / `run_write_sweep.sh` — read/write GB/s across
+block-size and queue-depth settings).
+
+Run: PYTHONPATH=. python tests/perf/aio_sweep.py [dir]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from deeperspeed_tpu.runtime.swap_tensor.aio_engine import AsyncIOEngine
+
+
+def sweep(dirname, mb=256):
+    buf = np.random.default_rng(0).standard_normal(
+        mb * 1024 * 1024 // 4).astype(np.float32)
+    out = np.empty_like(buf)
+    path = os.path.join(dirname, "aio_sweep.bin")
+    for block_size in (256 * 1024, 1024 * 1024, 8 * 1024 * 1024):
+        for queue_depth in (4, 16):
+            eng = AsyncIOEngine(block_size=block_size,
+                                queue_depth=queue_depth, thread_count=2)
+            t0 = time.perf_counter()
+            eng.aio_write(buf, path)
+            eng.wait()
+            t_w = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            eng.aio_read(out, path)
+            eng.wait()
+            t_r = time.perf_counter() - t0
+            assert (out[:1024] == buf[:1024]).all()
+            print(json.dumps({
+                "bench": "aio", "block_size": block_size,
+                "queue_depth": queue_depth, "mb": mb,
+                "write_gb_s": round(mb / 1024 / t_w, 2),
+                "read_gb_s": round(mb / 1024 / t_r, 2),
+            }), flush=True)
+    os.unlink(path)
+
+
+if __name__ == "__main__":
+    if not AsyncIOEngine.available():
+        raise SystemExit("native aio library unavailable")
+    target = sys.argv[1] if len(sys.argv) > 1 else tempfile.gettempdir()
+    sweep(target)

@@ -1,0 +1,38 @@
+"""Native CPU Adam microbench (reference: `tests/perf/adam_test.py` —
+steps/sec of the AVX `DeepSpeedCPUAdam` on host-resident shards).
+
+Run: PYTHONPATH=. python tests/perf/cpu_adam_bench.py
+"""
+
+import json
+import time
+
+import numpy as np
+
+from deeperspeed_tpu.ops.adam.cpu_adam_native import (NativeCPUAdam,
+                                                      cpu_adam_available)
+
+
+def bench(n_params, iters=20):
+    opt = NativeCPUAdam(lr=1e-3)
+    p = np.random.default_rng(0).standard_normal(n_params).astype(np.float32)
+    g = np.full(n_params, 1e-3, np.float32)
+    m = np.zeros(n_params, np.float32)
+    v = np.zeros(n_params, np.float32)
+    opt.step_flat(p, g, m, v)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        opt.step_flat(p, g, m, v)
+    dt = (time.perf_counter() - t0) / iters
+    print(json.dumps({
+        "bench": "cpu_adam", "params": n_params,
+        "ms_per_step": round(dt * 1e3, 2),
+        "gparams_per_sec": round(n_params / dt / 1e9, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    if not cpu_adam_available():
+        raise SystemExit("native cpu_adam library unavailable")
+    for n in (1 << 20, 1 << 24, 1 << 27):  # 1M / 16M / 128M params
+        bench(n)
