@@ -68,10 +68,12 @@ def _sparse_fwd_kernel(lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k = k_ref[0].astype(jnp.float32)
+        # matmuls in the wire dtype (bf16 -> full MXU rate), fp32 accum
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * \
+            sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         m_prev = m_scr[:, :1]
@@ -92,8 +94,9 @@ def _sparse_fwd_kernel(lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:] + jnp.log(jnp.where(l_scr[:] == 0.0, 1.0,
-                                                  l_scr[:]))
+        # compact [1, BQ] row-vector: 128x less HBM than lane-broadcast
+        lse = m_scr[:, :1] + jnp.log(l_safe)
+        lse_ref[0] = lse.reshape(1, -1)
 
 
 def _kv_col_index(lut_ref, bh, qi, ai, *, num_heads, max_active, n_q,
@@ -142,8 +145,8 @@ def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
         out_specs=[
             pl.BlockSpec((1, block_q, d),
                          lambda bh, qi, ai, lut_ref: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES),
-                         lambda bh, qi, ai, lut_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bh, qi, ai, lut_ref: (bh, 0, qi)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -156,7 +159,7 @@ def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -164,7 +167,7 @@ def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
     )(lut_flat, qb, kb, vb)
 
     out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-    return out4, (qb, kb, vb, out, lse)
+    return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
 
 
 def _sparse_dkv_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -186,21 +189,23 @@ def _sparse_dkv_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * \
+            sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])
-        do = do_ref[0].astype(jnp.float32)
-        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        p = jnp.exp(s - lse_ref[0].reshape(-1, 1))
+        do = do_ref[0]
+        dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
-        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        ds = p * (dp - delta_ref[0].reshape(-1, 1)) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
     @pl.when(ai == pl.num_programs(2) - 1)
@@ -227,19 +232,20 @@ def _sparse_dq_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * \
+            sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])
-        do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        p = jnp.exp(s - lse_ref[0].reshape(-1, 1))
+        do = do_ref[0]
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
-        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        ds = p * (dp - delta_ref[0].reshape(-1, 1)) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
     @pl.when(ai == pl.num_programs(2) - 1)
@@ -254,9 +260,9 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
     bdim = g.shape[0]
     h = bh // bdim
     do = g.transpose(0, 2, 1, 3).reshape(bh, s, d)
+    lse = lse.reshape(bh, 1, s)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)
-    delta = jnp.broadcast_to(delta, (bh, s, LANES))
+                    axis=-1).reshape(bh, 1, s)
 
     n_q, n_k = s // block_q, s // block_k
     max_a = lut.shape[-1]
@@ -286,12 +292,12 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
             pl.BlockSpec((1, block_q, d),
                          lambda b, ki, ai, lref:
                          (b, row_map(lref, b, ki, ai), 0)),
-            pl.BlockSpec((1, block_q, LANES),
+            pl.BlockSpec((1, 1, block_q),
                          lambda b, ki, ai, lref:
-                         (b, row_map(lref, b, ki, ai), 0)),
-            pl.BlockSpec((1, block_q, LANES),
+                         (b, 0, row_map(lref, b, ki, ai))),
+            pl.BlockSpec((1, 1, block_q),
                          lambda b, ki, ai, lref:
-                         (b, row_map(lref, b, ki, ai), 0)),
+                         (b, 0, row_map(lref, b, ki, ai))),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d),
@@ -336,10 +342,10 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
                          (b, col_map(lref, b, qi, ai), 0)),
             pl.BlockSpec((1, block_q, d),
                          lambda b, qi, ai, lref: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES),
-                         lambda b, qi, ai, lref: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES),
-                         lambda b, qi, ai, lref: (b, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, qi, ai, lref: (b, 0, qi)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, qi, ai, lref: (b, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda b, qi, ai, lref: (b, qi, 0)),
