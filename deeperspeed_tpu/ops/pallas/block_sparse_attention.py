@@ -12,6 +12,15 @@ Layout comes from `SparsityConfig.make_layout(seq)` →
 [num_heads, nQ, nK] 0/1 (see `..sparse_attention.sparsity_config`).
 `causal=True` applies an element-level triangular mask inside diagonal
 blocks (unidirectional patterns).
+
+**2-D block grouping**: per-grid-instance fixed cost (~6µs on v5e)
+dominates one-128×128-block-per-instance execution, so the kernels
+process GROUP×GROUP (default 4×4) squares of layout blocks per
+instance — q AND k/v tiles are [group·128, d], the LUT lists the UNION
+of active coarse column groups per coarse row group, and a per-entry
+16-bit mask (`(bits >> (row·group + col)) & 1`) kills the inactive
+128×128 sub-blocks elementwise. Instance count drops ~group²×; windowed
+patterns' adjacent rows share columns, keeping the union tight.
 """
 
 import functools
@@ -27,6 +36,7 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import LANES, NEG_INF, _causal_mask, _interpret
 
 DEFAULT_BLOCK = 128
+DEFAULT_GROUP = 4
 
 
 def build_lut(layout):
@@ -47,10 +57,52 @@ def build_lut(layout):
     return lut, n_k
 
 
-def _sparse_fwd_kernel(lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                       m_scr, l_scr, acc_scr,
+def build_lut_grouped(layout, group_q, group_k):
+    """Union LUT over `group_q`x`group_k` squares of layout blocks.
+
+    Returns (lut [H, nGq, maxU] int32, bits [H, nGq, maxU] int32,
+    sentinel): entry (h, g, a) is a COARSE column group (of group_k
+    adjacent 128-blocks) active for at least one row of row-group g; bit
+    (r*group_k + c) of bits[h, g, a] says fine row g*group_q+r is active
+    for fine column col*group_k+c. Padded with sentinel/0."""
+    layout = np.asarray(layout)
+    h, n_q, n_k = layout.shape
+    if n_q % group_q or n_k % group_k:
+        raise ValueError(
+            f"layout {n_q}x{n_k} not divisible by {group_q}x{group_k}")
+    n_gq, n_gk = n_q // group_q, n_k // group_k
+    grouped = layout.reshape(h, n_gq, group_q, n_gk, group_k)
+    union = grouped.any(axis=(2, 4))          # [H, nGq, nGk]
+    max_u = max(1, int(union.sum(axis=2).max()))
+    lut = np.full((h, n_gq, max_u), n_gk, np.int32)
+    bits = np.zeros((h, n_gq, max_u), np.int32)
+    shifts = (np.arange(group_q)[:, None] * group_k
+              + np.arange(group_k)[None, :])
+    for hi in range(h):
+        for g in range(n_gq):
+            cols = np.nonzero(union[hi, g])[0]
+            lut[hi, g, :len(cols)] = cols
+            for a, col in enumerate(cols):
+                sq = grouped[hi, g, :, col, :]      # [group_q, group_k]
+                bits[hi, g, a] = int((sq.astype(np.int64) << shifts).sum())
+    return lut, bits, n_gk
+
+
+def _activity_mask(s, bits, base_block, group_k, transpose=False):
+    """Mask score entries whose 128x128 sub-block is inactive: bit
+    (r*group_k + c) of `bits` covers the sub-block at fine row r, fine
+    col c of this tile. `transpose=True` swaps the roles (for the dk/dv
+    kernel, whose LUT is built from the transposed layout)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // base_block
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // base_block
+    idx = cols * group_k + rows if transpose else rows * group_k + cols
+    return jnp.where(((bits >> idx) & 1) == 1, s, NEG_INF)
+
+
+def _sparse_fwd_kernel(lut_ref, bits_ref, q_ref, k_ref, v_ref, o_ref,
+                       lse_ref, m_scr, l_scr, acc_scr,
                        *, sm_scale, causal, block_q, block_k, num_heads,
-                       max_active, sentinel):
+                       max_active, sentinel, group):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ai = pl.program_id(2)
@@ -74,6 +126,9 @@ def _sparse_fwd_kernel(lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * \
             sm_scale
+        if group > 1:
+            bits = bits_ref[h * n_q * max_active + qi * max_active + ai]
+            s = _activity_mask(s, bits, block_q // group, group)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         m_prev = m_scr[:, :1]
@@ -91,11 +146,19 @@ def _sparse_fwd_kernel(lut_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(ai == pl.num_programs(2) - 1)
     def _finalize():
+        # Rows with NO active blocks (dragged into a tile by the group
+        # union, every score = NEG_INF) have m stuck at NEG_INF: emit 0
+        # (the ungrouped kernels' l==0 convention) and poison their lse
+        # to +|NEG_INF| so the backward recompute yields p = exp(s-lse)
+        # = 0 instead of exp(0) garbage.
+        m_row = m_scr[:, :1]
+        dead = m_row <= NEG_INF * 0.5
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0] = jnp.where(dead, 0.0,
+                             acc_scr[:] / l_safe).astype(o_ref.dtype)
         # compact [1, BQ] row-vector: 128x less HBM than lane-broadcast
-        lse = m_scr[:, :1] + jnp.log(l_safe)
+        lse = jnp.where(dead, -NEG_INF, m_row + jnp.log(l_safe))
         lse_ref[0] = lse.reshape(1, -1)
 
 
@@ -107,8 +170,8 @@ def _kv_col_index(lut_ref, bh, qi, ai, *, num_heads, max_active, n_q,
     return jax.lax.select(ki < sentinel, ki, 0)
 
 
-def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
-                         block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK):
+def sparse_attention_fwd(q, k, v, lut, bits, sentinel, causal, sm_scale,
+                         block_q, block_k, group):
     b, s, h, d = q.shape
 
     def to_bh(x):
@@ -116,37 +179,37 @@ def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
 
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     n_q = s // block_q
-    n_k = s // block_k
     max_active = lut.shape[-1]
     lut_flat = jnp.asarray(lut.reshape(-1), jnp.int32)
+    bits_flat = jnp.asarray(bits.reshape(-1), jnp.int32)
 
     kernel = functools.partial(
         _sparse_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, num_heads=h,
-        max_active=max_active, sentinel=sentinel)
+        max_active=max_active, sentinel=sentinel, group=group)
 
     kv_map = functools.partial(_kv_col_index, num_heads=h,
                                max_active=max_active, n_q=n_q,
                                sentinel=sentinel)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(b * h, n_q, max_active),
         in_specs=[
             pl.BlockSpec((1, block_q, d),
-                         lambda bh, qi, ai, lut_ref: (bh, qi, 0)),
+                         lambda bh, qi, ai, lref, bref: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ai, lut_ref:
-                         (bh, kv_map(lut_ref, bh, qi, ai), 0)),
+                         lambda bh, qi, ai, lref, bref:
+                         (bh, kv_map(lref, bh, qi, ai), 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ai, lut_ref:
-                         (bh, kv_map(lut_ref, bh, qi, ai), 0)),
+                         lambda bh, qi, ai, lref, bref:
+                         (bh, kv_map(lref, bh, qi, ai), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d),
-                         lambda bh, qi, ai, lut_ref: (bh, qi, 0)),
+                         lambda bh, qi, ai, lref, bref: (bh, qi, 0)),
             pl.BlockSpec((1, 1, block_q),
-                         lambda bh, qi, ai, lut_ref: (bh, 0, qi)),
+                         lambda bh, qi, ai, lref, bref: (bh, 0, qi)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -164,16 +227,20 @@ def sparse_attention_fwd(q, k, v, lut, sentinel, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(lut_flat, qb, kb, vb)
+    )(lut_flat, bits_flat, qb, kb, vb)
 
     out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
 
 
-def _sparse_dkv_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                       delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+def _sparse_dkv_kernel(lut_ref, bits_ref, q_ref, k_ref, v_ref, do_ref,
+                       lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                        *, sm_scale, causal, block_q, block_k, num_heads,
-                       max_active, sentinel):
+                       max_active, sentinel, group):
+    """Symmetric coarse tiles: k/v/dk/dv tiles cover a `group`-column
+    coarse block, q/do tiles a `group`-row coarse block from the
+    transposed-layout LUT; bits (transposed layout) mask inactive
+    128x128 sub-blocks."""
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     ai = pl.program_id(2)
@@ -194,6 +261,10 @@ def _sparse_dkv_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * \
             sm_scale
+        if group > 1:
+            bits = bits_ref[h * n_kv * max_active + ki * max_active + ai]
+            s = _activity_mask(s, bits, block_k // group, group,
+                               transpose=True)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0].reshape(-1, 1))
@@ -214,10 +285,11 @@ def _sparse_dkv_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _sparse_dq_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, dq_scr,
+def _sparse_dq_kernel(lut_ref, bits_ref, q_ref, k_ref, v_ref, do_ref,
+                      lse_ref, delta_ref, dq_ref, dq_scr,
                       *, sm_scale, causal, block_q, block_k, num_heads,
-                      max_active, sentinel):
+                      max_active, sentinel, group):
+    """Row-grouped like the forward kernel."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ai = pl.program_id(2)
@@ -237,6 +309,9 @@ def _sparse_dq_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * \
             sm_scale
+        if group > 1:
+            bits = bits_ref[h * n_q * max_active + qi * max_active + ai]
+            s = _activity_mask(s, bits, block_q // group, group)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0].reshape(-1, 1))
@@ -253,8 +328,10 @@ def _sparse_dq_kernel(lut_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
-                         block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK):
+def sparse_attention_bwd(res, g, lut, bits, lut_t, bits_t, sentinel,
+                         causal, sm_scale, block_q, block_k, group):
+    """block_q == block_k == group·128: all tiles are coarse on both
+    sides; bits mask inactive 128x128 sub-blocks inside each tile."""
     qb, kb, vb, out, lse = res
     bh, s, d = qb.shape
     bdim = g.shape[0]
@@ -268,42 +345,45 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
     max_a = lut.shape[-1]
     max_at = lut_t.shape[-1]
     lut_flat = jnp.asarray(lut.reshape(-1), jnp.int32)
+    bits_flat = jnp.asarray(bits.reshape(-1), jnp.int32)
     lut_t_flat = jnp.asarray(lut_t.reshape(-1), jnp.int32)
+    bits_t_flat = jnp.asarray(bits_t.reshape(-1), jnp.int32)
 
-    # dk/dv: grid over column blocks; LUT lists the active row blocks.
+    # dk/dv: grid over GROUPED column blocks; LUT lists active 128-row
+    # blocks of the transposed layout.
     row_map = functools.partial(_kv_col_index, num_heads=h,
                                 max_active=max_at, n_q=n_k,
                                 sentinel=sentinel)
     dkv_kernel = functools.partial(
         _sparse_dkv_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, num_heads=h, max_active=max_at,
-        sentinel=sentinel)
+        sentinel=sentinel, group=group)
     dkv_grid = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(bh, n_k, max_at),
         in_specs=[
             pl.BlockSpec((1, block_q, d),
-                         lambda b, ki, ai, lref:
+                         lambda b, ki, ai, lref, bref:
                          (b, row_map(lref, b, ki, ai), 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda b, ki, ai, lref: (b, ki, 0)),
+                         lambda b, ki, ai, lref, bref: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda b, ki, ai, lref: (b, ki, 0)),
+                         lambda b, ki, ai, lref, bref: (b, ki, 0)),
             pl.BlockSpec((1, block_q, d),
-                         lambda b, ki, ai, lref:
+                         lambda b, ki, ai, lref, bref:
                          (b, row_map(lref, b, ki, ai), 0)),
             pl.BlockSpec((1, 1, block_q),
-                         lambda b, ki, ai, lref:
+                         lambda b, ki, ai, lref, bref:
                          (b, 0, row_map(lref, b, ki, ai))),
             pl.BlockSpec((1, 1, block_q),
-                         lambda b, ki, ai, lref:
+                         lambda b, ki, ai, lref, bref:
                          (b, 0, row_map(lref, b, ki, ai))),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d),
-                         lambda b, ki, ai, lref: (b, ki, 0)),
+                         lambda b, ki, ai, lref, bref: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda b, ki, ai, lref: (b, ki, 0)),
+                         lambda b, ki, ai, lref, bref: (b, ki, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -319,36 +399,37 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(lut_t_flat, qb, kb, vb, do, lse, delta)
+    )(lut_t_flat, bits_t_flat, qb, kb, vb, do, lse, delta)
 
+    # dq: grid over GROUPED row blocks; LUT lists active 128-col blocks.
     col_map = functools.partial(_kv_col_index, num_heads=h,
                                 max_active=max_a, n_q=n_q,
                                 sentinel=sentinel)
     dq_kernel = functools.partial(
         _sparse_dq_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, num_heads=h, max_active=max_a,
-        sentinel=sentinel)
+        sentinel=sentinel, group=group)
     dq_grid = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(bh, n_q, max_a),
         in_specs=[
             pl.BlockSpec((1, block_q, d),
-                         lambda b, qi, ai, lref: (b, qi, 0)),
+                         lambda b, qi, ai, lref, bref: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda b, qi, ai, lref:
+                         lambda b, qi, ai, lref, bref:
                          (b, col_map(lref, b, qi, ai), 0)),
             pl.BlockSpec((1, block_k, d),
-                         lambda b, qi, ai, lref:
+                         lambda b, qi, ai, lref, bref:
                          (b, col_map(lref, b, qi, ai), 0)),
             pl.BlockSpec((1, block_q, d),
-                         lambda b, qi, ai, lref: (b, qi, 0)),
+                         lambda b, qi, ai, lref, bref: (b, qi, 0)),
             pl.BlockSpec((1, 1, block_q),
-                         lambda b, qi, ai, lref: (b, 0, qi)),
+                         lambda b, qi, ai, lref, bref: (b, 0, qi)),
             pl.BlockSpec((1, 1, block_q),
-                         lambda b, qi, ai, lref: (b, 0, qi)),
+                         lambda b, qi, ai, lref, bref: (b, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda b, qi, ai, lref: (b, qi, 0)),
+                               lambda b, qi, ai, lref, bref: (b, qi, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )
     dq = pl.pallas_call(
@@ -357,7 +438,7 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(lut_flat, qb, kb, vb, do, lse, delta)
+    )(lut_flat, bits_flat, qb, kb, vb, do, lse, delta)
 
     def from_bh(x):
         return x.reshape(bdim, h, s, d).transpose(0, 2, 1, 3)
@@ -368,39 +449,50 @@ def sparse_attention_bwd(res, g, lut, lut_t, sentinel, causal, sm_scale,
 class BlockSparseAttention:
     """Callable bound to one (layout, block, causal) configuration.
 
-    Precomputes forward/backward LUTs host-side once; the kernels are then
-    pure functions of (q, k, v) with a custom VJP.
-    """
+    Precomputes forward/backward (grouped-union) LUTs host-side once; the
+    kernels are then pure functions of (q, k, v) with a custom VJP.
+    `group` adjacent layout rows (and, in backward, columns) share one
+    grid instance; pass group=1 to disable."""
 
     def __init__(self, layout, block=DEFAULT_BLOCK, causal=False,
-                 sm_scale=None):
+                 sm_scale=None, group=DEFAULT_GROUP):
         layout = np.asarray(layout)
         self.layout = layout
         self.block = block
         self.causal = causal
         self.sm_scale = sm_scale
-        self.lut, self.sentinel = build_lut(layout)
-        self.lut_t, _ = build_lut(layout.transpose(0, 2, 1))
+        n_q, n_k = layout.shape[1], layout.shape[2]
+        # group² activity bits must fit the int32 bits array
+        while group > 1 and (n_q % group or n_k % group
+                             or group * group > 32):
+            group //= 2
+        self.group = max(1, group)
+        self.lut, self.bits, self.sentinel = build_lut_grouped(
+            layout, self.group, self.group)
+        self.lut_t, self.bits_t, _ = build_lut_grouped(
+            layout.transpose(0, 2, 1), self.group, self.group)
+        self._tile = self.block * self.group
 
         @jax.custom_vjp
         def attend(q, k, v):
             scale = self.sm_scale or 1.0 / math.sqrt(q.shape[-1])
             out, _ = sparse_attention_fwd(
-                q, k, v, self.lut, self.sentinel, self.causal, scale,
-                self.block, self.block)
+                q, k, v, self.lut, self.bits, self.sentinel, self.causal,
+                scale, self._tile, self._tile, self.group)
             return out
 
         def fwd(q, k, v):
             scale = self.sm_scale or 1.0 / math.sqrt(q.shape[-1])
             return sparse_attention_fwd(
-                q, k, v, self.lut, self.sentinel, self.causal, scale,
-                self.block, self.block)
+                q, k, v, self.lut, self.bits, self.sentinel, self.causal,
+                scale, self._tile, self._tile, self.group)
 
         def bwd(res, g):
             scale = self.sm_scale or 1.0 / math.sqrt(res[0].shape[-1])
             return sparse_attention_bwd(
-                res, g, self.lut, self.lut_t, self.sentinel, self.causal,
-                scale, self.block, self.block)
+                res, g, self.lut, self.bits, self.lut_t, self.bits_t,
+                self.sentinel, self.causal, scale, self._tile, self._tile,
+                self.group)
 
         attend.defvjp(fwd, bwd)
         self._attend = attend

@@ -255,3 +255,56 @@ def test_bool_keep_mask_in_add_mode_rejected():
     kpm = jnp.ones((1, 64), jnp.bool_)
     with pytest.raises(ValueError, match="mul"):
         ssa(q, q, q, key_padding_mask=kpm)
+
+
+def test_grouped_lut_bits_semantics():
+    """build_lut_grouped: union columns + per-sub-block activity bits
+    (bit r*g+c ⇔ fine row r active for fine col c)."""
+    from deeperspeed_tpu.ops.pallas.block_sparse_attention import (
+        build_lut_grouped)
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 0, 1] = 1   # row 0 → col 1
+    layout[0, 1, 0] = 1   # row 1 → col 0
+    layout[0, 2, 2] = 1
+    layout[0, 3, 3] = 1
+    lut, bits, sentinel = build_lut_grouped(layout, 2, 2)
+    assert sentinel == 2
+    assert lut.shape == (1, 2, 1)  # one coarse col group per row group
+    # row-group 0 covers rows 0-1, both hit coarse col 0 (cols 0-1)
+    assert lut[0, 0, 0] == 0
+    # bits: row0/col1 → bit 0*2+1=1; row1/col0 → bit 1*2+0=2 → 0b0110
+    assert bits[0, 0, 0] == 0b0110
+    # row-group 1 (rows 2-3) hits coarse col 1; diag bits 0 and 3
+    assert lut[0, 1, 0] == 1
+    assert bits[0, 1, 0] == 0b1001
+
+
+def test_grouped_kernel_empty_rows_emit_zero():
+    """A layout row with NO active blocks inside an otherwise-active
+    4-row group must output zeros and contribute nothing to gradients
+    (regression: the group union dragged such rows into a tile where
+    every score was finite NEG_INF → uniform garbage)."""
+    from deeperspeed_tpu.ops.pallas.block_sparse_attention import (
+        BlockSparseAttention)
+    s, d = 512, 64
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 0, 0] = 1
+    layout[0, 2, :3] = 1   # rows 1 and 3 fully empty
+    kern = BlockSparseAttention(layout, block=128, causal=False)
+    assert kern.group == 4
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, s, 1, d)), jnp.float32)
+    out = np.asarray(kern(q, q, q))
+    np.testing.assert_array_equal(out[0, 128:256], 0.0)
+    np.testing.assert_array_equal(out[0, 384:], 0.0)
+    assert np.abs(out[0, :128]).max() > 0   # active rows nonzero
+
+    # with independent k/v, dead QUERY rows get exactly zero dq
+    k = jnp.asarray(rng.standard_normal((1, s, 1, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, s, 1, d)), jnp.float32)
+    dq = np.asarray(jax.grad(
+        lambda q: kern(q, k, v).astype(jnp.float32).sum())(q))
+    assert np.isfinite(dq).all()
+    np.testing.assert_array_equal(dq[0, 128:256], 0.0)
+    np.testing.assert_array_equal(dq[0, 384:], 0.0)
+    assert np.abs(dq[0, :128]).max() > 0
