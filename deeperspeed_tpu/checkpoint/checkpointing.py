@@ -126,16 +126,33 @@ def _save_zero_checkpoint(engine, ckpt_dir):
     rules = engine.zero_rules
     dp = engine.dp_world_size if rules.stage >= 1 else 1
 
-    master_flat = (_flat_arrays(state.master)
+    # Flat-padded (ragged) leaves are saved in natural shape so files are
+    # world-size independent (padding depends on dp world); they re-pad on
+    # load. Replication of these slices per rank mirrors the reference's
+    # handling of unpartitioned state.
+    master_flat = (_flat_arrays(engine.layout_to_natural(state.master))
                    if state.master is not None else None)
-    opt_flat = _flat_arrays(state.opt_state)
+    opt_flat = _flat_arrays(engine.opt_layout_to_natural(state.opt_state))
 
     def dims_of(flat):
-        return {k: _sharded_dim(rules.master_spec(v.shape))
-                for k, v in flat.items()}
+        """Per-key slicing rule: an int dim (evenly-sharded leaves), the
+        string "flat" (ragged leaves — saved as rank slices of the
+        raveled natural array so the biggest fp32 state is never
+        duplicated dp times on disk), or None (replicate)."""
+        out = {}
+        for k, v in flat.items():
+            if rules.master_pad_info(v.shape) is not None:
+                out[k] = "flat"
+            else:
+                out[k] = _sharded_dim(rules.master_spec(v.shape))
+        return out
 
     master_dims = dims_of(master_flat) if master_flat is not None else None
     opt_dims = dims_of(opt_flat)
+
+    def shapes_of(flat, dims):
+        return {k: tuple(v.shape) for k, v in flat.items()
+                if dims[k] == "flat"}
 
     for dp_rank in range(dp):
         def slice_flat(flat, dims):
@@ -144,6 +161,8 @@ def _save_zero_checkpoint(engine, ckpt_dir):
                 dim = dims[key]
                 if dim is None or dp == 1:
                     out[key] = arr  # replicated leaf: duplicated per rank
+                elif dim == "flat":
+                    out[key] = shard_slice(np.ravel(arr), dp, dp_rank, 0)
                 else:
                     out[key] = shard_slice(arr, dp, dp_rank, dim)
             return out
@@ -152,12 +171,16 @@ def _save_zero_checkpoint(engine, ckpt_dir):
             "optimizer_state_dict": {
                 "state": slice_flat(opt_flat, opt_dims),
                 "shard_dims": opt_dims,
+                "flat_shapes": shapes_of(opt_flat, opt_dims),
                 "param_groups": [dict(g) for g in
                                  engine.optimizer.param_groups],
             },
             "fp32_master": (slice_flat(master_flat, master_dims)
                             if master_flat is not None else None),
             "fp32_master_dims": master_dims,
+            "fp32_master_flat_shapes": (
+                shapes_of(master_flat, master_dims)
+                if master_flat is not None else None),
             "zero_stage": rules.stage,
             "partition_count": dp,
             "dp_rank": dp_rank,
@@ -327,42 +350,46 @@ def _load_zero_checkpoint(engine, ckpt_dir):
 
     saved_dp = shards[0]["partition_count"]
 
-    def merge_flat(flats, dims):
-        """Merge per-rank {path: slice} dicts back to full arrays."""
+    def merge_flat(flats, dims, flat_shapes=None):
+        """Merge per-rank {path: slice} dicts back to full natural-shaped
+        arrays. "flat"-sliced (ragged) leaves concat their raveled rank
+        slices and reshape to the recorded natural shape."""
         out = {}
         for key in flats[0]:
             dim = dims.get(key) if dims else None
             if dim is None or saved_dp == 1:
                 out[key] = flats[0][key]
+            elif dim == "flat":
+                merged = unshard_concat([f[key] for f in flats], 0)
+                out[key] = merged.reshape((flat_shapes or {})[key])
             else:
                 out[key] = unshard_concat([f[key] for f in flats], dim)
         return out
 
     opt_flats = [s["optimizer_state_dict"]["state"] for s in shards]
     opt_dims = shards[0]["optimizer_state_dict"].get("shard_dims", {})
-    opt_full = merge_flat(opt_flats, opt_dims)
+    opt_full = merge_flat(
+        opt_flats, opt_dims,
+        shards[0]["optimizer_state_dict"].get("flat_shapes"))
 
     master_full = None
     if shards[0].get("fp32_master") is not None:
         master_flats = [s["fp32_master"] for s in shards]
         master_full = merge_flat(master_flats,
-                                 shards[0].get("fp32_master_dims", {}))
+                                 shards[0].get("fp32_master_dims", {}),
+                                 shards[0].get("fp32_master_flat_shapes"))
 
     master = engine.state.master
     if master is not None and master_full is not None:
         master_np = state_dict_to_tree({"arrays": master_full},
                                        like=engine.state.master)
-        master = rules.place(
-            jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32),
-                                   master_np), rules.master_spec)
+        master = engine.natural_to_layout(master_np, engine.state.master)
     opt_state = engine.state.opt_state
     if opt_full:
         opt_np = state_dict_to_tree({"arrays": opt_full},
                                     like=engine.state.opt_state)
-        opt_state = jax.tree_util.tree_map(
-            lambda n, cur: jax.device_put(jnp.asarray(n, cur.dtype),
-                                          cur.sharding),
-            opt_np, engine.state.opt_state)
+        opt_state = engine.opt_natural_to_layout(opt_np,
+                                                 engine.state.opt_state)
         engine.optimizer.param_groups = [
             dict(g) for g in shards[0]["optimizer_state_dict"]
             ["param_groups"]]

@@ -46,7 +46,8 @@ from .fp16.loss_scaler import (LossScaleState, grads_finite,
 from .lr_schedules import get_scheduler_class
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import GradientNoiseScale, clip_grad_norm_, global_norm
-from .zero.partition_parameters import ZeroShardingRules
+from .zero.partition_parameters import (ZeroShardingRules, flat_pad,
+                                        flat_unpad, map_master_fields)
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
@@ -187,6 +188,18 @@ class DeepSpeedEngine:
             from ..profiling.flops_profiler.profiler import FlopsProfiler
             self.flops_profiler = FlopsProfiler(engine=self)
 
+        # Monitor (reference `engine.py:163-164,1222-1275`): tensorboard
+        # event stream of loss/lr/scale/grad-norm/step-time keyed by
+        # global sample count. Buffered — see runtime/monitor.py.
+        self.monitor = None
+        self._last_step_stamp = None
+        self._last_used_lr = None
+        if self._config.tensorboard_enabled:
+            from .monitor import TensorBoardMonitor
+            self.monitor = TensorBoardMonitor(
+                output_path=self._config.tensorboard_output_path,
+                job_name=self._config.tensorboard_job_name)
+
         # --- offload tier -------------------------------------------------
         zc = self._config.zero_config
         self.host_offload = (zc.offload_optimizer is not None)
@@ -221,6 +234,7 @@ class DeepSpeedEngine:
             steps_per_output=self._config.steps_per_print)
         self._cached = None          # (batch, loss, grads) from forward()
         self._accum_grads = None
+        self._accum_loss = None
         self._accum_count = 0
         self._compiled_grad = None
         self._compiled_update = None
@@ -399,6 +413,59 @@ class DeepSpeedEngine:
         self._master_sh = tree_of(rules.master_spec)
         self._grad_sh = tree_of(rules.grad_spec)
 
+        # Ragged leaves (no dp-divisible dim, e.g. an unpadded vocab):
+        # masters + moments are stored as padded flat 1-D buffers sharded
+        # over the data axis (reference pads-and-flattens every group,
+        # `zero/stage2.py:196-374`) so no fp32 state is ever replicated.
+        # Leaves are FlatPad or False (False, not None: None is not a
+        # pytree leaf and would break structure matching).
+        if base is None:
+            self._padinfo = jax.tree_util.tree_map(
+                lambda p: rules.master_pad_info(p.shape) or False,
+                model_parameters)
+        else:
+            self._padinfo = jax.tree_util.tree_map(
+                lambda p, b: rules.master_pad_info(p.shape, base=b) or False,
+                model_parameters, base,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+        flat_sh = rules.flat_master_sharding()
+        self._master_sh = jax.tree_util.tree_map(
+            lambda sh, info: flat_sh if info else sh,
+            self._master_sh, self._padinfo)
+
+    def layout_to_natural(self, tree):
+        """Master/moment tree in storage layout → natural param shapes
+        (flat-padded leaves unpadded/reshaped). Used by checkpoint save so
+        files are world-size independent."""
+        return jax.tree_util.tree_map(
+            lambda x, info: flat_unpad(x, info) if info else x,
+            tree, self._padinfo)
+
+    def natural_to_layout(self, tree, like):
+        """Natural-shaped host tree → storage layout, placed with `like`'s
+        dtypes/shardings (checkpoint load, incl. elastic restores)."""
+        return jax.tree_util.tree_map(
+            lambda x, info, l: jax.device_put(
+                flat_pad(jnp.asarray(x, l.dtype), info) if info
+                else jnp.asarray(x, l.dtype), l.sharding),
+            tree, self._padinfo, like)
+
+    @property
+    def _master_treedef(self):
+        return jax.tree_util.tree_structure(self._padinfo)
+
+    def opt_layout_to_natural(self, opt_state):
+        return map_master_fields(opt_state, self._master_treedef,
+                                 self.layout_to_natural)
+
+    def opt_natural_to_layout(self, opt_state_natural, like):
+        return map_master_fields(
+            opt_state_natural, self._master_treedef,
+            self.natural_to_layout, like,
+            passthrough=lambda nat, cur: jax.tree_util.tree_map(
+                lambda n, c: jax.device_put(
+                    jnp.asarray(n, c.dtype), c.sharding), nat, cur))
+
     def _init_host_state(self, model_parameters):
         """ZeRO-Offload: fp32 masters + moments live in host DRAM (numpy),
         stepped by the native CPU Adam; optionally tiered to NVMe via the
@@ -415,8 +482,11 @@ class DeepSpeedEngine:
             weight_decay=group["weight_decay"],
             bias_correction=group.get("bias_correction", True),
             adam_w_mode=getattr(self.optimizer, "adam_w_mode", True))
-        masters = [np.ascontiguousarray(np.asarray(l).reshape(-1),
-                                        np.float32) for l in leaves]
+        # np.array(copy=True), NOT ascontiguousarray: when dtype/layout
+        # already match, ascontiguousarray returns the SAME (read-only,
+        # jax-owned) buffer and the native Adam would write into it.
+        masters = [np.array(np.asarray(l).reshape(-1), np.float32)
+                   for l in leaves]
         moments_m = [np.zeros(m.shape, np.float32) for m in masters]
         moments_v = [np.zeros(m.shape, np.float32) for m in masters]
         self._host_state = {"master": masters, "m": moments_m,
@@ -446,15 +516,25 @@ class DeepSpeedEngine:
 
         # copy=True: the engine's state buffers must never alias the
         # caller's arrays or each other — the jitted step donates state.
+        # Ragged leaves: the master is stored flat-padded (see
+        # _compute_shardings); the compute param keeps its natural shape.
+        def make_master(p, sh, info):
+            m = jnp.array(p, dtype=jnp.float32, copy=True)
+            if info:
+                m = flat_pad(m, info)
+            return jax.device_put(m, sh)
+
         master = jax.tree_util.tree_map(
-            lambda p, sh: jax.device_put(
-                jnp.array(p, dtype=jnp.float32, copy=True), sh),
-            model_parameters, self._master_sh)
+            make_master, model_parameters, self._master_sh, self._padinfo)
+
+        def make_param(m, sh, info):
+            if info:
+                m = flat_unpad(m, info)
+            return jax.device_put(
+                jnp.array(m, dtype=self.compute_dtype, copy=True), sh)
 
         params = jax.tree_util.tree_map(
-            lambda p, sh: jax.device_put(
-                jnp.array(p, dtype=self.compute_dtype, copy=True), sh),
-            master, self._param_sh)
+            make_param, master, self._param_sh, self._padinfo)
 
         if self.host_offload:
             # Device holds only compute params; masters/moments are host-
@@ -559,6 +639,16 @@ class DeepSpeedEngine:
                                        norm=grad_norm)
 
         masters = state.master if state.master is not None else state.params
+        # Ragged leaves: move grads into the flat-padded master layout so
+        # the elementwise update runs 1/dp-sharded (the constraint turns
+        # the grad all-reduce into reduce-scatter for these leaves too).
+        def grad_to_layout(g, info, sh):
+            if not info:
+                return g
+            return jax.lax.with_sharding_constraint(flat_pad(g, info), sh)
+
+        grads = jax.tree_util.tree_map(grad_to_layout, grads,
+                                       self._padinfo, self._master_sh)
         new_master, new_opt = self.optimizer.update(grads, state.opt_state,
                                                     masters, lr=lr)
 
@@ -579,9 +669,10 @@ class DeepSpeedEngine:
                 state.opt_state)
 
         new_params = jax.tree_util.tree_map(
-            lambda m, sh: jax.lax.with_sharding_constraint(
-                m.astype(self.compute_dtype), sh),
-            new_master, self._param_sh)
+            lambda m, sh, info: jax.lax.with_sharding_constraint(
+                (flat_unpad(m, info) if info else m).astype(
+                    self.compute_dtype), sh),
+            new_master, self._param_sh, self._padinfo)
 
         if self.dynamic_loss_scale():
             args = cfg.dynamic_loss_scale_args or {}
@@ -752,6 +843,7 @@ class DeepSpeedEngine:
                 coef = clip / (grad_norm + 1e-6)
                 flat_grads = [g * coef for g in flat_grads]
             lr = float(self.optimizer.param_groups[0]["lr"])
+            self._last_used_lr = lr
             use_bf16 = self.compute_dtype == jnp.bfloat16
             new_leaves = []
             # One optimizer step across all shards (bias correction).
@@ -881,9 +973,9 @@ class DeepSpeedEngine:
     def _current_lr(self):
         """Current LR as an explicitly-placed, mesh-replicated device
         scalar (see `_next_rng` on transfer discipline)."""
-        return jax.device_put(
-            np.float32(self.optimizer.param_groups[0]["lr"]),
-            self._replicated_sharding)
+        lr = float(self.optimizer.param_groups[0]["lr"])
+        self._last_used_lr = lr  # what THIS step runs with (monitor truth)
+        return jax.device_put(np.float32(lr), self._replicated_sharding)
 
     # ------------------------------------------------------------------
     # training API
@@ -922,13 +1014,15 @@ class DeepSpeedEngine:
             raise RuntimeError("backward() called before forward()")
         if self.wall_clock_breakdown():
             self.timers("backward").start()
-        _, grads = self._cached
+        fwd_loss, grads = self._cached
         self._cached = None
         if self._accum_grads is None:
             self._accum_grads = grads
+            self._accum_loss = fwd_loss
         else:
             self._accum_grads = jax.tree_util.tree_map(
                 lambda a, g: a + g, self._accum_grads, grads)
+            self._accum_loss = self._accum_loss + fwd_loss
         self._accum_count += 1
         self.micro_steps += 1
         if self.store_gradients:
@@ -950,7 +1044,9 @@ class DeepSpeedEngine:
             self.timers("step").start()
         grads = jax.tree_util.tree_map(
             lambda g: g / self._accum_count, self._accum_grads)
+        mean_loss = self._accum_loss / self._accum_count
         self._accum_grads = None
+        self._accum_loss = None
         self._accum_count = 0
         if self.host_offload:
             metrics = self._host_apply_update(grads)
@@ -960,6 +1056,9 @@ class DeepSpeedEngine:
             lr = self._current_lr()
             self.state, metrics = self._compiled_update(self.state, grads,
                                                         lr)
+        # _apply_update has no loss in scope; the monitor (and the caller)
+        # get the mean of the accumulated micro-batch losses.
+        metrics = metrics._replace(loss=mean_loss.astype(jnp.float32))
         self._after_step(metrics)
         if self.wall_clock_breakdown():
             self.timers("step").stop()
@@ -1049,6 +1148,32 @@ class DeepSpeedEngine:
             self._advance_host_schedules(taken=0)
         else:
             self._advance_host_schedules(taken=1)
+        if self.monitor is not None:
+            self._record_step_metrics(metrics)
+
+    def _record_step_metrics(self, metrics, sample_count=None):
+        """Queue one step's scalars on the monitor (values stay device
+        scalars until the buffered flush — no dispatch stall)."""
+        import time
+        # lr: the value the step actually ran with (_last_used_lr), not
+        # get_lr() — the scheduler has already advanced past this step.
+        lr = self._last_used_lr
+        scalars = {"Train/Samples/train_loss": metrics.loss,
+                   "Train/Samples/lr": lr if lr is not None
+                   else self.get_lr()[0]}
+        if self._config.loss_scaling_enabled:
+            scalars["Train/Samples/loss_scale"] = metrics.loss_scale
+        if self._monitor_wants_grad_norm or \
+                self._config.gradient_clipping > 0:
+            scalars["Train/Samples/grad_norm"] = metrics.grad_norm
+        now = time.monotonic()
+        if self._last_step_stamp is not None:
+            scalars["Train/Samples/step_time_ms"] = \
+                (now - self._last_step_stamp) * 1e3
+        self._last_step_stamp = now
+        self.monitor.record(
+            self.global_samples if sample_count is None else sample_count,
+            scalars)
 
     def _advance_host_schedules(self, taken, skipped=0):
         """Advance the host-side per-step machinery after `taken` device
@@ -1170,6 +1295,17 @@ class DeepSpeedEngine:
         else:
             taken = n_steps
         self._advance_host_schedules(taken=taken, skipped=n_steps - taken)
+        if self.monitor is not None:
+            # per-step losses from the window, keyed by sample count
+            # (approximate under skipped steps: losses of skipped steps
+            # still appear, at the surrounding sample counts)
+            bs = self.train_batch_size()
+            base = self.global_samples - bs * taken
+            lr = self._last_used_lr  # frozen lr the window ran with
+            for i in range(n_steps):
+                self.monitor.record(base + bs * (i + 1),
+                                    {"Train/Samples/train_loss": losses[i],
+                                     "Train/Samples/lr": lr})
         self.tput_timer.stop()
         return losses
 
@@ -1197,6 +1333,8 @@ class DeepSpeedEngine:
         mom = self.get_mom()
         log_dist(f"step={step}, skipped={self.skipped_steps}, lr={lr}, "
                  f"mom={mom}", ranks=[0])
+        if self.monitor is not None:
+            self.monitor.flush(drain=False)  # periodic: stay non-blocking
 
     def enable_gradient_noise_scale(self, n_batches=10, beta=0.99):
         self.gradient_noise_scale = GradientNoiseScale(
@@ -1232,6 +1370,61 @@ class DeepSpeedEngine:
         return _load(self, load_dir, tag=tag,
                      load_optimizer_states=load_optimizer_states,
                      load_lr_scheduler_states=load_lr_scheduler_states)
+
+    def gathered_parameters(self, modifier_rank=0):
+        """`zero.GatheredParameters` over the LIVE training state: yields
+        mutable full-precision host views of the params; on exit the
+        mutations are folded back into the sharded state — compute params
+        AND fp32 masters — so training continues from the edited weights
+        (reference `partition_parameters.py:1002` modifier_rank
+        semantics; the GPT-NeoX init pattern mutates under this context).
+        Optimizer moments are left untouched, as in the reference."""
+        from .zero.partition_parameters import GatheredParameters
+
+        if self.host_offload:
+            # fp32 masters live on the host (DRAM or NVMe) — gather THOSE,
+            # not the rounded compute params, or write-back would wipe
+            # sub-epsilon master precision for every leaf.
+            if self._host_swapper is not None:
+                flats = [self._host_swapper.load_group(i)["master"]
+                         for i in range(len(self._host_shapes))]
+            else:
+                flats = self._host_state["master"]
+            leaves = [np.asarray(f, np.float32).reshape(s)
+                      for f, s in zip(flats, self._host_shapes)]
+            natural = jax.tree_util.tree_unflatten(self._host_treedef,
+                                                   leaves)
+        elif self.state.master is not None:
+            natural = self.layout_to_natural(self.state.master)
+        else:
+            natural = self.state.params
+
+        def write_back(view):
+            new_master = self.state.master
+            if new_master is not None:
+                new_master = self.natural_to_layout(view, new_master)
+            if self.host_offload:
+                # host-resident fp32 masters (DRAM or NVMe groups)
+                leaves = jax.tree_util.tree_leaves(view)
+                if self._host_swapper is not None:
+                    for i, leaf in enumerate(leaves):
+                        group = self._host_swapper.load_group(i)
+                        group["master"][:] = np.ravel(
+                            np.asarray(leaf, np.float32))
+                        self._host_swapper.initialize_group(i, group)
+                else:
+                    for i, leaf in enumerate(leaves):
+                        self._host_state["master"][i][:] = np.ravel(
+                            np.asarray(leaf, np.float32))
+            new_params = jax.tree_util.tree_map(
+                lambda v, p, sh: jax.device_put(
+                    jnp.asarray(v, self.compute_dtype), sh),
+                view, self.state.params, self._param_sh)
+            self.state = self.state._replace(params=new_params,
+                                             master=new_master)
+
+        return GatheredParameters(natural, modifier_rank=modifier_rank,
+                                  on_exit=write_back)
 
     def _zero3_consolidated_fp16_state_dict(self):
         """Gather ZeRO-3-sharded params into one host state dict in the

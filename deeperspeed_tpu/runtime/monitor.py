@@ -1,0 +1,125 @@
+"""Training-metrics event writer (reference: `deepspeed/runtime/engine.py:
+163-164,1222-1275` — tensorboardX SummaryWriter logging train loss, lr,
+loss scale, and step times, keyed by global SAMPLE count).
+
+TPU-specific design: the jitted step returns metrics as device scalars and
+a per-step `device_get` would stall the async dispatch pipeline (host reads
+serialize XLA launches). The monitor therefore *buffers* the device scalars
+— they are already materialized by the time anyone reads them — and drains
+them to the event file every `flush_interval` steps, so steady-state
+training never blocks on the writer.
+
+Backends: tensorboardX when importable (real event files, same as the
+reference), else a TSV file with the same tag/value/sample rows — the data
+is never silently dropped.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+
+from ..utils.logging import log_dist, logger
+
+try:
+    from tensorboardX import SummaryWriter as _TBWriter
+    _HAVE_TB = True
+except Exception:  # pragma: no cover
+    _TBWriter = None
+    _HAVE_TB = False
+
+
+class _TSVWriter:
+    """Fallback event writer: one `events.tsv` of (tag, sample, value)."""
+
+    def __init__(self, log_dir):
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = open(os.path.join(log_dir, "events.tsv"), "a")
+        if self._f.tell() == 0:  # header only for a fresh file
+            self._f.write("tag\tsample\tvalue\n")
+
+    def add_scalar(self, tag, value, global_step):
+        self._f.write(f"{tag}\t{global_step}\t{value}\n")
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class TensorBoardMonitor:
+    """Reference-layout event stream: `Train/Samples/<metric>` scalars
+    keyed by global sample count (reference `engine.py:1222-1275`)."""
+
+    def __init__(self, output_path="", job_name="DeepSpeedJobName",
+                 flush_interval=10, rank=None):
+        rank = jax.process_index() if rank is None else rank
+        self.enabled = rank == 0
+        self._pending = []          # (sample_count, {tag: device-or-float})
+        self.flush_interval = max(1, int(flush_interval))
+        self.writer = None
+        if not self.enabled:
+            return
+        log_dir = os.path.join(output_path or os.getcwd(), job_name)
+        if _HAVE_TB:
+            self.writer = _TBWriter(log_dir=log_dir)
+        else:  # pragma: no cover
+            self.writer = _TSVWriter(log_dir)
+            logger.warning("tensorboardX unavailable; writing TSV events "
+                           f"to {log_dir}/events.tsv")
+        log_dist(f"Monitor: writing events to {log_dir}", ranks=[0])
+
+    def record(self, sample_count, scalars):
+        """Queue `{tag: value}` at `sample_count`; values may be device
+        scalars (fetched lazily at flush — no dispatch stall)."""
+        if not self.enabled:
+            return
+        self._pending.append((int(sample_count), dict(scalars)))
+        if len(self._pending) >= self.flush_interval:
+            # periodic flush: hand events to the writer thread but do NOT
+            # drain it — draining blocks the training loop on telemetry
+            self.flush(drain=False)
+
+    def flush(self, drain=True):
+        """Write pending scalars. `drain=True` (explicit/user flush) also
+        waits for the writer thread so events are durable for readers;
+        the periodic auto-flush passes drain=False to stay non-blocking."""
+        if not self.enabled or not self._pending:
+            return
+        for sample_count, scalars in self._pending:
+            for tag, value in scalars.items():
+                self.writer.add_scalar(tag, float(np.asarray(value)),
+                                       sample_count)
+        self._pending.clear()
+        if drain:
+            self._drain_writer_queue()
+        self.writer.flush()
+
+    def _drain_writer_queue(self):
+        """tensorboardX queues events to a worker thread and its flush()
+        does NOT drain the queue — without this, events recorded just
+        before flush can be invisible to readers until close()."""
+        import time
+        fw = getattr(self.writer, "file_writer", None)
+        ew = getattr(fw, "event_writer", None) if fw is not None else None
+        q = getattr(ew, "_event_queue", None) if ew is not None else None
+        if q is None:
+            return
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                if q.empty():
+                    break
+            except (OSError, ValueError):  # pragma: no cover - closed queue
+                break
+            time.sleep(0.005)
+        # the worker may still be mid-write on the last event it popped
+        time.sleep(0.02)
+
+    def close(self):
+        if self.writer is not None:
+            self.flush()
+            self.writer.close()
+            self.writer = None

@@ -20,8 +20,6 @@ threshold stay replicated (the reference keeps them persisted to avoid
 latency-bound gathers — same trade-off).
 """
 
-import contextlib
-
 import numpy as np
 
 import jax
@@ -34,21 +32,74 @@ from ..config_utils import DeepSpeedConfigError
 
 def _shardable_dim(shape, world, threshold_numel=0):
     """Pick the dimension to shard over the data axis: the largest dim
-    that divides evenly by `world`; None (replicate) for scalars, params
-    under the persistence threshold, or shapes with no evenly-divisible
-    dim. Large ragged params (rare: vocabs are conventionally padded to
-    the dp world, e.g. 50304) currently forfeit sharding — a
-    pad-the-master scheme could lift that."""
+    that divides evenly by `world`; None for scalars, params under the
+    persistence threshold, or shapes with no evenly-divisible dim. Ragged
+    shapes do NOT forfeit sharding: callers route them through
+    `master_pad_info` (pad-the-master, the reference's flatten-and-
+    partition `stage2.py:196-374` done as a padded flat shard)."""
     numel = int(np.prod(shape)) if shape else 1
     if not shape or numel < max(threshold_numel, world):
         return None
     divisible = [d for d in range(len(shape)) if shape[d] % world == 0]
     if divisible:
         return max(divisible, key=lambda d: shape[d])
-    # No dim divides the dp world (e.g. a 10-class head over 8 ranks):
-    # replicate. `device_put` with a NamedSharding requires even shards —
-    # GSPMD's padding only applies to in-program sharding constraints.
+    # No dim divides the dp world (e.g. an unpadded 50257 vocab over 8
+    # ranks): `device_put` with a NamedSharding requires even shards, so
+    # dim-sharding is out — the flat-pad layout below handles these.
     return None
+
+
+class FlatPad:
+    """Descriptor for a leaf stored flat-padded: `shape` is the natural
+    (compute) shape, `numel` its true size, `padded` the dp-divisible
+    length of the stored 1-D master/moment buffer. Deliberately NOT a
+    NamedTuple: it must be an opaque pytree leaf, not a container."""
+
+    __slots__ = ("shape", "numel", "padded")
+
+    def __init__(self, shape, numel, padded):
+        self.shape = tuple(shape)
+        self.numel = numel
+        self.padded = padded
+
+    def __repr__(self):
+        return (f"FlatPad(shape={self.shape}, numel={self.numel}, "
+                f"padded={self.padded})")
+
+
+def flat_pad(arr, info):
+    """Natural-shaped array → padded flat buffer (zero-padded tail). Works
+    on jnp (traced or not) and numpy arrays alike."""
+    flat = jnp.ravel(arr)
+    return jnp.pad(flat, (0, info.padded - info.numel))
+
+
+def flat_unpad(flat, info):
+    """Padded flat buffer → natural-shaped array."""
+    return flat[:info.numel].reshape(info.shape)
+
+
+def map_master_fields(opt_state, master_def, fn, *rest, passthrough=None):
+    """Rebuild an optimizer-state NamedTuple, applying `fn(field, *extras)`
+    to fields whose pytree structure mirrors the master params
+    (moments), and `passthrough` (default: identity on the first item) to
+    the rest (e.g. the scalar step counter). `rest` are parallel
+    opt-state-like containers zipped field-wise into fn/passthrough —
+    used to pair a natural-shaped tree with its layout template."""
+    fields = []
+    for items in zip(opt_state, *rest):
+        field = items[0]
+        try:
+            mirrors = jax.tree_util.tree_structure(field) == master_def
+        except Exception:
+            mirrors = False
+        if mirrors:
+            fields.append(fn(*items))
+        elif passthrough is not None:
+            fields.append(passthrough(*items))
+        else:
+            fields.append(field)
+    return type(opt_state)(*fields)
 
 
 class ZeroShardingRules:
@@ -99,6 +150,33 @@ class ZeroShardingRules:
         if self.stage >= 1:
             return self._spec(shape, base=base)
         return PartitionSpec(*base) if base is not None else PartitionSpec()
+
+    def master_pad_info(self, shape, base=None):
+        """`FlatPad` descriptor when the leaf's master/moments must be
+        stored flat-padded to get sharded at all: stage >= 1, a data axis
+        with world > 1, the leaf is at least world-sized, no tensor-
+        parallel base sharding, and no natural dim divides the dp world.
+        Returns None when normal dim-sharding (or replication of tiny
+        leaves) applies. This is the reference's pad-and-flatten
+        partitioning (`stage2.py:196-374`, `stage1.py:328-465`): every
+        large param gets 1/world of its fp32 state per rank, vocab-50257
+        included."""
+        if self.stage < 1 or self.data_axis is None or self.dp_world == 1:
+            return None
+        if base is not None and any(a is not None for a in base):
+            return None  # TP-sharded leaves keep their dim layout
+        numel = int(np.prod(shape)) if shape else 1
+        if not shape or numel < self.dp_world:
+            return None
+        if self.data_axis in self._spec(shape):
+            return None  # a natural dim shards evenly
+        world = self.dp_world
+        padded = -(-numel // world) * world
+        return FlatPad(tuple(shape), numel, padded)
+
+    def flat_master_sharding(self):
+        """Sharding of a flat-padded master/moment buffer."""
+        return NamedSharding(self.mesh, PartitionSpec(self.data_axis))
 
     def grad_spec(self, shape, base=None):
         """Gradients: reduce-scattered from stage 2."""
@@ -198,19 +276,65 @@ def current_init_context():
     return _CURRENT_INIT
 
 
-@contextlib.contextmanager
-def GatheredParameters(params, modifier_rank=None, fwd_module=None,
-                       enabled=True):
-    """Yield fully-replicated host-side views of (possibly sharded) params
-    (reference `partition_parameters.py:1002`). Mutations inside the
-    context are NOT written back automatically (JAX arrays are immutable);
-    use the yielded list's `.result()`-style replacement instead."""
-    if not enabled:
-        yield params
-        return
-    gathered = jax.tree_util.tree_map(lambda p: np.asarray(jax.device_get(p)),
-                                      params)
-    yield gathered
+class GatheredParameters:
+    """Context manager yielding fully-gathered MUTABLE host views of
+    (possibly sharded) params, with write-back on exit (reference
+    `partition_parameters.py:1002`).
+
+    Reference semantics: under ``modifier_rank=r``, code inside the
+    context mutates the gathered params and on exit rank r's values are
+    scattered back to the partitioned storage. Here (single-controller
+    SPMD — every process traces the same program) ``modifier_rank`` not
+    None simply enables write-back: the yielded numpy arrays are
+    re-placed with each param's original sharding/dtype on exit, and the
+    result is available as ``.updated`` (JAX arrays are immutable, so the
+    caller swaps the tree rather than relying on aliasing)::
+
+        gp = GatheredParameters(params, modifier_rank=0)
+        with gp as full:
+            full["w"][:2] = 0.0
+        params = gp.updated
+
+    With ``modifier_rank=None`` (read-only gather, the reference default)
+    mutations are discarded, as in the reference. Engines wire an
+    ``on_exit`` callback to fold mutations into live training state — see
+    `DeepSpeedEngine.gathered_parameters`.
+    """
+
+    def __init__(self, params, modifier_rank=None, fwd_module=None,
+                 enabled=True, on_exit=None):
+        self.params = params
+        self.modifier_rank = modifier_rank
+        self.enabled = enabled
+        self.updated = None
+        self._on_exit = on_exit
+        self._view = None
+
+    def __enter__(self):
+        if not self.enabled:
+            self._view = self.params
+            return self.params
+        # np.array (not asarray): a mutable copy, never a read-only view
+        self._view = jax.tree_util.tree_map(
+            lambda p: np.array(jax.device_get(p)), self.params)
+        return self._view
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None or not self.enabled:
+            return False
+        if self.modifier_rank is not None:
+            if self._on_exit is not None:
+                # the callback owns the write-back; don't also materialize
+                # .updated (a second full-model host→device copy)
+                self._on_exit(self._view)
+            else:
+                self.updated = jax.tree_util.tree_map(
+                    lambda v, p: jax.device_put(
+                        jnp.asarray(v, p.dtype),
+                        getattr(p, "sharding", None))
+                    if hasattr(p, "sharding") else jnp.asarray(v, p.dtype),
+                    self._view, self.params)
+        return False
 
 
 # External-parameter registry (reference `partition_parameters.py:56`): in

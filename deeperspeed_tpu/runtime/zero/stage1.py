@@ -28,7 +28,8 @@ from ...parallel.mesh import DATA_AXIS
 from ..utils import clip_grad_norm_, global_norm
 from ..fp16.loss_scaler import (LossScaleState, grads_finite,
                                 init_loss_scale_state, update_loss_scale)
-from .partition_parameters import ZeroShardingRules
+from .partition_parameters import (ZeroShardingRules, flat_pad, flat_unpad,
+                                   map_master_fields)
 
 
 # ---------------------------------------------------------------------------
@@ -151,11 +152,21 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
     # -- placement ---------------------------------------------------------
 
     def init_state(self, params):
-        master = jax.tree_util.tree_map(
-            lambda p: jax.device_put(
-                jnp.asarray(p, jnp.float32),
-                NamedSharding(self.mesh, self.rules.master_spec(p.shape))),
-            params)
+        # Ragged leaves (no dp-divisible dim) store master/moments as
+        # padded flat 1-D shards — the reference's pad-and-flatten
+        # partitioning (`stage1.py:328-465`); see `FlatPad`.
+        self._padinfo = jax.tree_util.tree_map(
+            lambda p: self.rules.master_pad_info(p.shape) or False, params)
+
+        def make_master(p, info):
+            m = jnp.asarray(p, jnp.float32)
+            if info:
+                return jax.device_put(flat_pad(m, info),
+                                      self.rules.flat_master_sharding())
+            return jax.device_put(
+                m, NamedSharding(self.mesh, self.rules.master_spec(p.shape)))
+
+        master = jax.tree_util.tree_map(make_master, params, self._padinfo)
         compute = jax.tree_util.tree_map(
             lambda p: jax.device_put(
                 jnp.asarray(p, self.precision),
@@ -197,6 +208,13 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
         if self.stage >= 2:
             grads = self.rules.constrain_grads(grads)
 
+        # Move ragged-leaf grads into the flat-padded master layout.
+        grads = jax.tree_util.tree_map(
+            lambda g, info: jax.lax.with_sharding_constraint(
+                flat_pad(g, info), self.rules.flat_master_sharding())
+            if info else g,
+            grads, self._padinfo)
+
         new_master, new_opt = self.optimizer.update(
             grads, state.opt_state, state.master, lr=lr)
 
@@ -205,10 +223,10 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
         new_opt = jax.tree_util.tree_map(
             lambda n, o: jnp.where(overflow, o, n), new_opt, state.opt_state)
         new_params = jax.tree_util.tree_map(
-            lambda p, m: jax.lax.with_sharding_constraint(
-                m.astype(p.dtype),
+            lambda p, m, info: jax.lax.with_sharding_constraint(
+                (flat_unpad(m, info) if info else m).astype(p.dtype),
                 NamedSharding(self.mesh, self.rules.param_spec(p.shape))),
-            state.params, new_master)
+            state.params, new_master, self._padinfo)
 
         if self.dynamic:
             new_scale = update_loss_scale(
@@ -225,12 +243,37 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
 
     # -- checkpoint surface (elastic; reference stage1 state-dict machinery)
 
+    def _opt_to_natural(self, opt_state):
+        master_def = jax.tree_util.tree_structure(self._padinfo)
+        return map_master_fields(
+            opt_state, master_def, lambda t: jax.tree_util.tree_map(
+                lambda x, i: np.asarray(flat_unpad(x, i) if i else x),
+                t, self._padinfo))
+
+    def _opt_to_layout(self, opt_state, like):
+        master_def = jax.tree_util.tree_structure(self._padinfo)
+
+        def relayout(t, cur):
+            return jax.tree_util.tree_map(
+                lambda x, i, c: jax.device_put(
+                    flat_pad(jnp.asarray(x, jnp.float32), i) if i
+                    else jnp.asarray(x), c.sharding),
+                t, self._padinfo, cur)
+
+        return map_master_fields(opt_state, master_def, relayout, like,
+                                 passthrough=lambda n, c: jnp.asarray(n))
+
     def state_dict(self, state):
         """Per-dp-rank flat sub-partitions of master+moments, so a restart
         at a different world size can merge + re-slice (the checkpoint
         layer does the same for the engine path)."""
+        # Unpad flat-padded leaves first: the padded length depends on the
+        # dp world, and this state_dict must merge across world sizes.
+        info_leaves = jax.tree_util.tree_leaves(self._padinfo)
         flat_master = jnp.concatenate(
-            [jnp.ravel(l) for l in jax.tree_util.tree_leaves(state.master)])
+            [jnp.ravel(flat_unpad(l, i) if i else l)
+             for l, i in zip(jax.tree_util.tree_leaves(state.master),
+                             info_leaves)])
         sub_parts = flat_sub_partitions(np.asarray(flat_master),
                                         self.dp_world)
         return {
@@ -241,7 +284,7 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
             "local_sub_partitions_of_fp32_groups":
                 [[np.asarray(p) for p in parts] for parts in sub_parts],
             "optimizer_state_dict": self.optimizer.state_dict(
-                state.opt_state),
+                self._opt_to_natural(state.opt_state)),
         }
 
     def load_state_dict(self, state, sd, load_optimizer_states=True):
@@ -256,27 +299,28 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
         flat = np.concatenate([np.asarray(p).ravel() for p in ordered])
 
         leaves = jax.tree_util.tree_leaves(state.master)
+        info_leaves = jax.tree_util.tree_leaves(self._padinfo)
         new_leaves, off = [], 0
-        for leaf in leaves:
-            n = int(np.prod(leaf.shape)) if leaf.shape else 1
-            new_leaves.append(
-                jax.device_put(jnp.asarray(flat[off:off + n],
-                                           jnp.float32).reshape(leaf.shape),
-                               leaf.sharding))
+        for leaf, info in zip(leaves, info_leaves):
+            n = info.numel if info else (
+                int(np.prod(leaf.shape)) if leaf.shape else 1)
+            piece = jnp.asarray(flat[off:off + n], jnp.float32)
+            piece = flat_pad(piece, info) if info else piece.reshape(
+                leaf.shape)
+            new_leaves.append(jax.device_put(piece, leaf.sharding))
             off += n
         master = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(state.master), new_leaves)
         params = jax.tree_util.tree_map(
-            lambda p, m: jax.device_put(m.astype(p.dtype), p.sharding),
-            state.params, master)
+            lambda p, m, info: jax.device_put(
+                (flat_unpad(m, info) if info else m).astype(p.dtype),
+                p.sharding),
+            state.params, master, self._padinfo)
         opt_state = state.opt_state
         if load_optimizer_states and "optimizer_state_dict" in sd:
-            opt_state = self.optimizer.load_state_dict(
-                sd["optimizer_state_dict"])
-            opt_state = jax.tree_util.tree_map(
-                lambda n, o: jax.device_put(jnp.asarray(n), o.sharding)
-                if getattr(o, "ndim", 0) > 0 else jnp.asarray(n),
-                opt_state, state.opt_state)
+            opt_state = self._opt_to_layout(
+                self.optimizer.load_state_dict(sd["optimizer_state_dict"]),
+                state.opt_state)
         scale = state.scale._replace(
             cur_scale=jnp.asarray(sd["cur_scale"], jnp.float32),
             cur_iter=jnp.asarray(sd["cur_iter"], jnp.int32))

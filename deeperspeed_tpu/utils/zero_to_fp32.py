@@ -77,13 +77,19 @@ def get_zero_files(checkpoint_dir, mp_rank=0):
     return sorted(files, key=dp_rank)
 
 
-def _merge_sliced(per_rank, dims, saved_dp):
-    """Merge per-dp-rank {path: slice} dicts into full arrays."""
+def _merge_sliced(per_rank, dims, saved_dp, flat_shapes=None):
+    """Merge per-dp-rank {path: slice} dicts into full arrays. A dim of
+    "flat" marks a ragged leaf saved as rank slices of its raveled
+    natural array; `flat_shapes[key]` restores the natural shape."""
     merged = {}
     for key in per_rank[0]:
         dim = dims.get(key) if dims else None
         if dim is None or saved_dp == 1:
             merged[key] = np.asarray(per_rank[0][key])
+        elif dim == "flat":
+            flat = np.concatenate(
+                [np.asarray(r[key]).ravel() for r in per_rank])
+            merged[key] = flat.reshape((flat_shapes or {})[key])
         else:
             merged[key] = np.concatenate(
                 [np.asarray(r[key]) for r in per_rank], axis=dim)
@@ -109,7 +115,9 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, mp_rank=0):
         if shards[0].get("fp32_master") is not None:
             masters = [s["fp32_master"] for s in shards]
             dims = shards[0].get("fp32_master_dims", {}) or {}
-            merged = _merge_sliced(masters, dims, saved_dp)
+            merged = _merge_sliced(
+                masters, dims, saved_dp,
+                shards[0].get("fp32_master_flat_shapes"))
             return {k: np.asarray(v, np.float32) for k, v in merged.items()}
         osd = shards[0].get("optimizer_state_dict", {})
         if osd.get("host_offload"):

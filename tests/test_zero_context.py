@@ -78,3 +78,98 @@ def test_external_parameter_registry():
     module, param = object(), object()
     register_external_parameter(module, param)
     unregister_external_parameter(module, param)
+
+
+def test_gathered_parameters_write_back():
+    """modifier_rank semantics (reference partition_parameters.py:1002):
+    mutations under the context survive, re-placed with the original
+    shardings."""
+    mesh = data_mesh()
+    with zero.Init(mesh=mesh, stage=3, param_persistence_threshold=0) as ctx:
+        params = ctx.materialize(init_fn, jax.random.PRNGKey(0))
+    gp = zero.GatheredParameters(params, modifier_rank=0)
+    with gp as full:
+        full["w"][0, :] = 7.0
+    assert gp.updated is not None
+    w = gp.updated["w"]
+    assert w.sharding == params["w"].sharding  # stays ZeRO-3 sharded
+    np.testing.assert_allclose(np.asarray(w)[0], 7.0)
+    np.testing.assert_allclose(np.asarray(w)[1:],
+                               np.asarray(params["w"])[1:], rtol=1e-6)
+
+
+def test_gathered_parameters_read_only_drops_mutations():
+    params = {"w": jnp.ones((8, 8))}
+    gp = zero.GatheredParameters(params)  # modifier_rank=None
+    with gp as full:
+        full["w"][:] = 5.0
+    assert gp.updated is None
+    np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+
+
+def test_engine_gathered_parameters_updates_training_state():
+    """Mutating under engine.gathered_parameters edits the LIVE sharded
+    state: compute params AND fp32 masters, so the next step trains from
+    the edited weights."""
+    import deeperspeed_tpu
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        return (((x @ params["w"]).sum(-1) - y) ** 2).mean()
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 24)) * 0.1}
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config_params={"train_batch_size": 16,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                       "zero_optimization": {"stage": 2},
+                       "steps_per_print": 1000})
+    with engine.gathered_parameters(modifier_rank=0) as full:
+        full["w"][:, 0] = 3.25
+    np.testing.assert_allclose(np.asarray(engine.state.params["w"])[:, 0],
+                               3.25)
+    master_nat = engine.layout_to_natural(engine.state.master)
+    np.testing.assert_allclose(np.asarray(master_nat["w"])[:, 0], 3.25)
+
+    # and training proceeds from the edited weights (master drives params)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1, 16, 8)).astype(np.float32)
+    y = rng.normal(size=(1, 16)).astype(np.float32)
+    engine.train_batch(batch=(x, y))
+    w_after = np.asarray(engine.state.params["w"])
+    assert np.allclose(w_after[:, 0], 3.25, atol=0.01)  # moved by ~lr only
+
+
+def test_engine_gathered_parameters_host_offload_masters():
+    """With ZeRO-Offload the gather must read/write the host fp32 masters
+    — NOT round everything through the compute dtype."""
+    import deeperspeed_tpu
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        return (((x @ params["w"]).sum(-1) - y) ** 2).mean()
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 24)) * 0.1}
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config_params={"train_batch_size": 16,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                       "fp16": {"enabled": True, "type": "bfloat16"},
+                       "zero_optimization": {
+                           "stage": 2,
+                           "offload_optimizer": {"device": "cpu"}},
+                       "steps_per_print": 1000})
+    # plant a value NOT representable in bf16; an untouched leaf's master
+    # must keep full fp32 precision through the context
+    probe = np.float32(0.1000123)
+    engine._host_state["master"][0][0] = probe
+    with engine.gathered_parameters(modifier_rank=0) as full:
+        assert full["w"].dtype == np.float32
+        assert full["w"].ravel()[0] == probe  # gathered FROM host masters
+        full["w"][0, 1] = 0.5
+    assert engine._host_state["master"][0][0] == probe  # precision kept
+    assert engine._host_state["master"][0][engine._host_shapes[0][1]
+                                           * 0 + 1] == np.float32(0.5)
+    np.testing.assert_allclose(
+        np.asarray(engine.state.params["w"], np.float32)[0, 1], 0.5,
+        rtol=1e-2)
