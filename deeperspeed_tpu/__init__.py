@@ -62,6 +62,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                                 collate_fn=collate_fn,
                                 config=config,
                                 config_params=config_params,
+                                mesh=mesh,
                                 rng=rng)
     else:
         engine = DeepSpeedEngine(args=args,

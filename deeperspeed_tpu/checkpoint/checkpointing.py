@@ -237,8 +237,15 @@ def _load_host_offload_checkpoint(engine, shard):
                 i, {"master": mast, "exp_avg": m, "exp_avg_sq": v})
     else:
         engine._host_state = {"master": masters, "m": ms, "v": vs}
-    # Rebuild device params from the restored masters.
+    # Rebuild compute params from the restored masters: into the host/
+    # NVMe store under param offload, onto the device otherwise.
     import jax.numpy as jnp
+    if getattr(engine, "param_offload", False):
+        for host_leaf, m in zip(engine._host_param_leaves, masters):
+            flat = host_leaf.reshape(-1)
+            flat[:] = np.asarray(m, np.float32).astype(flat.dtype)
+        engine._coord.publish_host_update()
+        return engine.state.params
     leaves = [jnp.asarray(m.reshape(s), engine.compute_dtype)
               for m, s in zip(masters, engine._host_shapes)]
     params = jax.tree_util.tree_unflatten(engine._host_treedef, leaves)

@@ -469,6 +469,54 @@ class GPTNeoX:
                         temperature=temperature, rng=rng,
                         use_pallas=self.use_pallas)
 
+    # -- ZeRO-Infinity parameter offload (layer streaming) ----------------
+
+    def stream_plan(self):
+        """`StreamPlan` decomposition for the engine's param-offload
+        executor (reference `zero/stage3.py:916-935` NVMe param path):
+        embed → N uniform blocks (one shared compilation) → LM head. The
+        tied embedding appears in both the embed and head segments; the
+        stream executor sums their gradients by shared leaf index."""
+        from ..runtime.zero.param_offload import StreamPlan
+
+        cfg = self.config
+        use_pallas = self.use_pallas
+
+        def tok_lab(batch):
+            if isinstance(batch, (tuple, list)):
+                return batch[0], batch[1]
+            return batch, batch
+
+        def embed_fwd(sp, carry, batch, rng):
+            tokens, _ = tok_lab(batch)
+            return sp["wte"][tokens]
+
+        def block_fwd(sp, carry, batch, rng):
+            tokens, _ = tok_lab(batch)
+            cos_sin = _rotary_cache(cfg, tokens.shape[-1])
+            return block_forward(cfg, sp, carry, cos_sin,
+                                 use_pallas=use_pallas)
+
+        def head_fwd(sp, carry, batch, rng):
+            _, labels = tok_lab(batch)
+            x = layer_norm(carry, sp["final_ln"]["scale"],
+                           sp["final_ln"]["bias"], cfg.layernorm_eps)
+            return fused_lm_head_loss(x, sp["wte"], labels)
+
+        segments = [("embed", lambda p: {"wte": p["embed"]["wte"]})]
+        forward = {"embed": embed_fwd, "head": head_fwd}
+        kinds = {}
+        for i in range(cfg.num_layers):
+            name = f"block_{i}"
+            segments.append((name, (lambda j: lambda p: p["blocks"][j])(i)))
+            forward[name] = block_fwd
+            kinds[name] = "block"
+        segments.append((
+            "head",
+            lambda p: {"final_ln": p["final_ln"],
+                       "wte": p.get("embed_out", p["embed"])["wte"]}))
+        return StreamPlan(segments, forward, kinds)
+
     # -- layer-activation capture (engine.set_layers_to_hook) ------------
 
     def layer_names(self):

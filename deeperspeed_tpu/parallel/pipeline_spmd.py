@@ -24,6 +24,8 @@ model.
 
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import shard_map
@@ -165,6 +167,215 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
             check_vma=False)
         return mapped(params["blocks"], params["embed"], params["head"],
                       tokens, labels)
+
+    return loss_fn
+
+
+def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
+                            data_axis=None, fp32_comm=None, remat=True):
+    """Lower an arbitrary `PipelineModule` (heterogeneous LayerSpec list)
+    onto the SPMD ppermute executor (reference `pipe/engine.py:654-1139`
+    executes any layer list across stages; here the whole 1F1B batch is
+    one shard_map program over the ``pipe`` mesh axis).
+
+    SPMD needs every stage to run the same program with uniform shapes,
+    but heterogeneous stages have different activation shapes and param
+    structures. Both are made uniform by FLATTENING:
+
+    - inter-stage activations travel as one padded flat buffer sized to
+      the largest boundary activation; each stage's `lax.switch` branch
+      reshapes its statically-known input shape out of the buffer and
+      flattens its output back in;
+    - per-stage params are packed into a [n_stages, P_max] row matrix
+      sharded over ``pipe`` (each stage materializes only its row — the
+      reference's "build only local layers", `module.py:358`); branches
+      unpack their row into the layer subtrees.
+
+    Tied subtrees stay replicated over ``pipe`` and their gradient psum
+    falls out of the shard_map transpose — the reference's
+    `allreduce_tied_weight_gradients`.
+
+    Returns ``loss_fn(params, batch, rng)`` over the FULL effective batch
+    (the batch splits into `n_micro` pipeline micro-batches internally).
+    """
+    from ..runtime.pipe import p2p
+
+    n_stages = int(mesh.shape[axis_name])
+    if module.num_stages != n_stages:
+        raise ValueError(
+            f"module has {module.num_stages} stages but mesh axis "
+            f"{axis_name!r} has {n_stages}")
+    parts = module.parts
+    dp_active = (data_axis is not None and data_axis in mesh.axis_names
+                 and int(mesh.shape[data_axis]) > 1)
+
+    def stage_param_leaves(params, s):
+        """Non-tied leaves of stage s, in deterministic order."""
+        leaves = []
+        for idx in range(parts[s], parts[s + 1]):
+            if module._tied_keys_per_layer[idx] is None:
+                leaves.extend(
+                    jax.tree_util.tree_leaves(params["layers"][idx]))
+        return leaves
+
+    def loss_fn(params, batch, rng=None):
+        inputs, labels = batch
+        b = inputs.shape[0]
+        if b % n_micro != 0:
+            raise ValueError(
+                f"batch {b} must split into n_micro={n_micro}")
+        mb = b // n_micro
+        in_micro = inputs.reshape((n_micro, mb) + inputs.shape[1:])
+        lab_micro = labels.reshape((n_micro, mb) + labels.shape[1:])
+
+        # --- static per-stage activation shapes (per-dp-shard sizes) ----
+        dp_size = int(mesh.shape[data_axis]) if dp_active else 1
+        if mb % dp_size != 0:
+            raise ValueError(
+                f"micro-batch {mb} must divide over data axis {dp_size}")
+        mb_local = mb // dp_size
+        stage_in, stage_out = [], []
+        cur = jax.ShapeDtypeStruct((mb_local,) + inputs.shape[1:],
+                                   inputs.dtype)
+        for s in range(n_stages):
+            stage_in.append(cur)
+            cur = jax.eval_shape(
+                lambda p, xx, s=s: module.forward_range(
+                    p, xx, parts[s], parts[s + 1]), params, cur)
+            stage_out.append(cur)
+        act_dtype = stage_in[0].dtype
+        for sd in stage_in + stage_out:
+            if sd.dtype != act_dtype:
+                raise ValueError(
+                    "pipelined stages must share one activation dtype; "
+                    f"got {sd.dtype} vs {act_dtype}")
+
+        def numel(sd):
+            return int(np.prod(sd.shape))
+
+        A = max(numel(sd) for sd in stage_in + stage_out)
+
+        # --- pack per-stage params into [n_stages, P_max] ----------------
+        leaves_by_stage = [stage_param_leaves(params, s)
+                           for s in range(n_stages)]
+        sizes = [sum(int(np.prod(l.shape)) for l in ls)
+                 for ls in leaves_by_stage]
+        p_dtypes = {l.dtype for ls in leaves_by_stage for l in ls}
+        if len(p_dtypes) > 1:
+            raise ValueError(
+                f"pipelined stage params must share one dtype; {p_dtypes}")
+        p_dtype = p_dtypes.pop() if p_dtypes else jnp.float32
+        P_max = max(max(sizes), 1)
+        rows = []
+        for ls, sz in zip(leaves_by_stage, sizes):
+            flat = (jnp.concatenate([jnp.ravel(l) for l in ls])
+                    if ls else jnp.zeros((0,), p_dtype))
+            rows.append(jnp.pad(flat, (0, P_max - sz)))
+        packed = jax.lax.with_sharding_constraint(
+            jnp.stack(rows),
+            jax.sharding.NamedSharding(mesh, P(axis_name, None)))
+
+        tied = params["tied"]
+
+        # --- per-stage branch: flat buf -> flat buf ----------------------
+        def make_branch(s):
+            in_sd, out_sd = stage_in[s], stage_out[s]
+
+            def branch(row, tied, buf, mb_rng):
+                x = buf[:numel(in_sd)].reshape(in_sd.shape)
+                # rebuild this stage's layer params from the flat row
+                layers = [{} for _ in range(len(module.layers))]
+                off = 0
+                for idx in range(parts[s], parts[s + 1]):
+                    if module._tied_keys_per_layer[idx] is not None:
+                        continue
+                    tmpl = params["layers"][idx]
+                    lvs, tdef = jax.tree_util.tree_flatten(tmpl)
+                    rebuilt = []
+                    for l in lvs:
+                        n = int(np.prod(l.shape))
+                        rebuilt.append(
+                            row[off:off + n].reshape(l.shape))
+                        off += n
+                    layers[idx] = jax.tree_util.tree_unflatten(tdef,
+                                                               rebuilt)
+                pseudo = {"layers": layers, "tied": tied}
+                y = module.forward_range(pseudo, x, parts[s],
+                                         parts[s + 1], rng=mb_rng)
+                return jnp.pad(jnp.ravel(y), (0, A - numel(out_sd)))
+
+            return branch
+
+        branches = [make_branch(s) for s in range(n_stages)]
+
+        # --- shard_map body: fill/steady/drain scan ----------------------
+        def inner(packed_local, tied, in_micro, lab_micro, rng):
+            stage = jax.lax.axis_index(axis_name)
+            row = packed_local[0]
+
+            def apply_stage(buf, mb_rng):
+                fns = [(lambda b, r, s=s: branches[s](row, tied, b, r))
+                       for s in range(n_stages)]
+                return jax.lax.switch(stage, fns, buf, mb_rng)
+
+            body = jax.checkpoint(apply_stage) if remat else apply_stage
+
+            flat_in = jax.vmap(
+                lambda x: jnp.pad(jnp.ravel(x).astype(act_dtype),
+                                  (0, A - numel(stage_in[0]))))(in_micro)
+
+            total_ticks = n_micro + n_stages - 1
+
+            def tick(carry, t):
+                buf, outputs = carry
+                idx = jnp.clip(t, 0, n_micro - 1)
+                inject = jax.lax.dynamic_index_in_dim(flat_in, idx, 0,
+                                                      keepdims=False)
+                x = jnp.where(stage == 0, inject, buf)
+                # per-micro-batch stream (layer-level fold_in happens in
+                # forward_range); stochastic layers get distinct keys per
+                # micro-batch, like the sequential gas scan
+                y = body(x, jax.random.fold_in(rng, idx))
+                out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+                write = (t >= n_stages - 1).astype(y.dtype)
+                current = jax.lax.dynamic_index_in_dim(outputs, out_idx,
+                                                       0, keepdims=False)
+                outputs = jax.lax.dynamic_update_index_in_dim(
+                    outputs, write * y + (1 - write) * current, out_idx, 0)
+                buf_next = p2p.send_to_next(y, axis_name, n_stages,
+                                            fp32_comm=fp32_comm)
+                return (buf_next, outputs), None
+
+            buf0 = jnp.zeros((A,), act_dtype)
+            outputs0 = jnp.zeros((n_micro, A), act_dtype)
+            (_, outputs), _ = jax.lax.scan(tick, (buf0, outputs0),
+                                           jnp.arange(total_ticks))
+
+            out_sd = stage_out[-1]
+            outs = outputs[:, :numel(out_sd)].reshape(
+                (n_micro,) + out_sd.shape)
+            if module.loss_fn is not None:
+                losses = jax.vmap(module.loss_fn)(outs, lab_micro)
+            else:
+                losses = jnp.mean(outs, axis=tuple(range(1, outs.ndim)))
+            loss = jnp.mean(losses)
+            loss = last_stage_value(loss, axis_name, n_stages)
+            if dp_active:
+                loss = jax.lax.pmean(loss, data_axis)
+            return loss
+
+        tied_specs = jax.tree_util.tree_map(lambda _: P(), tied)
+        # micro dim 0 is a scan axis; data parallelism shards dim 1
+        batch_spec = P(None, data_axis) if dp_active else P()
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        mapped = shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(axis_name, None), tied_specs, batch_spec,
+                      batch_spec, P()),
+            out_specs=P(),
+            check_vma=False)
+        return mapped(packed, tied, in_micro, lab_micro, rng)
 
     return loss_fn
 

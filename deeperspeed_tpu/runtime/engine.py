@@ -208,6 +208,26 @@ class DeepSpeedEngine:
         self._host_opt = None
         self._host_state = None
 
+        # ZeRO-Infinity parameter offload (reference `zero/stage3.py:
+        # 916-935` + `swap_tensor/partitioned_param_swapper.py:36`):
+        # params rest on host/NVMe and stream through HBM one segment at
+        # a time — see runtime/zero/param_offload.py.
+        self.param_offload = zc.offload_param is not None
+        self._param_nvme = (self.param_offload and
+                            zc.offload_param.device == "nvme")
+        if self.param_offload:
+            if not self.host_offload:
+                raise DeepSpeedConfigError(
+                    "offload_param requires offload_optimizer: the "
+                    "ZeRO-Infinity host tier owns the fp32 masters that "
+                    "the streamed update writes back")
+            if not hasattr(model, "stream_plan"):
+                raise DeepSpeedConfigError(
+                    "offload_param needs a model exposing stream_plan() "
+                    "(a layer-streaming decomposition; see "
+                    "runtime/zero/param_offload.StreamPlan — "
+                    "models.gpt_neox.GPTNeoX implements it)")
+
         # --- state --------------------------------------------------------
         if model_parameters is None and hasattr(model, "init_params"):
             model_parameters = model.init_params(
@@ -508,11 +528,27 @@ class DeepSpeedEngine:
             # NVMe holds the state; drop the DRAM copies.
             self._host_state = None
 
+    def _make_scale_state(self):
+        """Initial loss-scale state from the config (shared by the device,
+        host-offload, and param-streaming init paths)."""
+        init_scale = 1.0
+        if self._config.loss_scaling_enabled:
+            init_scale = (self._config.loss_scale
+                          if self._config.loss_scale else
+                          self._config.initial_dynamic_scale)
+        return init_loss_scale_state(
+            init_scale=init_scale,
+            delayed_shift=(self._config.dynamic_loss_scale_args or
+                           {}).get("hysteresis", 1),
+            static=not self.dynamic_loss_scale())
+
     def _init_state(self, model_parameters):
         """Place params/master/opt-state on the mesh with ZeRO shardings."""
         self._compute_shardings(model_parameters)
         if self.host_offload:
             self._init_host_state(model_parameters)
+        if self.param_offload:
+            return self._init_streamed_state(model_parameters)
 
         # copy=True: the engine's state buffers must never alias the
         # caller's arrays or each other — the jitted step donates state.
@@ -539,15 +575,8 @@ class DeepSpeedEngine:
         if self.host_offload:
             # Device holds only compute params; masters/moments are host-
             # resident (see _init_host_state).
-            scale_state = init_loss_scale_state(
-                init_scale=(self._config.loss_scale or
-                            self._config.initial_dynamic_scale)
-                if self._config.loss_scaling_enabled else 1.0,
-                delayed_shift=(self._config.dynamic_loss_scale_args or
-                               {}).get("hysteresis", 1),
-                static=not self.dynamic_loss_scale())
             return EngineState(params=params, master=None, opt_state=(),
-                               scale=scale_state,
+                               scale=self._make_scale_state(),
                                global_steps=jnp.asarray(0, jnp.int32),
                                skipped_steps=jnp.asarray(0, jnp.int32))
 
@@ -559,23 +588,68 @@ class DeepSpeedEngine:
         if not self.keep_master:
             master = None
 
-        static = not self.dynamic_loss_scale()
-        init_scale = 1.0
-        if self._config.loss_scaling_enabled:
-            init_scale = (self._config.loss_scale
-                          if self._config.loss_scale else
-                          self._config.initial_dynamic_scale)
-        scale_state = init_loss_scale_state(
-            init_scale=init_scale,
-            delayed_shift=(self._config.dynamic_loss_scale_args or
-                           {}).get("hysteresis", 1),
-            static=static)
-
         return EngineState(
             params=params, master=master, opt_state=opt_state,
-            scale=scale_state,
+            scale=self._make_scale_state(),
             global_steps=jnp.asarray(0, jnp.int32),
             skipped_steps=jnp.asarray(0, jnp.int32))
+
+    def _init_streamed_state(self, model_parameters):
+        """ZeRO-Infinity param offload: params NEVER fully materialize in
+        HBM. The engine state holds the host compute-dtype store; the
+        stream coordinator uploads one segment at a time (NVMe tier reads
+        through the async swapper). Masters/moments are the host tier
+        from `_init_host_state`."""
+        from .zero.param_offload import (ParamStreamCoordinator,
+                                         make_segment_fns,
+                                         segment_leaf_indices)
+
+        def to_host(p):
+            # np.array(order="C"): a WRITABLE, C-CONTIGUOUS copy. Both
+            # matter: the host Adam updates the store in place through
+            # reshape(-1) views, and device_get on TPU can return F-order
+            # arrays whose reshape(-1) would be a silent COPY (the update
+            # would vanish). order="K" (the default) preserves F-order.
+            return np.array(np.asarray(
+                jax.device_get(jnp.asarray(p, self.compute_dtype))),
+                order="C")
+
+        host_params = jax.tree_util.tree_map(to_host, model_parameters)
+
+        self._stream_plan = self.module_obj.stream_plan()
+        swapper = None
+        if self._param_nvme:
+            from .swap_tensor.partitioned_param_swapper import \
+                AsyncPartitionedParameterSwapper
+            nvme_path = self._config.zero_config.offload_param.nvme_path
+            if nvme_path is None:
+                raise DeepSpeedConfigError(
+                    "offload_param.device=nvme requires nvme_path")
+            seg_bytes = [
+                sum(l.size * l.dtype.itemsize
+                    for l in jax.tree_util.tree_leaves(sel(host_params)))
+                for _, sel in self._stream_plan.segments]
+            swapper = AsyncPartitionedParameterSwapper(
+                nvme_path=nvme_path, buffer_count=4,
+                buffer_size=max(seg_bytes),
+                aio_config=self._config.aio_config, dtype=np.uint8)
+        self._coord = ParamStreamCoordinator(
+            self._stream_plan, host_params, self.compute_dtype,
+            sharding=NamedSharding(self.mesh, PartitionSpec()),
+            swapper=swapper)
+        self._seg_fwd, self._seg_bwd = make_segment_fns(self._stream_plan)
+        self._seg_idx = segment_leaf_indices(self._stream_plan, host_params)
+        self._host_param_leaves = jax.tree_util.tree_leaves(host_params)
+        for leaf in self._host_param_leaves:
+            if not (leaf.flags["C_CONTIGUOUS"] and leaf.flags["WRITEABLE"]):
+                raise AssertionError(
+                    "host param store leaves must be writable C-contiguous "
+                    "(in-place update writes would silently vanish)")
+
+        return EngineState(params=host_params, master=None, opt_state=(),
+                           scale=self._make_scale_state(),
+                           global_steps=jnp.asarray(0, jnp.int32),
+                           skipped_steps=jnp.asarray(0, jnp.int32))
 
     # ------------------------------------------------------------------
     # jitted step builders
@@ -828,12 +902,19 @@ class DeepSpeedEngine:
     def _host_apply_update(self, grads):
         """ZeRO-Offload update: unscale/clip/step on host DRAM (or NVMe via
         the pipelined swapper), upload compute-dtype params."""
-        from .fp16.loss_scaler import update_loss_scale
-
         scale = float(self.state.scale.cur_scale)
         flat_grads = [np.asarray(jax.device_get(g), np.float32).reshape(-1)
                       / scale
                       for g in jax.tree_util.tree_leaves(grads)]
+        return self._host_step_flat(flat_grads, scale)
+
+    def _host_step_flat(self, flat_grads, scale):
+        """Shared host-optimizer step over unscaled flat fp32 grads (one
+        per param leaf): clip, native CPU-Adam, publish the new compute-
+        dtype params — to device (ZeRO-Offload) or back into the host/
+        NVMe param store (ZeRO-Infinity param offload)."""
+        from .fp16.loss_scaler import update_loss_scale
+
         finite = all(np.isfinite(g).all() for g in flat_grads)
         grad_norm = math_sqrt_sum(flat_grads)
 
@@ -850,6 +931,20 @@ class DeepSpeedEngine:
             opt_step = self._host_opt.step_count + 1
 
             def step_leaf(i, master, m, v):
+                if self.param_offload:
+                    # write the fresh compute-dtype leaf STRAIGHT into the
+                    # host param store (params never live on device)
+                    host_leaf = self._host_param_leaves[i].reshape(-1)
+                    if use_bf16:
+                        self._host_opt.step_flat(
+                            master, flat_grads[i], m, v, lr=lr,
+                            bf16_out=host_leaf.view(np.uint16),
+                            step=opt_step)
+                    else:
+                        self._host_opt.step_flat(master, flat_grads[i], m,
+                                                 v, lr=lr, step=opt_step)
+                        host_leaf[:] = master.astype(host_leaf.dtype)
+                    return None, master, m, v
                 bf16 = np.empty(master.size, np.uint16) if use_bf16 else None
                 self._host_opt.step_flat(master, flat_grads[i], m, v,
                                          lr=lr, bf16_out=bf16,
@@ -883,11 +978,16 @@ class DeepSpeedEngine:
                                          hs["v"][i])
                     new_leaves.append(leaf)
 
-            new_params = jax.tree_util.tree_unflatten(self._host_treedef,
-                                                      new_leaves)
-            new_params = jax.tree_util.tree_map(
-                lambda p, sh: jax.device_put(p, sh), new_params,
-                self._param_sh)
+            if self.param_offload:
+                # host store already updated in place; respill NVMe tier
+                self._coord.publish_host_update()
+                new_params = self.state.params
+            else:
+                new_params = jax.tree_util.tree_unflatten(
+                    self._host_treedef, new_leaves)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, sh: jax.device_put(p, sh), new_params,
+                    self._param_sh)
         else:
             new_params = self.state.params
 
@@ -917,6 +1017,88 @@ class DeepSpeedEngine:
         def eval_fn(params, batch, rng):
             return self.loss_fn(params, batch, rng)
         return jax.jit(eval_fn)
+
+    # ------------------------------------------------------------------
+    # ZeRO-Infinity param-offload streamed execution
+    # (reference zero/stage3.py:916-935; design in zero/param_offload.py)
+    # ------------------------------------------------------------------
+
+    def _stream_forward(self, mb, rng):
+        """Streamed forward only: segment k+1's params upload while
+        segment k computes (the reference's trace prefetch). Returns the
+        per-segment input carries (for backward) and the loss."""
+        plan = self._stream_plan
+        names = [n for n, _ in plan.segments]
+        carries, carry = [], None
+        for k, name in enumerate(names):
+            dev = self._coord.fetch(name)
+            if k + 1 < len(names):
+                self._coord.prefetch(names[k + 1])
+            carries.append(carry)
+            carry = self._seg_fwd[plan.kind(name)](dev, carry, mb, rng)
+            self._coord.release(name)
+        return carries, carry  # carry == scalar loss
+
+    def _stream_fwd_bwd(self, mb, rng, grad_acc):
+        """One micro-batch: streamed forward, then reverse streamed
+        backward — each segment's forward is recomputed under `jax.vjp`
+        (layer-granular remat) and its gradients are pulled to the host
+        accumulators immediately, so neither the full param set nor the
+        full gradient set ever occupies HBM."""
+        plan = self._stream_plan
+        names = [n for n, _ in plan.segments]
+        carries, loss = self._stream_forward(mb, rng)
+
+        # d(scaled loss)/dloss: the host step divides by the scale later,
+        # matching the ZeRO-Offload path.
+        ct = jnp.asarray(float(self.state.scale.cur_scale), jnp.float32)
+        for k in range(len(names) - 1, -1, -1):
+            name = names[k]
+            dev = self._coord.fetch(name)
+            if k > 0:
+                self._coord.prefetch(names[k - 1])
+            dparams, dcarry = self._seg_bwd[plan.kind(name)](
+                dev, carries[k], ct, mb, rng)
+            self._coord.release(name)
+            for idx, g in zip(self._seg_idx[name],
+                              jax.tree_util.tree_leaves(dparams)):
+                g32 = np.asarray(jax.device_get(g),
+                                 np.float32).reshape(-1)
+                if grad_acc[idx] is None:
+                    # device_get can return a read-only zero-copy view;
+                    # the accumulator must be writable
+                    grad_acc[idx] = (g32 if g32.flags.writeable
+                                     else g32.copy())
+                else:
+                    grad_acc[idx] += g32
+            ct = dcarry
+        return loss
+
+    def _streamed_train_batch(self, batch):
+        """train_batch under param offload: per-micro-batch streamed
+        fwd+bwd with host-side grad accumulation, then the host CPU-Adam
+        step writing fresh params into the host/NVMe store."""
+        gas = self.gradient_accumulation_steps()
+        grad_acc = [None] * len(self._host_param_leaves)
+        loss_sum = 0.0
+        for j in range(gas):
+            mb = jax.tree_util.tree_map(lambda b: np.asarray(b)[j], batch)
+            mb = self._shard_batch(mb)
+            loss = self._stream_fwd_bwd(mb, self._next_rng(), grad_acc)
+            loss_sum += float(loss)
+            self.micro_steps += 1
+        scale = float(self.state.scale.cur_scale)
+        flat_grads = [
+            (g if g is not None
+             else np.zeros(leaf.size, np.float32)) / (gas * scale)
+            for g, leaf in zip(grad_acc, self._host_param_leaves)]
+        metrics = self._host_step_flat(flat_grads, scale)
+        return metrics._replace(
+            loss=jnp.asarray(loss_sum / gas, jnp.float32))
+
+    def _streamed_eval(self, batch, rng):
+        _, loss = self._stream_forward(batch, rng)
+        return loss
 
     # ------------------------------------------------------------------
     # data
@@ -983,6 +1165,10 @@ class DeepSpeedEngine:
 
     def forward(self, batch, rng=None):
         """Compute loss (and cache grads for the coming backward())."""
+        if self.param_offload:
+            raise RuntimeError(
+                "forward/backward/step needs full params on device; with "
+                "offload_param use train_batch (layer-streamed)")
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         self._assert_comm_precision()
@@ -1209,6 +1395,17 @@ class DeepSpeedEngine:
                 lambda *xs: np.stack(xs), *micro)
         self._assert_comm_precision()
 
+        if self.param_offload:
+            # ZeRO-Infinity: params stream from host/NVMe segment by
+            # segment — skip the whole-batch device upload and the
+            # full-params profiler below (both would materialize state
+            # this mode exists to keep out of HBM).
+            self.tput_timer.start()
+            metrics = self._streamed_train_batch(batch)
+            self._after_step(metrics)
+            self.tput_timer.stop()
+            return metrics.loss
+
         self._maybe_profile_flops(batch)
 
         self.tput_timer.start()
@@ -1263,6 +1460,9 @@ class DeepSpeedEngine:
         tiers or activation-capture hooks (those need the host between
         steps); the flops profiler likewise only fires on the
         `train_batch` path."""
+        if self.param_offload:
+            raise RuntimeError("train_steps: offload_param streams params "
+                               "from the host per segment; use train_batch")
         if self.host_offload:
             raise RuntimeError("train_steps: host-offload optimizers step "
                                "on the host between device steps; use "
@@ -1318,10 +1518,12 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch, rng=None):
         self._assert_comm_precision()
-        if self._compiled_eval is None:
-            self._compiled_eval = self._build_eval_fn()
         batch = self._shard_batch(batch)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if self.param_offload:
+            return self._streamed_eval(batch, rng)
+        if self._compiled_eval is None:
+            self._compiled_eval = self._build_eval_fn()
         return self._compiled_eval(self.state.params, batch, rng)
 
     def allreduce_gradients(self, bucket_size=MEMORY_OPT_ALLREDUCE_SIZE):
@@ -1416,6 +1618,18 @@ class DeepSpeedEngine:
                     for i, leaf in enumerate(leaves):
                         self._host_state["master"][i][:] = np.ravel(
                             np.asarray(leaf, np.float32))
+            if self.param_offload:
+                # params live in the host/NVMe store — update it in place
+                # and respill; NEVER materialize the full tree in HBM
+                # (that is the memory this mode exists to avoid)
+                for host_leaf, leaf in zip(
+                        self._host_param_leaves,
+                        jax.tree_util.tree_leaves(view)):
+                    flat = host_leaf.reshape(-1)
+                    flat[:] = np.ravel(np.asarray(leaf)).astype(flat.dtype)
+                self._coord.publish_host_update()
+                self.state = self.state._replace(master=new_master)
+                return
             new_params = jax.tree_util.tree_map(
                 lambda v, p, sh: jax.device_put(
                     jnp.asarray(v, self.compute_dtype), sh),

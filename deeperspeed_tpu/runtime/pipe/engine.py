@@ -50,11 +50,52 @@ class PipelineEngine(DeepSpeedEngine):
         self.log_batch_step_id = -1
         self.agg_train_loss = None
 
+        # With a ``pipe`` mesh axis present, lower the LayerSpec list onto
+        # the SPMD ppermute executor — REAL pipelining for arbitrary
+        # PipelineModules (reference `pipe/engine.py:654-1139`); without
+        # one, the model compiles as a sequential program (single-stage
+        # semantics, same math).
+        from ...parallel.mesh import DATA_AXIS, PIPE_AXIS
+        self._spmd_pipelined = (
+            PIPE_AXIS in self.mesh.axis_names
+            and int(self.mesh.shape[PIPE_AXIS]) > 1
+            and model.num_stages > 1)
+        if self._spmd_pipelined:
+            from ...parallel.pipeline_spmd import module_pipeline_loss_fn
+            self.loss_fn = module_pipeline_loss_fn(
+                model, self.mesh,
+                n_micro=max(self.micro_batches, 1),
+                data_axis=DATA_AXIS if DATA_AXIS in self.mesh.axis_names
+                else None,
+                fp32_comm=self._fp32_comm or None,
+                remat=True)
+
     @staticmethod
     def _resolve_model(model):
         def loss_fn(params, batch, rng):
             return model.loss(params, batch, rng=rng)
         return loss_fn
+
+    def _train_step_body(self, accum_steps):
+        """Pipelined mode: the gradient-accumulation micro-batches ARE the
+        pipeline micro-batches (one fused 1F1B schedule, reference
+        `pipe/engine.py:264` — micro_batches == gas). Merge the stacked
+        [gas, micro, ...] batch into one effective batch and run the
+        pipelined loss once; the micro splitting happens inside it."""
+        if not self._spmd_pipelined:
+            return super()._train_step_body(accum_steps)
+
+        def train_step(state, batches, rng, lr):
+            scale = state.scale.cur_scale
+            full = jax.tree_util.tree_map(
+                lambda b: b.reshape((-1,) + b.shape[2:]), batches)
+            loss, grads = self._loss_and_grads(state.params, full, rng,
+                                               scale)
+            new_state, metrics = self._apply_update(state, grads, lr)
+            return new_state, metrics._replace(
+                loss=loss.astype(jnp.float32))
+
+        return train_step
 
     # ------------------------------------------------------------------
     # schedule construction (exposed for parity/tests; the compiled path

@@ -32,9 +32,14 @@ CONFIGS = {
     "gas2": {"gradient_accumulation_steps": 2},
     "bf16-zero2": {"fp16": {"enabled": True, "type": "bfloat16"},
                    "zero_optimization": {"stage": 2}},
+    # ZeRO-Infinity: params stream from the host, layer by layer
+    "zero3-param-offload": {"zero_optimization": {
+        "stage": 3, "offload_optimizer": {"device": "cpu"},
+        "offload_param": {"device": "cpu"}}},
 }
 EXACT = {"zero1", "zero2", "zero3", "gas2"}  # must match baseline to fp32 tol
-CLOSE = {"zero2-offload": 5e-4}  # native C++ Adam rounds differently
+CLOSE = {"zero2-offload": 5e-4,  # native C++ Adam rounds differently
+         "zero3-param-offload": 5e-4}
 
 
 def run_config(name, overrides, steps, model_family):

@@ -1,0 +1,108 @@
+"""Max-trainable-params ladder: HBM-only vs +DRAM optimizer offload vs
+ZeRO-Infinity param streaming (reference: the ZeRO-Offload "13B on one
+V100" pitch, `docs/_tutorials/zero-offload.md`, and ZeRO-Infinity's
+100B+/device claim).
+
+For each memory tier, walks GPT-NeoX sizes upward until a 2-step train
+OOMs, and prints one JSON line per tier with the largest size that
+trained and its step time. Run ON the target chip:
+
+    PYTHONPATH=. python tests/perf/param_offload_ladder.py [--seq 1024]
+
+On the CPU mesh this exercises the machinery but the numbers are
+meaningless — capacity there is host RAM for every tier.
+"""
+
+import argparse
+import gc
+import json
+import time
+
+import numpy as np
+
+
+TIERS = {
+    "hbm-zero2": {"zero_optimization": {"stage": 2}},
+    "dram-optimizer": {"zero_optimization": {
+        "stage": 2, "offload_optimizer": {"device": "cpu"}}},
+    "param-stream": {"zero_optimization": {
+        "stage": 3, "offload_optimizer": {"device": "cpu"},
+        "offload_param": {"device": "cpu"}}},
+}
+
+# (hidden, layers, heads) rungs; params ~ 12*h^2*L + 2*V*h
+LADDER = [
+    (768, 12, 12),     # ~125M
+    (1536, 16, 16),    # ~480M
+    (2048, 24, 16),    # ~1.2B
+    (2560, 32, 20),    # ~2.5B
+    (4096, 32, 32),    # ~6.4B
+    (5120, 40, 40),    # ~12.5B
+    (6144, 44, 48),    # ~20B
+    (8192, 48, 64),    # ~38B
+]
+
+
+def try_size(tier_cfg, hidden, layers, heads, seq, batch):
+    import jax
+
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    cfg = GPTNeoXConfig(vocab_size=50304, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=seq)
+    model = GPTNeoX(cfg, use_pallas=True, remat_blocks=True)
+    config = {"train_batch_size": batch,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+              "fp16": {"enabled": True, "type": "bfloat16"},
+              "steps_per_print": 100_000}
+    config.update(tier_cfg)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=model.init_params(
+            jax.random.PRNGKey(0)),
+        config_params=config)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (1, batch, seq), np.int32)
+    engine.train_batch(batch=(toks, toks))  # compile + step 1
+    t0 = time.perf_counter()
+    loss = engine.train_batch(batch=(toks, toks))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    n_params = cfg.num_params()
+    del engine, model
+    gc.collect()
+    return n_params, dt
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq", type=int, default=1024)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--tiers", nargs="*", default=list(TIERS))
+    args = parser.parse_args()
+
+    import jax
+    print(f"# devices: {jax.device_count()}x "
+          f"{jax.devices()[0].device_kind}")
+
+    for tier in args.tiers:
+        best = None
+        for hidden, layers, heads in LADDER:
+            try:
+                n, dt = try_size(TIERS[tier], hidden, layers, heads,
+                                 args.seq, args.batch)
+                best = {"tier": tier, "hidden": hidden, "layers": layers,
+                        "params": n, "step_time_s": round(dt, 3)}
+                print(f"#   {tier}: {n/1e9:.2f}B ok ({dt:.2f}s/step)")
+            except Exception as e:  # OOM or compile failure ends the climb
+                print(f"#   {tier}: {hidden}x{layers} failed: "
+                      f"{type(e).__name__}")
+                gc.collect()
+                break
+        if best:
+            print(json.dumps(best))
+
+
+if __name__ == "__main__":
+    main()

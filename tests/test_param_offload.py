@@ -1,0 +1,158 @@
+"""ZeRO-Infinity parameter offload: layer-streamed training (reference:
+`deepspeed/runtime/zero/stage3.py:916-935` NVMe param path,
+`swap_tensor/partitioned_param_swapper.py:36`).
+
+`offload_param: {device: cpu|nvme}` must actually train — params resting
+off-device, streamed through the device segment by segment — with loss
+parity against the wired ZeRO-Offload baseline."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import deeperspeed_tpu
+from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+from deeperspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+STEPS = 4
+
+
+def _config(extra):
+    config = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    }
+    config.update(extra)
+    return config
+
+
+def _engine(extra, seed=0):
+    model = GPTNeoX(GPTNeoXConfig.tiny(), use_pallas=False)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=_config(extra))
+    return engine
+
+
+def _train(engine, steps=STEPS, gas=1, seed=1):
+    rng = np.random.default_rng(seed)
+    V = engine.module_obj.config.vocab_size
+    losses = []
+    for _ in range(steps):
+        toks = rng.integers(0, V, (gas, 16 // gas, 32), np.int32)
+        losses.append(float(engine.train_batch(batch=(toks, toks))))
+    return np.asarray(losses)
+
+
+OFFLOAD_BASE = {"zero_optimization": {
+    "stage": 2, "offload_optimizer": {"device": "cpu"}}}
+PARAM_CPU = {"zero_optimization": {
+    "stage": 3, "offload_optimizer": {"device": "cpu"},
+    "offload_param": {"device": "cpu"}}}
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return _train(_engine(OFFLOAD_BASE))
+
+
+def test_param_offload_cpu_matches_offload_baseline(baseline, devices):
+    """Streaming params from host must not change the math: same host
+    CPU-Adam, same forward — trajectory parity with ZeRO-Offload."""
+    engine = _engine(PARAM_CPU)
+    got = _train(engine)
+    np.testing.assert_allclose(got, baseline, rtol=2e-4, atol=2e-4)
+    # params really are host-resident numpy, not device arrays
+    leaf = jax.tree_util.tree_leaves(engine.state.params)[0]
+    assert isinstance(leaf, np.ndarray)
+
+
+def test_param_offload_grad_accumulation(baseline, devices):
+    cfg = dict(PARAM_CPU)
+    cfg["gradient_accumulation_steps"] = 2
+    got = _train(_engine(cfg), gas=2)
+    np.testing.assert_allclose(got, baseline, rtol=2e-4, atol=2e-4)
+
+
+def test_param_offload_nvme(tmp_path, baseline, devices):
+    """NVMe tier: segment files appear under the swap dir and training
+    reads through them with unchanged results."""
+    cfg = {"zero_optimization": {
+        "stage": 3, "offload_optimizer": {"device": "cpu"},
+        "offload_param": {"device": "nvme", "nvme_path": str(tmp_path)}}}
+    engine = _engine(cfg)
+    swp = glob.glob(os.path.join(str(tmp_path), "zero_stage_3", "*.swp"))
+    assert len(swp) == engine.module_obj.config.num_layers + 2  # e,b*,h
+    got = _train(engine)
+    np.testing.assert_allclose(got, baseline, rtol=2e-4, atol=2e-4)
+
+
+def test_param_offload_eval_batch(devices):
+    engine = _engine(PARAM_CPU)
+    rng = np.random.default_rng(0)
+    V = engine.module_obj.config.vocab_size
+    toks = rng.integers(0, V, (16, 32), np.int32)
+    loss = float(engine.eval_batch((toks, toks)))
+    assert np.isfinite(loss) and loss > 0
+
+
+def test_param_offload_checkpoint_roundtrip(tmp_path, devices):
+    engine = _engine(PARAM_CPU)
+    _train(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    ref = _train(engine, steps=2, seed=7)
+
+    engine2 = _engine(PARAM_CPU, seed=5)
+    engine2.load_checkpoint(str(tmp_path / "ckpt"))
+    got = _train(engine2, steps=2, seed=7)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_param_offload_gathered_parameters_updates_store(devices):
+    """Mutations under gathered_parameters land in the host param store
+    (the next streamed forward must see them) without materializing the
+    full tree on device."""
+    engine = _engine(PARAM_CPU)
+    with engine.gathered_parameters(modifier_rank=0) as full:
+        full["final_ln"]["scale"][:] = 2.5
+    # host store updated in place; state.params still the numpy store
+    leaf = engine.state.params["final_ln"]["scale"]
+    assert isinstance(leaf, np.ndarray)
+    np.testing.assert_allclose(np.asarray(leaf, np.float32), 2.5)
+    # and the streamed forward consumes the edit
+    rng = np.random.default_rng(0)
+    V = engine.module_obj.config.vocab_size
+    toks = rng.integers(0, V, (16, 32), np.int32)
+    loss = float(engine.eval_batch((toks, toks)))
+    assert np.isfinite(loss)
+
+
+def test_param_offload_train_steps_raises(devices):
+    engine = _engine(PARAM_CPU)
+    with pytest.raises(RuntimeError, match="offload_param"):
+        engine.train_steps(np.zeros((2, 1, 16, 32), np.int32))
+
+
+def test_param_offload_requires_optimizer_offload(devices):
+    with pytest.raises(DeepSpeedConfigError, match="offload_optimizer"):
+        _engine({"zero_optimization": {
+            "stage": 3, "offload_param": {"device": "cpu"}}})
+
+
+def test_param_offload_requires_stream_plan(devices):
+    def plain_loss(params, batch, rng):
+        x, y = batch
+        return ((x @ params["w"]).sum() - y.sum()) ** 2
+
+    with pytest.raises(DeepSpeedConfigError, match="stream_plan"):
+        deeperspeed_tpu.initialize(
+            model=plain_loss,
+            model_parameters={"w": np.zeros((4, 4), np.float32)},
+            config_params=_config({"zero_optimization": {
+                "stage": 3, "offload_optimizer": {"device": "cpu"},
+                "offload_param": {"device": "cpu"}}}))
