@@ -68,11 +68,14 @@ def spmd_pipeline(stage_fn, stage_params, x_micro, axis_name, n_stages,
         x = jnp.where(stage == 0, inject.astype(buf.dtype), buf)
         y = body(stage_params, x)
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-        write = (t >= n_stages - 1).astype(y.dtype)
+        # select, NOT an arithmetic blend: fill-tick computations run on
+        # garbage buffers and may be NaN/Inf, which a blend would
+        # propagate into the real outputs (0*NaN = NaN)
+        write = t >= n_stages - 1
         current = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
                                                keepdims=False)
         outputs = jax.lax.dynamic_update_index_in_dim(
-            outputs, write * y + (1 - write) * current, out_idx, 0)
+            outputs, jnp.where(write, y, current), out_idx, 0)
         buf_next = p2p.send_to_next(y, axis_name, n_stages,
                                     fp32_comm=fp32_comm)
         return (buf_next, outputs), None
@@ -197,6 +200,13 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
 
     Returns ``loss_fn(params, batch, rng)`` over the FULL effective batch
     (the batch splits into `n_micro` pipeline micro-batches internally).
+
+    Caveat: during pipeline fill/drain, stages run on zero buffers whose
+    results are discarded by select (never blended into outputs). Layer
+    primals may be non-finite on zeros without harm, but their VJPs
+    should not emit NaN under a zero cotangent (0·∞ patterns, e.g.
+    unguarded ``x/|x|``) — the same discipline `jnp.where` gradients
+    require everywhere in JAX.
     """
     from ..runtime.pipe import p2p
 
@@ -337,11 +347,12 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
                 # micro-batch, like the sequential gas scan
                 y = body(x, jax.random.fold_in(rng, idx))
                 out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-                write = (t >= n_stages - 1).astype(y.dtype)
+                # select (NaN-safe), not a blend — see spmd_pipeline
+                write = t >= n_stages - 1
                 current = jax.lax.dynamic_index_in_dim(outputs, out_idx,
                                                        0, keepdims=False)
                 outputs = jax.lax.dynamic_update_index_in_dim(
-                    outputs, write * y + (1 - write) * current, out_idx, 0)
+                    outputs, jnp.where(write, y, current), out_idx, 0)
                 buf_next = p2p.send_to_next(y, axis_name, n_stages,
                                             fp32_comm=fp32_comm)
                 return (buf_next, outputs), None

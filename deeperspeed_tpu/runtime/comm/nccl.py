@@ -40,8 +40,21 @@ class NcclBackend:
     # -- in-mesh (shard_map / pjit) path ------------------------------
 
     def compressed_allreduce_in_mesh(self, x, worker_error):
-        """Usable inside shard_map: returns (averaged, new_worker_error)."""
+        """Usable inside shard_map: returns (averaged, new_worker_error).
+        Dense single-phase variant (quantization numerics only)."""
         return compressed_allreduce_dense(x, worker_error, self.axis_name)
+
+    def compressed_allreduce_packed(self, x, worker_error, server_error,
+                                    world):
+        """The real wire protocol inside shard_map (reference
+        `nccl.py:47-186`): packed int8 sign bits via all_to_all +
+        all_gather with two-phase error feedback — ~16× less wire volume
+        than an fp32 ring allreduce. `x` is this rank's flat buffer
+        (length % world·8 == 0); `server_error` is the rank's phase-2
+        chunk buffer [n/world]."""
+        from .compressed import compressed_allreduce_two_phase
+        return compressed_allreduce_two_phase(
+            x, worker_error, server_error, self.axis_name, world)
 
     # -- host path (single process or explicit buffers) ---------------
 
@@ -54,34 +67,42 @@ class NcclBackend:
         process, so `buffer_m` may be a list of per-rank buffers. Returns
         the updated buffer(s) and mutates nothing.
         """
+        from .compressed import compressed_allreduce_two_phase_host
+
         single = not isinstance(buffer_m, (list, tuple))
-        buffers = [buffer_m] if single else list(buffer_m)
-        errors = [worker_error] if single else list(worker_error)
+        buffers = [jnp.asarray(b, jnp.float32)
+                   for b in ([buffer_m] if single else buffer_m)]
+        errors = [jnp.asarray(e, jnp.float32)
+                  for e in ([worker_error] if single else worker_error)]
         world = len(buffers)
+        n = buffers[0].shape[0]
+        # zero-pad to a world-divisible length so server chunking never
+        # drops elements (arbitrary n, like the pre-chunked behavior)
+        pad = (-n) % world
+        if pad:
+            buffers = [jnp.pad(b, (0, pad)) for b in buffers]
+            errors = [jnp.pad(e, (0, pad)) for e in errors]
+        chunk = (n + pad) // world
+        if isinstance(server_error, (list, tuple)):
+            server_errors = [jnp.asarray(e, jnp.float32)
+                             for e in server_error]
+            if server_errors[0].shape[0] != chunk:
+                raise ValueError(
+                    f"server_error chunks must be length {chunk} "
+                    f"(n={n} padded over world={world}); got "
+                    f"{server_errors[0].shape[0]}")
+        else:
+            # one flat buffer → per-rank server chunks (padded domain)
+            se = jnp.asarray(server_error, jnp.float32)
+            se = jnp.pad(se, (0, world * chunk - se.shape[0]))
+            server_errors = list(se.reshape(world, chunk))
 
-        # phase 1: worker-side quantization with error feedback
-        quantized, new_worker_errors = [], []
-        for buf, err in zip(buffers, errors):
-            buf = jnp.asarray(buf, jnp.float32)
-            err = jnp.asarray(err, jnp.float32)
-            compensated = buf + err
-            scale = jnp.mean(jnp.abs(compensated))
-            signs = jnp.where(compensated >= 0, 1.0, -1.0)
-            q = signs * scale
-            quantized.append(q)
-            new_worker_errors.append(compensated - q)
-
-        # phase 2: server-side average + re-quantization with the server
-        # error buffer
-        mean = sum(quantized) / world
-        server_error = jnp.asarray(server_error, jnp.float32)
-        compensated = mean + server_error
-        scale2 = jnp.mean(jnp.abs(compensated))
-        signs2 = jnp.where(compensated >= 0, 1.0, -1.0)
-        out = signs2 * scale2
-        new_server_error = compensated - out
-
-        outs = [out for _ in buffers]
+        outs, new_worker_errors, new_server_errors = \
+            compressed_allreduce_two_phase_host(buffers, errors,
+                                                server_errors)
+        if pad:
+            outs = [o[:n] for o in outs]
+            new_worker_errors = [e[:n] for e in new_worker_errors]
         if single:
-            return outs[0], new_worker_errors[0], new_server_error
-        return outs, new_worker_errors, new_server_error
+            return outs[0], new_worker_errors[0], new_server_errors[0]
+        return outs, new_worker_errors, new_server_errors

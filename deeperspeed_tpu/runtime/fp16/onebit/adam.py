@@ -18,14 +18,15 @@ import jax
 import jax.numpy as jnp
 
 from ....ops.adam.fused_adam import FusedAdam
-from ...comm.compressed import compressed_allreduce_dense
+from ...comm.compressed import compressed_allreduce_dense_two_phase
 
 
 class OnebitAdamState(NamedTuple):
     step: jnp.ndarray
     exp_avg: object
     exp_avg_sq: object
-    worker_error: object   # error-feedback residual per leaf
+    worker_error: object   # phase-1 error-feedback residual per leaf
+    server_error: object   # phase-2 (server requant) residual per leaf
 
 
 class OnebitAdam(FusedAdam):
@@ -47,11 +48,16 @@ class OnebitAdam(FusedAdam):
 
     def init_state(self, master_params):
         base = super().init_state(master_params)
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+
+        def zeros():
+            # distinct buffers per field: donated steps may not receive
+            # the same buffer twice
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+
         return OnebitAdamState(step=base.step, exp_avg=base.exp_avg,
                                exp_avg_sq=base.exp_avg_sq,
-                               worker_error=zeros)
+                               worker_error=zeros(), server_error=zeros())
 
     def update(self, grads, state, master_params, lr=None, axis_name=None):
         group = self.param_groups[0]
@@ -62,7 +68,7 @@ class OnebitAdam(FusedAdam):
         step = state.step + 1
         in_warmup = step <= self.freeze_step
 
-        def leaf(p, g, m, v, err):
+        def leaf(p, g, m, v, err, serr):
             g = g.astype(jnp.float32)
             p = p.astype(jnp.float32)
             if weight_decay != 0.0:
@@ -71,23 +77,30 @@ class OnebitAdam(FusedAdam):
             # Variance frozen after warmup (reference adam.py freeze logic).
             v_new = jnp.where(in_warmup,
                               beta2 * v + (1 - beta2) * jnp.square(g), v)
-            if axis_name is not None:
-                m_comp, err_new = compressed_allreduce_dense(
-                    m_new, err, axis_name)
-                m_new = jnp.where(in_warmup, m_new, m_comp)
-                err = jnp.where(in_warmup, err, err_new)
+            # full two-phase semantics post-warmup (worker quant + server
+            # requant with its own error buffer, reference nccl.py:47-186);
+            # the cross-rank mean runs only with an axis_name (shard_map)
+            m_comp, err_new, serr_new = \
+                compressed_allreduce_dense_two_phase(
+                    m_new, err, serr, axis_name)
+            m_new = jnp.where(in_warmup, m_new, m_comp)
+            err = jnp.where(in_warmup, err, err_new)
+            serr = jnp.where(in_warmup, serr, serr_new)
             update = m_new / (jnp.sqrt(v_new) + eps)
-            return p - lr * update, m_new, v_new, err
+            return p - lr * update, m_new, v_new, err, serr
 
         flat_p, treedef = jax.tree_util.tree_flatten(master_params)
         flat_g = treedef.flatten_up_to(grads)
         flat_m = treedef.flatten_up_to(state.exp_avg)
         flat_v = treedef.flatten_up_to(state.exp_avg_sq)
         flat_e = treedef.flatten_up_to(state.worker_error)
+        flat_s = treedef.flatten_up_to(state.server_error)
 
-        outs = [leaf(p, g, m, v, e) for p, g, m, v, e in
-                zip(flat_p, flat_g, flat_m, flat_v, flat_e)]
+        outs = [leaf(p, g, m, v, e, s) for p, g, m, v, e, s in
+                zip(flat_p, flat_g, flat_m, flat_v, flat_e, flat_s)]
         unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
             treedef, [o[i] for o in outs])
         return unf(0), OnebitAdamState(step=step, exp_avg=unf(1),
-                                       exp_avg_sq=unf(2), worker_error=unf(3))
+                                       exp_avg_sq=unf(2),
+                                       worker_error=unf(3),
+                                       server_error=unf(4))

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ....ops.lamb.fused_lamb import FusedLamb
-from ...comm.compressed import compressed_allreduce_dense
+from ...comm.compressed import compressed_allreduce_dense_two_phase
 
 
 class OnebitLambState(NamedTuple):
@@ -19,6 +19,7 @@ class OnebitLambState(NamedTuple):
     exp_avg: object
     exp_avg_sq: object
     worker_error: object
+    server_error: object   # phase-2 (server requant) residual per leaf
     frozen_scale: object   # per-leaf trust scaling frozen at freeze_step
 
 
@@ -42,13 +43,18 @@ class OnebitLamb(FusedLamb):
 
     def init_state(self, master_params):
         base = super().init_state(master_params)
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+
+        def zeros():
+            # distinct buffers per field (donated steps reject aliases)
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+
         ones = jax.tree_util.tree_map(
             lambda p: jnp.ones((), jnp.float32), master_params)
         return OnebitLambState(step=base.step, exp_avg=base.exp_avg,
                                exp_avg_sq=base.exp_avg_sq,
-                               worker_error=zeros, frozen_scale=ones)
+                               worker_error=zeros(), server_error=zeros(),
+                               frozen_scale=ones)
 
     def update(self, grads, state, master_params, lr=None, axis_name=None):
         group = self.param_groups[0]
@@ -61,17 +67,19 @@ class OnebitLamb(FusedLamb):
         step = state.step + 1
         in_warmup = step <= self.freeze_step
 
-        def leaf(p, g, m, v, err, fs):
+        def leaf(p, g, m, v, err, serr, fs):
             g = g.astype(jnp.float32)
             p = p.astype(jnp.float32)
             m_new = beta1 * m + (1 - beta1) * g
             v_new = jnp.where(in_warmup,
                               beta2 * v + (1 - beta2) * jnp.square(g), v)
-            if axis_name is not None:
-                m_comp, err_new = compressed_allreduce_dense(m_new, err,
-                                                             axis_name)
-                m_new = jnp.where(in_warmup, m_new, m_comp)
-                err = jnp.where(in_warmup, err, err_new)
+            # two-phase semantics post-warmup (see onebit/adam.py)
+            m_comp, err_new, serr_new = \
+                compressed_allreduce_dense_two_phase(m_new, err, serr,
+                                                     axis_name)
+            m_new = jnp.where(in_warmup, m_new, m_comp)
+            err = jnp.where(in_warmup, err, err_new)
+            serr = jnp.where(in_warmup, serr, serr_new)
             update = m_new / (jnp.sqrt(v_new) + eps)
             if weight_decay != 0.0:
                 update = update + weight_decay * p
@@ -89,16 +97,17 @@ class OnebitLamb(FusedLamb):
                 in_warmup, trust,
                 jnp.clip(trust, fs_new * self.factor_min,
                          fs_new * self.factor_max))
-            return p - lr * trust * update, m_new, v_new, err, fs_new
+            return p - lr * trust * update, m_new, v_new, err, serr, fs_new
 
         flat_p, treedef = jax.tree_util.tree_flatten(master_params)
         flat = [treedef.flatten_up_to(t) for t in
                 (grads, state.exp_avg, state.exp_avg_sq, state.worker_error,
-                 state.frozen_scale)]
-        outs = [leaf(p, g, m, v, e, f) for p, g, m, v, e, f in
+                 state.server_error, state.frozen_scale)]
+        outs = [leaf(p, g, m, v, e, s, f) for p, g, m, v, e, s, f in
                 zip(flat_p, *flat)]
         unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
             treedef, [o[i] for o in outs])
         return unf(0), OnebitLambState(step=step, exp_avg=unf(1),
                                        exp_avg_sq=unf(2), worker_error=unf(3),
-                                       frozen_scale=unf(4))
+                                       server_error=unf(4),
+                                       frozen_scale=unf(5))

@@ -41,15 +41,35 @@ def test_compressed_allreduce_error_feedback(backend_cls):
 
     # Exact error-feedback invariant: sum_t out_t = T·mean − (w̄err_T +
     # serr_T); the residual errors are all that separates the applied
-    # cumulative update from the true one.
+    # cumulative update from the true one. server_err comes back as
+    # per-rank server CHUNKS (the reference's rank-local phase-2 buffers).
     werr_mean = sum(np.asarray(e, np.float64) for e in worker_err) / world
-    recovered = (acc + werr_mean + np.asarray(server_err, np.float64)) / steps
+    serr_flat = np.concatenate([np.asarray(e, np.float64)
+                                for e in server_err])
+    recovered = (acc + werr_mean + serr_flat) / steps
     np.testing.assert_allclose(recovered, true_mean, atol=1e-4)
 
     # and the residuals stay bounded (error feedback self-stabilizes:
     # the quantization scale grows with the compensated buffer, so the
     # error plateaus at a few × the input norm instead of diverging)
     assert np.linalg.norm(werr_mean) < 10 * np.linalg.norm(xs[0])
+
+
+def test_compressed_allreduce_ragged_length():
+    """Buffer length not divisible by world: zero-padded internally, no
+    element silently dropped."""
+    rng = np.random.default_rng(3)
+    world, n = 3, 10
+    xs = [rng.normal(size=n).astype(np.float32) for _ in range(world)]
+    be = NcclBackend()
+    worker_err = [np.zeros(n, np.float32) for _ in range(world)]
+    server_err = np.zeros(n, np.float32)
+    outs, werr, serr = be.compressed_allreduce(xs, worker_err, server_err)
+    assert all(np.asarray(o).shape == (n,) for o in outs)
+    assert all(np.asarray(e).shape == (n,) for e in werr)
+    # feeding the returned server chunks back works
+    outs2, werr2, serr2 = be.compressed_allreduce(xs, werr, serr)
+    assert np.asarray(outs2[0]).shape == (n,)
 
 
 def test_compressed_allreduce_single_buffer():
