@@ -72,12 +72,42 @@ def _causal_mask(s, qi, ki, block_q, block_k):
     return jnp.where(rows >= cols, s, NEG_INF)
 
 
+MASK_GRAIN = 128  # layout-mask granularity (one sparsity block)
+
+
+def _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k):
+    """Mask scores with the head's [S/128, S/128] block-activity map
+    (whole map in SMEM; scalar reads take dynamic indices — the same
+    mechanism as the block-sparse kernels' LUTs). Inactive 128x128
+    sub-blocks of the [BQ, BK] tile get NEG_INF; the expansion uses
+    static sub-block slices (no in-kernel gather/reshape needed)."""
+    mq, mk = block_q // MASK_GRAIN, block_k // MASK_GRAIN
+    rows = []
+    for a in range(mq):
+        tiles = []
+        for c in range(mk):
+            penalty = jnp.where(m_ref[0, qi * mq + a, ki * mk + c] > 0,
+                                0.0, NEG_INF)
+            tiles.append(jnp.full((MASK_GRAIN, MASK_GRAIN), penalty,
+                                  jnp.float32))
+        rows.append(tiles[0] if mk == 1 else
+                    jnp.concatenate(tiles, axis=1))
+    penalty = rows[0] if mq == 1 else jnp.concatenate(rows, axis=0)
+    # additive, not select: NEG_INF + finite score stays ~NEG_INF
+    return s + penalty
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k):
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False):
+    if use_mask:
+        (q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        m_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -105,6 +135,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32) * sm_scale    # [BQ, BK]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if m_ref is not None:
+            s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
 
         m_prev = m_scr[:, :1]                                 # [BQ, 1]
         l_prev = l_scr[:, :1]
@@ -112,6 +144,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                       # [BQ, 1]
         p = jnp.exp(s - m_new)                                # [BQ, BK]
+        if m_ref is not None:
+            # rows with EVERY entry layout-masked would otherwise see
+            # exp(s - max) == 1 uniformly; zero masked entries so l==0
+            # flags the dead row (poisoned-lse convention)
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
 
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -127,12 +164,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         # lse row-vector [1, BQ]: the [BQ]-per-row stats transposed onto
-        # the lane dim — 128x less HBM than a lane-broadcast [BQ, LANES]
-        lse = m_scr[:, :1] + jnp.log(l_safe)
+        # the lane dim — 128x less HBM than a lane-broadcast [BQ, LANES].
+        # Dead rows (no active block — possible under a layout mask) get
+        # POISONED lse (+1e30) so backward's exp(s - lse) is exactly 0,
+        # the block-sparse kernels' invariant.
+        lse = jnp.where(l == 0.0, -NEG_INF, m_scr[:, :1] + jnp.log(l_safe))
         lse_ref[0] = lse.reshape(1, -1)
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
+def _mask_spec(h, n_fine_q, n_fine_k):
+    """BlockSpec for the [H, S/128, S/128] layout mask: the WHOLE
+    per-head map as one SMEM block (Mosaic requires trailing block dims
+    to be 8/128-multiples or full-size; scalar SMEM reads then take
+    dynamic indices)."""
+    return pl.BlockSpec((1, n_fine_q, n_fine_k),
+                        lambda bh, i, j: (bh % h, 0, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
+         layout=None):
     b, s, h, d = q.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
 
@@ -146,15 +197,21 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
-                               block_k=block_k)
+                               block_k=block_k,
+                               use_mask=layout is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    inputs = [qb, kb, vb]
+    if layout is not None:
+        in_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
+        inputs.append(layout)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
@@ -170,7 +227,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
         ],
         compiler_params=_DIMSEM,
         interpret=_interpret(),
-    )(qb, kb, vb)
+    )(*inputs)
 
     out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
@@ -180,9 +237,15 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, sm_scale, causal, block_q, block_k):
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
+                    use_mask=False):
+    if use_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        m_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -205,6 +268,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * sm_scale   # [BQ, BK]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if m_ref is not None:
+            s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0].reshape(-1, 1))           # [BQ, BK] f32
         do = do_ref[0]                                       # [BQ, D]
         # dV += Pᵀ dO  (P quantized to the wire dtype for MXU rate,
@@ -228,8 +293,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, sm_scale, causal, block_q, block_k):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
+                   use_mask=False):
+    if use_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref, dq_ref,
+         dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_scr) = refs
+        m_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -251,6 +323,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if m_ref is not None:
+            s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0].reshape(-1, 1))
         do = do_ref[0]
         dp = jax.lax.dot_general(
@@ -266,7 +340,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd(causal, sm_scale_arg, block_q, block_k, res, g):
+def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None):
     qb, kb, vb, out, lse = res
     bh, s, d = qb.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
@@ -283,21 +357,27 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g):
                     axis=-1).reshape(bh, 1, s)                # [BH, 1, S]
 
     n_q, n_k = s // block_q, s // block_k
+    use_mask = layout is not None
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
-                                   block_k=block_k)
+                                   block_k=block_k, use_mask=use_mask)
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+    ]
+    dkv_inputs = [qb, kb, vb, do, lse, delta]
+    if use_mask:
+        dkv_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
+        dkv_inputs.append(layout)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, n_k, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
@@ -312,29 +392,34 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g):
         ],
         compiler_params=_DIMSEM,
         interpret=_interpret(),
-    )(qb, kb, vb, do, lse, delta)
+    )(*dkv_inputs)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
-                                  block_k=block_k)
+                                  block_k=block_k, use_mask=use_mask)
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+    ]
+    dq_inputs = [qb, kb, vb, do, lse, delta]
+    if use_mask:
+        dq_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
+        dq_inputs.append(layout)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_DIMSEM,
         interpret=_interpret(),
-    )(qb, kb, vb, do, lse, delta)
+    )(*dq_inputs)
 
     def from_bh(x):
         return x.reshape(bdim, h, s, d).transpose(0, 2, 1, 3)
@@ -362,3 +447,59 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def make_masked_flash_attention(layout128, causal=False, sm_scale=None,
+                                block_q=BLOCK_Q, block_k=BLOCK_K):
+    """Dense-iteration flash attention honoring a STATIC 128-granular
+    block layout: every tile is computed (dense-flash cost, independent
+    of density) and inactive 128x128 blocks are masked to -inf — the
+    exact block-sparse pattern semantics at dense-kernel throughput.
+
+    This is the high-density arm of `SparseSelfAttention`'s auto
+    dispatch: above the measured sparse-vs-dense crossover (~30% active
+    blocks, docs/sparse-attention.md) iterating everything beats the
+    sparse kernels' LUT/two-pass overheads.
+
+    layout128: [H, S/128, S/128] numpy bool/int block-activity mask
+    (static — baked into the compiled kernel's mask operand).
+    Returns fn(q, k, v) on [B, S, H, D] with a custom VJP.
+    """
+    import numpy as np
+    layout = jnp.asarray(np.asarray(layout128) != 0, jnp.int32)
+
+    def check(q):
+        # the SMEM mask index map clamps out-of-range blocks — mismatched
+        # shapes would silently reuse wrong masks, so validate here (the
+        # sparse arm raises the same way, block_sparse_attention.py:504)
+        h, s = q.shape[2], q.shape[1]
+        if h != layout.shape[0]:
+            raise ValueError(
+                f"got {h} heads, layout has {layout.shape[0]}")
+        if s != layout.shape[1] * MASK_GRAIN:
+            raise ValueError(
+                f"got seq {s}, layout covers "
+                f"{layout.shape[1] * MASK_GRAIN}")
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def fn(q, k, v):
+        check(q)
+        scale = sm_scale if sm_scale is not None else \
+            1.0 / math.sqrt(q.shape[-1])
+        out, _ = _fwd(q, k, v, causal, scale, block_q, block_k,
+                      layout=layout)
+        return out
+
+    def fwd(q, k, v):
+        check(q)
+        scale = sm_scale if sm_scale is not None else \
+            1.0 / math.sqrt(q.shape[-1])
+        return _fwd(q, k, v, causal, scale, block_q, block_k,
+                    layout=layout)
+
+    def bwd(res, g):
+        return _bwd(causal, sm_scale, block_q, block_k, res, g,
+                    layout=layout)
+
+    fn.defvjp(fwd, bwd)
+    return fn

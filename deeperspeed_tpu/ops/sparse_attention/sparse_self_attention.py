@@ -55,9 +55,15 @@ class SparseSelfAttention:
     [B, H, S, D]; use `transpose_inputs=True` for that layout).
     """
 
+    # Measured sparse-vs-dense crossover on v5e (docs/sparse-attention.md):
+    # BigBird at 18% active wins on the sparse kernels, Fixed at 30%
+    # loses — above this active-block fraction a dense-iteration masked
+    # flash kernel (cost independent of density) is faster.
+    DENSE_DISPATCH_DENSITY = 0.25
+
     def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
                  attn_mask_mode="mul", max_seq_length=2048,
-                 transpose_inputs=False):
+                 transpose_inputs=False, dense_dispatch_density=None):
         self.sparsity_config = sparsity_config or FixedSparsityConfig(
             num_heads=4)
         if not isinstance(self.sparsity_config, SparsityConfig):
@@ -66,6 +72,11 @@ class SparseSelfAttention:
         self.attn_mask_mode = attn_mask_mode
         self.max_seq_length = max_seq_length
         self.transpose_inputs = transpose_inputs
+        # auto kernel dispatch threshold; 1.0 forces the sparse kernels,
+        # 0.0 forces the masked dense-flash path
+        self.dense_dispatch_density = (
+            self.DENSE_DISPATCH_DENSITY if dense_dispatch_density is None
+            else dense_dispatch_density)
         self._cache = {}
 
     @property
@@ -85,8 +96,23 @@ class SparseSelfAttention:
                 refine = block // 128
                 fine = np.repeat(np.repeat(layout, refine, axis=1),
                                  refine, axis=2)
-                kernel = BlockSparseAttention(fine, block=128,
-                                              causal=causal)
+                density = float(np.asarray(fine, bool).mean())
+                # masked flash keeps the whole per-head block map in
+                # SMEM; cap it (64x64 int32 = 16KB fits, 16k-seq maps
+                # don't — those are low-density anyway)
+                mask_fits_smem = fine.shape[1] * fine.shape[2] * 4 <= 32768
+                if density >= self.dense_dispatch_density and \
+                        mask_fits_smem:
+                    # auto dispatch: dense-ish layouts run the masked
+                    # dense-flash kernel (same pattern semantics, cost
+                    # independent of density — never slower than dense)
+                    from ..pallas.flash_attention import \
+                        make_masked_flash_attention
+                    kernel = make_masked_flash_attention(fine,
+                                                         causal=causal)
+                else:
+                    kernel = BlockSparseAttention(fine, block=128,
+                                                  causal=causal)
             # Mid-tier for masked/rpe calls: the reference's own
             # three-op pipeline (sdd → block softmax → dsd) — compute
             # still scales with active blocks, unlike the dense fallback.

@@ -127,30 +127,39 @@ def compressed_allreduce_two_phase(x, worker_error, server_error,
 
 
 def compressed_allreduce_two_phase_host(buffers, worker_errors,
-                                        server_errors):
+                                        server_errors, n_valid=None):
     """Single-process reference of the two-phase math (one array per
     simulated rank) — the oracle the in-mesh transport is tested
-    against."""
+    against. ``n_valid`` < n marks a zero-padded tail (ragged lengths):
+    pads are excluded from both quantization scales and contribute
+    exactly 0, so they cannot distort the real elements' requantization.
+    """
     world = len(buffers)
     n = buffers[0].shape[0]
     chunk = n // world
+    if n_valid is None:
+        n_valid = n
+    valid = (jnp.arange(n) < n_valid).astype(jnp.float32)
+
     quantized, new_worker_errors = [], []
     for buf, err in zip(buffers, worker_errors):
-        compensated = jnp.asarray(buf, jnp.float32) + err
-        scale = jnp.mean(jnp.abs(compensated))
+        compensated = (jnp.asarray(buf, jnp.float32) + err) * valid
+        scale = jnp.sum(jnp.abs(compensated)) / n_valid
         signs = compensated >= 0
-        q = jnp.where(signs, scale, -scale)
+        q = jnp.where(signs, scale, -scale) * valid
         quantized.append(q)
         new_worker_errors.append(compensated - q)
 
     out_chunks, new_server_errors = [None] * world, []
     for s in range(world):
+        vchunk = valid[s * chunk:(s + 1) * chunk]
+        n_chunk_valid = jnp.maximum(jnp.sum(vchunk), 1.0)
         vals = jnp.stack([q[s * chunk:(s + 1) * chunk] for q in quantized])
         mean = jnp.mean(vals, axis=0)
-        compensated2 = mean + server_errors[s]
-        scale2 = jnp.mean(jnp.abs(compensated2))
+        compensated2 = (mean + server_errors[s]) * vchunk
+        scale2 = jnp.sum(jnp.abs(compensated2)) / n_chunk_valid
         signs2 = compensated2 >= 0
-        out = jnp.where(signs2, scale2, -scale2)
+        out = jnp.where(signs2, scale2, -scale2) * vchunk
         new_server_errors.append(compensated2 - out)
         out_chunks[s] = out
     full = jnp.concatenate(out_chunks)

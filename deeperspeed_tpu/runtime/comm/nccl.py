@@ -99,7 +99,7 @@ class NcclBackend:
 
         outs, new_worker_errors, new_server_errors = \
             compressed_allreduce_two_phase_host(buffers, errors,
-                                                server_errors)
+                                                server_errors, n_valid=n)
         if pad:
             outs = [o[:n] for o in outs]
             new_worker_errors = [e[:n] for e in new_worker_errors]
