@@ -54,6 +54,12 @@ class Autotuner:
     def cached(self, key):
         return self._cache.get((key, _device_kind()))
 
+    def store(self, key, value):
+        """Record a decision without measuring (fallback paths cache
+        their default so repeat calls skip the candidate-fitting work)."""
+        self._cache[(key, _device_kind())] = value
+        return value
+
     def pick(self, key, candidates, run):
         full_key = (key, _device_kind())
         if full_key in self._cache:
@@ -99,7 +105,10 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
     GSPMD tracing that is the GLOBAL shape, so results are a geometry
     heuristic, not a per-shard measurement. Cached per (shape, dtype,
     causal, device kind); the first miss pays a few kernel launches.
-    Oversized shapes skip measurement and keep the fattest default."""
+    NOTE: that measurement runs EAGERLY during the first jit trace of any
+    step calling this — budget the one-time latency accordingly.
+    Oversized shapes and multi-host runs skip measurement and cache the
+    fattest default."""
     from .pallas.flash_attention import (_fit_block, flash_attention,
                                          flash_attention_supported)
     import numpy as np
@@ -107,6 +116,11 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
 
     tuner = tuner or _global_tuner
     b, s, h, d = shape
+    key = ("flash", tuple(shape), str(dtype), bool(causal))
+    hit = tuner.cached(key)  # before candidate fitting: repeat calls
+    if hit is not None:      # (incl. stored fallbacks) skip the scan
+        return hit
+
     # dedupe candidates on their FITTED geometry — several requests can
     # collapse to the same block pair and must be measured once
     candidates = []
@@ -120,19 +134,14 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
         raise ValueError(f"no flash block candidates fit shape {shape}")
     if len(candidates) == 1:
         return candidates[0]
-
-    key = ("flash", tuple(shape), str(dtype), bool(causal))
-    hit = tuner.cached(key)
-    if hit is not None:
-        return hit
     # Multi-host SPMD: per-host wall-clock picks can disagree, lowering
     # DIFFERENT programs per host → deadlock at the first collective.
     # Take the deterministic default instead of measuring.
     if jax.process_count() > 1:
-        return candidates[0]
+        return tuner.store(key, candidates[0])
     itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
     if b * s * h * d * itemsize * 4 > _MAX_TUNE_BYTES:
-        return candidates[0]
+        return tuner.store(key, candidates[0])
 
     zeros = jnp.zeros(shape, dtype)
 

@@ -704,10 +704,13 @@ class DeepSpeedEngine:
 
         # The norm is a full read pass over the gradient tree; skip it
         # unless something consumes it (clipping, or monitor logging).
+        # -1.0 sentinel when skipped: a constant 0.0 reads as a measured
+        # zero norm, and a NaN sentinel would trip jax_debug_nans on
+        # every step (norms are never negative, so -1 is unambiguous).
         if cfg.gradient_clipping > 0 or self._monitor_wants_grad_norm:
             grad_norm = global_norm(grads)
         else:
-            grad_norm = jnp.asarray(0.0, jnp.float32)
+            grad_norm = jnp.asarray(-1.0, jnp.float32)
         if cfg.gradient_clipping > 0:
             grads, _ = clip_grad_norm_(grads, cfg.gradient_clipping,
                                        norm=grad_norm)
@@ -814,17 +817,26 @@ class DeepSpeedEngine:
         pipelines per-step launches, and the much larger scan program can
         compile slowly — benchmark before adopting. The LR is frozen for
         the window (the in-jit schedules — loss scale, PLD theta — still
-        advance per step)."""
+        advance per step).
+
+        RNG parity with `train_batch`: step i derives its key as
+        fold_in(base, micro_steps0 + i·gas) — exactly the per-call
+        `_next_rng` stream, so models with dropout see the SAME
+        trajectory under either path."""
         step = self._train_step_body(accum_steps)
 
-        def window(state, all_batches, rng, lr):
-            def body(st, xs):
-                step_batches, step_rng = xs
+        def window(state, all_batches, base_rng, micro_steps0, lr):
+            def body(st, i):
+                step_batches = jax.tree_util.tree_map(
+                    lambda b: b[i], all_batches)
+                step_rng = jax.random.fold_in(
+                    base_rng,
+                    micro_steps0 + i * jnp.uint32(accum_steps))
                 new_st, metrics = step(st, step_batches, step_rng, lr)
                 return new_st, metrics.loss
 
-            rngs = jax.random.split(rng, n_steps)
-            state, losses = jax.lax.scan(body, state, (all_batches, rngs))
+            state, losses = jax.lax.scan(
+                body, state, jnp.arange(n_steps, dtype=jnp.uint32))
             return state, losses
 
         return jax.jit(window, donate_argnums=(0,))
@@ -1137,15 +1149,21 @@ class DeepSpeedEngine:
             lambda x: jax.device_put(np.asarray(x),
                                      NamedSharding(self.mesh, spec)), batch)
 
+    def _get_base_rng(self):
+        """The one base key both `_next_rng` and the `train_steps` window
+        derive from (keeping their streams identical)."""
+        if not hasattr(self, "_base_rng"):
+            self._base_rng = jax.random.PRNGKey(1234)
+        return self._base_rng
+
     def _next_rng(self):
         """Deterministic per-micro-step stream. The base key is cached and
         the step counter uploaded EXPLICITLY — the hot loop stays clean
         under `jax.transfer_guard('disallow')` (implicit transfers stall
         async dispatch; tests/test_transfer_discipline.py pins this)."""
-        if not hasattr(self, "_base_rng"):
-            self._base_rng = jax.random.PRNGKey(1234)
         step = jax.device_put(np.uint32(self.micro_steps))
-        return jax.device_put(jax.random.fold_in(self._base_rng, step),
+        return jax.device_put(jax.random.fold_in(self._get_base_rng(),
+                                                 step),
                               self._replicated_sharding)
 
     @property
@@ -1211,6 +1229,8 @@ class DeepSpeedEngine:
             self._accum_loss = self._accum_loss + fwd_loss
         self._accum_count += 1
         self.micro_steps += 1
+        if self.gradient_noise_scale is not None:
+            self.gradient_noise_scale.update(grads)
         if self.store_gradients:
             self.stored_gradients = jax.tree_util.tree_map(
                 lambda g: np.asarray(g) if self._config.store_gradients_cpu
@@ -1394,6 +1414,7 @@ class DeepSpeedEngine:
             batch = jax.tree_util.tree_map(
                 lambda *xs: np.stack(xs), *micro)
         self._assert_comm_precision()
+        self._warn_gns_not_fed("train_batch")
 
         if self.param_offload:
             # ZeRO-Infinity: params stream from host/NVMe segment by
@@ -1481,13 +1502,18 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         # data axis on dim 2: dims 0/1 are the step and grad-accum scans
         sharded = self._shard_stacked_batch(batches, n_scan_dims=2)
+        self._warn_gns_not_fed("train_steps")
         key = ("window", gas, n_steps)
         if key not in self._compiled_train:
             self._compiled_train[key] = self._build_train_window(gas,
                                                                  n_steps)
         lr = self._current_lr()
+        base_rng = jax.device_put(self._get_base_rng(),
+                                  self._replicated_sharding)
+        ms0 = jax.device_put(np.uint32(self.micro_steps),
+                             self._replicated_sharding)
         self.state, losses = self._compiled_train[key](
-            self.state, sharded, self._next_rng(), lr)
+            self.state, sharded, base_rng, ms0, lr)
         self.micro_steps += gas * n_steps
         if self._config.loss_scaling_enabled:
             # dynamic scale may have skipped steps; sync from device
@@ -1539,13 +1565,30 @@ class DeepSpeedEngine:
             self.monitor.flush(drain=False)  # periodic: stay non-blocking
 
     def enable_gradient_noise_scale(self, n_batches=10, beta=0.99):
+        """GNS estimation consumes per-micro-batch gradients, which only
+        exist host-side on the forward/backward/step loop (the fused
+        train_batch keeps them on device); `backward()` feeds the
+        estimator."""
         self.gradient_noise_scale = GradientNoiseScale(
             batch_size_small=self.train_micro_batch_size_per_gpu(),
             n_batches=n_batches, beta=beta)
+        self._gns_warned = False
         # the fused steps specialize on whether grad_norm is consumed
         self._compiled_train = {}
         self._compiled_update = None
         return self.gradient_noise_scale
+
+    def _warn_gns_not_fed(self, path):
+        """Once-only: the estimator needs per-micro grads on the host —
+        only `backward()` provides them."""
+        if self.gradient_noise_scale is None or \
+                getattr(self, "_gns_warned", False):
+            return
+        self._gns_warned = True
+        logger.warning(
+            f"{path}: GradientNoiseScale is enabled but this fused path "
+            "keeps per-micro-batch gradients on device; the estimator "
+            "only updates under the forward()/backward()/step() loop")
 
     @property
     def _monitor_wants_grad_norm(self):

@@ -68,6 +68,44 @@ def test_window_matches_stepwise(overrides):
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_window_rng_stream_matches_stepwise():
+    """Stochastic models (dropout) must see the SAME per-micro-step RNG
+    stream under train_steps as under train_batch (the window derives
+    step keys as fold_in(base, micro_steps0 + i*gas))."""
+    import jax.numpy as jnp
+
+    def noisy_loss(params, batch, rng):
+        x, y = batch
+        h = x @ params["w"]
+        keep = jax.random.bernoulli(rng, 0.8, h.shape)  # dropout
+        h = jnp.where(keep, h / 0.8, 0.0)
+        return jnp.mean((h.sum(-1) - y) ** 2)
+
+    def make():
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0),
+                                         (16, 16)) * 0.1}
+        engine, *_ = deeperspeed_tpu.initialize(
+            model=noisy_loss, model_parameters=params,
+            config_params={"train_batch_size": MICRO * GAS,
+                           "gradient_accumulation_steps": GAS,
+                           "optimizer": {"type": "Adam",
+                                         "params": {"lr": 1e-2}},
+                           "steps_per_print": 1000})
+        return engine
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(N_STEPS, GAS, MICRO, 16)).astype(np.float32)
+    y = rng.normal(size=(N_STEPS, GAS, MICRO)).astype(np.float32)
+
+    e1 = make()
+    step_losses = [float(e1.train_batch(batch=(x[i], y[i])))
+                   for i in range(N_STEPS)]
+    e2 = make()
+    window_losses = np.asarray(e2.train_steps((x, y)))
+    np.testing.assert_allclose(window_losses, step_losses, rtol=1e-5,
+                               atol=1e-5)
+
+
 def test_window_advances_lr_scheduler():
     sched = {"type": "WarmupLR",
              "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
