@@ -43,8 +43,11 @@ def main():
     # ~115M-param GPT-NeoX (GPT2-small scale), seq 1024.
     cfg = GPTNeoXConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024)
+    import os
     seq = 1024
-    batch_per_chip = 32
+    # bs48 fits the 16GB chip with the single-block attention kernels and
+    # runs ~1.5% higher MFU than bs32 (bs64 OOMs); override via env.
+    batch_per_chip = int(os.environ.get("DS_BENCH_BS", "48"))
     batch = batch_per_chip * n_chips
 
     model = GPTNeoX(cfg, use_pallas=True)

@@ -133,7 +133,7 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
     if not candidates:
         raise ValueError(f"no flash block candidates fit shape {shape}")
     if len(candidates) == 1:
-        return candidates[0]
+        return tuner.store(key, candidates[0])
     # Multi-host SPMD: per-host wall-clock picks can disagree, lowering
     # DIFFERENT programs per host → deadlock at the first collective.
     # Take the deterministic default instead of measuring.

@@ -1230,7 +1230,16 @@ class DeepSpeedEngine:
         self._accum_count += 1
         self.micro_steps += 1
         if self.gradient_noise_scale is not None:
-            self.gradient_noise_scale.update(grads)
+            # feed UNSCALED, finite-checked grads: the cached grads carry
+            # the loss scale, and overflow steps would poison the EMA
+            scale = float(self.state.scale.cur_scale) \
+                if self._config.loss_scaling_enabled else 1.0
+            host_g = jax.tree_util.tree_map(
+                lambda g: np.asarray(jax.device_get(g),
+                                     np.float32) / scale, grads)
+            if all(np.isfinite(l).all()
+                   for l in jax.tree_util.tree_leaves(host_g)):
+                self.gradient_noise_scale.update(host_g)
         if self.store_gradients:
             self.stored_gradients = jax.tree_util.tree_map(
                 lambda g: np.asarray(g) if self._config.store_gradients_cpu

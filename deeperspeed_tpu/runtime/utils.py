@@ -306,9 +306,13 @@ class GradientNoiseScale:
 
     def update(self, grads):
         curr = self._flatten(grads)
-        self.buffer.append(curr)
+        # running sum, not a buffer of n_batches full gradient copies —
+        # only the mean is ever consumed, and buffering costs
+        # n_batches x model-size fp32 of live memory
+        self.buffer = [curr] if not self.buffer else \
+            [self.buffer[0] + curr]
         if self.n_updates % self.n_batches == self.n_batches - 1:
-            past = jnp.stack(self.buffer, axis=1).mean(axis=1)
+            past = self.buffer[0] / self.n_batches
             self.buffer = []
             g_big = float(jnp.mean(past ** 2))
             g_small = float(jnp.mean(curr ** 2))
