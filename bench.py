@@ -100,6 +100,61 @@ def main():
     peak = peak_flops_per_chip(devices[0])
     mfu = achieved / peak
 
+    # Secondary configs (BASELINE's primary metric is tokens/s/chip under
+    # ZeRO-3; an offload tier shows the capacity ladder's cost). Fewer
+    # steps — these report alongside, not as, the headline number.
+    import gc
+    final_loss = float(loss)
+    del engine, loss  # bs48 leaves no HBM headroom for two live engines
+    gc.collect()
+
+    def measure_config(zero_cfg, steps=3, warmup=2):
+        eng, *_ = deeperspeed_tpu.initialize(
+            model=model,
+            model_parameters=params,
+            config_params={
+                "train_batch_size": batch,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 10_000,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "fp16": {"enabled": True, "type": "bfloat16"},
+                "zero_optimization": zero_cfg,
+            })
+        for _ in range(warmup):
+            eng.train_batch(batch=stacked)
+        force(eng.state.params)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.train_batch(batch=stacked)
+        force(eng.state.params)
+        dt = time.perf_counter() - t0
+        tps = batch * seq * steps / dt / n_chips
+        del eng
+        gc.collect()
+        return round(tps, 1), round(tps * flops_per_token / peak, 4)
+
+    extra_configs = {}
+    try:
+        tps3, mfu3 = measure_config({"stage": 3})
+        extra_configs["zero3_tokens_per_sec_chip"] = tps3
+        extra_configs["zero3_mfu"] = mfu3
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        extra_configs["zero3_error"] = f"{type(e).__name__}: {e}"[:200]
+    # Host-offload is only measured when the chip link is local: every
+    # step moves the full grad set device→host and params back, which a
+    # tunneled chip turns into minutes per step (measured; a TPU-VM's
+    # local PCIe link is the real deployment). Opt in via env.
+    if os.environ.get("DS_BENCH_OFFLOAD", "0") not in ("0", "", "false"):
+        try:
+            tpso, mfuo = measure_config(
+                {"stage": 2, "offload_optimizer": {"device": "cpu"}},
+                steps=2, warmup=1)
+            extra_configs["zero2_offload_tokens_per_sec_chip"] = tpso
+            extra_configs["zero2_offload_mfu"] = mfuo
+        except Exception as e:  # noqa: BLE001
+            extra_configs["offload_error"] = \
+                f"{type(e).__name__}: {e}"[:200]
+
     print(json.dumps({
         "metric": "gpt_neox_125m_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 1),
@@ -111,9 +166,10 @@ def main():
             "mfu": round(mfu, 4),
             "achieved_tflops_per_chip": round(achieved / 1e12, 2),
             "params_m": round(n_params / 1e6, 1),
-            "final_loss": float(loss),
+            "final_loss": final_loss,
             "seq": seq,
             "batch_per_chip": batch_per_chip,
+            **extra_configs,
         },
     }))
 

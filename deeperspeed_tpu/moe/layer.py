@@ -27,34 +27,69 @@ import jax
 import jax.numpy as jnp
 
 
-def _one_hot_dispatch(gate_logits, capacity):
-    """Top-1 capacity routing.
-
-    gate_logits [T, E] fp32 → (dispatch [T, E, C] bool-ish float,
-    combine [T, E, C] float = gate prob on the kept slot, aux_loss).
-    """
-    T, E = gate_logits.shape
-    probs = jax.nn.softmax(gate_logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                     # [T]
-    gate = jnp.take_along_axis(probs, expert[:, None],
-                               axis=-1)[:, 0]               # [T]
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)   # [T, E]
-
-    # position of each token within its expert's queue
+def _choice_dispatch(onehot, capacity, base_counts=None):
+    """Per-choice capacity bookkeeping: position-ordered slots within
+    each expert's queue, offset by `base_counts` (earlier choices'
+    occupancy — GShard queues second choices AFTER all first choices).
+    Returns (dispatch [T, E, C], counts [E])."""
+    T, E = onehot.shape
     pos = jnp.cumsum(onehot, axis=0) * onehot               # [T, E]
+    if base_counts is not None:
+        pos = pos + base_counts[None, :] * onehot
     pos_in_expert = jnp.sum(pos, axis=-1) - 1.0             # [T]
     keep = pos_in_expert < capacity                         # [T]
-
     slot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
                           dtype=jnp.float32)                # [T, C]
     dispatch = onehot[:, :, None] * slot[:, None, :] * \
         keep[:, None, None]                                 # [T, E, C]
-    combine = dispatch * gate[:, None, None]
+    return dispatch, jnp.sum(onehot, axis=0)
 
-    # GShard aux loss: E * sum_e mean(prob_e) * mean(assigned_e)
+
+def _one_hot_dispatch(gate_logits, capacity, top_k=1, rng=None,
+                      jitter_eps=0.0):
+    """Top-k capacity routing (GShard: k=2 is the paper default; k=1 is
+    Switch).
+
+    gate_logits [T, E] fp32 → (dispatch [T, E, C] bool-ish float,
+    combine [T, E, C] float = normalized gate prob on the kept slot,
+    aux_loss). With `rng` and `jitter_eps`, logits get GShard's
+    multiplicative uniform jitter (training-time exploration).
+    """
+    T, E = gate_logits.shape
+    if rng is not None and jitter_eps > 0.0:
+        noise = jax.random.uniform(rng, gate_logits.shape,
+                                   minval=1.0 - jitter_eps,
+                                   maxval=1.0 + jitter_eps)
+        gate_logits = gate_logits * noise
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+
+    expert1 = jnp.argmax(probs, axis=-1)                    # [T]
+    onehot1 = jax.nn.one_hot(expert1, E, dtype=jnp.float32)
+    g1 = jnp.take_along_axis(probs, expert1[:, None], axis=-1)[:, 0]
+
+    # GShard aux loss uses the FIRST choice's assignment statistics
     me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(onehot, axis=0)
+    ce = jnp.mean(onehot1, axis=0)
     aux = E * jnp.sum(me * ce)
+
+    dispatch1, counts1 = _choice_dispatch(onehot1, capacity)
+    if top_k == 1:
+        return dispatch1, dispatch1 * g1[:, None, None], aux
+
+    if top_k != 2:
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+    probs2 = probs * (1.0 - onehot1)                        # mask top-1
+    expert2 = jnp.argmax(probs2, axis=-1)
+    onehot2 = jax.nn.one_hot(expert2, E, dtype=jnp.float32)
+    g2 = jnp.take_along_axis(probs, expert2[:, None], axis=-1)[:, 0]
+    # normalize the pair (GShard combine weights)
+    denom = g1 + g2 + 1e-9
+    g1n, g2n = g1 / denom, g2 / denom
+    dispatch2, _ = _choice_dispatch(onehot2, capacity,
+                                    base_counts=counts1)
+    dispatch = dispatch1 + dispatch2
+    combine = dispatch1 * g1n[:, None, None] + \
+        dispatch2 * g2n[:, None, None]
     return dispatch, combine, aux
 
 
@@ -64,15 +99,18 @@ def _expert_ffn(w_in, b_in, w_out, b_out, x):
     return h @ w_out.astype(x.dtype) + b_out.astype(x.dtype)
 
 
-def moe_ffn_dense(params, x, capacity_factor=1.25):
+def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
+                  jitter_eps=0.0):
     """Reference semantics on one device. params: stacked expert weights
     {"w_in" [E, H, I], "b_in" [E, I], "w_out" [E, I, H], "b_out" [E, H],
     "gate" [H, E]}; x [T, H] → (y [T, H], aux_loss)."""
     T, H = x.shape
     E = params["w_in"].shape[0]
-    capacity = max(1, int(capacity_factor * T / E))
+    capacity = max(1, int(capacity_factor * top_k * T / E))
     logits = (x @ params["gate"].astype(x.dtype)).astype(jnp.float32)
-    dispatch, combine, aux = _one_hot_dispatch(logits, capacity)
+    dispatch, combine, aux = _one_hot_dispatch(logits, capacity,
+                                               top_k=top_k, rng=rng,
+                                               jitter_eps=jitter_eps)
 
     expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
     expert_out = jax.vmap(_expert_ffn)(
@@ -82,7 +120,8 @@ def moe_ffn_dense(params, x, capacity_factor=1.25):
     return y, aux
 
 
-def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25):
+def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25,
+                            top_k=1, rng=None, jitter_eps=0.0):
     """Inside shard_map: x is this rank's token shard [T_local, H];
     params carry this rank's experts ({"w_in" [E/ep, H, I], ...}) with
     the gate replicated. all_to_all exchanges expert-major token blocks
@@ -91,9 +130,15 @@ def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25):
     T, H = x.shape
     e_local = params["w_in"].shape[0]
     E = e_local * ep
-    capacity = max(1, int(capacity_factor * T / E))
+    capacity = max(1, int(capacity_factor * top_k * T / E))
     logits = (x @ params["gate"].astype(x.dtype)).astype(jnp.float32)
-    dispatch, combine, aux = _one_hot_dispatch(logits, capacity)
+    if rng is not None:
+        # decorrelate jitter across ranks: a replicated key would give
+        # every rank's tokens identical noise (1/ep of the exploration)
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+    dispatch, combine, aux = _one_hot_dispatch(logits, capacity,
+                                               top_k=top_k, rng=rng,
+                                               jitter_eps=jitter_eps)
 
     # [T, E, C] → [E, C, H] expert-major buffers, then exchange:
     # split E = ep × e_local; all_to_all gives [ep, e_local, C, H] where
@@ -125,11 +170,13 @@ class MoELayer:
 
     def __init__(self, hidden_size, intermediate_size, num_experts,
                  capacity_factor=1.25, mesh=None, axis_name="expert",
-                 param_dtype=jnp.float32):
+                 param_dtype=jnp.float32, top_k=1, jitter_eps=0.0):
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
+        self.top_k = top_k          # 1 = Switch, 2 = GShard default
+        self.jitter_eps = jitter_eps
         self.axis_name = axis_name
         self.ep = int(mesh.shape[axis_name]) \
             if mesh is not None and axis_name in mesh.axis_names else 1
@@ -162,11 +209,12 @@ class MoELayer:
         shard_map depending on construction."""
         lead = x.shape[:-1]
         flat = x.reshape(-1, self.hidden_size)
+        kw = dict(capacity_factor=self.capacity_factor, top_k=self.top_k,
+                  rng=rng, jitter_eps=self.jitter_eps if rng is not None
+                  else 0.0)
         if self.ep > 1:
             y, aux = moe_ffn_expert_parallel(
-                params, flat, self.axis_name, self.ep,
-                capacity_factor=self.capacity_factor)
+                params, flat, self.axis_name, self.ep, **kw)
         else:
-            y, aux = moe_ffn_dense(params, flat,
-                                   capacity_factor=self.capacity_factor)
+            y, aux = moe_ffn_dense(params, flat, **kw)
         return y.reshape(*lead, self.hidden_size), aux

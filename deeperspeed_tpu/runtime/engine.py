@@ -811,13 +811,16 @@ class DeepSpeedEngine:
         """Fused multi-step window: `lax.scan` over WHOLE training steps.
 
         Dispatching one jit per step costs a fixed host/runtime latency
-        that the window pays once. Worth it on pod runtimes with real
-        per-dispatch cost and device-resident data pipelines; on
-        single-chip/tunneled backends XLA's async dispatch already
-        pipelines per-step launches, and the much larger scan program can
-        compile slowly — benchmark before adopting. The LR is frozen for
-        the window (the in-jit schedules — loss scale, PLD theta — still
-        advance per step).
+        that the window pays once. Measured (v5e single chip, GPT-NeoX
+        125M bs32, 4-step window, 2026-07): the window compiles twice
+        (the second call retraces once when the donated state's layouts
+        settle) then runs steady at ~335 ms/step vs ~318 ms/step for the
+        per-step loop — XLA's async dispatch already pipelines per-step
+        launches on a single chip, so the window only pays off where
+        dispatch is NOT hidden (multi-host pods with slow coordination,
+        or host-bound input pipelines). The LR is frozen for the window
+        (the in-jit schedules — loss scale, PLD theta — still advance
+        per step).
 
         RNG parity with `train_batch`: step i derives its key as
         fold_in(base, micro_steps0 + i·gas) — exactly the per-call
@@ -913,11 +916,20 @@ class DeepSpeedEngine:
 
     def _host_apply_update(self, grads):
         """ZeRO-Offload update: unscale/clip/step on host DRAM (or NVMe via
-        the pipelined swapper), upload compute-dtype params."""
+        the pipelined swapper), upload compute-dtype params. Grad pulls
+        overlap: every leaf's device→host DMA starts before the first
+        blocking read, so later transfers ride under earlier leaves'
+        unscale/step work (the reference overlaps copies with compute in
+        `cpu_adam.cpp` Step_4/Step_8)."""
         scale = float(self.state.scale.cur_scale)
+        leaves = jax.tree_util.tree_leaves(grads)
+        for leaf in leaves:
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:  # non-jax leaf (host fallback paths)
+                pass
         flat_grads = [np.asarray(jax.device_get(g), np.float32).reshape(-1)
-                      / scale
-                      for g in jax.tree_util.tree_leaves(grads)]
+                      / scale for g in leaves]
         return self._host_step_flat(flat_grads, scale)
 
     def _host_step_flat(self, flat_grads, scale):

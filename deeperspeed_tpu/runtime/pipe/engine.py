@@ -156,7 +156,8 @@ class PipelineEngine(DeepSpeedEngine):
     def eval_batch(self, data_iter=None, batch=None, return_logits=False,
                    layers_to_hook=None):
         """Forward-only evaluation over micro-batches (reference
-        `pipe/engine.py:351`; `return_logits` is a fork addition)."""
+        `pipe/engine.py:351`; `return_logits` is a fork addition). ONE
+        jitted call scans all micro-batches — no per-micro dispatch."""
         if layers_to_hook is not None:
             self.set_layers_to_hook(layers_to_hook)
         gas = self.gradient_accumulation_steps()
@@ -164,25 +165,42 @@ class PipelineEngine(DeepSpeedEngine):
             micro = [next(data_iter) for _ in range(gas)]
             batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro)
 
-        losses = []
-        logits = []
         module = self.pipeline_module
-        for i in range(gas):
-            mb = jax.tree_util.tree_map(lambda x: x[i], batch)
-            mb = self._shard_batch(mb)
-            inputs, labels = mb
-            outputs = self._forward_logits(inputs)
-            if module.loss_fn is not None:
-                losses.append(module.loss_fn(outputs, labels))
-            else:
-                losses.append(outputs)
-            if return_logits:
-                logits.append(outputs)
+        # cache key: logits retention changes peak memory (stacking every
+        # micro-batch's logits OOMs loss-only eval of LM-head models),
+        # and a later-attached loss_fn must not hit a stale closure
+        key = (bool(return_logits), module.loss_fn is not None)
+        if not hasattr(self, "_compiled_pipe_eval"):
+            self._compiled_pipe_eval = {}
+        if key not in self._compiled_pipe_eval:
+
+            def eval_all(params, stacked, _return_logits=return_logits):
+                def one(_, mb):
+                    inputs, labels = mb
+                    outputs = module.forward(params, inputs)
+                    loss = (module.loss_fn(outputs, labels)
+                            if module.loss_fn is not None
+                            else jnp.mean(outputs))
+                    # keep logits only when asked: stacking all micro
+                    # batches' outputs is a large live-memory cost
+                    return None, ((loss, outputs) if _return_logits
+                                  else (loss,))
+
+                _, res = jax.lax.scan(one, None, stacked)
+                if _return_logits:
+                    losses, outs = res
+                    return jnp.mean(losses), outs
+                return (jnp.mean(res[0]),)
+
+            self._compiled_pipe_eval[key] = jax.jit(eval_all)
+
+        sharded = self._shard_stacked_batch(batch)
+        result = self._compiled_pipe_eval[key](self.state.params, sharded)
         self._capture_hooks(batch)
-        mean_loss = jnp.mean(jnp.stack(losses))
         if return_logits:
-            return mean_loss, jnp.concatenate(logits, axis=0)
-        return mean_loss
+            mean_loss, outs = result
+            return mean_loss, outs.reshape((-1,) + outs.shape[2:])
+        return result[0]
 
     def inference_batch(self, data_iter=None, batch=None,
                         layers_to_hook=None):

@@ -101,3 +101,80 @@ def test_moe_layer_trains_through_engine(devices):
     y = rng.normal(size=(1, 16, H)).astype(np.float32) * 0.1
     losses = [float(engine.train_batch(batch=(x, y))) for _ in range(25)]
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+# --- top-2 gating (GShard default) ----------------------------------------
+
+def test_top2_dense_routes_two_experts():
+    params = _params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(2), (24, H), jnp.float32)
+    y1, _ = moe_ffn_dense(params, x, top_k=1)
+    y2, _ = moe_ffn_dense(params, x, top_k=2)
+    assert y2.shape == x.shape
+    assert np.isfinite(np.asarray(y2)).all()
+    # top-2 output differs from top-1 (second expert contributes)
+    assert np.abs(np.asarray(y2) - np.asarray(y1)).max() > 1e-6
+
+
+def test_top2_combine_weights_normalized():
+    """With ample capacity, each token's combine weights over its two
+    experts sum to ~1 (GShard normalization)."""
+    from deeperspeed_tpu.moe.layer import _one_hot_dispatch
+    logits = jax.random.normal(jax.random.PRNGKey(3), (16, E),
+                               jnp.float32)
+    dispatch, combine, _ = _one_hot_dispatch(logits, capacity=16, top_k=2)
+    per_token = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    np.testing.assert_allclose(per_token, 1.0, atol=1e-5)
+    # and each token occupies exactly two slots
+    slots = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    np.testing.assert_allclose(slots, 2.0, atol=1e-6)
+
+
+def test_top2_second_choices_queue_after_first():
+    """Capacity is consumed by first choices before any second choice
+    (GShard queueing): with capacity == exact top-1 load, second choices
+    overflow."""
+    from deeperspeed_tpu.moe.layer import _one_hot_dispatch
+    # all tokens: top1 = expert 0, top2 = expert 1
+    logits = jnp.tile(jnp.asarray([[2.0, 1.0, -5.0, -5.0]]), (4, 1))
+    dispatch, combine, _ = _one_hot_dispatch(logits, capacity=4, top_k=2)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == 4          # all first choices kept
+    assert d[:, 1].sum() == 4          # second choices fill expert 1
+    dispatch, _, _ = _one_hot_dispatch(logits, capacity=2, top_k=2)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == 2          # first two tokens keep expert 0
+    assert d[:, 1].sum() == 2
+
+
+def test_top2_expert_parallel_matches_dense(devices):
+    ep = 4
+    mesh = Mesh(np.asarray(devices[:ep]), ("expert",))
+    layer = MoELayer(H, I, E, mesh=mesh, top_k=2)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(4), (ep * 8, H), jnp.float32)
+
+    # per-shard dense reference (each rank routes its own tokens)
+    refs = [moe_ffn_dense(params, x[r * 8:(r + 1) * 8], top_k=2)[0]
+            for r in range(ep)]
+    ref = jnp.concatenate(refs, axis=0)
+
+    mapped = shard_map(
+        lambda p, x: moe_ffn_expert_parallel(p, x, "expert", ep, top_k=2),
+        mesh=mesh, in_specs=(layer.param_specs(), P("expert")),
+        out_specs=(P("expert"), P()), check_vma=False)
+    y, aux = jax.jit(mapped)(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gate_jitter_changes_routing_only_with_rng():
+    layer = MoELayer(H, I, E, top_k=2, jitter_eps=0.3)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(5), (32, H), jnp.float32)
+    y_det, _ = layer.apply(params, x)            # no rng → no jitter
+    y_det2, _ = layer.apply(params, x)
+    np.testing.assert_array_equal(np.asarray(y_det), np.asarray(y_det2))
+    y_a, _ = layer.apply(params, x, rng=jax.random.PRNGKey(1))
+    y_b, _ = layer.apply(params, x, rng=jax.random.PRNGKey(2))
+    assert np.abs(np.asarray(y_a) - np.asarray(y_b)).max() > 1e-8
