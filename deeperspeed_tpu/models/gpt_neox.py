@@ -342,14 +342,22 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
     `collect_hidden` also returns [embed, block outputs..., final norm]
     (the activation-capture path shares this exact forward)."""
     x = params["embed"]["wte"][tokens]
-    cos_sin = _rotary_cache(cfg, tokens.shape[1])
+    cos, sin, rot_dim = _rotary_cache(cfg, tokens.shape[1])
     hidden = [x] if collect_hidden else None
 
-    block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
     if remat_blocks:
-        block_fn = jax.checkpoint(block_fn, static_argnums=())
+        # rot_dim must stay a STATIC python int: routed through
+        # jax.checkpoint's traced args it becomes an int32 tracer and
+        # the rotary slice bound blows up; close over it instead
+        ck = jax.checkpoint(
+            lambda bp, x, cos, sin: block_forward(
+                cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas))
+        block_fn = lambda bp, x: ck(bp, x, cos, sin)       # noqa: E731
+    else:
+        block_fn = lambda bp, x: block_forward(            # noqa: E731
+            cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas)
     for bp in params["blocks"]:
-        x = block_fn(bp, x, cos_sin)
+        x = block_fn(bp, x)
         if collect_hidden:
             hidden.append(x)
 

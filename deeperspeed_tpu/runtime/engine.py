@@ -610,6 +610,12 @@ class DeepSpeedEngine:
             # reshape(-1) views, and device_get on TPU can return F-order
             # arrays whose reshape(-1) would be a silent COPY (the update
             # would vanish). order="K" (the default) preserves F-order.
+            if isinstance(p, np.ndarray):
+                # host-resident init must NOT bounce through HBM — the
+                # whole point of this mode is params larger than HBM
+                # (np.dtype(jnp.bfloat16) resolves via ml_dtypes)
+                return np.array(p, dtype=np.dtype(self.compute_dtype),
+                                order="C")
             return np.array(np.asarray(
                 jax.device_get(jnp.asarray(p, self.compute_dtype))),
                 order="C")

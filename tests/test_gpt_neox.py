@@ -176,3 +176,20 @@ def test_generate_sampling_shapes_and_determinism():
                        rng=jax.random.PRNGKey(3))
     assert a.shape == (1, 5)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_remat_blocks_matches_no_remat_under_jit():
+    """remat_blocks must not change the math — and must TRACE: python
+    ints routed through jax.checkpoint args become tracers (the rotary
+    rot_dim slice bound), so statics stay closed over."""
+    m1 = gpt_neox.GPTNeoX(CFG, use_pallas=False, remat_blocks=False)
+    m2 = gpt_neox.GPTNeoX(CFG, use_pallas=False, remat_blocks=True)
+    p = m1.init_params(jax.random.PRNGKey(0))
+    toks = np.random.default_rng(0).integers(0, CFG.vocab_size, (4, 32),
+                                             np.int32)
+    l1 = float(jax.jit(lambda p: m1.loss_fn(p, (toks, toks)))(p))
+    l2 = float(jax.jit(lambda p: m2.loss_fn(p, (toks, toks)))(p))
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+    g = jax.jit(jax.grad(lambda p: m2.loss_fn(p, (toks, toks))))(p)
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(g))
