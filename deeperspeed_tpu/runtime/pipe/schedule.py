@@ -19,14 +19,6 @@ from abc import ABC, abstractmethod
 from ..utils import call_to_str
 
 
-def _is_even(x):
-    return x % 2 == 0
-
-
-def _is_odd(x):
-    return x % 2 != 0
-
-
 class PipeSchedule(ABC):
     """Generates instruction sequences to process one batch's micro-batches.
 
@@ -91,44 +83,37 @@ class PipeSchedule(ABC):
 
 
 class InferenceSchedule(PipeSchedule):
-    """Forward-only pipeline; two alternating buffers per stage."""
+    """Forward-only pipeline: micro-batch m runs on stage s at step
+    t = s + m (the forward wavefront moves one stage per step), with two
+    alternating buffers per stage — compute lands in one buffer while the
+    previous step's result ships out of the other."""
 
     def steps(self):
-        total_steps = self.micro_batches + self.stages - 1
-        for step_id in range(total_steps):
+        for t in range(self.micro_batches + self.stages - 1):
             cmds = []
-            micro_batch_id = step_id - self.stage_id
+            m = t - self.stage_id
+            # Buffer roles flip every step; the stage offset keeps a
+            # sender's out-buffer aligned with its neighbor's in-buffer.
+            work_buf = (t + self.stage_id) % 2
+            ship_buf = 1 - work_buf
 
-            if _is_even(self.stage_id):
-                recv_buf = step_id % 2
-                send_buf = (step_id + 1) % 2
-            else:
-                recv_buf = (step_id + 1) % 2
-                send_buf = step_id % 2
+            if (self.is_first_stage or self.is_last_stage) and \
+                    self._valid_micro_batch(m):
+                cmds.append(LoadMicroBatch(work_buf))
 
-            if self.is_first_stage or self.is_last_stage:
-                if self._valid_micro_batch(micro_batch_id):
-                    cmds.append(LoadMicroBatch(recv_buf))
+            sends = [SendActivation(ship_buf)] \
+                if not self.is_last_stage and \
+                self._valid_micro_batch(m - 1) else []
+            recvs = [RecvActivation(work_buf)] \
+                if not self.is_first_stage and \
+                self._valid_micro_batch(m) else []
+            # Even stages send before receiving, odd stages the reverse,
+            # so eager rendezvous transports pair up without deadlock.
+            cmds += sends + recvs if self.stage_id % 2 == 0 \
+                else recvs + sends
 
-            # Even stages send before receiving; odd stages the reverse —
-            # pairwise exchanges can then rendezvous without deadlock.
-            if _is_even(self.stage_id):
-                if self._valid_stage(self.next_stage) and \
-                        self._valid_micro_batch(micro_batch_id - 1):
-                    cmds.append(SendActivation(send_buf))
-                if self._valid_stage(self.prev_stage) and \
-                        self._valid_micro_batch(micro_batch_id):
-                    cmds.append(RecvActivation(recv_buf))
-            else:
-                if self._valid_stage(self.prev_stage) and \
-                        self._valid_micro_batch(micro_batch_id):
-                    cmds.append(RecvActivation(recv_buf))
-                if self._valid_stage(self.next_stage) and \
-                        self._valid_micro_batch(micro_batch_id - 1):
-                    cmds.append(SendActivation(send_buf))
-
-            if self._valid_micro_batch(micro_batch_id):
-                cmds.append(ForwardPass(recv_buf))
+            if self._valid_micro_batch(m):
+                cmds.append(ForwardPass(work_buf))
 
             yield cmds
 
@@ -139,83 +124,87 @@ class InferenceSchedule(PipeSchedule):
 class TrainSchedule(PipeSchedule):
     """1F1B-interleaved training schedule: pipeline parallelism extracted
     through gradient accumulation, so convergence matches data parallelism
-    at the same effective batch."""
+    at the same effective batch.
+
+    The whole interleave collapses to two linear clocks over half-steps
+    ``t`` in ``[0, 2*(micro_batches + stages - 1))``:
+
+    - forward of micro-batch ``m`` runs on stage ``s`` at ``t = s + 2m``
+    - backward of micro-batch ``m`` on stage ``s`` at ``t = 2S - 1 - s + 2m``
+
+    Forward ticks share the stage's parity and backward ticks the
+    opposite, so every stage strictly alternates F/B slots while the two
+    wavefronts sweep the pipe in opposite directions at one stage per
+    step. `steps()` inverts the clocks at each ``t``; a unit's product
+    ships on the following half-step, which is exactly when the
+    neighbor's matching recv fires (same-``t`` rendezvous).
+    """
 
     def steps(self):
-        prev_micro_batch_id = -1
-        total_steps = 2 * (self.micro_batches + self.stages - 1)
-        for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
-
-            if self._valid_micro_batch(prev_micro_batch_id):
-                prev_buffer = self._buffer_idx(prev_micro_batch_id)
-            if self._valid_micro_batch(micro_batch_id):
-                curr_buffer = self._buffer_idx(micro_batch_id)
-
+        total = 2 * (self.micro_batches + self.stages - 1)
+        for t in range(total):
             cmds = []
+            work = self._work_at(t)          # (micro, is_forward) or None
+            made = self._work_at(t - 1)      # last half-step's product
 
-            if is_forward:
-                if self._valid_micro_batch(micro_batch_id) and \
-                        self._valid_stage(self.prev_stage):
-                    cmds.append(RecvActivation(curr_buffer))
-                if self._valid_micro_batch(prev_micro_batch_id) and \
-                        self._valid_stage(self.prev_stage):
-                    cmds.append(SendGrad(prev_buffer))
-            else:
-                if self._valid_micro_batch(prev_micro_batch_id) and \
-                        self._valid_stage(self.next_stage):
-                    cmds.append(SendActivation(prev_buffer))
-                if self._valid_micro_batch(micro_batch_id) and \
-                        self._valid_stage(self.next_stage):
-                    cmds.append(RecvGrad(curr_buffer))
+            # A forward unit's dependency arrives from upstream first.
+            if work is not None and work[1] and not self.is_first_stage:
+                cmds.append(RecvActivation(self._buffer_idx(work[0])))
 
-            if self.stage_id == 0 or self.stage_id == self.stages - 1:
-                if is_forward and self._valid_micro_batch(micro_batch_id):
-                    cmds.append(LoadMicroBatch(curr_buffer))
+            # Ship what this stage produced one half-step ago: forward
+            # products flow down as activations, backward products flow
+            # up as input gradients.
+            if made is not None:
+                pbuf = self._buffer_idx(made[0])
+                if made[1] and not self.is_last_stage:
+                    cmds.append(SendActivation(pbuf))
+                elif not made[1] and not self.is_first_stage:
+                    cmds.append(SendGrad(pbuf))
 
-            if self._valid_micro_batch(micro_batch_id):
-                if is_forward:
-                    cmds.append(ForwardPass(curr_buffer))
-                else:
-                    cmds.append(BackwardPass(curr_buffer))
+            # A backward unit's dependency arrives from downstream.
+            if work is not None and not work[1] and not self.is_last_stage:
+                cmds.append(RecvGrad(self._buffer_idx(work[0])))
 
-            if step_id == total_steps - 1:
+            if work is not None:
+                m, fwd = work
+                buf = self._buffer_idx(m)
+                if fwd and (self.is_first_stage or self.is_last_stage):
+                    cmds.append(LoadMicroBatch(buf))
+                cmds.append(ForwardPass(buf) if fwd else BackwardPass(buf))
+
+            if t == total - 1:
                 cmds.append(ReduceTiedGrads())
                 cmds.append(ReduceGrads())
                 cmds.append(OptimizerStep())
 
-            prev_micro_batch_id = micro_batch_id
             yield cmds
 
     def num_pipe_buffers(self):
         buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
         return max(2, buffers)
 
+    def _clock_at(self, t):
+        """Raw clock inversion at half-step ``t``: (micro_batch_id,
+        is_forward), where the id may be out of range (fill/drain bubble).
+        The clocks have disjoint parities at a fixed stage, so exactly one
+        applies."""
+        if (t - self.stage_id) % 2 == 0:
+            return (t - self.stage_id) // 2, True
+        return (t - (2 * self.stages - 1 - self.stage_id)) // 2, False
+
+    def _work_at(self, t):
+        """(micro_batch_id, is_forward) scheduled at half-step ``t``, or
+        None when the stage idles in the fill/drain bubble."""
+        if t < 0:
+            return None
+        m, fwd = self._clock_at(t)
+        return (m, fwd) if self._valid_micro_batch(m) else None
+
     def _step_to_micro_batch(self, step_id):
-        """Map a schedule step to (micro_batch_id, is_forward): even stages
-        run forwards on even steps, odd stages on odd steps (1F1B
-        interleave; reference `schedule.py:249-289`)."""
-        if _is_even(step_id) and _is_even(self.stage_id):
-            return self._even_step_forward_id(step_id), True
-        if _is_odd(step_id) and _is_odd(self.stage_id):
-            return self._odd_step_forward_id(step_id), True
-        if _is_even(step_id) and _is_odd(self.stage_id):
-            return self._even_step_backward_id(step_id), False
-        if _is_odd(step_id) and _is_even(self.stage_id):
-            return self._odd_step_backward_id(step_id), False
-        raise AssertionError("unreachable")
-
-    def _even_step_forward_id(self, step_id):
-        return step_id // 2 - self.stage_id // 2
-
-    def _odd_step_forward_id(self, step_id):
-        return (step_id - 1) // 2 - self.stage_id // 2
-
-    def _even_step_backward_id(self, step_id):
-        return step_id // 2 - self.stages + (self.stage_id + 1) // 2
-
-    def _odd_step_backward_id(self, step_id):
-        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
+        """Compat shim (reference exposes this name); returns the clock
+        position even when the id is out of range, per the reference
+        contract."""
+        return self._clock_at(step_id)
 
 
 class DataParallelSchedule(PipeSchedule):

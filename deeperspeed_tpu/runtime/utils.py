@@ -7,9 +7,6 @@ scatter/gather container used for activation ("slice") parallelism
 `GradientNoiseScale` estimator (reference `utils.py:618-674`).
 """
 
-from bisect import bisect_left
-from math import floor
-
 import numpy as np
 
 import jax
@@ -86,77 +83,78 @@ def get_weight_norm(weights, mpu=None, norm_type=2):
 
 def prefix_sum_inc(weights):
     """Inclusive prefix sum: [3,4,5] -> [3,7,12]."""
-    out = list(weights)
-    for i in range(1, len(out)):
-        out[i] += out[i - 1]
-    return out
+    return np.cumsum(np.asarray(weights)).tolist()
 
 
 def partition_uniform(num_items, num_parts):
-    parts = [0] * (num_parts + 1)
+    """Evenly spaced boundaries; part p owns [bounds[p], bounds[p+1]).
+    The trailing part absorbs the division remainder; with fewer items
+    than parts, each item gets its own part and the rest sit empty."""
     if num_items <= num_parts:
-        for p in range(num_parts + 1):
-            parts[p] = min(p, num_items)
-        return parts
-    chunksize = floor(num_items / num_parts)
-    for p in range(num_parts):
-        parts[p] = min(chunksize * p, num_items)
-    parts[num_parts] = num_items
-    return parts
+        bounds = np.minimum(np.arange(num_parts + 1), num_items)
+    else:
+        bounds = np.arange(num_parts + 1) * (num_items // num_parts)
+        bounds[num_parts] = num_items
+    return bounds.tolist()
 
 
-def _lprobe(weights, num_parts, bottleneck):
-    """Greedy left-to-right probe: can `weights` (inclusive prefix sums) be
-    split into `num_parts` with no part heavier than `bottleneck`?"""
-    num_items = len(weights)
-    total_weight = weights[-1]
-
-    parts = [0] * (num_parts + 1)
-    for p in range(1, num_parts + 1):
-        parts[p] = num_items
-
-    bsum = bottleneck
-    chunksize = num_items // num_parts
-    step = chunksize
-    for p in range(1, num_parts):
-        while step < num_items and weights[step] < bsum:
-            step += chunksize
-        parts[p] = bisect_left(weights, bsum, lo=step - chunksize,
-                               hi=min(step, num_items))
-        if parts[p] == num_items:
-            part_size = weights[-1] - weights[parts[p - 1]]
-            return parts, part_size < bottleneck
-        bsum = weights[parts[p] - 1] + bottleneck
-
-    return parts, bsum >= total_weight
+def _greedy_cuts(csum, num_parts, cap):
+    """First-fit sweep over inclusive prefix sums `csum`: each cut is the
+    furthest index that keeps the open part's weight within `cap`
+    (np.searchsorted). Returns num_parts+1 boundaries; when the sweep
+    finishes early the unused trailing parts sit empty at n."""
+    n = len(csum)
+    bounds = [0]
+    base = 0.0
+    for _ in range(num_parts - 1):
+        cut = int(np.searchsorted(csum, base + cap, side="right"))
+        # `base + cap` can round across an exact prefix-sum boundary;
+        # settle the cut against the directly-computed part weight.
+        while cut < n and float(csum[cut]) - base <= cap:
+            cut += 1
+        while cut - 1 > bounds[-1] and float(csum[cut - 1]) - base > cap:
+            cut -= 1
+        cut = min(max(cut, bounds[-1] + 1), n)  # always advance, never past n
+        bounds.append(cut)
+        base = float(csum[cut - 1])
+    bounds.append(n)
+    return bounds
 
 
-def _rb_partition_balanced(weights, num_parts, eps):
-    """Binary-search the smallest feasible bottleneck."""
-    total_weight = weights[-1]
-    lower = total_weight / num_parts
-    upper = total_weight
-    while upper > lower + eps:
-        mid = lower + ((upper - lower) / 2)
-        _, success = _lprobe(weights, num_parts, mid)
-        if success:
-            upper = mid
-        else:
-            lower = mid + eps
-    return upper
+def _fits(csum, num_parts, cap):
+    """Does the first-fit sweep at `cap` leave every part — including the
+    forced-advance and tail parts — no heavier than `cap`?"""
+    bounds = _greedy_cuts(csum, num_parts, cap)
+    prev = 0.0
+    for b in bounds[1:]:
+        here = float(csum[b - 1]) if b > 0 else 0.0
+        if here - prev > cap:
+            return False
+        prev = here
+    return True
 
 
 def partition_balanced(weights, num_parts, eps=1e-3):
-    """Split items into contiguous parts minimizing the heaviest part
-    (reference `utils.py:399`). Returns num_parts+1 boundary indices."""
+    """Contiguous split of `weights` into `num_parts` parts minimizing the
+    heaviest part (reference contract, `utils.py:399`). Returns
+    num_parts+1 boundary indices.
+
+    Bisects on the bottleneck value between total/num_parts (perfect
+    balance) and total (everything in one part), with the first-fit sweep
+    as feasibility oracle, then cuts at the converged cap."""
     num_items = len(weights)
     if num_items <= num_parts:
         return partition_uniform(num_items, num_parts)
-    prefix = prefix_sum_inc(weights)
-    bottleneck = _rb_partition_balanced(prefix, num_parts, eps=eps)
-    parts, success = _lprobe(prefix, num_parts, bottleneck)
-    assert success
-    return parts
+    csum = np.cumsum(np.asarray(weights, dtype=np.float64))
+    total = float(csum[-1])
+    lo, hi = total / num_parts, total
+    while hi - lo > eps:
+        mid = (lo + hi) / 2
+        if _fits(csum, num_parts, mid):
+            hi = mid
+        else:
+            lo = mid
+    return _greedy_cuts(csum, num_parts, hi)
 
 
 # ---------------------------------------------------------------------------
