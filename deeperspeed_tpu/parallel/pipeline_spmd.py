@@ -344,8 +344,12 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
                 x = jnp.where(stage == 0, inject, buf)
                 # per-micro-batch stream (layer-level fold_in happens in
                 # forward_range); stochastic layers get distinct keys per
-                # micro-batch, like the sequential gas scan
-                y = body(x, jax.random.fold_in(rng, idx))
+                # micro-batch, like the sequential gas scan. The micro in
+                # flight at THIS stage at tick t is t - stage (stage 0's
+                # index `idx` would make drain ticks reuse late micros'
+                # keys downstream).
+                mb_idx = jnp.clip(t - stage, 0, n_micro - 1)
+                y = body(x, jax.random.fold_in(rng, mb_idx))
                 out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
                 # select (NaN-safe), not a blend — see spmd_pipeline
                 write = t >= n_stages - 1

@@ -43,20 +43,42 @@ def compressed_allreduce_dense(x, worker_error, axis_name):
 
 
 def compressed_allreduce_dense_two_phase(x, worker_error, server_error,
-                                         axis_name):
+                                         axis_name, n_valid=None):
     """Dense collectives with the reference's FULL two-phase semantics
     (`comm/nccl.py:47-186`): worker sign+scale with error feedback, mean,
     then server-side requantization with its own error buffer. Works on
     arbitrary-shaped leaves inside shard_map or replicated jit (where the
     server phase computes identically on every rank, i.e. one logical
     server). The packed transport (`compressed_allreduce_two_phase`) is
-    the wire-optimal variant of the same math for flat buffers."""
-    compensated = x + worker_error
-    quantized, new_worker_error = _sign_scale(compensated)
+    the wire-optimal variant of the same math for flat buffers.
+
+    ``n_valid`` (static) marks a zero-padded tail in a flat leaf (the
+    ZeRO flat-pad master layout): pad lanes are excluded from the
+    quantization scales and pinned to exactly 0 in the output and both
+    error buffers — otherwise sign(0)=+1 writes ±scale into lanes that
+    must stay zero (and would leak into momentum/master tails)."""
+    if n_valid is None or n_valid == x.size:
+        compensated = x + worker_error
+        quantized, new_worker_error = _sign_scale(compensated)
+        averaged = (jax.lax.pmean(quantized, axis_name=axis_name)
+                    if axis_name is not None else quantized)
+        compensated2 = averaged + server_error
+        out, new_server_error = _sign_scale(compensated2)
+        return out, new_worker_error, new_server_error
+
+    valid = (jnp.arange(x.size) < n_valid).reshape(x.shape).astype(x.dtype)
+
+    def sign_scale_valid(v):
+        scale = jnp.sum(jnp.abs(v)) / n_valid
+        q = jnp.where(v >= 0, scale, -scale) * valid
+        return q, v - q
+
+    compensated = (x + worker_error) * valid
+    quantized, new_worker_error = sign_scale_valid(compensated)
     averaged = (jax.lax.pmean(quantized, axis_name=axis_name)
                 if axis_name is not None else quantized)
-    compensated2 = averaged + server_error
-    out, new_server_error = _sign_scale(compensated2)
+    compensated2 = (averaged + server_error) * valid
+    out, new_server_error = sign_scale_valid(compensated2)
     return out, new_worker_error, new_server_error
 
 
@@ -86,7 +108,7 @@ def wire_pad(n, world):
 
 
 def compressed_allreduce_two_phase(x, worker_error, server_error,
-                                   axis_name, world):
+                                   axis_name, world, n_valid=None):
     """The reference's ACTUAL transport (`comm/nccl.py:47-186`), inside
     shard_map: packed sign bits move via all_to_all (worker→server
     chunks) and all_gather (server results), with two-phase error
@@ -97,33 +119,46 @@ def compressed_allreduce_two_phase(x, worker_error, server_error,
       x: flat [n] tensor, n % (world·8) == 0 (see `wire_pad`).
       worker_error: [n] phase-1 error-feedback buffer.
       server_error: [n // world] phase-2 (server-chunk) error buffer.
+      n_valid: static count of real elements; lanes >= n_valid are a
+        zero-padded tail (ragged lengths), excluded from both phases'
+        quantization scales and pinned to 0 in outputs and errors —
+        mirroring the host oracle `compressed_allreduce_two_phase_host`.
     Returns (allreduced [n], new_worker_error, new_server_error).
     """
     n = x.shape[0]
     chunk = n // world
+    if n_valid is None:
+        n_valid = n
+    valid = (jnp.arange(n) < n_valid).astype(x.dtype)
 
     # phase 1: worker quantization with error feedback
-    compensated = x + worker_error
-    scale = jnp.mean(jnp.abs(compensated))
+    compensated = (x + worker_error) * valid
+    scale = jnp.sum(jnp.abs(compensated)) / n_valid
     signs = compensated >= 0
-    new_worker_error = compensated - jnp.where(signs, scale, -scale)
+    new_worker_error = compensated - jnp.where(signs, scale, -scale) * valid
     packed = pack_signs(signs.reshape(world, chunk))          # [w, c/8] u8
     recv = jax.lax.all_to_all(packed, axis_name, 0, 0, tiled=False)
     recv = recv.reshape(world, chunk // 8)
     scales = jax.lax.all_gather(scale, axis_name)             # [w] f32
 
-    # phase 2: server average + requantization with server error
-    vals = unpack_signs(recv) * scales[:, None]               # [w, c]
+    # phase 2: server average + requantization with server error.
+    # This rank serves chunk lanes [rank*chunk, rank*chunk + chunk).
+    rank = jax.lax.axis_index(axis_name)
+    vchunk = jax.lax.dynamic_slice(valid, (rank * chunk,), (chunk,))
+    n_chunk_valid = jnp.maximum(jnp.sum(vchunk), 1.0)
+    # pad lanes' sign bits unpack to +1; re-mask before averaging
+    vals = unpack_signs(recv) * scales[:, None] * vchunk      # [w, c]
     mean = jnp.mean(vals, axis=0)
-    compensated2 = mean + server_error
-    scale2 = jnp.mean(jnp.abs(compensated2))
+    compensated2 = (mean + server_error) * vchunk
+    scale2 = jnp.sum(jnp.abs(compensated2)) / n_chunk_valid
     signs2 = compensated2 >= 0
-    new_server_error = compensated2 - jnp.where(signs2, scale2, -scale2)
+    new_server_error = compensated2 - \
+        jnp.where(signs2, scale2, -scale2) * vchunk
     packed2 = pack_signs(signs2[None, :])[0]                  # [c/8] u8
     all_packed = jax.lax.all_gather(packed2, axis_name)       # [w, c/8]
     all_scales = jax.lax.all_gather(scale2, axis_name)        # [w]
     out = (unpack_signs(all_packed) * all_scales[:, None]).reshape(n)
-    return out, new_worker_error, new_server_error
+    return out * valid, new_worker_error, new_server_error
 
 
 def compressed_allreduce_two_phase_host(buffers, worker_errors,

@@ -47,7 +47,8 @@ from .lr_schedules import get_scheduler_class
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import GradientNoiseScale, clip_grad_norm_, global_norm
 from .zero.partition_parameters import (ZeroShardingRules, flat_pad,
-                                        flat_unpad, map_master_fields)
+                                        flat_unpad, map_master_fields,
+                                        to_layout_leaf, to_natural_leaf)
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
@@ -457,17 +458,14 @@ class DeepSpeedEngine:
         """Master/moment tree in storage layout → natural param shapes
         (flat-padded leaves unpadded/reshaped). Used by checkpoint save so
         files are world-size independent."""
-        return jax.tree_util.tree_map(
-            lambda x, info: flat_unpad(x, info) if info else x,
-            tree, self._padinfo)
+        return jax.tree_util.tree_map(to_natural_leaf, tree, self._padinfo)
 
     def natural_to_layout(self, tree, like):
         """Natural-shaped host tree → storage layout, placed with `like`'s
         dtypes/shardings (checkpoint load, incl. elastic restores)."""
         return jax.tree_util.tree_map(
             lambda x, info, l: jax.device_put(
-                flat_pad(jnp.asarray(x, l.dtype), info) if info
-                else jnp.asarray(x, l.dtype), l.sharding),
+                to_layout_leaf(jnp.asarray(x, l.dtype), info), l.sharding),
             tree, self._padinfo, like)
 
     @property
@@ -545,6 +543,10 @@ class DeepSpeedEngine:
     def _init_state(self, model_parameters):
         """Place params/master/opt-state on the mesh with ZeRO shardings."""
         self._compute_shardings(model_parameters)
+        if hasattr(self.optimizer, "pad_info"):
+            # 1-bit optimizers must know which masters are flat-padded so
+            # compression scales exclude (and never write) the pad tails.
+            self.optimizer.pad_info = self._padinfo
         if self.host_offload:
             self._init_host_state(model_parameters)
         if self.param_offload:

@@ -45,6 +45,10 @@ class OnebitAdam(FusedAdam):
         self.adam_freeze_key = False
         self.initialize = False
         self.comm_backend_name = "xla"
+        # Set by the engine when masters use the ZeRO flat-pad layout: a
+        # tree of FlatPad|False matching the params. Padded tails must be
+        # excluded from compression scales and stay exactly 0.
+        self.pad_info = None
 
     def init_state(self, master_params):
         base = super().init_state(master_params)
@@ -68,7 +72,7 @@ class OnebitAdam(FusedAdam):
         step = state.step + 1
         in_warmup = step <= self.freeze_step
 
-        def leaf(p, g, m, v, err, serr):
+        def leaf(p, g, m, v, err, serr, info=None):
             g = g.astype(jnp.float32)
             p = p.astype(jnp.float32)
             if weight_decay != 0.0:
@@ -82,7 +86,8 @@ class OnebitAdam(FusedAdam):
             # the cross-rank mean runs only with an axis_name (shard_map)
             m_comp, err_new, serr_new = \
                 compressed_allreduce_dense_two_phase(
-                    m_new, err, serr, axis_name)
+                    m_new, err, serr, axis_name,
+                    n_valid=info.numel if info else None)
             m_new = jnp.where(in_warmup, m_new, m_comp)
             err = jnp.where(in_warmup, err, err_new)
             serr = jnp.where(in_warmup, serr, serr_new)
@@ -95,9 +100,11 @@ class OnebitAdam(FusedAdam):
         flat_v = treedef.flatten_up_to(state.exp_avg_sq)
         flat_e = treedef.flatten_up_to(state.worker_error)
         flat_s = treedef.flatten_up_to(state.server_error)
+        flat_i = (treedef.flatten_up_to(self.pad_info)
+                  if self.pad_info is not None else [None] * len(flat_p))
 
-        outs = [leaf(p, g, m, v, e, s) for p, g, m, v, e, s in
-                zip(flat_p, flat_g, flat_m, flat_v, flat_e, flat_s)]
+        outs = [leaf(p, g, m, v, e, s, i) for p, g, m, v, e, s, i in
+                zip(flat_p, flat_g, flat_m, flat_v, flat_e, flat_s, flat_i)]
         unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
             treedef, [o[i] for o in outs])
         return unf(0), OnebitAdamState(step=step, exp_avg=unf(1),

@@ -36,6 +36,9 @@ class OnebitLamb(FusedLamb):
                          max_coeff=max_coeff, min_coeff=min_coeff)
         self.freeze_step = freeze_step
         self.deepspeed = deepspeed
+        # Tree of FlatPad|False installed by the engine for flat-padded
+        # masters (see onebit/adam.py).
+        self.pad_info = None
         self.coeff_beta = coeff_beta
         self.factor_max = factor_max
         self.factor_min = factor_min
@@ -67,7 +70,7 @@ class OnebitLamb(FusedLamb):
         step = state.step + 1
         in_warmup = step <= self.freeze_step
 
-        def leaf(p, g, m, v, err, serr, fs):
+        def leaf(p, g, m, v, err, serr, fs, info=None):
             g = g.astype(jnp.float32)
             p = p.astype(jnp.float32)
             m_new = beta1 * m + (1 - beta1) * g
@@ -75,8 +78,9 @@ class OnebitLamb(FusedLamb):
                               beta2 * v + (1 - beta2) * jnp.square(g), v)
             # two-phase semantics post-warmup (see onebit/adam.py)
             m_comp, err_new, serr_new = \
-                compressed_allreduce_dense_two_phase(m_new, err, serr,
-                                                     axis_name)
+                compressed_allreduce_dense_two_phase(
+                    m_new, err, serr, axis_name,
+                    n_valid=info.numel if info else None)
             m_new = jnp.where(in_warmup, m_new, m_comp)
             err = jnp.where(in_warmup, err, err_new)
             serr = jnp.where(in_warmup, serr, serr_new)
@@ -103,7 +107,9 @@ class OnebitLamb(FusedLamb):
         flat = [treedef.flatten_up_to(t) for t in
                 (grads, state.exp_avg, state.exp_avg_sq, state.worker_error,
                  state.server_error, state.frozen_scale)]
-        outs = [leaf(p, g, m, v, e, s, f) for p, g, m, v, e, s, f in
+        flat.append(treedef.flatten_up_to(self.pad_info)
+                    if self.pad_info is not None else [None] * len(flat_p))
+        outs = [leaf(p, g, m, v, e, s, f, i) for p, g, m, v, e, s, f, i in
                 zip(flat_p, *flat)]
         unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
             treedef, [o[i] for o in outs])

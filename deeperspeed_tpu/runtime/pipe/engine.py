@@ -61,6 +61,21 @@ class PipelineEngine(DeepSpeedEngine):
             and int(self.mesh.shape[PIPE_AXIS]) > 1
             and model.num_stages > 1)
         if self._spmd_pipelined:
+            # The pipelined loss re-splits its input into the 1F1B micro
+            # geometry; paths that feed one micro-batch at a time (manual
+            # forward/backward, host-offload grad accumulation, PLD theta
+            # threading) would silently run a different geometry.
+            if self.host_offload or self.param_offload:
+                raise RuntimeError(
+                    "pipelined execution (pipe mesh axis) is incompatible "
+                    "with offload_optimizer/offload_param: the offload "
+                    "paths accumulate per-micro-batch grads outside the "
+                    "fused 1F1B program")
+            if self._config.pld_enabled:
+                raise RuntimeError(
+                    "progressive_layer_drop is not supported with "
+                    "pipelined execution (theta is not threaded through "
+                    "the 1F1B program)")
             from ...parallel.pipeline_spmd import module_pipeline_loss_fn
             self.loss_fn = module_pipeline_loss_fn(
                 model, self.mesh,
@@ -75,6 +90,27 @@ class PipelineEngine(DeepSpeedEngine):
         def loss_fn(params, batch, rng):
             return model.loss(params, batch, rng=rng)
         return loss_fn
+
+    def forward(self, batch, rng=None):
+        """Manual micro-batch stepping is disabled when really pipelined:
+        the whole 1F1B batch is one compiled program (the reference makes
+        the same restriction, `pipe/engine.py:1186-1195`)."""
+        if self._spmd_pipelined:
+            raise RuntimeError(
+                "Only train_batch()/eval_batch() are accessible in "
+                "pipeline mode; forward() drives one micro-batch, but "
+                "this engine compiles the full 1F1B schedule as one "
+                "program")
+        return super().forward(batch, rng=rng)
+
+    __call__ = forward
+
+    def backward(self, loss=None, **kwargs):
+        if self._spmd_pipelined:
+            raise RuntimeError(
+                "Only train_batch()/eval_batch() are accessible in "
+                "pipeline mode; see forward()")
+        return super().backward(loss, **kwargs)
 
     def _train_step_body(self, accum_steps):
         """Pipelined mode: the gradient-accumulation micro-batches ARE the

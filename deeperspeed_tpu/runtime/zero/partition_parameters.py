@@ -79,6 +79,52 @@ def flat_unpad(flat, info):
     return flat[:info.numel].reshape(info.shape)
 
 
+def is_layout_shaped(x, info):
+    """Is `x` actually stored in `info`'s flat-padded layout? Optimizer
+    states can carry fields whose pytree structure mirrors the masters
+    but whose leaves are differently shaped (e.g. OnebitLamb's per-param
+    () scalars); those must pass through layout conversion untouched."""
+    return getattr(x, "ndim", None) == 1 and x.size == info.padded
+
+
+def is_natural_shaped(x, info):
+    """Does `x` have `info`'s natural (compute) shape? See
+    `is_layout_shaped` for why mirroring trees can disagree."""
+    return tuple(getattr(x, "shape", ())) == info.shape
+
+
+def to_natural_leaf(x, info):
+    """Layout→natural for one leaf: unpad layout-shaped leaves, pass
+    scalars (mirroring opt-state fields) and already-natural leaves, and
+    fail LOUDLY on anything else — a silently forwarded wrong-width leaf
+    would only surface as an opaque shape error deep in the jitted step
+    (or a corrupt re-saved checkpoint)."""
+    if not info:
+        return x
+    if is_layout_shaped(x, info):
+        return flat_unpad(x, info)
+    if getattr(x, "ndim", 0) == 0 or is_natural_shaped(x, info):
+        return x
+    raise ValueError(
+        f"leaf shape {tuple(x.shape)} matches neither the stored flat-pad "
+        f"layout ({info.padded},) nor the natural shape {info.shape} — "
+        "checkpoint/model geometry mismatch?")
+
+
+def to_layout_leaf(x, info):
+    """Natural→layout for one leaf (see `to_natural_leaf`)."""
+    if not info:
+        return x
+    if is_natural_shaped(x, info):
+        return flat_pad(x, info)
+    if getattr(x, "ndim", 0) == 0 or is_layout_shaped(x, info):
+        return x
+    raise ValueError(
+        f"leaf shape {tuple(x.shape)} matches neither the natural shape "
+        f"{info.shape} nor the stored flat-pad layout ({info.padded},) — "
+        "checkpoint/model geometry mismatch?")
+
+
 def map_master_fields(opt_state, master_def, fn, *rest, passthrough=None):
     """Rebuild an optimizer-state NamedTuple, applying `fn(field, *extras)`
     to fields whose pytree structure mirrors the master params

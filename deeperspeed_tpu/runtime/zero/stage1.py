@@ -29,7 +29,8 @@ from ..utils import clip_grad_norm_, global_norm
 from ..fp16.loss_scaler import (LossScaleState, grads_finite,
                                 init_loss_scale_state, update_loss_scale)
 from .partition_parameters import (ZeroShardingRules, flat_pad, flat_unpad,
-                                   map_master_fields)
+                                   map_master_fields, to_layout_leaf,
+                                   to_natural_leaf)
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +158,9 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
         # partitioning (`stage1.py:328-465`); see `FlatPad`.
         self._padinfo = jax.tree_util.tree_map(
             lambda p: self.rules.master_pad_info(p.shape) or False, params)
+        if hasattr(self.optimizer, "pad_info"):
+            # 1-bit optimizers: compression must skip flat-pad tails.
+            self.optimizer.pad_info = self._padinfo
 
         def make_master(p, info):
             m = jnp.asarray(p, jnp.float32)
@@ -247,7 +251,7 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
         master_def = jax.tree_util.tree_structure(self._padinfo)
         return map_master_fields(
             opt_state, master_def, lambda t: jax.tree_util.tree_map(
-                lambda x, i: np.asarray(flat_unpad(x, i) if i else x),
+                lambda x, i: np.asarray(to_natural_leaf(x, i)),
                 t, self._padinfo))
 
     def _opt_to_layout(self, opt_state, like):
@@ -256,8 +260,8 @@ class FP16_DeepSpeedZeroOptimizer_Stage1:
         def relayout(t, cur):
             return jax.tree_util.tree_map(
                 lambda x, i, c: jax.device_put(
-                    flat_pad(jnp.asarray(x, jnp.float32), i) if i
-                    else jnp.asarray(x), c.sharding),
+                    to_layout_leaf(jnp.asarray(x, jnp.float32)
+                                   if i else jnp.asarray(x), i), c.sharding),
                 t, self._padinfo, cur)
 
         return map_master_fields(opt_state, master_def, relayout, like,

@@ -107,6 +107,48 @@ def test_two_phase_packed_matches_host_reference():
                                    rtol=1e-6, atol=1e-6)
 
 
+def test_two_phase_packed_matches_host_reference_ragged():
+    """n_valid < n (zero-padded ragged tail): transport and oracle must
+    still agree — scales normalized by valid counts, pad lanes pinned to
+    0 in outputs and both error buffers."""
+    world = 8
+    n = 256
+    n_valid = 231  # tail spans part of the last server chunk
+    mesh = Mesh(np.asarray(jax.devices()[:world]), ("data",))
+    rng = np.random.default_rng(3)
+    mask = (np.arange(n) < n_valid)
+    xs = rng.normal(size=(world, n)).astype(np.float32) * mask
+    werr = rng.normal(size=(world, n)).astype(np.float32) * 0.1 * mask
+    serr = (rng.normal(size=(world, n // world)).astype(np.float32) * 0.1
+            * mask.reshape(world, n // world))
+
+    def body(x, we, se):
+        return compressed_allreduce_two_phase(x[0], we[0], se[0],
+                                              "data", world,
+                                              n_valid=n_valid)
+
+    out, new_we, new_se = shard_map(
+        body, mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P("data")),
+        check_vma=False)(xs, werr, serr)
+    out = np.asarray(out).reshape(world, n)
+    new_we = np.asarray(new_we).reshape(world, n)
+    new_se = np.asarray(new_se).reshape(world, n // world)
+    ref_outs, ref_we, ref_se = compressed_allreduce_two_phase_host(
+        list(jnp.asarray(xs)), list(jnp.asarray(werr)),
+        list(jnp.asarray(serr)), n_valid=n_valid)
+    np.testing.assert_allclose(out, np.broadcast_to(
+        np.asarray(ref_outs[0]), (world, n)), rtol=1e-6, atol=1e-6)
+    assert np.all(out[:, n_valid:] == 0)
+    for r in range(world):
+        np.testing.assert_allclose(new_we[r], np.asarray(ref_we[r]),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(new_se[r], np.asarray(ref_se[r]),
+                                   rtol=1e-6, atol=1e-6)
+    assert np.all(new_we[:, n_valid:] == 0)
+
+
 def test_two_phase_packed_wire_volume():
     """Measured bytes on the wire: the compiled packed transport moves
     sign BYTES (u8), beating an fp32 allreduce by >=4x (VERDICT target;

@@ -14,7 +14,7 @@ from jax.sharding import Mesh
 import deeperspeed_tpu
 from deeperspeed_tpu.parallel.pipeline_spmd import module_pipeline_loss_fn
 from deeperspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
-from tests.simple_model import (mse_loss, random_batches,
+from tests.simple_model import (LinearLayer, mse_loss, random_batches,
                                 simple_pipeline_module,
                                 tied_pipeline_module)
 
@@ -163,3 +163,72 @@ def test_four_stage_pipeline(devices):
                    for _ in range(4)]
     np.testing.assert_allclose(pipe_losses, seq_losses, rtol=2e-5,
                                atol=2e-5)
+
+
+def test_pipelined_rejects_manual_and_offload_paths(devices):
+    """Paths that feed one micro-batch at a time (manual forward/backward,
+    offload accumulation) are incompatible with the fused 1F1B program
+    and must fail loudly (the reference disables them too,
+    `pipe/engine.py:1186-1195`)."""
+    pipe = _make(simple_pipeline_module(num_layers=4, dim=DIM,
+                                        num_stages=2),
+                 mesh=_mesh(devices, pipe=2))
+    x = np.zeros((8, DIM), np.float32)
+    with pytest.raises(RuntimeError, match="train_batch"):
+        pipe.forward((x, x))
+    with pytest.raises(RuntimeError, match="train_batch"):
+        pipe.backward()
+    with pytest.raises(RuntimeError, match="offload"):
+        _make(simple_pipeline_module(num_layers=4, dim=DIM, num_stages=2),
+              mesh=_mesh(devices, pipe=2),
+              config=pipe_config(zero_optimization={
+                  "stage": 2, "offload_optimizer": {"device": "cpu"}}))
+
+
+class NoisyLinearLayer:
+    """Stochastic layer fixture: multiplicative bernoulli mask from the
+    per-micro-batch rng stream."""
+
+    def __init__(self, dim=16):
+        self.dim = dim
+
+    def init(self, rng, x):
+        k1, _ = jax.random.split(rng)
+        return {"w": jax.random.normal(k1, (self.dim, self.dim),
+                                       jnp.float32) * 0.1}
+
+    def apply(self, params, x, rng=None):
+        h = x @ params["w"]
+        if rng is None:
+            return h
+        return h * jax.random.bernoulli(rng, 0.7, h.shape)
+
+
+def test_pipelined_rng_stream_per_micro_batch(devices):
+    """Stage s at tick t runs micro-batch t - s; its key must be
+    fold_in(rng, t - s) — the documented per-micro stream. A stochastic
+    layer on stage 1 catches tick-indexed (stage-0) keys, which shift
+    every later stage's masks off by the stage id."""
+    from deeperspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+
+    specs = [LayerSpec(LinearLayer, DIM), LayerSpec(LinearLayer, DIM),
+             LayerSpec(NoisyLinearLayer, DIM),
+             LayerSpec(NoisyLinearLayer, DIM)]
+    module = PipelineModule(layers=specs, num_stages=2, loss_fn=mse_loss)
+    params = module.init_params(
+        jax.random.PRNGKey(0), example_input=np.zeros((1, DIM), np.float32))
+    mesh = _mesh(devices, pipe=2)
+    n_micro = 4
+    loss_fn = module_pipeline_loss_fn(module, mesh, n_micro=n_micro)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, DIM)).astype(np.float32)
+    y = rng.normal(size=(8, DIM)).astype(np.float32)
+    key = jax.random.PRNGKey(7)
+    with mesh:
+        got = float(loss_fn(params, (x, y), key))
+    mb = x.shape[0] // n_micro
+    ref = np.mean([float(module.loss(
+        params, (x[m * mb:(m + 1) * mb], y[m * mb:(m + 1) * mb]),
+        rng=jax.random.fold_in(key, m))) for m in range(n_micro)])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

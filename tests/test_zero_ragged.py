@@ -153,6 +153,25 @@ def test_ragged_checkpoint_roundtrip(tmp_path, devices):
     np.testing.assert_array_equal(sd["w"], saved_master_w)
 
 
+def test_ragged_onebit_lamb_checkpoint_roundtrip(tmp_path, devices):
+    """OnebitLamb's opt state carries fields (per-leaf () scalars like
+    frozen_scale) whose pytree STRUCTURE mirrors the masters but whose
+    leaves are not layout-shaped; checkpoint layout conversion must leave
+    them untouched instead of flat-unpadding them (IndexError on 0-d)."""
+    extra = {"optimizer": {"type": "OneBitLamb",
+                           "params": {"lr": 1e-4, "freeze_step": 2}},
+             "zero_optimization": {"stage": 2}}
+    engine = _engine(None, extra=extra)
+    _train(engine, steps=3)
+    engine.save_checkpoint(str(tmp_path))
+    ref_losses = _train(engine, steps=2, seed=9)
+
+    engine2 = _engine(None, seed=3, extra=extra)
+    engine2.load_checkpoint(str(tmp_path))
+    got_losses = _train(engine2, steps=2, seed=9)
+    np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-6, atol=1e-6)
+
+
 def test_ragged_vocab_embedding_parity(devices):
     """GPT-style: unpadded-vocab embedding + tied softmax stays exact."""
     V, D = 201, 9  # no dim divides the 8-device data axis
