@@ -100,6 +100,159 @@ def last_stage_value(value, axis_name, n_stages):
     return jax.lax.psum(masked, axis_name)
 
 
+# ---------------------------------------------------------------------------
+# 1F1B executors (manual pipeline autodiff at stage granularity)
+#
+# The GPipe-shaped scan above differentiates THROUGH the scan, so autodiff
+# saves per-tick residuals and live activation memory grows with n_micro.
+# The two functions below realize the reference's 1F1B memory bound
+# (`schedule.py:243-249`: live buffers ~ n_stages, not n_micro) in one
+# compiled program: the backward schedule is hand-interleaved into the
+# same tick loop, per-stage VJPs are taken explicitly (recompute-from-
+# stashed-input — remat by construction), and nothing differentiates
+# through the scan at all.
+#
+# Both run INSIDE shard_map over the pipe axis. The schedule is the two
+# clock relations of `runtime/pipe/schedule.py`: forward of micro m on
+# stage s at half-tick t = s + 2m, backward at t = 2S - 1 - s + 2m.
+# Adjacent stages therefore alternate parity, and each tick sends one
+# activation down and one input-cotangent up (one of the two is bubble
+# garbage, gated by the receiver's validity mask).
+# ---------------------------------------------------------------------------
+
+
+def pipeline_1f1b_ticks(stage_apply, diff_args, buf_template, n_stages,
+                        n_micro, axis_name, rng, fp32_comm=None):
+    """Interleaved forward+backward 1F1B loop; returns (loss, grads).
+
+    Args (inside shard_map over `axis_name`):
+      stage_apply: (diff_args, buf, m_idx, rng) -> (out_buf, loss_f32).
+        Encapsulates per-stage behavior: stage 0 ignores `buf` and
+        injects micro m's input; the last stage computes the per-micro
+        loss (other stages return 0.0). `out_buf` must match
+        `buf_template`.
+      diff_args: pytree of parameters to differentiate against.
+      buf_template: ShapeDtypeStruct of the inter-stage activation buffer.
+      rng: base key; stage_apply derives per-micro keys (the SAME key is
+        used to recompute micro m's forward in its backward tick).
+    Returns:
+      loss: mean over micro-batches (valid on the last stage only —
+        broadcast with `last_stage_value`).
+      grads: pytree like diff_args (fp32), this device's local
+        contribution; the caller reduces over replicated axes.
+
+    Live activation state: a [D, |buf|] stash with D = min(n_stages,
+    n_micro) — micro m's stage input is stashed at its forward tick and
+    recomputed through `jax.vjp` at its backward tick, so peak memory is
+    bounded by pipeline depth, not micro-batch count.
+    """
+    from ..runtime.pipe import p2p
+
+    stage = jax.lax.axis_index(axis_name)
+    D = min(n_stages, n_micro)
+    total = 2 * (n_micro + n_stages - 1)
+    buf0 = jnp.zeros(buf_template.shape, buf_template.dtype)
+
+    gacc0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), diff_args)
+
+    def tick(carry, t):
+        fwd_buf, bwd_buf, stash, gacc, loss_acc = carry
+        tf = t - stage                       # forward clock
+        tb = t - (2 * n_stages - 1 - stage)  # backward clock
+        is_fwd = (tf % 2) == 0
+        m_f = jnp.clip(tf // 2, 0, n_micro - 1)
+        valid_f = is_fwd & (tf >= 0) & (tf < 2 * n_micro)
+        m_b = jnp.clip(tb // 2, 0, n_micro - 1)
+        valid_b = jnp.logical_not(is_fwd) & (tb >= 0) & (tb < 2 * n_micro)
+
+        def fwd_tick(fwd_buf, bwd_buf, stash, gacc):
+            y, l = stage_apply(diff_args, fwd_buf, m_f, rng)
+            # Gated stash write: drain ticks carry stale buffers whose
+            # clipped slot would clobber a still-live micro's input.
+            slot = m_f % D
+            keep = jax.lax.dynamic_index_in_dim(stash, slot, 0,
+                                                keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_f, fwd_buf, keep), slot, 0)
+            return y, buf0, l.astype(jnp.float32), stash, gacc
+
+        def bwd_tick(fwd_buf, bwd_buf, stash, gacc):
+            x = jax.lax.dynamic_index_in_dim(stash, m_b % D, 0,
+                                             keepdims=False)
+            # Last stage seeds from its own loss; everyone else pulls
+            # back the downstream cotangent.
+            cot_y = jnp.where(stage == n_stages - 1,
+                              jnp.zeros_like(bwd_buf), bwd_buf)
+            cot_l = jnp.asarray(1.0 / n_micro, jnp.float32)
+            _, pull = jax.vjp(
+                lambda args, xx: stage_apply(args, xx, m_b, rng),
+                diff_args, x)
+            args_bar, x_bar = pull((cot_y.astype(buf_template.dtype),
+                                    cot_l))
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(valid_b,
+                                           g.astype(jnp.float32), 0.0),
+                gacc, args_bar)
+            return buf0, x_bar, jnp.asarray(0.0, jnp.float32), stash, gacc
+
+        y_out, xbar_out, l, stash, gacc = jax.lax.cond(
+            is_fwd, fwd_tick, bwd_tick, fwd_buf, bwd_buf, stash, gacc)
+        loss_acc = loss_acc + jnp.where(
+            valid_f & (stage == n_stages - 1), l, 0.0)
+        # Unconditional neighbor exchange: activations down, input
+        # cotangents up. Bubble payloads are zeros/garbage and are gated
+        # by the receiving tick's validity mask.
+        fwd_next = p2p.send_to_next(y_out, axis_name, n_stages,
+                                    fp32_comm=fp32_comm)
+        bwd_next = p2p.send_to_prev(xbar_out, axis_name, n_stages,
+                                    fp32_comm=fp32_comm)
+        return (fwd_next, bwd_next, stash, gacc, loss_acc), None
+
+    stash0 = jnp.zeros((D,) + buf_template.shape, buf_template.dtype)
+    carry0 = (buf0, buf0, stash0, gacc0, jnp.asarray(0.0, jnp.float32))
+    (_, _, _, gacc, loss_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(total))
+    return loss_acc / n_micro, gacc
+
+
+def pipeline_forward_ticks(stage_apply, diff_args, buf_template, n_stages,
+                           n_micro, axis_name, rng, fp32_comm=None,
+                           collect_outputs=False):
+    """Forward-only fill/drain loop (eval/inference): full ticks, no
+    stash, no grads. Returns (loss, outputs | None); loss is the mean
+    over micro-batches (valid on the last stage), `outputs` is the last
+    stage's [n_micro, *buf] boundary outputs when requested."""
+    from ..runtime.pipe import p2p
+
+    stage = jax.lax.axis_index(axis_name)
+    total = n_micro + n_stages - 1
+    buf0 = jnp.zeros(buf_template.shape, buf_template.dtype)
+
+    def tick(carry, t):
+        buf, loss_acc, outputs = carry
+        m = jnp.clip(t - stage, 0, n_micro - 1)
+        valid = (t >= stage) & (t - stage < n_micro)
+        y, l = stage_apply(diff_args, buf, m, rng)
+        loss_acc = loss_acc + jnp.where(
+            valid & (stage == n_stages - 1), l.astype(jnp.float32), 0.0)
+        if outputs is not None:
+            cur = jax.lax.dynamic_index_in_dim(outputs, m, 0,
+                                               keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, y, cur), m, 0)
+        buf = p2p.send_to_next(y, axis_name, n_stages,
+                               fp32_comm=fp32_comm)
+        return (buf, loss_acc, outputs), None
+
+    outputs0 = jnp.zeros((n_micro,) + buf_template.shape,
+                         buf_template.dtype) if collect_outputs else None
+    carry0 = (buf0, jnp.asarray(0.0, jnp.float32), outputs0)
+    (_, loss_acc, outputs), _ = jax.lax.scan(tick, carry0,
+                                             jnp.arange(total))
+    return loss_acc / n_micro, outputs
+
+
 def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
                      axis_name=PIPE_AXIS, remat=True, fp32_comm=None,
                      data_axis=None, blocks_specs=None, embed_specs=None,
@@ -174,12 +327,113 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
     return loss_fn
 
 
+def _zero_tangents(tree):
+    """Zero cotangents for non-differentiated custom_vjp primals (int
+    leaves — tokens, PRNG keys — take float0 tangents)."""
+    def zt(x):
+        if x is None:
+            return None
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.zeros_like(x)
+        return np.zeros(x.shape, jax.dtypes.float0)
+    return jax.tree_util.tree_map(zt, tree)
+
+
+class ModulePackMeta:
+    """Static packing geometry for a `PipelineModule`'s per-stage
+    parameter rows — the reference's "build only local layers"
+    (`pipe/module.py:186,358`) realized as a data layout: stage s's
+    non-tied leaves concatenate into row s of a [n_stages, P_max] matrix
+    sharded over ``pipe``, so at-rest param bytes per device scale
+    1/n_stages.
+
+    `P_max` is rounded up so the trailing dim can also shard evenly over
+    the data axis (2-D pipe x data sharding of the fp32 masters/moments
+    — ZeRO over the packed rows)."""
+
+    def __init__(self, module, templates, mesh=None, axis_name=PIPE_AXIS,
+                 data_axis=None):
+        self.module = module
+        parts = module.parts
+        self.n_stages = module.num_stages
+        self.stage_slots = []   # per stage: [(layer_idx, treedef, specs)]
+        sizes = []
+        dtypes = set()
+        for s in range(self.n_stages):
+            slots = []
+            off = 0
+            for idx in range(parts[s], parts[s + 1]):
+                if module._tied_keys_per_layer[idx] is not None:
+                    continue
+                lvs, tdef = jax.tree_util.tree_flatten(
+                    templates["layers"][idx])
+                specs = []
+                for l in lvs:
+                    n = int(np.prod(l.shape))
+                    specs.append((tuple(l.shape), jnp.dtype(l.dtype),
+                                  off, n))
+                    dtypes.add(jnp.dtype(l.dtype))
+                    off += n
+                slots.append((idx, tdef, specs))
+            self.stage_slots.append(slots)
+            sizes.append(off)
+        if len(dtypes) > 1:
+            raise ValueError(
+                f"pipelined stage params must share one dtype; {dtypes}")
+        self.p_dtype = dtypes.pop() if dtypes else jnp.dtype(jnp.float32)
+        self.sizes = sizes
+        align = 8
+        if mesh is not None and data_axis is not None \
+                and data_axis in mesh.axis_names:
+            align = 8 * int(mesh.shape[data_axis])
+        self.P_max = -(-max(max(sizes), 1) // align) * align
+
+    def pack(self, params):
+        """Natural param tree -> [n_stages, P_max] rows (in or out of
+        jit)."""
+        rows = []
+        for s in range(self.n_stages):
+            leaves = []
+            for idx, _tdef, _specs in self.stage_slots[s]:
+                leaves.extend(
+                    jax.tree_util.tree_leaves(params["layers"][idx]))
+            flat = (jnp.concatenate([jnp.ravel(l) for l in leaves])
+                    if leaves else jnp.zeros((0,), self.p_dtype))
+            rows.append(jnp.pad(flat, (0, self.P_max - self.sizes[s])))
+        return jnp.stack(rows)
+
+    def unpack_stage(self, row, s):
+        """One stage's [P_max] row -> the per-layer params list slot for
+        `forward_range` (tied slots empty; filled from params['tied'])."""
+        layers = [{} for _ in range(len(self.module.layers))]
+        for idx, tdef, specs in self.stage_slots[s]:
+            leaves = [row[off:off + n].reshape(shape)
+                      for (shape, _dt, off, n) in specs]
+            layers[idx] = jax.tree_util.tree_unflatten(tdef, leaves)
+        return layers
+
+    def unpack(self, rows, cast=True):
+        """[n_stages, P_max] rows -> full per-layer params list."""
+        layers = [{} for _ in range(len(self.module.layers))]
+        for s in range(self.n_stages):
+            row = rows[s]
+            for idx, tdef, specs in self.stage_slots[s]:
+                leaves = [row[off:off + n].reshape(shape).astype(dt)
+                          if cast else row[off:off + n].reshape(shape)
+                          for (shape, dt, off, n) in specs]
+                layers[idx] = jax.tree_util.tree_unflatten(tdef, leaves)
+        return layers
+
+
 def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
-                            data_axis=None, fp32_comm=None, remat=True):
+                            data_axis=None, fp32_comm=None, remat=True,
+                            packed_io=False, param_templates=None):
     """Lower an arbitrary `PipelineModule` (heterogeneous LayerSpec list)
-    onto the SPMD ppermute executor (reference `pipe/engine.py:654-1139`
-    executes any layer list across stages; here the whole 1F1B batch is
-    one shard_map program over the ``pipe`` mesh axis).
+    onto the compiled 1F1B executor (reference `pipe/engine.py:654-1139`
+    executes any layer list across stages; here the whole 1F1B batch —
+    forward AND backward — is one shard_map program over the ``pipe``
+    mesh axis).
 
     SPMD needs every stage to run the same program with uniform shapes,
     but heterogeneous stages have different activation shapes and param
@@ -190,26 +444,40 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
       reshapes its statically-known input shape out of the buffer and
       flattens its output back in;
     - per-stage params are packed into a [n_stages, P_max] row matrix
-      sharded over ``pipe`` (each stage materializes only its row — the
-      reference's "build only local layers", `module.py:358`); branches
-      unpack their row into the layer subtrees.
+      sharded over ``pipe`` (`ModulePackMeta`) — the reference's "build
+      only local layers" (`module.py:358`).
 
-    Tied subtrees stay replicated over ``pipe`` and their gradient psum
-    falls out of the shard_map transpose — the reference's
-    `allreduce_tied_weight_gradients`.
+    The returned ``loss_fn(params, batch, rng)`` is a `jax.custom_vjp`:
+    called directly (eval) it runs a forward-only fill/drain loop;
+    under `jax.grad`/`value_and_grad` the VJP runs `pipeline_1f1b_ticks`,
+    which interleaves backward ticks into the same loop with per-stage
+    recompute — live activation memory is bounded by min(n_stages,
+    n_micro) boundary buffers, the reference's 1F1B cap
+    (`schedule.py:243-249`), not by n_micro as in a GPipe-shaped scan.
 
-    Returns ``loss_fn(params, batch, rng)`` over the FULL effective batch
-    (the batch splits into `n_micro` pipeline micro-batches internally).
+    With ``packed_io=True`` params are the packed representation
+    ``{"rows": [n_stages, P_max], "tied": {...}}`` (built once by the
+    engine via `ModulePackMeta.pack`; `param_templates` supplies the
+    natural shapes) — no per-call repacking appears in the step HLO and
+    grads come back in the same packed layout. With the default natural
+    tree IO, packing happens inside the program and grads are unpacked
+    to the natural structure.
+
+    Tied subtrees stay replicated over ``pipe``; their per-stage
+    gradient contributions are psum'd over the pipe axis — the
+    reference's `allreduce_tied_weight_gradients`.
+
+    ``loss_fn.pipelined_eval(params, batch, rng, return_logits=)`` runs
+    the forward-only loop and can return the last stage's outputs
+    (reference `pipe/engine.py:351,422` eval/inference schedules).
 
     Caveat: during pipeline fill/drain, stages run on zero buffers whose
     results are discarded by select (never blended into outputs). Layer
     primals may be non-finite on zeros without harm, but their VJPs
-    should not emit NaN under a zero cotangent (0·∞ patterns, e.g.
+    should not emit NaN under a zero cotangent (0*inf patterns, e.g.
     unguarded ``x/|x|``) — the same discipline `jnp.where` gradients
     require everywhere in JAX.
     """
-    from ..runtime.pipe import p2p
-
     n_stages = int(mesh.shape[axis_name])
     if module.num_stages != n_stages:
         raise ValueError(
@@ -218,27 +486,33 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
     parts = module.parts
     dp_active = (data_axis is not None and data_axis in mesh.axis_names
                  and int(mesh.shape[data_axis]) > 1)
+    if packed_io and param_templates is None:
+        raise ValueError("packed_io=True requires param_templates")
 
-    def stage_param_leaves(params, s):
-        """Non-tied leaves of stage s, in deterministic order."""
-        leaves = []
-        for idx in range(parts[s], parts[s + 1]):
-            if module._tied_keys_per_layer[idx] is None:
-                leaves.extend(
-                    jax.tree_util.tree_leaves(params["layers"][idx]))
-        return leaves
+    meta_box = [None]
 
-    def loss_fn(params, batch, rng=None):
-        inputs, labels = batch
+    def get_meta(templates):
+        if meta_box[0] is None:
+            meta_box[0] = ModulePackMeta(module, templates, mesh=mesh,
+                                         axis_name=axis_name,
+                                         data_axis=data_axis)
+        return meta_box[0]
+
+    if packed_io:
+        get_meta(param_templates)
+
+    def _split(params):
+        """-> (rows, tied, natural-shape templates)."""
+        if packed_io:
+            return params["rows"], params["tied"], param_templates
+        return get_meta(params).pack(params), params["tied"], params
+
+    def _geometry(templates, inputs):
         b = inputs.shape[0]
         if b % n_micro != 0:
             raise ValueError(
                 f"batch {b} must split into n_micro={n_micro}")
         mb = b // n_micro
-        in_micro = inputs.reshape((n_micro, mb) + inputs.shape[1:])
-        lab_micro = labels.reshape((n_micro, mb) + labels.shape[1:])
-
-        # --- static per-stage activation shapes (per-dp-shard sizes) ----
         dp_size = int(mesh.shape[data_axis]) if dp_active else 1
         if mb % dp_size != 0:
             raise ValueError(
@@ -251,7 +525,7 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
             stage_in.append(cur)
             cur = jax.eval_shape(
                 lambda p, xx, s=s: module.forward_range(
-                    p, xx, parts[s], parts[s + 1]), params, cur)
+                    p, xx, parts[s], parts[s + 1]), templates, cur)
             stage_out.append(cur)
         act_dtype = stage_in[0].dtype
         for sd in stage_in + stage_out:
@@ -259,139 +533,154 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
                 raise ValueError(
                     "pipelined stages must share one activation dtype; "
                     f"got {sd.dtype} vs {act_dtype}")
+        A = max(int(np.prod(sd.shape)) for sd in stage_in + stage_out)
+        return stage_in, stage_out, A, act_dtype, mb
+
+    def _call(params, batch, rng, mode, collect=False):
+        rows, tied, templates = _split(params)
+        meta = get_meta(templates)
+        inputs, labels = batch
+        stage_in, stage_out, A, act_dtype, mb = _geometry(templates,
+                                                          inputs)
+        in_micro = inputs.reshape((n_micro, mb) + inputs.shape[1:])
+        lab_micro = labels.reshape((n_micro, mb) + labels.shape[1:])
+        rows = jax.lax.with_sharding_constraint(
+            rows, jax.sharding.NamedSharding(mesh, P(axis_name, None)))
 
         def numel(sd):
             return int(np.prod(sd.shape))
 
-        A = max(numel(sd) for sd in stage_in + stage_out)
+        out_sd = stage_out[-1]
+        buf_tmpl = jax.ShapeDtypeStruct((A,), act_dtype)
 
-        # --- pack per-stage params into [n_stages, P_max] ----------------
-        leaves_by_stage = [stage_param_leaves(params, s)
-                           for s in range(n_stages)]
-        sizes = [sum(int(np.prod(l.shape)) for l in ls)
-                 for ls in leaves_by_stage]
-        p_dtypes = {l.dtype for ls in leaves_by_stage for l in ls}
-        if len(p_dtypes) > 1:
-            raise ValueError(
-                f"pipelined stage params must share one dtype; {p_dtypes}")
-        p_dtype = p_dtypes.pop() if p_dtypes else jnp.float32
-        P_max = max(max(sizes), 1)
-        rows = []
-        for ls, sz in zip(leaves_by_stage, sizes):
-            flat = (jnp.concatenate([jnp.ravel(l) for l in ls])
-                    if ls else jnp.zeros((0,), p_dtype))
-            rows.append(jnp.pad(flat, (0, P_max - sz)))
-        packed = jax.lax.with_sharding_constraint(
-            jnp.stack(rows),
-            jax.sharding.NamedSharding(mesh, P(axis_name, None)))
-
-        tied = params["tied"]
-
-        # --- per-stage branch: flat buf -> flat buf ----------------------
-        def make_branch(s):
-            in_sd, out_sd = stage_in[s], stage_out[s]
-
-            def branch(row, tied, buf, mb_rng):
-                x = buf[:numel(in_sd)].reshape(in_sd.shape)
-                # rebuild this stage's layer params from the flat row
-                layers = [{} for _ in range(len(module.layers))]
-                off = 0
-                for idx in range(parts[s], parts[s + 1]):
-                    if module._tied_keys_per_layer[idx] is not None:
-                        continue
-                    tmpl = params["layers"][idx]
-                    lvs, tdef = jax.tree_util.tree_flatten(tmpl)
-                    rebuilt = []
-                    for l in lvs:
-                        n = int(np.prod(l.shape))
-                        rebuilt.append(
-                            row[off:off + n].reshape(l.shape))
-                        off += n
-                    layers[idx] = jax.tree_util.tree_unflatten(tdef,
-                                                               rebuilt)
-                pseudo = {"layers": layers, "tied": tied}
-                y = module.forward_range(pseudo, x, parts[s],
-                                         parts[s + 1], rng=mb_rng)
-                return jnp.pad(jnp.ravel(y), (0, A - numel(out_sd)))
-
-            return branch
-
-        branches = [make_branch(s) for s in range(n_stages)]
-
-        # --- shard_map body: fill/steady/drain scan ----------------------
-        def inner(packed_local, tied, in_micro, lab_micro, rng):
+        def inner(rows_local, tied, in_micro, lab_micro, rng):
             stage = jax.lax.axis_index(axis_name)
-            row = packed_local[0]
 
-            def apply_stage(buf, mb_rng):
-                fns = [(lambda b, r, s=s: branches[s](row, tied, b, r))
-                       for s in range(n_stages)]
-                return jax.lax.switch(stage, fns, buf, mb_rng)
+            def stage_apply(args, buf, m_idx, rng_):
+                rows_l, tied_ = args
+                row = rows_l[0]
+                mb_rng = jax.random.fold_in(rng_, m_idx)
 
-            body = jax.checkpoint(apply_stage) if remat else apply_stage
+                def make_branch(s):
+                    in_sd, o_sd = stage_in[s], stage_out[s]
 
-            flat_in = jax.vmap(
-                lambda x: jnp.pad(jnp.ravel(x).astype(act_dtype),
-                                  (0, A - numel(stage_in[0]))))(in_micro)
+                    def f(buf):
+                        if s == 0:
+                            x = jax.lax.dynamic_index_in_dim(
+                                in_micro, m_idx, 0, keepdims=False)
+                        else:
+                            x = buf[:numel(in_sd)].reshape(in_sd.shape)
+                        pseudo = {"layers": meta.unpack_stage(row, s),
+                                  "tied": tied_}
+                        y = module.forward_range(pseudo, x, parts[s],
+                                                 parts[s + 1], rng=mb_rng)
+                        if s == n_stages - 1:
+                            lab = jax.lax.dynamic_index_in_dim(
+                                lab_micro, m_idx, 0, keepdims=False)
+                            l = (module.loss_fn(y, lab)
+                                 if module.loss_fn is not None
+                                 else jnp.mean(y))
+                            out = (jnp.pad(
+                                jnp.ravel(y).astype(act_dtype),
+                                (0, A - numel(o_sd))) if collect
+                                else jnp.zeros((A,), act_dtype))
+                            return out, l.astype(jnp.float32)
+                        return (jnp.pad(jnp.ravel(y), (0, A - numel(o_sd))),
+                                jnp.asarray(0.0, jnp.float32))
 
-            total_ticks = n_micro + n_stages - 1
+                    return f
 
-            def tick(carry, t):
-                buf, outputs = carry
-                idx = jnp.clip(t, 0, n_micro - 1)
-                inject = jax.lax.dynamic_index_in_dim(flat_in, idx, 0,
-                                                      keepdims=False)
-                x = jnp.where(stage == 0, inject, buf)
-                # per-micro-batch stream (layer-level fold_in happens in
-                # forward_range); stochastic layers get distinct keys per
-                # micro-batch, like the sequential gas scan. The micro in
-                # flight at THIS stage at tick t is t - stage (stage 0's
-                # index `idx` would make drain ticks reuse late micros'
-                # keys downstream).
-                mb_idx = jnp.clip(t - stage, 0, n_micro - 1)
-                y = body(x, jax.random.fold_in(rng, mb_idx))
-                out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-                # select (NaN-safe), not a blend — see spmd_pipeline
-                write = t >= n_stages - 1
-                current = jax.lax.dynamic_index_in_dim(outputs, out_idx,
-                                                       0, keepdims=False)
-                outputs = jax.lax.dynamic_update_index_in_dim(
-                    outputs, jnp.where(write, y, current), out_idx, 0)
-                buf_next = p2p.send_to_next(y, axis_name, n_stages,
-                                            fp32_comm=fp32_comm)
-                return (buf_next, outputs), None
+                fns = [make_branch(s) for s in range(n_stages)]
+                return jax.lax.switch(stage, fns, buf)
 
-            buf0 = jnp.zeros((A,), act_dtype)
-            outputs0 = jnp.zeros((n_micro, A), act_dtype)
-            (_, outputs), _ = jax.lax.scan(tick, (buf0, outputs0),
-                                           jnp.arange(total_ticks))
+            diff_args = (rows_local, tied)
+            if mode == "grad":
+                loss, (rows_g, tied_g) = pipeline_1f1b_ticks(
+                    stage_apply, diff_args, buf_tmpl, n_stages, n_micro,
+                    axis_name, rng, fp32_comm=fp32_comm)
+                loss = last_stage_value(loss, axis_name, n_stages)
+                # tied params are replicated over pipe: sum each stage's
+                # contribution (reference allreduce_tied_weight_gradients)
+                tied_g = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, axis_name), tied_g)
+                if dp_active:
+                    loss = jax.lax.pmean(loss, data_axis)
+                    rows_g = jax.lax.pmean(rows_g, data_axis)
+                    tied_g = jax.tree_util.tree_map(
+                        lambda g: jax.lax.pmean(g, data_axis), tied_g)
+                return loss, rows_g, tied_g
 
-            out_sd = stage_out[-1]
-            outs = outputs[:, :numel(out_sd)].reshape(
-                (n_micro,) + out_sd.shape)
-            if module.loss_fn is not None:
-                losses = jax.vmap(module.loss_fn)(outs, lab_micro)
-            else:
-                losses = jnp.mean(outs, axis=tuple(range(1, outs.ndim)))
-            loss = jnp.mean(losses)
+            loss, outputs = pipeline_forward_ticks(
+                stage_apply, diff_args, buf_tmpl, n_stages, n_micro,
+                axis_name, rng, fp32_comm=fp32_comm,
+                collect_outputs=collect)
             loss = last_stage_value(loss, axis_name, n_stages)
             if dp_active:
                 loss = jax.lax.pmean(loss, data_axis)
-            return loss
+            if not collect:
+                return loss
+            outs = outputs[:, :numel(out_sd)].reshape(
+                (n_micro,) + out_sd.shape)
+            outs = last_stage_value(outs, axis_name, n_stages)
+            if dp_active:
+                outs = jnp.moveaxis(
+                    jax.lax.all_gather(outs, data_axis), 0, 1)
+                outs = outs.reshape((n_micro, mb) + out_sd.shape[1:])
+            return loss, outs
 
         tied_specs = jax.tree_util.tree_map(lambda _: P(), tied)
-        # micro dim 0 is a scan axis; data parallelism shards dim 1
+        # micro dim 0 is a loop axis; data parallelism shards dim 1
         batch_spec = P(None, data_axis) if dp_active else P()
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        if mode == "grad":
+            out_specs = (P(), P(axis_name, None), tied_specs)
+        elif collect:
+            out_specs = (P(), P())
+        else:
+            out_specs = P()
         mapped = shard_map(
             inner, mesh=mesh,
             in_specs=(P(axis_name, None), tied_specs, batch_spec,
                       batch_spec, P()),
-            out_specs=P(),
+            out_specs=out_specs,
             check_vma=False)
-        return mapped(packed, tied, in_micro, lab_micro, rng)
+        return mapped(rows, tied, in_micro, lab_micro, rng)
 
+    def primal(params, batch, rng=None):
+        return _call(params, batch, rng, "fwd")
+
+    def fwd_rule(params, batch, rng=None):
+        loss, rows_g, tied_g = _call(params, batch, rng, "grad")
+        if packed_io:
+            grads = {"rows": rows_g, "tied": tied_g}
+        else:
+            grads = {"layers": get_meta(params).unpack(rows_g, cast=False),
+                     "tied": tied_g}
+        return loss, (grads, params, batch, rng)
+
+    def bwd_rule(res, cot):
+        grads, params, batch, rng = res
+        cot32 = cot.astype(jnp.float32)
+        g = jax.tree_util.tree_map(
+            lambda gg, pp: (gg.astype(jnp.float32) * cot32).astype(
+                pp.dtype),
+            grads, params)
+        return g, _zero_tangents(batch), _zero_tangents(rng)
+
+    loss_fn = jax.custom_vjp(primal)
+    loss_fn.defvjp(fwd_rule, bwd_rule)
+
+    def pipelined_eval(params, batch, rng=None, return_logits=False):
+        """Forward-only fill/drain across stages (reference
+        InferenceSchedule, `pipe/engine.py:351,422`); with
+        `return_logits` the last stage's outputs are gathered."""
+        if not return_logits:
+            return _call(params, batch, rng, "fwd")
+        return _call(params, batch, rng, "fwd", collect=True)
+
+    loss_fn.pipelined_eval = pipelined_eval
+    loss_fn.pack_meta = get_meta(param_templates) if packed_io else None
     return loss_fn
 
 

@@ -232,3 +232,28 @@ def test_pipelined_rng_stream_per_micro_batch(devices):
         params, (x[m * mb:(m + 1) * mb], y[m * mb:(m + 1) * mb]),
         rng=jax.random.fold_in(key, m))) for m in range(n_micro)])
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_activation_memory_bound(devices):
+    """Live compiled memory must stay flat as n_micro rises at fixed
+    batch (the reference's 1F1B cap, `schedule.py:243-249`): the
+    executor stashes min(n_stages, n_micro) stage inputs and recomputes
+    in the interleaved backward, instead of holding n_micro residuals
+    as a GPipe-shaped differentiated scan would."""
+    module = simple_pipeline_module(num_layers=4, dim=64, num_stages=2)
+    params = module.init_params(
+        jax.random.PRNGKey(0), example_input=np.zeros((1, 64), np.float32))
+    mesh = _mesh(devices, pipe=2)
+    B = 64
+    x = np.zeros((B, 64), np.float32)
+
+    def temp_bytes(n_micro):
+        loss_fn = module_pipeline_loss_fn(module, mesh, n_micro=n_micro)
+        f = jax.jit(jax.value_and_grad(loss_fn))
+        with mesh:
+            compiled = f.lower(params, (x, x),
+                               jax.random.PRNGKey(0)).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    lo, hi = temp_bytes(4), temp_bytes(32)
+    assert hi <= lo * 1.15, (lo, hi)
