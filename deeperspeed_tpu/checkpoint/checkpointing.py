@@ -58,7 +58,10 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     # --- model states (params + host-side training state) ----------------
     state = engine.state
     model_state = {
-        "module": tree_to_state_dict(state.params),
+        # natural layout on disk: storage layouts (ZeRO flat-pad, packed
+        # pipeline rows) depend on the mesh and must not leak into files
+        "module": tree_to_state_dict(engine.params_to_natural(
+            state.params)),
         "optimizer": None,
         "lr_scheduler": (engine.lr_scheduler.state_dict()
                          if engine.lr_scheduler is not None else None),
@@ -84,7 +87,8 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     model_state.update(client_state)
     if not engine.zero_optimization():
         model_state["optimizer"] = {
-            "state": tree_to_state_dict(state.opt_state),
+            "state": tree_to_state_dict(
+                engine.opt_layout_to_natural(state.opt_state)),
             "param_groups": [dict(g) for g in
                              engine.optimizer.param_groups],
         }
@@ -274,13 +278,8 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
 
     # --- params -----------------------------------------------------------
     params_np = state_dict_to_tree(model_state["module"],
-                                   like=engine.state.params)
-    rules = engine.zero_rules
-    params = rules.place(
-        jax.tree_util.tree_map(
-            lambda p, cur: jnp.asarray(p, cur.dtype),
-            params_np, engine.state.params),
-        rules.param_spec)
+                                   like=engine.params_natural_like())
+    params = engine.params_from_natural(params_np)
 
     master = engine.state.master
     opt_state = engine.state.opt_state
@@ -295,11 +294,11 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
         elif engine.zero_optimization() or engine.keep_master:
             master, opt_state = _load_zero_checkpoint(engine, ckpt_dir)
         elif model_state.get("optimizer"):
+            opt_natural = engine.opt_layout_to_natural(
+                engine.state.opt_state)
             opt_np = state_dict_to_tree(model_state["optimizer"]["state"],
-                                        like=engine.state.opt_state)
-            opt_state = jax.tree_util.tree_map(
-                lambda n, cur: jax.device_put(
-                    jnp.asarray(n, cur.dtype), cur.sharding),
+                                        like=opt_natural)
+            opt_state = engine.opt_natural_to_layout(
                 opt_np, engine.state.opt_state)
             engine.optimizer.param_groups = [
                 dict(g) for g in model_state["optimizer"]["param_groups"]]
@@ -388,13 +387,17 @@ def _load_zero_checkpoint(engine, ckpt_dir):
 
     master = engine.state.master
     if master is not None and master_full is not None:
-        master_np = state_dict_to_tree({"arrays": master_full},
-                                       like=engine.state.master)
+        # like= must carry the NATURAL tree structure: saved keys are
+        # natural-layout paths (packed-rows engines store per-layer keys)
+        master_np = state_dict_to_tree(
+            {"arrays": master_full},
+            like=engine.layout_to_natural(engine.state.master))
         master = engine.natural_to_layout(master_np, engine.state.master)
     opt_state = engine.state.opt_state
     if opt_full:
-        opt_np = state_dict_to_tree({"arrays": opt_full},
-                                    like=engine.state.opt_state)
+        opt_np = state_dict_to_tree(
+            {"arrays": opt_full},
+            like=engine.opt_layout_to_natural(engine.state.opt_state))
         opt_state = engine.opt_natural_to_layout(opt_np,
                                                  engine.state.opt_state)
         engine.optimizer.param_groups = [

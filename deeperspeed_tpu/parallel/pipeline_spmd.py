@@ -266,19 +266,74 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
     (tokens [B, S], labels). The global batch splits into `n_micro`
     micro-batches along dim 0.
 
+    The returned function is a `jax.custom_vjp`: called directly it runs
+    a forward-only fill/drain loop; under `value_and_grad` it runs the
+    hand-interleaved 1F1B loop (`pipeline_1f1b_ticks`), so live
+    activation memory is bounded by min(n_stages, n_micro) boundary
+    buffers, not n_micro. Stage-edge work is gated per device with
+    `lax.cond`: only stage 0 embeds, only the last stage runs the
+    LM-head loss — interior stages skip both entirely. `remat` is
+    accepted for API compatibility but ignored: the 1F1B backward
+    recomputes each stage from its stashed input by construction.
+
     With `data_axis` set (and present in the mesh), the batch is consumed
     sharded over that axis and the loss is the data-parallel mean — a
-    full dp×pp(×tp) step in one program; shard_map's transpose inserts
-    the gradient psums over every axis a parameter is replicated on.
+    full dp x pp (x tp) step in one program. Gradients are reduced
+    explicitly: for each param leaf, psum over every mesh axis its
+    PartitionSpec does not use (tp-replicated leaves, pipe-replicated
+    embed/head) and pmean over the data axis.
     """
     n_stages = int(mesh.shape[axis_name])
     dp_active = (data_axis is not None and data_axis in mesh.axis_names
                  and int(mesh.shape[data_axis]) > 1)
 
-    def loss_fn(params, batch, rng=None):
-        tokens, labels = batch
+    def _axes_used(spec):
+        used = set()
+        for part in spec:
+            if part is None:
+                continue
+            if isinstance(part, tuple):
+                used.update(part)
+            else:
+                used.add(part)
+        return used
 
-        def inner(blocks_local, embed_params, head_params, tokens, labels):
+    def _reduce_grads(gtree, spec_tree):
+        """psum a leaf over every mesh axis absent from its spec (the
+        computation was replicated there), pmean over data (the loss is
+        the dp mean)."""
+        def red(g, spec):
+            used = _axes_used(spec)
+            for axis in mesh.axis_names:
+                if axis in used or int(mesh.shape[axis]) == 1:
+                    continue
+                g = (jax.lax.pmean(g, axis) if axis == data_axis
+                     else jax.lax.psum(g, axis))
+            return g
+        return jax.tree_util.tree_map(
+            red, gtree, spec_tree,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def _specs(params):
+        bspecs = blocks_specs if blocks_specs is not None else \
+            jax.tree_util.tree_map(lambda _: P(axis_name),
+                                   params["blocks"])
+        especs = embed_specs if embed_specs is not None else \
+            jax.tree_util.tree_map(lambda _: P(), params["embed"])
+        hspecs = head_specs if head_specs is not None else \
+            jax.tree_util.tree_map(lambda _: P(), params["head"])
+        return bspecs, especs, hspecs
+
+    def _call(params, batch, rng, mode):
+        tokens, labels = batch
+        bspecs, especs, hspecs = _specs(params)
+        batch_spec = P(data_axis) if dp_active else P()
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def inner(blocks_local, embed_params, head_params, tokens,
+                  labels, rng):
+            stage = jax.lax.axis_index(axis_name)
             b = tokens.shape[0]
             if b % n_micro != 0 or b < n_micro:
                 raise ValueError(
@@ -288,42 +343,83 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
             mb = b // n_micro
             tok_micro = tokens.reshape((n_micro, mb) + tokens.shape[1:])
             lab_micro = labels.reshape((n_micro, mb) + labels.shape[1:])
-            # Embedding is cheap; every stage computes it replicated so
-            # stage 0's injections exist locally (no host scatter).
-            x_micro = jax.vmap(lambda t: embed_fn(embed_params, t))(
-                tok_micro)
+            buf_tmpl = jax.eval_shape(
+                embed_fn, embed_params,
+                jax.ShapeDtypeStruct((mb,) + tokens.shape[1:],
+                                     tokens.dtype))
 
-            outputs = spmd_pipeline(stage_fn, blocks_local, x_micro,
-                                    axis_name, n_stages, n_micro,
-                                    remat=remat, fp32_comm=fp32_comm)
-            losses = jax.vmap(
-                lambda h, l: head_loss_fn(head_params, h, l))(outputs,
-                                                              lab_micro)
-            loss = jnp.mean(losses)
+            def stage_apply(args, buf, m_idx, rng_):
+                blocks, embed, head = args
+                tok = jax.lax.dynamic_index_in_dim(tok_micro, m_idx, 0,
+                                                   keepdims=False)
+                # only stage 0 pays the embedding lookup
+                x = jax.lax.cond(
+                    stage == 0,
+                    lambda: embed_fn(embed, tok).astype(buf.dtype),
+                    lambda: buf)
+                y = stage_fn(blocks, x)
+                lab = jax.lax.dynamic_index_in_dim(lab_micro, m_idx, 0,
+                                                   keepdims=False)
+                # only the last stage pays the LM-head matmul + loss
+                l = jax.lax.cond(
+                    stage == n_stages - 1,
+                    lambda: head_loss_fn(head, y, lab).astype(
+                        jnp.float32),
+                    lambda: jnp.asarray(0.0, jnp.float32))
+                return y, l
+
+            diff_args = (blocks_local, embed_params, head_params)
+            if mode == "grad":
+                loss, gacc = pipeline_1f1b_ticks(
+                    stage_apply, diff_args, buf_tmpl, n_stages, n_micro,
+                    axis_name, rng, fp32_comm=fp32_comm)
+                loss = last_stage_value(loss, axis_name, n_stages)
+                if dp_active:
+                    loss = jax.lax.pmean(loss, data_axis)
+                gb, ge, gh = gacc
+                gb = _reduce_grads(gb, bspecs)
+                ge = _reduce_grads(ge, especs)
+                gh = _reduce_grads(gh, hspecs)
+                return loss, gb, ge, gh
+
+            loss, _ = pipeline_forward_ticks(
+                stage_apply, diff_args, buf_tmpl, n_stages, n_micro,
+                axis_name, rng, fp32_comm=fp32_comm)
             loss = last_stage_value(loss, axis_name, n_stages)
             if dp_active:
                 loss = jax.lax.pmean(loss, data_axis)
             return loss
 
-        if blocks_specs is None:
-            bspecs = jax.tree_util.tree_map(
-                lambda _: P(axis_name), params["blocks"])
-        else:
-            bspecs = blocks_specs
-        other = P()
-        especs = embed_specs if embed_specs is not None else \
-            jax.tree_util.tree_map(lambda _: other, params["embed"])
-        hspecs = head_specs if head_specs is not None else \
-            jax.tree_util.tree_map(lambda _: other, params["head"])
-        batch_spec = P(data_axis) if dp_active else P()
+        out_specs = (P(), bspecs, especs, hspecs) if mode == "grad" \
+            else P()
         mapped = shard_map(
             inner, mesh=mesh,
-            in_specs=(bspecs, especs, hspecs, batch_spec, batch_spec),
-            out_specs=other,
+            in_specs=(bspecs, especs, hspecs, batch_spec, batch_spec,
+                      P()),
+            out_specs=out_specs,
             check_vma=False)
         return mapped(params["blocks"], params["embed"], params["head"],
-                      tokens, labels)
+                      tokens, labels, rng)
 
+    def primal(params, batch, rng=None):
+        return _call(params, batch, rng, "fwd")
+
+    def fwd_rule(params, batch, rng=None):
+        loss, gb, ge, gh = _call(params, batch, rng, "grad")
+        grads = {"blocks": gb, "embed": ge, "head": gh}
+        return loss, (grads, params, batch, rng)
+
+    def bwd_rule(res, cot):
+        grads, params, batch, rng = res
+        cot32 = cot.astype(jnp.float32)
+        g = jax.tree_util.tree_map(
+            lambda gg, pp: (gg.astype(jnp.float32) * cot32).astype(
+                pp.dtype),
+            grads, params)
+        return g, _zero_tangents(batch), _zero_tangents(rng)
+
+    loss_fn = jax.custom_vjp(primal)
+    loss_fn.defvjp(fwd_rule, bwd_rule)
     return loss_fn
 
 
@@ -391,17 +487,35 @@ class ModulePackMeta:
 
     def pack(self, params):
         """Natural param tree -> [n_stages, P_max] rows (in or out of
-        jit)."""
-        rows = []
+        jit). The row dtype follows the tree's leaves — the same meta
+        packs compute params and their fp32 masters."""
+        flats = []
         for s in range(self.n_stages):
             leaves = []
             for idx, _tdef, _specs in self.stage_slots[s]:
                 leaves.extend(
                     jax.tree_util.tree_leaves(params["layers"][idx]))
-            flat = (jnp.concatenate([jnp.ravel(l) for l in leaves])
-                    if leaves else jnp.zeros((0,), self.p_dtype))
-            rows.append(jnp.pad(flat, (0, self.P_max - self.sizes[s])))
+            flats.append(jnp.concatenate([jnp.ravel(l) for l in leaves])
+                         if leaves else None)
+        dt = next((f.dtype for f in flats if f is not None), self.p_dtype)
+        rows = [jnp.pad(f if f is not None else jnp.zeros((0,), dt),
+                        (0, self.P_max - self.sizes[s]))
+                for s, f in enumerate(flats)]
         return jnp.stack(rows)
+
+    def pack_host(self, params):
+        """`pack` on the host with numpy: no device allocation, so a
+        host-resident tree larger than one device's HBM can be packed
+        and then placed sharded (device 0 never holds the full matrix)."""
+        rows = np.zeros((self.n_stages, self.P_max), self.p_dtype)
+        for s in range(self.n_stages):
+            off = 0
+            for idx, _tdef, _specs in self.stage_slots[s]:
+                for l in jax.tree_util.tree_leaves(params["layers"][idx]):
+                    a = np.asarray(l).ravel()
+                    rows[s, off:off + a.size] = a
+                    off += a.size
+        return rows
 
     def unpack_stage(self, row, s):
         """One stage's [P_max] row -> the per-layer params list slot for
@@ -536,7 +650,7 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
         A = max(int(np.prod(sd.shape)) for sd in stage_in + stage_out)
         return stage_in, stage_out, A, act_dtype, mb
 
-    def _call(params, batch, rng, mode, collect=False):
+    def _call(params, batch, rng, mode, collect=False, with_loss=True):
         rows, tied, templates = _split(params)
         meta = get_meta(templates)
         inputs, labels = batch
@@ -575,16 +689,20 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
                         y = module.forward_range(pseudo, x, parts[s],
                                                  parts[s + 1], rng=mb_rng)
                         if s == n_stages - 1:
-                            lab = jax.lax.dynamic_index_in_dim(
-                                lab_micro, m_idx, 0, keepdims=False)
-                            l = (module.loss_fn(y, lab)
-                                 if module.loss_fn is not None
-                                 else jnp.mean(y))
+                            if with_loss:
+                                lab = jax.lax.dynamic_index_in_dim(
+                                    lab_micro, m_idx, 0, keepdims=False)
+                                l = (module.loss_fn(y, lab)
+                                     if module.loss_fn is not None
+                                     else jnp.mean(y)).astype(jnp.float32)
+                            else:
+                                # logits-only inference: labels untouched
+                                l = jnp.asarray(0.0, jnp.float32)
                             out = (jnp.pad(
                                 jnp.ravel(y).astype(act_dtype),
                                 (0, A - numel(o_sd))) if collect
                                 else jnp.zeros((A,), act_dtype))
-                            return out, l.astype(jnp.float32)
+                            return out, l
                         return (jnp.pad(jnp.ravel(y), (0, A - numel(o_sd))),
                                 jnp.asarray(0.0, jnp.float32))
 
@@ -671,13 +789,17 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
     loss_fn = jax.custom_vjp(primal)
     loss_fn.defvjp(fwd_rule, bwd_rule)
 
-    def pipelined_eval(params, batch, rng=None, return_logits=False):
+    def pipelined_eval(params, batch, rng=None, return_logits=False,
+                       with_loss=True):
         """Forward-only fill/drain across stages (reference
         InferenceSchedule, `pipe/engine.py:351,422`); with
-        `return_logits` the last stage's outputs are gathered."""
+        `return_logits` the last stage's outputs are gathered. Pass
+        ``with_loss=False`` for logits-only inference (labels are never
+        read — callers may pass the inputs twice)."""
         if not return_logits:
-            return _call(params, batch, rng, "fwd")
-        return _call(params, batch, rng, "fwd", collect=True)
+            return _call(params, batch, rng, "fwd", with_loss=with_loss)
+        return _call(params, batch, rng, "fwd", collect=True,
+                     with_loss=with_loss)
 
     loss_fn.pipelined_eval = pipelined_eval
     loss_fn.pack_meta = get_meta(param_templates) if packed_io else None

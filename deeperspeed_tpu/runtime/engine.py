@@ -47,8 +47,9 @@ from .lr_schedules import get_scheduler_class
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import GradientNoiseScale, clip_grad_norm_, global_norm
 from .zero.partition_parameters import (ZeroShardingRules, flat_pad,
-                                        flat_unpad, map_master_fields,
-                                        to_layout_leaf, to_natural_leaf)
+                                        flat_unpad, is_layout_shaped,
+                                        map_master_fields, to_layout_leaf,
+                                        to_natural_leaf)
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
@@ -415,8 +416,8 @@ class DeepSpeedEngine:
         model's tensor-parallel base specs (``model.param_specs``) with the
         ZeRO data-axis sharding."""
         rules = self.zero_rules
-        base = None
-        if hasattr(self.module_obj, "param_specs"):
+        base = getattr(self, "_base_specs_override", None)
+        if base is None and hasattr(self.module_obj, "param_specs"):
             base = self.module_obj.param_specs(model_parameters, self.mesh)
 
         def tree_of(spec_fn):
@@ -454,6 +455,27 @@ class DeepSpeedEngine:
             lambda sh, info: flat_sh if info else sh,
             self._master_sh, self._padinfo)
 
+        # Stage 3: ragged COMPUTE params (no dp-divisible dim) also rest
+        # flat-padded + sharded; the in-step unpad is the stage-3 param
+        # all-gather. Grads flow back in the same layout.
+        if base is None:
+            self._param_padinfo = jax.tree_util.tree_map(
+                lambda p: rules.param_pad_info(p.shape) or False,
+                model_parameters)
+        else:
+            self._param_padinfo = jax.tree_util.tree_map(
+                lambda p, b: rules.param_pad_info(p.shape, base=b) or False,
+                model_parameters, base,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+        self._any_param_pad = any(
+            bool(i) for i in jax.tree_util.tree_leaves(self._param_padinfo))
+        self._param_sh = jax.tree_util.tree_map(
+            lambda sh, info: flat_sh if info else sh,
+            self._param_sh, self._param_padinfo)
+        self._grad_sh = jax.tree_util.tree_map(
+            lambda sh, info: flat_sh if info else sh,
+            self._grad_sh, self._param_padinfo)
+
     def layout_to_natural(self, tree):
         """Master/moment tree in storage layout → natural param shapes
         (flat-padded leaves unpadded/reshaped). Used by checkpoint save so
@@ -467,6 +489,40 @@ class DeepSpeedEngine:
             lambda x, info, l: jax.device_put(
                 to_layout_leaf(jnp.asarray(x, l.dtype), info), l.sharding),
             tree, self._padinfo, like)
+
+    # --- params storage-layout hooks (identity here; PipelineEngine
+    # stores packed per-stage rows and overrides all three so
+    # checkpoints stay world-size independent) -------------------------
+
+    def _compute_view(self, params):
+        """Inside the jitted step: unpad stage-3 flat-stored ragged
+        params to their natural shapes (GSPMD turns the unpad of a
+        data-sharded flat buffer into the stage-3 param all-gather)."""
+        if not getattr(self, "_any_param_pad", False):
+            return params
+        return jax.tree_util.tree_map(
+            lambda x, i: flat_unpad(x, i) if i else x,
+            params, self._param_padinfo)
+
+    def params_to_natural(self, tree):
+        """Engine params state → natural (user-facing) param tree."""
+        if not getattr(self, "_any_param_pad", False):
+            return tree
+        return jax.tree_util.tree_map(to_natural_leaf, tree,
+                                      self._param_padinfo)
+
+    def params_natural_like(self):
+        """Structure template for the natural param tree."""
+        return self.params_to_natural(self.state.params)
+
+    def params_from_natural(self, tree):
+        """Natural param tree → engine params state placed with the
+        engine's shardings (tensor-parallel base specs included; stage-3
+        flat-stored ragged leaves re-pad)."""
+        return jax.tree_util.tree_map(
+            lambda p, sh, cur, i: jax.device_put(
+                to_layout_leaf(jnp.asarray(p, cur.dtype), i), sh),
+            tree, self._param_sh, self.state.params, self._param_padinfo)
 
     @property
     def _master_treedef(self):
@@ -565,14 +621,18 @@ class DeepSpeedEngine:
         master = jax.tree_util.tree_map(
             make_master, model_parameters, self._master_sh, self._padinfo)
 
-        def make_param(m, sh, info):
-            if info:
+        def make_param(m, sh, info, pinfo):
+            # pinfo set (stage-3 ragged): the compute param keeps the
+            # master's flat-padded layout and rests sharded; otherwise
+            # unpad to the natural shape.
+            if info and not pinfo:
                 m = flat_unpad(m, info)
             return jax.device_put(
                 jnp.array(m, dtype=self.compute_dtype, copy=True), sh)
 
         params = jax.tree_util.tree_map(
-            make_param, master, self._param_sh, self._padinfo)
+            make_param, master, self._param_sh, self._padinfo,
+            self._param_padinfo)
 
         if self.host_offload:
             # Device holds only compute params; masters/moments are host-
@@ -670,7 +730,7 @@ class DeepSpeedEngine:
             kw["pld_theta"] = pld_theta
 
         def scaled_loss(p):
-            loss = self.loss_fn(p, batch, rng, **kw)
+            loss = self.loss_fn(self._compute_view(p), batch, rng, **kw)
             return loss * scale.astype(loss.dtype), loss
 
         (scaled, loss), grads = jax.value_and_grad(
@@ -730,6 +790,9 @@ class DeepSpeedEngine:
         def grad_to_layout(g, info, sh):
             if not info:
                 return g
+            # stage-3 flat-stored leaves differentiate in layout already
+            if is_layout_shaped(g, info):
+                return jax.lax.with_sharding_constraint(g, sh)
             return jax.lax.with_sharding_constraint(flat_pad(g, info), sh)
 
         grads = jax.tree_util.tree_map(grad_to_layout, grads,
@@ -754,10 +817,11 @@ class DeepSpeedEngine:
                 state.opt_state)
 
         new_params = jax.tree_util.tree_map(
-            lambda m, sh, info: jax.lax.with_sharding_constraint(
-                (flat_unpad(m, info) if info else m).astype(
+            lambda m, sh, info, pinfo: jax.lax.with_sharding_constraint(
+                (flat_unpad(m, info) if info and not pinfo else m).astype(
                     self.compute_dtype), sh),
-            new_master, self._param_sh, self._padinfo)
+            new_master, self._param_sh, self._padinfo,
+            self._param_padinfo)
 
         if self.dynamic_loss_scale():
             args = cfg.dynamic_loss_scale_args or {}
@@ -1047,7 +1111,7 @@ class DeepSpeedEngine:
 
     def _build_eval_fn(self):
         def eval_fn(params, batch, rng):
-            return self.loss_fn(params, batch, rng)
+            return self.loss_fn(self._compute_view(params), batch, rng)
         return jax.jit(eval_fn)
 
     # ------------------------------------------------------------------
@@ -1671,7 +1735,7 @@ class DeepSpeedEngine:
         elif self.state.master is not None:
             natural = self.layout_to_natural(self.state.master)
         else:
-            natural = self.state.params
+            natural = self.params_to_natural(self.state.params)
 
         def write_back(view):
             new_master = self.state.master
@@ -1702,10 +1766,7 @@ class DeepSpeedEngine:
                 self._coord.publish_host_update()
                 self.state = self.state._replace(master=new_master)
                 return
-            new_params = jax.tree_util.tree_map(
-                lambda v, p, sh: jax.device_put(
-                    jnp.asarray(v, self.compute_dtype), sh),
-                view, self.state.params, self._param_sh)
+            new_params = self.params_from_natural(view)
             self.state = self.state._replace(params=new_params,
                                              master=new_master)
 
@@ -1722,5 +1783,5 @@ class DeepSpeedEngine:
                 "this function only works for ZeRO-3; use "
                 "engine.state.params / module_state_dict otherwise")
         from .zero.stage3 import consolidate_params
-        return consolidate_params(self.state.params,
+        return consolidate_params(self.params_to_natural(self.state.params),
                                   dtype=self.compute_dtype)

@@ -38,6 +38,54 @@ class PipelineEngine(DeepSpeedEngine):
         self.pipeline_module = model
         self._layers_to_hook = []
         self._hooked_activations = {}
+
+        # With a ``pipe`` mesh axis present, the LayerSpec list lowers
+        # onto the compiled 1F1B executor — REAL pipelining for arbitrary
+        # PipelineModules (reference `pipe/engine.py:654-1139`); without
+        # one, the model compiles as a sequential program (single-stage
+        # semantics, same math). Decided BEFORE the base engine builds
+        # state: pipelined engines store params as packed per-stage rows
+        # sharded over ``pipe`` (the reference's "build only local
+        # layers", `pipe/module.py:186,358`) so at-rest param bytes per
+        # device scale 1/n_stages.
+        from ...parallel.mesh import DATA_AXIS, PIPE_AXIS
+        from jax.sharding import PartitionSpec as P
+        mesh = kwargs.get("mesh")
+        if mesh is None and kwargs.get("mpu") is not None:
+            mesh = getattr(kwargs.get("mpu"), "mesh", None)
+        self._spmd_pipelined = (
+            mesh is not None and PIPE_AXIS in mesh.axis_names
+            and int(mesh.shape[PIPE_AXIS]) > 1
+            and model.num_stages > 1)
+        self._pack_meta = None
+        if self._spmd_pipelined:
+            from ...parallel.pipeline_spmd import ModulePackMeta
+            natural = kwargs.get("model_parameters")
+            if natural is None:
+                raise ValueError(
+                    "pipelined PipelineEngine requires model_parameters")
+            data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+            self._pack_meta = ModulePackMeta(model, natural, mesh=mesh,
+                                             axis_name=PIPE_AXIS,
+                                             data_axis=data_axis)
+            # No device uploads here: templates read metadata only, and
+            # host params pack on host (device placement happens later
+            # under the engine's shardings — a full-matrix upload to one
+            # device would defeat the 1/n_stages at-rest memory).
+            self._pipe_templates = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                               np.result_type(x)),
+                natural)
+            kwargs["model_parameters"] = {
+                "rows": self._pack_meta.pack_host(natural),
+                "tied": natural["tied"],
+            }
+            self._base_specs_override = {
+                "rows": P(PIPE_AXIS, None),
+                "tied": jax.tree_util.tree_map(lambda _: P(),
+                                               natural["tied"]),
+            }
+
         super().__init__(*args, model=model, **kwargs)
 
         if self._config.elasticity_enabled:
@@ -50,16 +98,6 @@ class PipelineEngine(DeepSpeedEngine):
         self.log_batch_step_id = -1
         self.agg_train_loss = None
 
-        # With a ``pipe`` mesh axis present, lower the LayerSpec list onto
-        # the SPMD ppermute executor — REAL pipelining for arbitrary
-        # PipelineModules (reference `pipe/engine.py:654-1139`); without
-        # one, the model compiles as a sequential program (single-stage
-        # semantics, same math).
-        from ...parallel.mesh import DATA_AXIS, PIPE_AXIS
-        self._spmd_pipelined = (
-            PIPE_AXIS in self.mesh.axis_names
-            and int(self.mesh.shape[PIPE_AXIS]) > 1
-            and model.num_stages > 1)
         if self._spmd_pipelined:
             # The pipelined loss re-splits its input into the 1F1B micro
             # geometry; paths that feed one micro-batch at a time (manual
@@ -83,7 +121,69 @@ class PipelineEngine(DeepSpeedEngine):
                 data_axis=DATA_AXIS if DATA_AXIS in self.mesh.axis_names
                 else None,
                 fp32_comm=self._fp32_comm or None,
-                remat=True)
+                remat=True, packed_io=True,
+                param_templates=self._pipe_templates)
+
+    # ------------------------------------------------------------------
+    # packed-rows storage layout (pipelined engines): checkpoints and
+    # user-facing trees stay in the natural per-layer structure
+    # ------------------------------------------------------------------
+
+    def params_to_natural(self, tree):
+        if not self._spmd_pipelined:
+            return tree
+        return {"layers": self._pack_meta.unpack(tree["rows"]),
+                "tied": tree["tied"]}
+
+    def params_natural_like(self):
+        if not self._spmd_pipelined:
+            return super().params_natural_like()
+        return self._pipe_templates
+
+    def params_from_natural(self, tree):
+        if not self._spmd_pipelined:
+            return super().params_from_natural(tree)
+        packed = {"rows": self._pack_meta.pack(tree),
+                  "tied": tree["tied"]}
+        return jax.tree_util.tree_map(
+            lambda p, cur: jax.device_put(jnp.asarray(p, cur.dtype),
+                                          cur.sharding),
+            packed, self.state.params)
+
+    def layout_to_natural(self, tree):
+        tree = super().layout_to_natural(tree)
+        if self._spmd_pipelined and isinstance(tree, dict) \
+                and "rows" in tree \
+                and getattr(tree["rows"], "ndim", 0) == 2:
+            # cast=False: masters/moments keep their (fp32) dtype
+            return {"layers": self._pack_meta.unpack(tree["rows"],
+                                                     cast=False),
+                    "tied": tree["tied"]}
+        return tree
+
+    def natural_to_layout(self, tree, like):
+        if self._spmd_pipelined and isinstance(tree, dict) \
+                and "layers" in tree:
+            tree = {"rows": self._pack_meta.pack(tree),
+                    "tied": tree["tied"]}
+        return super().natural_to_layout(tree, like)
+
+    def opt_natural_to_layout(self, opt_state_natural, like):
+        """Checkpointed moment fields carry the NATURAL structure
+        ({"layers": [...]}), so the mirror test must run against the
+        natural treedef, not the packed master treedef the base engine
+        uses (scalar mirror fields — OnebitLamb frozen_scale — keep the
+        packed structure and fall through to the passthrough arm)."""
+        if not self._spmd_pipelined:
+            return super().opt_natural_to_layout(opt_state_natural, like)
+        from ..zero.partition_parameters import map_master_fields
+        natural_def = jax.tree_util.tree_structure(self._pipe_templates)
+        return map_master_fields(
+            opt_state_natural, natural_def,
+            self.natural_to_layout, like,
+            passthrough=lambda nat, cur: jax.tree_util.tree_map(
+                lambda n, c: jax.device_put(
+                    jnp.asarray(n, c.dtype), c.sharding), nat, cur))
 
     @staticmethod
     def _resolve_model(model):
@@ -201,6 +301,32 @@ class PipelineEngine(DeepSpeedEngine):
             micro = [next(data_iter) for _ in range(gas)]
             batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro)
 
+        if self._spmd_pipelined:
+            # Pipelined eval: forward-only fill/drain ACROSS the pipe
+            # mesh (reference InferenceSchedule, `pipe/engine.py:351`) —
+            # params stay stage-sharded; no full-model program exists.
+            full = jax.tree_util.tree_map(
+                lambda b: np.asarray(b).reshape((-1,) + b.shape[2:]),
+                batch)
+            # loss_fn attachment changes the traced program (same reason
+            # as the sequential branch's cache key below)
+            key = ("pipe", bool(return_logits),
+                   self.pipeline_module.loss_fn is not None)
+            if not hasattr(self, "_compiled_pipe_eval"):
+                self._compiled_pipe_eval = {}
+            if key not in self._compiled_pipe_eval:
+                ev = self.loss_fn.pipelined_eval
+                self._compiled_pipe_eval[key] = jax.jit(
+                    lambda p, b, _rl=bool(return_logits):
+                    ev(p, b, return_logits=_rl))
+            result = self._compiled_pipe_eval[key](self.state.params,
+                                                   full)
+            self._capture_hooks(batch)
+            if return_logits:
+                mean_loss, outs = result
+                return mean_loss, outs.reshape((-1,) + outs.shape[2:])
+            return result
+
         module = self.pipeline_module
         # cache key: logits retention changes peak memory (stacking every
         # micro-batch's logits OOMs loss-only eval of LM-head models),
@@ -253,6 +379,19 @@ class PipelineEngine(DeepSpeedEngine):
         return out
 
     def _forward_logits(self, inputs):
+        if self._spmd_pipelined:
+            # logits-only inference across the pipe mesh: labels are a
+            # placeholder the executor never reads (with_loss=False)
+            if not hasattr(self, "_compiled_logits"):
+                ev = self.loss_fn.pipelined_eval
+
+                def fwd(params, x):
+                    _, outs = ev(params, (x, x), return_logits=True,
+                                 with_loss=False)
+                    return outs.reshape((-1,) + outs.shape[2:])
+
+                self._compiled_logits = jax.jit(fwd)
+            return self._compiled_logits(self.state.params, inputs)
         if not hasattr(self, "_compiled_logits"):
             module = self.pipeline_module
 
@@ -268,7 +407,7 @@ class PipelineEngine(DeepSpeedEngine):
         if not hooks or batch is None:
             return
         module = self.pipeline_module
-        params = self.state.params
+        params = self.params_to_natural(self.state.params)
         mb = jax.tree_util.tree_map(
             lambda x: x[0] if hasattr(x, "ndim") and x.ndim > 0 else x,
             batch)
@@ -284,7 +423,7 @@ class PipelineEngine(DeepSpeedEngine):
     def module_state_dict(self):
         """Per-layer state dicts (reference writes layer_XX-model_states.pt
         via `pipe/module.py:546`)."""
-        params = self.state.params
+        params = self.params_to_natural(self.state.params)
         out = {}
         for idx in range(self.pipeline_module.num_layers()):
             out[f"layer_{idx:02d}"] = self.pipeline_module._layer_param(

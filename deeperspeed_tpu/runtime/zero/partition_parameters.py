@@ -224,6 +224,20 @@ class ZeroShardingRules:
         """Sharding of a flat-padded master/moment buffer."""
         return NamedSharding(self.mesh, PartitionSpec(self.data_axis))
 
+    def param_pad_info(self, shape, base=None):
+        """`FlatPad` descriptor for a COMPUTE param stored flat-padded at
+        rest at stage 3 (ragged leaves that would otherwise replicate —
+        the unpad inside the jitted step becomes the stage-3 all-gather).
+        Honors `param_persistence_threshold`: small params stay
+        replicated in natural shape (reference
+        `partition_parameters.py:610-744` persistence semantics)."""
+        if self.stage < 3:
+            return None
+        numel = int(np.prod(shape)) if shape else 1
+        if numel < self.param_persistence_threshold:
+            return None
+        return self.master_pad_info(shape, base=base)
+
     def grad_spec(self, shape, base=None):
         """Gradients: reduce-scattered from stage 2."""
         if self.stage >= 2:
