@@ -245,11 +245,12 @@ def _load_host_offload_checkpoint(engine, shard):
     # NVMe store under param offload, onto the device otherwise.
     import jax.numpy as jnp
     if getattr(engine, "param_offload", False):
-        for host_leaf, m in zip(engine._host_param_leaves, masters):
-            flat = host_leaf.reshape(-1)
-            flat[:] = np.asarray(m, np.float32).astype(flat.dtype)
-        engine._coord.publish_host_update()
-        return engine.state.params
+        natural = jax.tree_util.tree_unflatten(
+            engine._host_treedef,
+            [m.reshape(s) for m, s in zip(masters, engine._host_shapes)])
+        # cpu tier: in-place host-store write; nvme tier: segment
+        # swap-outs through the coordinator (no DRAM mirror exists)
+        return engine.params_from_natural(natural)
     leaves = [jnp.asarray(m.reshape(s), engine.compute_dtype)
               for m, s in zip(masters, engine._host_shapes)]
     params = jax.tree_util.tree_unflatten(engine._host_treedef, leaves)

@@ -67,6 +67,39 @@ class StreamPlan:
         return self.kinds[name]
 
 
+class LazyLeaf:
+    """Deferred parameter initializer for beyond-DRAM models (the
+    reference's `zero.Init`-with-immediate-NVMe-spill,
+    `zero/partition_parameters.py:610-744`): carries shape/dtype so
+    sharding rules and templates can be computed without materializing;
+    the engine realizes it one segment at a time during the initial
+    spill and frees it immediately — the full tree never exists in
+    DRAM."""
+
+    __slots__ = ("shape", "dtype", "init_fn")
+
+    def __init__(self, shape, dtype, init_fn):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.init_fn = init_fn
+
+    def __call__(self):
+        out = np.asarray(self.init_fn(), self.dtype)
+        if out.shape != self.shape:
+            raise ValueError(
+                f"LazyLeaf init_fn returned {out.shape}, "
+                f"declared {self.shape}")
+        return out
+
+
+def _flatten_bytes(subtree):
+    """Concatenate a subtree's leaves into one uint8 buffer (the on-disk
+    segment layout)."""
+    leaves = jax.tree_util.tree_leaves(subtree)
+    return np.concatenate([np.asarray(l).ravel().view(np.uint8)
+                           for l in leaves])
+
+
 class ParamStreamCoordinator:
     """Owns the off-device param store and the device-side streaming
     window (fetch/prefetch/release), mirroring the reference's
@@ -79,46 +112,87 @@ class ParamStreamCoordinator:
     """
 
     def __init__(self, plan, host_params, compute_dtype, sharding=None,
-                 swapper=None):
+                 swapper=None, spill=True):
         self.plan = plan
         self.compute_dtype = compute_dtype
         self.sharding = sharding
         self.swapper = swapper
         self._device: Dict[str, Any] = {}
-        self._host: Dict[str, Any] = {}
         self._nvme_inflight: Dict[str, Any] = {}
+        # Per-segment shape/dtype templates — the ONLY per-param host
+        # metadata the NVMe tier keeps resident.
+        self._templates: Dict[str, Any] = {}
         for name, sel in plan.segments:
-            self._host[name] = sel(host_params)
-        if swapper is not None:
-            # spill every segment to NVMe; the host tree may then be freed
-            for name in self._host:
-                self._seg_to_nvme(name)
-            swapper.synchronize_writes()
+            sub = sel(host_params)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                sub, is_leaf=lambda x: isinstance(x, LazyLeaf))
+            self._templates[name] = (
+                treedef, [(tuple(l.shape), np.dtype(l.dtype))
+                          for l in leaves])
+        if swapper is None:
+            self._host: Optional[Dict[str, Any]] = {
+                name: sel(host_params) for name, sel in plan.segments}
+        else:
+            # NVMe is the store of record (reference
+            # `partitioned_param_swapper.py:36,238-304`): the segments
+            # spill once (here, or segment-by-segment by the engine when
+            # `spill=False` — the lazy-init path), then DRAM holds no
+            # param mirror, only the templates above.
+            self._host = None
+            if spill:
+                for name, sel in plan.segments:
+                    self.swapper.swap_out(
+                        name, _flatten_bytes(sel(host_params)))
+                swapper.synchronize_writes()
+
+    def segment_nbytes(self, name):
+        _, specs = self._templates[name]
+        return sum(int(np.prod(s)) * dt.itemsize for s, dt in specs)
 
     # -- NVMe segment <-> flat-file helpers --------------------------------
-
-    def _seg_flat(self, name):
-        leaves = jax.tree_util.tree_leaves(self._host[name])
-        return np.concatenate([np.asarray(l).ravel().view(np.uint8)
-                               for l in leaves])
-
-    def _seg_to_nvme(self, name):
-        self.swapper.swap_out(name, self._seg_flat(name))
 
     def _seg_from_flat(self, name, flat_u8):
         """Rebuild the segment subtree from raw bytes. COPIES out of the
         pooled aio buffer: `device_put` can be zero-copy (the CPU backend
         aliases host memory), so views into the pool would silently
         change when the buffer is reused for the next read."""
-        tmpl = self._host[name]
-        leaves, treedef = jax.tree_util.tree_flatten(tmpl)
+        treedef, specs = self._templates[name]
         out, off = [], 0
-        for l in leaves:
-            nbytes = l.size * l.dtype.itemsize
+        for shape, dt in specs:
+            nbytes = int(np.prod(shape)) * dt.itemsize
             out.append(np.array(
-                flat_u8[off:off + nbytes].view(l.dtype)).reshape(l.shape))
+                flat_u8[off:off + nbytes].view(dt)).reshape(shape))
             off += nbytes
         return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- host-side segment IO (checkpoints, GatheredParameters) ------------
+
+    def read_segment_host(self, name):
+        """The segment's params as host numpy arrays (NVMe: synchronous
+        read through the pooled aio buffers)."""
+        if self.swapper is None:
+            return self._host[name]
+        views = self.swapper.swap_in([name], async_op=False)
+        sub = self._seg_from_flat(name, views[name])
+        self.swapper.release([name])
+        return sub
+
+    def write_segment(self, name, subtree=None, flat_u8=None,
+                      async_op=True):
+        """Replace a segment's stored params (NVMe tier; the cpu tier's
+        leaves are shared views the caller mutates in place). Call
+        `synchronize_writes` after a batch of writes."""
+        if self.swapper is None:
+            return
+        if flat_u8 is None:
+            flat_u8 = _flatten_bytes(subtree)
+        self.swapper.swap_out(name, flat_u8)
+        if not async_op:
+            self.swapper.synchronize_writes()
+
+    def synchronize_writes(self):
+        if self.swapper is not None:
+            self.swapper.synchronize_writes()
 
     # -- streaming window --------------------------------------------------
 
@@ -169,14 +243,67 @@ class ParamStreamCoordinator:
         self._device.pop(name, None)
 
     def publish_host_update(self, names=None):
-        """After the host optimizer rewrote the host param leaves, push
-        NVMe segments back out (host tier needs nothing: the leaves are
-        shared views)."""
+        """cpu tier: no-op (the store leaves are shared views the host
+        optimizer mutated in place). NVMe tier: there is no host mirror
+        to publish FROM — callers must `write_segment` the fresh bytes
+        they produced; reaching here is a stale-caller bug."""
         if self.swapper is None:
             return
-        for name in (names if names is not None else self._host):
-            self._seg_to_nvme(name)
+        raise RuntimeError(
+            "NVMe param tier has no host mirror: write updated segments "
+            "with write_segment(name, subtree) instead of "
+            "publish_host_update()")
+
+
+class GradSpillStore:
+    """Per-segment fp32 gradient accumulation on NVMe (reference: the
+    ZeRO-Infinity gradient swap path, `swap_tensor/optimizer_utils.py`).
+
+    During the streamed backward, each segment's gradients are added into
+    a per-segment flat fp32 file: DRAM holds at most one segment's
+    gradients at a time, so accumulation memory — like params and
+    optimizer state — is bounded by NVMe, not DRAM. Tied leaves appear
+    in several segments' files as PARTIAL contributions; `leaf_slices`
+    lets the optimizer sum them at step time."""
+
+    def __init__(self, swapper, plan, seg_leaf_ids):
+        self.swapper = swapper
+        self.plan = plan
+        self.seg_leaf_ids = dict(seg_leaf_ids)
+        self._written = set()
+        # {segment: [(leaf_id, start_f32, size_f32)]}
+        self.leaf_slices: Dict[str, List[Tuple[int, int, int]]] = {}
+
+    def begin_step(self):
+        self._written.clear()
+
+    def add(self, name, dparams):
+        """Accumulate one micro-batch's segment grads (read-modify-write
+        after the first micro)."""
+        leaves = jax.tree_util.tree_leaves(dparams)
+        flats = [np.asarray(jax.device_get(g), np.float32).ravel()
+                 for g in leaves]
+        if name not in self.leaf_slices:
+            slices, off = [], 0
+            for lid, f in zip(self.seg_leaf_ids[name], flats):
+                slices.append((lid, off, f.size))
+                off += f.size
+            self.leaf_slices[name] = slices
+        total = np.concatenate(flats)
+        if name in self._written:
+            views = self.swapper.swap_in([name], async_op=False)
+            total = total + views[name].view(np.float32)
+            self.swapper.release([name])
+        self.swapper.swap_out(name, total.view(np.uint8))
         self.swapper.synchronize_writes()
+        self._written.add(name)
+
+    def read(self, name):
+        """The segment's accumulated flat fp32 grads (a copy)."""
+        views = self.swapper.swap_in([name], async_op=False)
+        out = np.array(views[name].view(np.float32))
+        self.swapper.release([name])
+        return out
 
 
 def make_segment_fns(plan, donate_carry=True):

@@ -1,0 +1,120 @@
+"""NVMe store-of-record capacity proof: train a model whose parameter
+bytes EXCEED a hard DRAM cap (RLIMIT_DATA on the heap), with
+`offload_param: {device: nvme}` + `offload_optimizer: {device: nvme}`.
+
+Round-2 verdict demanded this rung be real: with the DRAM mirror gone,
+resident host memory is bounded by one segment (params/grads/opt-state
+all live on NVMe), so the cap can sit far below total param bytes and
+training must still run.
+
+Usage:
+    python tests/perf/nvme_capacity_harness.py [--cap-mb N] [--layers L]
+
+The harness re-execs itself in a child with the rlimit applied (JAX must
+initialize entirely under the cap)."""
+
+import argparse
+import os
+import resource
+import subprocess
+import sys
+
+
+def run_capped(cap_mb, layers, hidden, nvme_dir):
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    cfg = GPTNeoXConfig(vocab_size=2048, hidden_size=hidden,
+                        num_layers=layers, num_heads=8, max_seq_len=64)
+    model = GPTNeoX(cfg, use_pallas=False)
+    n_params = cfg.num_params()
+    param_mb = n_params * 4 / 2**20       # fp32 compute on CPU harness
+    state_mb = n_params * 16 / 2**20      # + fp32 master, m, v
+    print(f"model: {n_params/1e6:.1f}M params = {param_mb:.0f} MB params, "
+          f"{state_mb:.0f} MB with optimizer state; DRAM cap {cap_mb} MB")
+
+    # LazyLeaf init: each leaf materializes one segment at a time during
+    # the NVMe spill — the full tree never exists in DRAM.
+    from deeperspeed_tpu.runtime.zero.param_offload import LazyLeaf
+
+    shapes = jax.eval_shape(
+        lambda k: model.init_params(k), jax.random.PRNGKey(0))
+
+    def lazify(path, l):
+        seed = abs(hash(jax.tree_util.keystr(path))) % 2**31
+
+        def init(shape=l.shape, seed=seed):
+            r = np.random.default_rng(seed)
+            return r.normal(0, 0.02, shape).astype(np.float32)
+
+        return LazyLeaf(l.shape, np.float32, init)
+
+    params = jax.tree_util.tree_map_with_path(lazify, shapes)
+
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "nvme",
+                                      "nvme_path": nvme_dir},
+                "offload_param": {"device": "nvme",
+                                  "nvme_path": nvme_dir},
+            }})
+    del params
+
+    losses = []
+    data_rng = np.random.default_rng(1)
+    for step in range(2):
+        toks = data_rng.integers(0, cfg.vocab_size,
+                                 (1, 4, 64)).astype(np.int32)
+        losses.append(float(engine.train_batch(batch=(toks, toks))))
+    peak_rss_mb = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss / 1024
+    print(f"trained 2 steps under the cap: losses={losses}, "
+          f"peak RSS {peak_rss_mb:.0f} MB (cap {cap_mb} MB, "
+          f"param+opt state {param_mb + state_mb:.0f} MB)")
+    assert all(np.isfinite(losses)), losses
+    assert param_mb > cap_mb, \
+        "model too small to prove anything — raise --layers"
+    print("CAPACITY PROOF OK: param bytes alone exceed the DRAM cap")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cap-mb", type=int, default=2000)
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--hidden", type=int, default=1536)
+    ap.add_argument("--nvme", default="/tmp/nvme_ladder")
+    ap.add_argument("--child", action="store_true")
+    args = ap.parse_args()
+
+    if args.child:
+        cap = args.cap_mb * 2**20
+        # RLIMIT_DATA caps the heap (numpy + XLA host buffers); leave
+        # address space alone (shared libs/mmaps are not the point).
+        resource.setrlimit(resource.RLIMIT_DATA, (cap, cap))
+        run_capped(args.cap_mb, args.layers, args.hidden, args.nvme)
+        return
+
+    os.makedirs(args.nvme, exist_ok=True)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           f"--cap-mb={args.cap_mb}", f"--layers={args.layers}",
+           f"--hidden={args.hidden}", f"--nvme={args.nvme}"]
+    # single malloc arena: RLIMIT_DATA counts arena high-water, and
+    # multi-arena fragmentation inflates it far beyond live RSS
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MALLOC_ARENA_MAX="1",
+               MALLOC_TRIM_THRESHOLD_="1048576")
+    sys.exit(subprocess.call(cmd, env=env))
+
+
+if __name__ == "__main__":
+    main()

@@ -156,3 +156,62 @@ def test_param_offload_requires_stream_plan(devices):
             config_params=_config({"zero_optimization": {
                 "stage": 3, "offload_optimizer": {"device": "cpu"},
                 "offload_param": {"device": "cpu"}}}))
+
+
+NVME = lambda p: {"zero_optimization": {  # noqa: E731
+    "stage": 3, "offload_optimizer": {"device": "cpu"},
+    "offload_param": {"device": "nvme", "nvme_path": str(p)}}}
+
+
+def test_param_offload_nvme_is_store_of_record(tmp_path, baseline,
+                                               devices):
+    """The NVMe tier keeps NO DRAM mirror (reference
+    `partitioned_param_swapper.py:36,238-304`): after init the
+    coordinator holds only shape/dtype templates, state.params leaves
+    are zero-strided placeholders, gradients accumulate in per-segment
+    NVMe files, and reads assemble through the swapper — so capacity is
+    bounded by NVMe, not DRAM."""
+    engine = _engine(NVME(tmp_path))
+    assert engine._host_param_leaves is None
+    assert engine._coord._host is None
+    for leaf in jax.tree_util.tree_leaves(engine.state.params):
+        assert isinstance(leaf, np.ndarray)
+        assert all(s == 0 for s in leaf.strides), "placeholder must be " \
+            "a zero-strided view (no model-sized DRAM)"
+    got = _train(engine)
+    np.testing.assert_allclose(got, baseline, rtol=2e-4, atol=2e-4)
+    # per-segment grad spill files exist
+    assert glob.glob(os.path.join(str(tmp_path), "grads", "**", "*.swp"),
+                     recursive=True)
+    # export reads assemble real values from NVMe
+    nat = engine.params_to_natural(engine.state.params)
+    emb = np.asarray(jax.tree_util.tree_leaves(nat["embed"])[0],
+                     np.float32)
+    assert np.isfinite(emb).all() and np.abs(emb).sum() > 0
+    # gathered-parameters write-back reaches the NVMe store
+    with engine.gathered_parameters(modifier_rank=0) as full:
+        full["final_ln"]["scale"][:] = 2.5
+    nat = engine.params_to_natural(engine.state.params)
+    np.testing.assert_allclose(
+        np.asarray(nat["final_ln"]["scale"], np.float32), 2.5)
+
+
+def test_param_offload_nvme_grad_accumulation(tmp_path, baseline,
+                                              devices):
+    cfg = NVME(tmp_path)
+    cfg["gradient_accumulation_steps"] = 2
+    got = _train(_engine(cfg), gas=2)
+    np.testing.assert_allclose(got, baseline, rtol=2e-4, atol=2e-4)
+
+
+def test_param_offload_nvme_checkpoint_roundtrip(tmp_path, devices):
+    cfg = NVME(tmp_path / "swap")
+    engine = _engine(cfg)
+    _train(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    ref = _train(engine, steps=2, seed=7)
+
+    engine2 = _engine(NVME(tmp_path / "swap2"), seed=5)
+    engine2.load_checkpoint(str(tmp_path / "ckpt"))
+    got = _train(engine2, steps=2, seed=7)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
