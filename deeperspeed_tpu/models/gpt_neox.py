@@ -49,6 +49,13 @@ class GPTNeoXConfig:
     use_parallel_residual: bool = True
     tie_word_embeddings: bool = False
     param_dtype: object = jnp.float32
+    # MoE FFN (GShard/Switch; 0 experts = dense MLP). Config-drivable
+    # via the JSON `moe` block (engine `apply_ds_config`).
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_jitter_eps: float = 0.0
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self):
@@ -60,9 +67,13 @@ class GPTNeoXConfig:
 
     def num_params(self):
         h, v, L = self.hidden_size, self.vocab_size, self.num_layers
-        per_layer = 4 * h * h + 3 * h + h + \
-            2 * h * self.intermediate_size + self.intermediate_size + h + \
-            4 * h  # qkv+out + biases + ln scales/biases + mlp
+        i = self.intermediate_size
+        mlp = 2 * h * i + i + h
+        if self.moe_num_experts:
+            E = self.moe_num_experts
+            mlp = h * E + E * (2 * h * i + i + h)  # gate + experts
+        per_layer = 4 * h * h + 3 * h + h + mlp + \
+            4 * h  # qkv+out + biases + ln scales/biases + ffn
         embed = v * h * (1 if self.tie_word_embeddings else 2)
         return embed + L * per_layer + 2 * h
 
@@ -109,12 +120,27 @@ def init_block_params(cfg, key):
             "out_w": _dense_init(keys[1], (h, h), dt, scale=out_scale),
             "out_b": jnp.zeros((h,), dt),
         },
-        "mlp": {
-            "in_w": _dense_init(keys[2], (h, i), dt),
+        "mlp": _init_ffn_params(cfg, keys[2], keys[3], out_scale),
+    }
+
+
+def _init_ffn_params(cfg, k_in, k_out, out_scale):
+    h, i, dt = cfg.hidden_size, cfg.intermediate_size, cfg.param_dtype
+    E = cfg.moe_num_experts
+    if not E:
+        return {
+            "in_w": _dense_init(k_in, (h, i), dt),
             "in_b": jnp.zeros((i,), dt),
-            "out_w": _dense_init(keys[3], (i, h), dt, scale=out_scale),
+            "out_w": _dense_init(k_out, (i, h), dt, scale=out_scale),
             "out_b": jnp.zeros((h,), dt),
-        },
+        }
+    kg, ki = jax.random.split(k_in)
+    return {
+        "gate": _dense_init(kg, (h, E), dt),
+        "w_in": _dense_init(ki, (E, h, i), dt),
+        "b_in": jnp.zeros((E, i), dt),
+        "w_out": _dense_init(k_out, (E, i, h), dt, scale=out_scale),
+        "b_out": jnp.zeros((E, h), dt),
     }
 
 
@@ -258,12 +284,12 @@ def _block_qkv(cfg, params, x, cos, sin, rot_dim, nh_local):
     return q, k, v
 
 
-def _block_post_attn(cfg, params, x, attn_flat, reduce_fn):
+def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
     """Everything after the attention core: out projection, residuals,
-    ln2, MLP — shared by training and decode. `attn_flat` is the
-    flattened [B, S, h/mp] attention output."""
+    ln2, MLP (dense or MoE) — shared by training and decode.
+    `attn_flat` is the flattened [B, S, h/mp] attention output. With
+    MoE enabled the return is (out, aux_load_balance_loss)."""
     out_b = params["attn"]["out_b"].astype(x.dtype)
-    mlp_b = params["mlp"]["out_b"].astype(x.dtype)
     attn_partial = attn_flat @ params["attn"]["out_w"].astype(x.dtype)
 
     if cfg.use_parallel_residual:
@@ -273,6 +299,21 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn):
         ln2_in = x + attn_out
     ln2 = layer_norm(ln2_in, params["ln_mlp"]["scale"],
                      params["ln_mlp"]["bias"], cfg.layernorm_eps)
+
+    if cfg.moe_num_experts:
+        from ..moe.layer import moe_ffn_dense
+        B, S, h = ln2.shape
+        y, aux = moe_ffn_dense(
+            params["mlp"], ln2.reshape(B * S, h),
+            capacity_factor=cfg.moe_capacity_factor,
+            top_k=cfg.moe_top_k, rng=rng,
+            jitter_eps=cfg.moe_jitter_eps)
+        moe_out = y.reshape(ln2.shape)
+        if cfg.use_parallel_residual:
+            return x + reduce_fn(attn_partial) + out_b + moe_out, aux
+        return ln2_in + moe_out, aux
+
+    mlp_b = params["mlp"]["out_b"].astype(x.dtype)
     hmid = ln2 @ params["mlp"]["in_w"].astype(x.dtype) + \
         params["mlp"]["in_b"].astype(x.dtype)
     hmid = jax.nn.gelu(hmid)
@@ -285,7 +326,7 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn):
 
 
 def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
-                return_kv=False):
+                return_kv=False, rng=None, attn_fn=None):
     """Shared block body: `mp == 1` with identity `reduce_fn` is the
     dense block; TP callers pass pre-sliced params (column/row parallel)
     and a psum reduce; the KV-cached decode step reuses the same
@@ -296,20 +337,24 @@ def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
     cos, sin, rot_dim = cos_sin
     q, k, v = _block_qkv(cfg, params, x, cos, sin, rot_dim,
                          cfg.num_heads // mp)
-    attn = causal_attention(q, k, v, use_pallas=use_pallas)
+    if attn_fn is not None:
+        attn = attn_fn(q, k, v)
+    else:
+        attn = causal_attention(q, k, v, use_pallas=use_pallas)
     out = _block_post_attn(cfg, params, x, attn.reshape(B, S, h // mp),
-                           reduce_fn)
+                           reduce_fn, rng=rng)
     if return_kv:
         return out, (k, v)
     return out
 
 
 def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
-                  use_pallas=True):
+                  use_pallas=True, rng=None, attn_fn=None):
     """One GPT-NeoX block with parallel residual:
-    x + attn(ln1(x)) + mlp(ln2(x))."""
+    x + attn(ln1(x)) + ffn(ln2(x)). With `cfg.moe_num_experts` the FFN
+    is the MoE layer and the return is (out, aux_loss)."""
     return _block_core(cfg, params, x, cos_sin, use_pallas, mp=1,
-                       reduce_fn=lambda t: t)
+                       reduce_fn=lambda t: t, rng=rng, attn_fn=attn_fn)
 
 
 def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
@@ -323,6 +368,10 @@ def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
 
     x is replicated over `model_axis`; mp = mesh size of that axis.
     """
+    if cfg.moe_num_experts:
+        raise NotImplementedError(
+            "tensor-parallel blocks with an MoE FFN are not supported "
+            "yet; use expert parallelism (mesh axis 'expert') instead")
     return _block_core(cfg, params, x, cos_sin, use_pallas, mp=mp,
                        reduce_fn=lambda t: jax.lax.psum(t, model_axis))
 
@@ -337,10 +386,12 @@ def block_param_specs_tp(pipe_axis=None):
 
 
 def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
-                   collect_hidden=False):
+                   collect_hidden=False, rng=None, attn_fn=None):
     """tokens [B, S] int32 → final-norm hidden states [B, S, H]; with
     `collect_hidden` also returns [embed, block outputs..., final norm]
-    (the activation-capture path shares this exact forward)."""
+    (the activation-capture path shares this exact forward). With MoE
+    enabled, returns (out, aux_loss_total[, hidden])."""
+    moe = bool(cfg.moe_num_experts)
     x = params["embed"]["wte"][tokens]
     cos, sin, rot_dim = _rotary_cache(cfg, tokens.shape[1])
     hidden = [x] if collect_hidden else None
@@ -350,19 +401,33 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
         # jax.checkpoint's traced args it becomes an int32 tracer and
         # the rotary slice bound blows up; close over it instead
         ck = jax.checkpoint(
-            lambda bp, x, cos, sin: block_forward(
-                cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas))
-        block_fn = lambda bp, x: ck(bp, x, cos, sin)       # noqa: E731
+            lambda bp, x, cos, sin, r: block_forward(
+                cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
+                rng=r, attn_fn=attn_fn))
+        block_fn = lambda bp, x, r: ck(bp, x, cos, sin, r)  # noqa: E731
     else:
-        block_fn = lambda bp, x: block_forward(            # noqa: E731
-            cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas)
-    for bp in params["blocks"]:
-        x = block_fn(bp, x)
+        block_fn = lambda bp, x, r: block_forward(         # noqa: E731
+            cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
+            rng=r, attn_fn=attn_fn)
+    aux_total = jnp.asarray(0.0, jnp.float32)
+    for i, bp in enumerate(params["blocks"]):
+        brng = jax.random.fold_in(rng, i) if (moe and rng is not None) \
+            else None
+        y = block_fn(bp, x, brng)
+        if moe:
+            x, aux = y
+            aux_total = aux_total + aux
+        else:
+            x = y
         if collect_hidden:
             hidden.append(x)
 
     out = layer_norm(x, params["final_ln"]["scale"],
                      params["final_ln"]["bias"], cfg.layernorm_eps)
+    if moe:
+        if collect_hidden:
+            return out, aux_total, hidden + [out]
+        return out, aux_total
     if collect_hidden:
         return out, hidden + [out]
     return out
@@ -372,6 +437,8 @@ def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
     """tokens [B, S] int32 → logits [B, S, V]."""
     x = forward_hidden(cfg, params, tokens, use_pallas=use_pallas,
                        remat_blocks=remat_blocks)
+    if cfg.moe_num_experts:
+        x, _ = x
     out_embed = params.get("embed_out", params["embed"])["wte"]
     logits = jnp.einsum("bsh,vh->bsv", x, out_embed.astype(x.dtype),
                         preferred_element_type=jnp.float32)
@@ -444,15 +511,57 @@ class GPTNeoX:
         self.config = config or GPTNeoXConfig(**kwargs)
         self.use_pallas = use_pallas
         self.remat_blocks = remat_blocks
+        self._attn_fn = None   # set by apply_ds_config (sequence parallel)
+
+    def apply_ds_config(self, ds_config, mesh=None):
+        """Wire the JSON `moe` / `sequence_parallel` blocks into the
+        model — the engine calls this before parameter init, so a user
+        config alone (no library imports) drives both axes."""
+        import dataclasses
+        moe = getattr(ds_config, "moe_params", None)
+        if moe:
+            self.config = dataclasses.replace(
+                self.config,
+                moe_num_experts=moe["num_experts"],
+                moe_top_k=moe["top_k"],
+                moe_capacity_factor=moe["capacity_factor"],
+                moe_jitter_eps=moe["jitter_eps"],
+                moe_aux_loss_coef=moe["aux_loss_coef"])
+        sp = getattr(ds_config, "sequence_parallel_params", None)
+        if sp:
+            from ..parallel.sequence import SequenceParallel
+            if mesh is None or sp["axis"] not in mesh.axis_names:
+                raise ValueError(
+                    f"sequence_parallel needs a mesh with axis "
+                    f"{sp['axis']!r}")
+            self._attn_fn = SequenceParallel(mesh, axis=sp["axis"],
+                                             mode=sp["mode"])
 
     def init_params(self, rng):
         return init_params(self.config, rng)
 
     def param_specs(self, params, mesh):
-        if MODEL_AXIS not in mesh.axis_names or \
-                mesh.shape[MODEL_AXIS] == 1:
-            return jax.tree_util.tree_map(lambda p: P(), params)
-        return param_specs(self.config, params)
+        has_mp = MODEL_AXIS in mesh.axis_names and \
+            mesh.shape[MODEL_AXIS] > 1
+        has_ep = ("expert" in mesh.axis_names
+                  and mesh.shape["expert"] > 1
+                  and self.config.moe_num_experts > 0)
+        if has_mp and self.config.moe_num_experts:
+            raise NotImplementedError(
+                "tensor parallel + MoE FFN is unsupported; shard experts "
+                "over an 'expert' mesh axis")
+        if has_mp:
+            return param_specs(self.config, params)
+        specs = jax.tree_util.tree_map(lambda p: P(), params)
+        if has_ep:
+            # expert dim sharded over the expert axis; XLA inserts the
+            # dispatch/combine exchange (GSPMD expert parallelism)
+            ep_specs = {"gate": P(), "w_in": P("expert"),
+                        "b_in": P("expert"), "w_out": P("expert"),
+                        "b_out": P("expert")}
+            for b in specs["blocks"]:
+                b["mlp"] = ep_specs
+        return specs
 
     def apply(self, params, tokens):
         return forward(self.config, params, tokens,
@@ -466,9 +575,17 @@ class GPTNeoX:
             tokens = labels = batch
         hidden = forward_hidden(self.config, params, tokens,
                                 use_pallas=self.use_pallas,
-                                remat_blocks=self.remat_blocks)
+                                remat_blocks=self.remat_blocks,
+                                rng=rng, attn_fn=self._attn_fn)
+        aux = None
+        if self.config.moe_num_experts:
+            hidden, aux = hidden
         out_embed = params.get("embed_out", params["embed"])["wte"]
-        return fused_lm_head_loss(hidden, out_embed, labels)
+        loss = fused_lm_head_loss(hidden, out_embed, labels)
+        if aux is not None:
+            loss = loss + self.config.moe_aux_loss_coef * \
+                aux / max(self.config.num_layers, 1)
+        return loss
 
     def generate(self, params, prompt, max_new_tokens, temperature=0.0,
                  rng=None):
@@ -537,10 +654,10 @@ class GPTNeoX:
         `forward_hidden` so the capture can never drift from the real
         forward."""
         tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
-        _, outs = forward_hidden(self.config, params, tokens,
-                                 use_pallas=self.use_pallas,
-                                 collect_hidden=True)
-        return outs
+        res = forward_hidden(self.config, params, tokens,
+                             use_pallas=self.use_pallas,
+                             collect_hidden=True, attn_fn=self._attn_fn)
+        return res[-1]
 
 
 # ---------------------------------------------------------------------------
@@ -576,6 +693,8 @@ def _block_decode(cfg, bp, x, kv, pos, cos_sin):
 
     out = _block_post_attn(cfg, bp, x, attn.reshape(B, 1, cfg.hidden_size),
                            reduce_fn=lambda t: t)
+    if cfg.moe_num_experts:
+        out, _ = out  # greedy decode ignores the aux loss
     return out, (k_cache, v_cache)
 
 

@@ -503,11 +503,14 @@ class ModulePackMeta:
                 for s, f in enumerate(flats)]
         return jnp.stack(rows)
 
-    def pack_host(self, params):
+    def pack_host(self, params, dtype=None):
         """`pack` on the host with numpy: no device allocation, so a
         host-resident tree larger than one device's HBM can be packed
-        and then placed sharded (device 0 never holds the full matrix)."""
-        rows = np.zeros((self.n_stages, self.P_max), self.p_dtype)
+        and then placed sharded (device 0 never holds the full matrix).
+        `dtype` overrides the row dtype (fp32 for master trees)."""
+        rows = np.zeros((self.n_stages, self.P_max),
+                        np.dtype(dtype) if dtype is not None
+                        else self.p_dtype)
         for s in range(self.n_stages):
             off = 0
             for idx, _tdef, _specs in self.stage_slots[s]:

@@ -334,6 +334,26 @@ class DeepSpeedConfig:
             c.PLD_GAMMA: pld.get(c.PLD_GAMMA, c.PLD_GAMMA_DEFAULT),
         } if self.pld_enabled else False
 
+        # Config-drivable MoE / sequence parallelism (the engine hands
+        # these to the model family via `apply_ds_config`; no library
+        # imports needed in user code).
+        moe = d.get("moe") or {}
+        self.moe_enabled = bool(moe.get("enabled",
+                                        moe.get("num_experts", 0)))
+        self.moe_params = {
+            "num_experts": int(moe.get("num_experts", 0)),
+            "top_k": int(moe.get("top_k", 1)),
+            "capacity_factor": float(moe.get("capacity_factor", 1.25)),
+            "jitter_eps": float(moe.get("jitter_eps", 0.0)),
+            "aux_loss_coef": float(moe.get("aux_loss_coef", 0.01)),
+        } if self.moe_enabled else False
+        sp = d.get("sequence_parallel") or {}
+        self.sequence_parallel_enabled = bool(sp.get("enabled", False))
+        self.sequence_parallel_params = {
+            "mode": str(sp.get("mode", "ring")),
+            "axis": str(sp.get("axis", "sp")),
+        } if self.sequence_parallel_enabled else False
+
         bs_sched = d.get(c.BATCH_SIZE_SCHEDULE) or {}
         self.batch_size_schedule_enabled = bool(
             bs_sched.get(c.BS_SCHEDULE_ENABLED, c.BS_SCHEDULE_ENABLED_DEFAULT))

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 
 from ....ops.adam.fused_adam import FusedAdam
-from ...comm.compressed import compressed_allreduce_dense_two_phase
+from ...comm.compressed import (compressed_allreduce_dense_two_phase,
+                                compressed_allreduce_two_phase, wire_pad)
 
 
 class OnebitAdamState(NamedTuple):
@@ -36,7 +37,7 @@ class OnebitAdam(FusedAdam):
                  freeze_step=100000, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
                  weight_decay=0.0, max_grad_norm=0.0, amsgrad=False,
-                 cuda_aware=False, **kwargs):
+                 cuda_aware=False, packed_transport=False, **kwargs):
         super().__init__(params, lr=lr, bias_correction=bias_correction,
                          betas=betas, eps=eps, weight_decay=weight_decay,
                          adam_w_mode=False)
@@ -44,7 +45,16 @@ class OnebitAdam(FusedAdam):
         self.deepspeed = deepspeed
         self.adam_freeze_key = False
         self.initialize = False
-        self.comm_backend_name = "xla"
+        # packed_transport: momentum sync moves PACKED sign bytes via
+        # all_to_all/all_gather inside the engine's shard_map step —
+        # the reference's actual wire path (`onebit/adam.py:218`,
+        # `comm/nccl.py:99-103`), for DCN/multi-slice regimes where the
+        # ~16x byte reduction matters. Default (dense) keeps the same
+        # quantization math as fp32-valued collectives — the right call
+        # on ICI. `dp_world` is set by the engine before init_state.
+        self.packed_transport = bool(packed_transport)
+        self.dp_world = 1
+        self.comm_backend_name = "nccl" if packed_transport else "xla"
         # Set by the engine when masters use the ZeRO flat-pad layout: a
         # tree of FlatPad|False matching the params. Padded tails must be
         # excluded from compression scales and stay exactly 0.
@@ -59,11 +69,31 @@ class OnebitAdam(FusedAdam):
             return jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
 
+        if self.packed_transport and self.dp_world > 1:
+            # Per-RANK error feedback: leading [world] dim, sharded over
+            # the data axis by the engine so each rank round-trips its
+            # own residuals. Worker errors span the wire-padded flat
+            # length; server errors cover this rank's server chunk.
+            w = self.dp_world
+
+            def rank_zeros(chunk_of_pad):
+                return jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(
+                        (w, chunk_of_pad(wire_pad(p.size, w))),
+                        jnp.float32),
+                    master_params)
+
+            return OnebitAdamState(
+                step=base.step, exp_avg=base.exp_avg,
+                exp_avg_sq=base.exp_avg_sq,
+                worker_error=rank_zeros(lambda pad: pad),
+                server_error=rank_zeros(lambda pad: pad // w))
         return OnebitAdamState(step=base.step, exp_avg=base.exp_avg,
                                exp_avg_sq=base.exp_avg_sq,
                                worker_error=zeros(), server_error=zeros())
 
-    def update(self, grads, state, master_params, lr=None, axis_name=None):
+    def update(self, grads, state, master_params, lr=None,
+               axis_name=None, compress=True):
         group = self.param_groups[0]
         beta1, beta2 = group["betas"]
         eps = group["eps"]
@@ -71,6 +101,12 @@ class OnebitAdam(FusedAdam):
         lr = group["lr"] if lr is None else lr
         step = state.step + 1
         in_warmup = step <= self.freeze_step
+
+        packed = (self.packed_transport and self.dp_world > 1
+                  and axis_name is not None)
+        # compress=False: the engine's warmup program — compression
+        # results would be discarded by the in_warmup select, but XLA
+        # cannot DCE collectives, so skip the wire statically
 
         def leaf(p, g, m, v, err, serr, info=None):
             g = g.astype(jnp.float32)
@@ -84,10 +120,26 @@ class OnebitAdam(FusedAdam):
             # full two-phase semantics post-warmup (worker quant + server
             # requant with its own error buffer, reference nccl.py:47-186);
             # the cross-rank mean runs only with an axis_name (shard_map)
-            m_comp, err_new, serr_new = \
-                compressed_allreduce_dense_two_phase(
-                    m_new, err, serr, axis_name,
-                    n_valid=info.numel if info else None)
+            if not compress:
+                update = m_new / (jnp.sqrt(v_new) + eps)
+                return p - lr * update, m_new, v_new, err, serr
+            if packed:
+                # the reference's actual wire path: sign bytes via
+                # all_to_all + all_gather (err/serr carry this rank's
+                # residuals under a leading [world] dim sliced to [1,..])
+                n = m_new.size
+                pad = wire_pad(n, self.dp_world)
+                flat = jnp.pad(jnp.ravel(m_new), (0, pad - n))
+                out, e2, s2 = compressed_allreduce_two_phase(
+                    flat, err[0], serr[0], axis_name, self.dp_world,
+                    n_valid=info.numel if info else n)
+                m_comp = out[:n].reshape(m_new.shape)
+                err_new, serr_new = e2[None], s2[None]
+            else:
+                m_comp, err_new, serr_new = \
+                    compressed_allreduce_dense_two_phase(
+                        m_new, err, serr, axis_name,
+                        n_valid=info.numel if info else None)
             m_new = jnp.where(in_warmup, m_new, m_comp)
             err = jnp.where(in_warmup, err, err_new)
             serr = jnp.where(in_warmup, serr, serr_new)

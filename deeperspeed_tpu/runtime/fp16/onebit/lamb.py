@@ -30,12 +30,17 @@ class OnebitLamb(FusedLamb):
                  weight_decay=0.0, max_grad_norm=0.0, max_coeff=10.0,
                  min_coeff=0.01, amsgrad=False, cuda_aware=False,
                  coeff_beta=0.9, factor_max=4.0, factor_min=0.5,
-                 factor_threshold=0.1, **kwargs):
+                 factor_threshold=0.1, packed_transport=False, **kwargs):
         super().__init__(params, lr=lr, bias_correction=bias_correction,
                          betas=betas, eps=eps, weight_decay=weight_decay,
                          max_coeff=max_coeff, min_coeff=min_coeff)
         self.freeze_step = freeze_step
         self.deepspeed = deepspeed
+        # Packed sign-byte wire transport (see onebit/adam.py); dp_world
+        # is installed by the engine before init_state.
+        self.packed_transport = bool(packed_transport)
+        self.dp_world = 1
+        self.comm_backend_name = "nccl" if packed_transport else "xla"
         # Tree of FlatPad|False installed by the engine for flat-padded
         # masters (see onebit/adam.py).
         self.pad_info = None
@@ -52,6 +57,24 @@ class OnebitLamb(FusedLamb):
             return jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
 
+        if self.packed_transport and self.dp_world > 1:
+            from ...comm.compressed import wire_pad
+            w = self.dp_world
+            worker = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((w, wire_pad(p.size, w)), jnp.float32),
+                master_params)
+            server = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((w, wire_pad(p.size, w) // w),
+                                    jnp.float32),
+                master_params)
+            ones_t = jax.tree_util.tree_map(
+                lambda p: jnp.ones((), jnp.float32), master_params)
+            return OnebitLambState(step=base.step, exp_avg=base.exp_avg,
+                                   exp_avg_sq=base.exp_avg_sq,
+                                   worker_error=worker,
+                                   server_error=server,
+                                   frozen_scale=ones_t)
+
         ones = jax.tree_util.tree_map(
             lambda p: jnp.ones((), jnp.float32), master_params)
         return OnebitLambState(step=base.step, exp_avg=base.exp_avg,
@@ -59,7 +82,8 @@ class OnebitLamb(FusedLamb):
                                worker_error=zeros(), server_error=zeros(),
                                frozen_scale=ones)
 
-    def update(self, grads, state, master_params, lr=None, axis_name=None):
+    def update(self, grads, state, master_params, lr=None,
+               axis_name=None, compress=True):
         group = self.param_groups[0]
         beta1, beta2 = group["betas"]
         eps = group["eps"]
@@ -70,6 +94,12 @@ class OnebitLamb(FusedLamb):
         step = state.step + 1
         in_warmup = step <= self.freeze_step
 
+        packed = (self.packed_transport and self.dp_world > 1
+                  and axis_name is not None)
+        # compress=False: the engine's warmup program — compression
+        # results would be discarded by the in_warmup select, but XLA
+        # cannot DCE collectives, so skip the wire statically
+
         def leaf(p, g, m, v, err, serr, fs, info=None):
             g = g.astype(jnp.float32)
             p = p.astype(jnp.float32)
@@ -77,10 +107,24 @@ class OnebitLamb(FusedLamb):
             v_new = jnp.where(in_warmup,
                               beta2 * v + (1 - beta2) * jnp.square(g), v)
             # two-phase semantics post-warmup (see onebit/adam.py)
-            m_comp, err_new, serr_new = \
-                compressed_allreduce_dense_two_phase(
-                    m_new, err, serr, axis_name,
-                    n_valid=info.numel if info else None)
+            if not compress:
+                m_comp, err_new, serr_new = m_new, err, serr
+            elif packed:
+                from ...comm.compressed import (
+                    compressed_allreduce_two_phase, wire_pad)
+                n = m_new.size
+                pad = wire_pad(n, self.dp_world)
+                flat = jnp.pad(jnp.ravel(m_new), (0, pad - n))
+                out, e2, s2 = compressed_allreduce_two_phase(
+                    flat, err[0], serr[0], axis_name, self.dp_world,
+                    n_valid=info.numel if info else n)
+                m_comp = out[:n].reshape(m_new.shape)
+                err_new, serr_new = e2[None], s2[None]
+            else:
+                m_comp, err_new, serr_new = \
+                    compressed_allreduce_dense_two_phase(
+                        m_new, err, serr, axis_name,
+                        n_valid=info.numel if info else None)
             m_new = jnp.where(in_warmup, m_new, m_comp)
             err = jnp.where(in_warmup, err, err_new)
             serr = jnp.where(in_warmup, serr, serr_new)

@@ -143,7 +143,11 @@ class PipelineEngine(DeepSpeedEngine):
     def params_from_natural(self, tree):
         if not self._spmd_pipelined:
             return super().params_from_natural(tree)
-        packed = {"rows": self._pack_meta.pack(tree),
+        # pack on HOST then place sharded: a device-side pack would
+        # transiently hold the full row matrix on one device — OOM for
+        # exactly the models pipelining exists for
+        host_tree = jax.tree_util.tree_map(np.asarray, tree)
+        packed = {"rows": self._pack_meta.pack_host(host_tree),
                   "tied": tree["tied"]}
         return jax.tree_util.tree_map(
             lambda p, cur: jax.device_put(jnp.asarray(p, cur.dtype),
@@ -164,8 +168,10 @@ class PipelineEngine(DeepSpeedEngine):
     def natural_to_layout(self, tree, like):
         if self._spmd_pipelined and isinstance(tree, dict) \
                 and "layers" in tree:
-            tree = {"rows": self._pack_meta.pack(tree),
-                    "tied": tree["tied"]}
+            host_tree = jax.tree_util.tree_map(np.asarray, tree)
+            tree = {"rows": self._pack_meta.pack_host(
+                host_tree, dtype=np.dtype(like["rows"].dtype)),
+                "tied": tree["tied"]}
         return super().natural_to_layout(tree, like)
 
     def opt_natural_to_layout(self, opt_state_natural, like):

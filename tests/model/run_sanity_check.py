@@ -36,6 +36,10 @@ CONFIGS = {
     "zero3-param-offload": {"zero_optimization": {
         "stage": 3, "offload_optimizer": {"device": "cpu"},
         "offload_param": {"device": "cpu"}}},
+    # GShard MoE FFN, driven purely by the JSON block (top-2 + jitter);
+    # a different model => only the trains-and-decreases check applies
+    "moe-top2": {"moe": {"num_experts": 4, "top_k": 2,
+                         "jitter_eps": 0.01}},
 }
 EXACT = {"zero1", "zero2", "zero3", "gas2"}  # must match baseline to fp32 tol
 CLOSE = {"zero2-offload": 5e-4,  # native C++ Adam rounds differently
@@ -60,10 +64,13 @@ def run_config(name, overrides, steps, model_family):
               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
     config.update(overrides)
     gas = config.get("gradient_accumulation_steps", 1)
+    # config-driven model features (moe) change the param tree; let the
+    # engine init params AFTER applying the config
     engine, *_ = deeperspeed_tpu.initialize(
-        model=model, model_parameters=model.init_params(
+        model=model,
+        model_parameters=None if "moe" in config else model.init_params(
             jax.random.PRNGKey(0)),
-        config_params=config)
+        config_params=config, rng=jax.random.PRNGKey(0))
     rng = np.random.default_rng(1)
     # one fixed batch repeated (memorizable): the loss must fall, and the
     # reference's func tests likewise compare losses on identical data

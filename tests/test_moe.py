@@ -178,3 +178,67 @@ def test_gate_jitter_changes_routing_only_with_rng():
     y_a, _ = layer.apply(params, x, rng=jax.random.PRNGKey(1))
     y_b, _ = layer.apply(params, x, rng=jax.random.PRNGKey(2))
     assert np.abs(np.asarray(y_a) - np.asarray(y_b)).max() > 1e-8
+
+
+# --- config-drivable MoE / SP (VERDICT round-2 #9) -----------------------
+
+def test_moe_config_drivable(devices):
+    """A user JSON config alone (no library imports) turns on the MoE
+    FFN: the engine applies the `moe` block before param init, expert
+    weights appear, and training on a fixed batch decreases the loss."""
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    model = GPTNeoX(GPTNeoXConfig.tiny(), use_pallas=False)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=None,
+        config_params={
+            "train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+            "moe": {"num_experts": 4, "top_k": 2, "jitter_eps": 0.01},
+        }, rng=jax.random.PRNGKey(0))
+    mlp = engine.state.params["blocks"][0]["mlp"]
+    assert mlp["w_in"].shape[0] == 4, "expert weights missing"
+    assert model.config.moe_top_k == 2
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, model.config.vocab_size, (1, 16, 32), np.int32)
+    losses = [float(engine.train_batch(batch=(toks, toks)))
+              for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_sequence_parallel_config_drivable(devices):
+    """The `sequence_parallel` JSON block swaps in ring attention over
+    the mesh's sp axis — trajectory parity with the dense engine."""
+    import deeperspeed_tpu
+    from jax.sharding import Mesh
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    cfg_json = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    }
+
+    def run(sp_mesh):
+        model = GPTNeoX(GPTNeoXConfig.tiny(), use_pallas=False)
+        extra = dict(cfg_json)
+        mesh = None
+        if sp_mesh:
+            mesh = Mesh(np.asarray(devices).reshape(2, 4),
+                        ("data", "sp"))
+            extra["sequence_parallel"] = {"enabled": True,
+                                          "mode": "ring", "axis": "sp"}
+        engine, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=None, config_params=extra,
+            mesh=mesh, rng=jax.random.PRNGKey(0))
+        rng = np.random.default_rng(2)
+        toks = rng.integers(0, model.config.vocab_size, (1, 8, 128),
+                            np.int32)
+        return [float(engine.train_batch(batch=(toks, toks)))
+                for _ in range(4)]
+
+    base = run(False)
+    got = run(True)
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=2e-4)

@@ -283,3 +283,107 @@ def test_onebit_adam_engine_config():
     y = rng.normal(size=(1, 8, 16)).astype(np.float32)
     losses = [float(engine.train_batch(batch=(x, y))) for _ in range(10)]
     assert losses[-1] < losses[0]
+
+
+# --- packed transport inside the ENGINE's step (VERDICT round-2 #5) ------
+
+def _packed_engine(freeze_step, packed=True, seed=0, dp=8):
+    import deeperspeed_tpu
+    D = 16
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        pred = jnp.tanh(x @ params["w1"]) @ params["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    params = {"w1": jax.random.normal(k1, (D, D)) * 0.3,
+              "w2": jax.random.normal(k2, (D, D)) * 0.3}
+    opt_params = {"lr": 1e-2, "freeze_step": freeze_step}
+    if packed:
+        opt_params["packed_transport"] = True
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config_params={"train_batch_size": 16,
+                       "optimizer": {"type": "OneBitAdam",
+                                     "params": opt_params},
+                       "steps_per_print": 1000})
+    return engine
+
+
+def _run_engine(engine, steps, seed=3, fixed=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(1, 16, 16)).astype(np.float32)
+    y = rng.normal(size=(1, 16, 16)).astype(np.float32)
+    out = []
+    for _ in range(steps):
+        if not fixed:
+            x = rng.normal(size=(1, 16, 16)).astype(np.float32)
+            y = rng.normal(size=(1, 16, 16)).astype(np.float32)
+        out.append(float(engine.train_batch(batch=(x, y))))
+    return np.asarray(out)
+
+
+def test_packed_engine_warmup_matches_dense(devices):
+    """During freeze_step warmup the packed engine runs plain Adam on the
+    dp-mean gradient — identical trajectory to the default path."""
+    ref = _run_engine(_packed_engine(100, packed=False), 4)
+    got = _run_engine(_packed_engine(100, packed=True), 4)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_packed_engine_post_freeze_converges(devices):
+    """After freeze_step the compressed-momentum step keeps training:
+    loss decreases and error-feedback buffers become active."""
+    engine = _packed_engine(2)
+    losses = _run_engine(engine, 20, fixed=True)
+    assert losses[-1] < losses[0] * 0.5, losses
+    we = jax.tree_util.tree_leaves(engine.state.opt_state.worker_error)
+    assert any(float(jnp.abs(w).sum()) > 0 for w in we), \
+        "compression never engaged"
+
+
+def test_packed_engine_wire_bytes(devices):
+    """The VERDICT 'done' criterion: the ENGINE's post-freeze compiled
+    step contains no fp32 gradient allreduce — its gradient-sync wire
+    volume (packed u8 all_to_all/all_gather + scales) is >=4x smaller
+    than the dense program's fp32 pmean traffic."""
+    import re
+
+    def wire_bytes(hlo, ops):
+        """Sum payload bytes of matching collectives; variadic ops carry
+        a result TUPLE, so every dtype[dims] before the op name counts."""
+        total = 0
+        pat = re.compile(r"=\s*(.*?)\s*(" + "|".join(ops) + r")\(")
+        for line in hlo.splitlines():
+            mt = pat.search(line)
+            if not mt:
+                continue
+            for dtype, dims in re.findall(
+                    r"(u8|f32|s32|bf16)\[([\d,]*)\]", mt.group(1)):
+                sz = int(np.prod([int(d) for d in dims.split(",") if d]))
+                total += sz * {"u8": 1, "bf16": 2, "f32": 4,
+                               "s32": 4}[dtype]
+        return total
+
+    def step_hlo(engine, post):
+        engine._onebit_post_phase = post
+        step = engine._train_step_body(1)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 16, 16)).astype(np.float32)
+        batch = jax.tree_util.tree_map(
+            lambda b: engine._shard_stacked_batch(b), (x, x))
+        return jax.jit(step).lower(
+            engine.state, batch, jax.random.PRNGKey(0),
+            jnp.asarray(1e-2)).compile().as_text()
+
+    engine = _packed_engine(2)
+    post_hlo = step_hlo(engine, post=True)
+    warm_hlo = step_hlo(engine, post=False)
+    post_bytes = wire_bytes(post_hlo,
+                            ["all-to-all", "all-gather", "all-reduce"])
+    warm_bytes = wire_bytes(warm_hlo, ["all-reduce"])
+    n_params = 2 * 16 * 16
+    assert warm_bytes >= n_params * 4, (warm_bytes,)
+    assert post_bytes > 0
+    assert post_bytes * 4 <= warm_bytes, (post_bytes, warm_bytes)
