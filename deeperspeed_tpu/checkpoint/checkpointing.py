@@ -103,15 +103,20 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     # reconstructed later without the framework (reference
     # `engine.py:1800-1808` does the same with its zero_to_fp32.py).
     try:
-        from ..utils import zero_to_fp32 as _z2f
-        shutil.copyfile(_z2f.__file__,
-                        os.path.join(ckpt_dir, "zero_to_fp32.py"))
+        if jax.process_index() == 0:
+            from ..utils import zero_to_fp32 as _z2f
+            shutil.copyfile(_z2f.__file__,
+                            os.path.join(ckpt_dir, "zero_to_fp32.py"))
     except Exception:  # pragma: no cover
         pass
 
-    if save_latest:
+    if save_latest and jax.process_index() == 0:
         with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
             f.write(str(tag))
+    if jax.process_count() > 1:
+        # writers finish before any process proceeds to read/continue
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("deeperspeed_ckpt_save")
     log_dist(f"Saved checkpoint {tag} to {ckpt_dir}", ranks=[0])
     return True
 

@@ -21,6 +21,11 @@ except Exception:  # pragma: no cover
 
 
 def save_obj(obj, path):
+    # Multi-process: every process computes the (collectively gathered)
+    # state, but only process 0 touches the filesystem (reference
+    # `engine.py` rank-0 save gating). Callers barrier afterwards.
+    if jax.process_index() != 0:
+        return
     if _HAVE_TORCH:
         torch.save(obj, path)
     else:  # pragma: no cover
@@ -49,12 +54,22 @@ def _path_key(path):
     return "/".join(parts)
 
 
+def to_host(leaf):
+    """Leaf → numpy on THIS host. Multi-process arrays are not fully
+    addressable locally — gather the global value over DCN first
+    (checkpoint writers need whole arrays)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(
+            multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
 def tree_to_state_dict(tree):
     """Flatten a pytree to {path: numpy array} + treedef pickle for exact
     structure restoration."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    arrays = {_path_key(path): np.asarray(jax.device_get(leaf))
-              for path, leaf in flat}
+    arrays = {_path_key(path): to_host(leaf) for path, leaf in flat}
     return {"arrays": arrays, "treedef": pickle.dumps(treedef)}
 
 

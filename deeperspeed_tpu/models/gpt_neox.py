@@ -126,7 +126,7 @@ def init_block_params(cfg, key):
 
 def _init_ffn_params(cfg, k_in, k_out, out_scale):
     h, i, dt = cfg.hidden_size, cfg.intermediate_size, cfg.param_dtype
-    E = cfg.moe_num_experts
+    E = getattr(cfg, "moe_num_experts", 0)
     if not E:
         return {
             "in_w": _dense_init(k_in, (h, i), dt),
@@ -300,7 +300,7 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
     ln2 = layer_norm(ln2_in, params["ln_mlp"]["scale"],
                      params["ln_mlp"]["bias"], cfg.layernorm_eps)
 
-    if cfg.moe_num_experts:
+    if getattr(cfg, "moe_num_experts", 0):
         from ..moe.layer import moe_ffn_dense
         B, S, h = ln2.shape
         y, aux = moe_ffn_dense(
@@ -368,7 +368,7 @@ def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
 
     x is replicated over `model_axis`; mp = mesh size of that axis.
     """
-    if cfg.moe_num_experts:
+    if getattr(cfg, "moe_num_experts", 0):
         raise NotImplementedError(
             "tensor-parallel blocks with an MoE FFN are not supported "
             "yet; use expert parallelism (mesh axis 'expert') instead")
@@ -391,7 +391,7 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
     `collect_hidden` also returns [embed, block outputs..., final norm]
     (the activation-capture path shares this exact forward). With MoE
     enabled, returns (out, aux_loss_total[, hidden])."""
-    moe = bool(cfg.moe_num_experts)
+    moe = bool(getattr(cfg, "moe_num_experts", 0))
     x = params["embed"]["wte"][tokens]
     cos, sin, rot_dim = _rotary_cache(cfg, tokens.shape[1])
     hidden = [x] if collect_hidden else None
@@ -437,7 +437,7 @@ def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
     """tokens [B, S] int32 → logits [B, S, V]."""
     x = forward_hidden(cfg, params, tokens, use_pallas=use_pallas,
                        remat_blocks=remat_blocks)
-    if cfg.moe_num_experts:
+    if getattr(cfg, "moe_num_experts", 0):
         x, _ = x
     out_embed = params.get("embed_out", params["embed"])["wte"]
     logits = jnp.einsum("bsh,vh->bsv", x, out_embed.astype(x.dtype),
@@ -693,7 +693,7 @@ def _block_decode(cfg, bp, x, kv, pos, cos_sin):
 
     out = _block_post_attn(cfg, bp, x, attn.reshape(B, 1, cfg.hidden_size),
                            reduce_fn=lambda t: t)
-    if cfg.moe_num_experts:
+    if getattr(cfg, "moe_num_experts", 0):
         out, _ = out  # greedy decode ignores the aux loss
     return out, (k_cache, v_cache)
 

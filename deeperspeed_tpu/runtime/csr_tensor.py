@@ -5,6 +5,19 @@ A row-sparse gradient is stored as (indices, values); the DP reduction
 all-gathers both (engine `csr_allreduce`) instead of densifying. On TPU the
 all-gather is `jax.lax.all_gather` over the `data` axis; `to_dense` uses a
 segment-sum so duplicate rows gathered from different ranks accumulate.
+
+Why the ENGINE's gradient path does not produce CSR tensors (by design,
+not omission): the reference intercepts torch's sparse embedding grads
+(`engine.py:1397-1448`), a CUDA-side representation torch emits for
+`nn.Embedding(sparse=True)`. JAX has no sparse cotangents — the VJP of a
+gather is a dense scatter-add that XLA fuses into the update, and under
+GSPMD the wire cost the reference's CSR allreduce saves is already
+avoided by sharding the embedding's fp32 state (ZeRO flat-pad shards the
+50257-row vocab; the grad constraint reduce-scatters it). `CSRTensor` +
+`csr_allreduce` therefore exist as the API-parity container for USER
+code that builds row-sparse grads explicitly (tested in
+tests/test_runtime_utils.py); `sparse_gradients_enabled` gates exactly
+that path, matching the reference default of dense reduction.
 """
 
 import jax

@@ -362,21 +362,35 @@ class GatheredParameters:
     """
 
     def __init__(self, params, modifier_rank=None, fwd_module=None,
-                 enabled=True, on_exit=None):
+                 enabled=True, on_exit=None, select=None):
         self.params = params
         self.modifier_rank = modifier_rank
         self.enabled = enabled
         self.updated = None
         self._on_exit = on_exit
         self._view = None
+        # select: per-leaf predicate on the tree path ("blocks/0/mlp/..."),
+        # so callers gather a SUB-TREE instead of stalling on a whole-
+        # model host materialization (reference gathers are per-param,
+        # `partition_parameters.py:1002`). Unselected leaves stay as
+        # (immutable) device arrays in the yielded tree.
+        self._select = select
+
+    def _selected(self, path):
+        if self._select is None:
+            return True
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        return self._select(key)
 
     def __enter__(self):
         if not self.enabled:
             self._view = self.params
             return self.params
         # np.array (not asarray): a mutable copy, never a read-only view
-        self._view = jax.tree_util.tree_map(
-            lambda p: np.array(jax.device_get(p)), self.params)
+        self._view = jax.tree_util.tree_map_with_path(
+            lambda path, p: np.array(jax.device_get(p))
+            if self._selected(path) else p, self.params)
         return self._view
 
     def __exit__(self, exc_type, exc, tb):
@@ -388,11 +402,13 @@ class GatheredParameters:
                 # .updated (a second full-model host→device copy)
                 self._on_exit(self._view)
             else:
-                self.updated = jax.tree_util.tree_map(
-                    lambda v, p: jax.device_put(
+                self.updated = jax.tree_util.tree_map_with_path(
+                    lambda path, v, p: (jax.device_put(
                         jnp.asarray(v, p.dtype),
                         getattr(p, "sharding", None))
-                    if hasattr(p, "sharding") else jnp.asarray(v, p.dtype),
+                        if hasattr(p, "sharding")
+                        else jnp.asarray(v, p.dtype))
+                    if self._selected(path) else p,
                     self._view, self.params)
         return False
 

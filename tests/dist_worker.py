@@ -1,0 +1,78 @@
+"""Worker for the multi-process integration test (the reference's
+`@distributed_test` forked workers, `tests/unit/common.py:16-100`): each
+process joins a 2-process gloo-backed CPU cluster, builds an engine over
+the GLOBAL device mesh, trains, checkpoints, restores, and asserts
+parity. Launched by tests/test_multiprocess.py."""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    ckpt_dir = sys.argv[3]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2, process_id=pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4  # 2 local per process
+
+    import numpy as np
+
+    import deeperspeed_tpu
+    import jax.numpy as jnp
+
+    D = 16
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        pred = jnp.tanh(x @ params["w1"]) @ params["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {"w1": jax.random.normal(k1, (D, D)) * 0.3,
+              "w2": jax.random.normal(k2, (D, D)) * 0.3}
+    config = {"train_batch_size": 16,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+              "zero_optimization": {"stage": 2},
+              "steps_per_print": 1000}
+
+    def make():
+        engine, *_ = deeperspeed_tpu.initialize(
+            model=loss_fn, model_parameters=params, config_params=config,
+            dist_init_required=False)
+        assert engine.dp_world_size == 4, engine.dp_world_size
+        return engine
+
+    def batches(seed, n):
+        rng = np.random.default_rng(seed)  # same data on every process
+        for _ in range(n):
+            x = rng.normal(size=(1, 16, D)).astype(np.float32)
+            y = rng.normal(size=(1, 16, D)).astype(np.float32)
+            yield (x, y)
+
+    engine = make()
+    losses = [float(engine.train_batch(batch=b)) for b in batches(1, 3)]
+    engine.save_checkpoint(ckpt_dir)
+    ref = [float(engine.train_batch(batch=b)) for b in batches(2, 2)]
+
+    engine2 = make()
+    engine2.load_checkpoint(ckpt_dir)
+    got = [float(engine2.train_batch(batch=b)) for b in batches(2, 2)]
+
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+    print("WORKER_RESULT " + json.dumps(
+        {"pid": pid, "losses": losses, "ref": ref, "got": got}))
+
+
+if __name__ == "__main__":
+    main()

@@ -173,3 +173,31 @@ def test_engine_gathered_parameters_host_offload_masters():
     np.testing.assert_allclose(
         np.asarray(engine.state.params["w"], np.float32)[0, 1], 0.5,
         rtol=1e-2)
+
+
+def test_gathered_parameters_subtree_select(devices):
+    """`select` gathers only the requested sub-tree: unselected leaves
+    never leave the device (no whole-model host stall), mutations to the
+    selected leaves still write back into training state."""
+    import deeperspeed_tpu
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        return jnp.mean((x @ params["a"]["w"] + params["b"]["w"] - y) ** 2)
+
+    params = {"a": {"w": jnp.ones((8, 8))}, "b": {"w": jnp.ones((8,))}}
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config_params={"train_batch_size": 16,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                       "zero_optimization": {"stage": 2},
+                       "steps_per_print": 1000})
+    with engine.gathered_parameters(modifier_rank=0,
+                                    select=["b/"]) as full:
+        assert isinstance(full["b"]["w"], np.ndarray)
+        assert not isinstance(full["a"]["w"], np.ndarray), \
+            "unselected leaf must stay a device array"
+        full["b"]["w"][:] = 3.5
+    nat = engine.params_to_natural(engine.state.params)
+    np.testing.assert_allclose(np.asarray(nat["b"]["w"], np.float32), 3.5)
+    np.testing.assert_allclose(np.asarray(nat["a"]["w"], np.float32), 1.0)
