@@ -55,6 +55,16 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
 
+    if getattr(engine, "_grad_spill", None) is not None:
+        # NVMe store-of-record tier: the segment + optimizer-group files
+        # ARE the model state — checkpoint by streaming file copies
+        # (O(1) memory), never assembling the tree in DRAM. Beyond-DRAM
+        # models can therefore persist/restore; the standard
+        # natural-layout format remains for models that fit.
+        return _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir,
+                                              tag, client_state,
+                                              save_latest)
+
     # --- model states (params + host-side training state) ----------------
     state = engine.state
     model_state = {
@@ -119,6 +129,99 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
         multihost_utils.sync_global_devices("deeperspeed_ckpt_save")
     log_dist(f"Saved checkpoint {tag} to {ckpt_dir}", ranks=[0])
     return True
+
+
+def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
+                                   client_state, save_latest):
+    state = engine.state
+    seg_names = [n for n, _ in engine._stream_plan.segments]
+    engine._coord.synchronize_writes()
+    for name in seg_names:
+        shutil.copyfile(engine._coord.swapper._path(name),
+                        os.path.join(ckpt_dir, f"param_seg_{name}.swp"))
+    opt_meta = {"step": engine._host_opt.step_count,
+                "param_groups": [dict(g) for g in
+                                 engine.optimizer.param_groups]}
+    if engine._host_swapper is not None:
+        for gid, info in engine._host_swapper.group_info.items():
+            for key in info:
+                shutil.copyfile(
+                    engine._host_swapper._path(gid, key),
+                    os.path.join(ckpt_dir, f"opt_{gid}_{key}.swp"))
+        opt_meta["group_info"] = dict(engine._host_swapper.group_info)
+    else:
+        # DRAM master tier (fits by definition): keep it in the shard
+        opt_meta["host_state"] = engine._host_state
+    meta = {
+        "streamed_nvme": True,
+        "segments": seg_names,
+        "optimizer": opt_meta,
+        "global_steps": engine.global_steps,
+        "global_samples": engine.global_samples,
+        "skipped_steps": engine.skipped_steps,
+        "micro_steps": engine.micro_steps,
+        "loss_scale_state": {
+            "cur_scale": float(state.scale.cur_scale),
+            "cur_iter": int(state.scale.cur_iter),
+            "last_overflow_iter": int(state.scale.last_overflow_iter),
+            "cur_hysteresis": int(state.scale.cur_hysteresis),
+        },
+        "lr_scheduler": (engine.lr_scheduler.state_dict()
+                         if engine.lr_scheduler is not None else None),
+        "ds_version": "0.3.15+tpu",
+    }
+    meta.update(client_state)
+    save_obj(meta, os.path.join(ckpt_dir, _model_states_name(0)))
+    if save_latest:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(str(tag))
+    log_dist(f"Saved streamed-NVMe checkpoint {tag} to {ckpt_dir}",
+             ranks=[0])
+    return True
+
+
+def _load_streamed_nvme_checkpoint(engine, ckpt_dir, meta):
+    """Restore by streaming files back into the engine's NVMe store."""
+    for name in meta["segments"]:
+        shutil.copyfile(os.path.join(ckpt_dir, f"param_seg_{name}.swp"),
+                        engine._coord.swapper._path(name))
+    opt = meta["optimizer"]
+    engine._host_opt.step_count = opt.get("step", 0)
+    engine.optimizer.param_groups = [dict(g) for g in opt["param_groups"]]
+    if engine._host_swapper is not None:
+        engine._host_swapper.group_info = {
+            int(k): v for k, v in opt["group_info"].items()}
+        for gid, info in engine._host_swapper.group_info.items():
+            for key in info:
+                shutil.copyfile(
+                    os.path.join(ckpt_dir, f"opt_{gid}_{key}.swp"),
+                    engine._host_swapper._path(gid, key))
+    else:
+        engine._host_state = opt["host_state"]
+
+    engine.global_steps = meta.get("global_steps", 0)
+    engine.global_samples = meta.get("global_samples", 0)
+    engine.skipped_steps = meta.get("skipped_steps", 0)
+    engine.micro_steps = meta.get("micro_steps", 0)
+    if meta.get("lr_scheduler") is not None and \
+            engine.lr_scheduler is not None:
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    ls = meta.get("loss_scale_state", {})
+    engine.state = engine.state._replace(
+        scale=LossScaleState(
+            cur_scale=jnp.asarray(ls.get("cur_scale", 1.0), jnp.float32),
+            cur_iter=jnp.asarray(ls.get("cur_iter", 0), jnp.int32),
+            last_overflow_iter=jnp.asarray(
+                ls.get("last_overflow_iter", -1), jnp.int32),
+            cur_hysteresis=jnp.asarray(ls.get("cur_hysteresis", 1),
+                                       jnp.int32)),
+        global_steps=jnp.asarray(engine.global_steps, jnp.int32),
+        skipped_steps=jnp.asarray(engine.skipped_steps, jnp.int32))
+    client_state = {k: v for k, v in meta.items()
+                    if k not in ("streamed_nvme", "segments", "optimizer",
+                                 "loss_scale_state", "lr_scheduler")}
+    log_dist(f"Loaded streamed-NVMe checkpoint from {ckpt_dir}", ranks=[0])
+    return ckpt_dir, client_state
 
 
 def _flat_arrays(tree):
@@ -281,6 +384,15 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
         return None, {}
 
     model_state = load_obj(model_path)
+
+    if model_state.get("streamed_nvme"):
+        if getattr(engine, "_grad_spill", None) is None:
+            raise RuntimeError(
+                "this checkpoint was saved by the NVMe store-of-record "
+                "tier (streamed file copies); load it with an "
+                "offload_param {device: nvme} engine")
+        return _load_streamed_nvme_checkpoint(engine, ckpt_dir,
+                                              model_state)
 
     # --- params -----------------------------------------------------------
     params_np = state_dict_to_tree(model_state["module"],
