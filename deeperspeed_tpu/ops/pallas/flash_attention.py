@@ -104,14 +104,23 @@ def _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k):
 CAUSAL_STRIPS = 8  # column strips for dead-sub-block exp skipping
 
 
-def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
-                       causal):
+def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
     """One (q, k) block covers the whole sequence: straight (non-online)
     softmax — no running max/denominator scratch, no alpha rescale, no
     accumulator round-trips. For causal tiles the columns are processed
     in strips so exp/sum only touch rows at or below each strip (the
     upper ~(1 - (n+1)/2n) of the triangle never reaches the VPU —
-    37.5% of the softmax work at 4 strips)."""
+    37.5% of the softmax work at 4 strips).
+
+    With ``use_bias`` an additive per-key row [1, S] is fused into the
+    scores pre-max — the TPU equivalent of the reference's mask-taking
+    fused softmax (`csrc/transformer/softmax_kernels.cu` attn_softmax
+    taking attn_mask): key-padding masks never materialize [S, S]."""
+    if use_bias:
+        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        b_ref = None
     q = q_ref[0]                                              # [S, D]
     k = k_ref[0]
     v = v_ref[0]
@@ -120,6 +129,8 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale        # [Sq, Sk]
+    if b_ref is not None:
+        s = s + b_ref[0]                                      # [1, Sk] bcast
     # NOTE: per-strip matmuls (skipping dead sub-blocks' MXU work) were
     # measured SLOWER than one dense matmul — ragged [S-lo, w] shapes
     # cost the MXU more than the skipped flops save. Strips only gate
@@ -151,6 +162,11 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
         for c in range(CAUSAL_STRIPS):
             lo = c * w
             pc = jnp.exp(strips[c] - m[lo:])
+            if use_bias:
+                # a fully-masked row has m == NEG_INF and exp(s - m) == 1
+                # uniformly; zero masked entries so l == 0 flags the dead
+                # row (poisoned-lse convention)
+                pc = jnp.where(strips[c] <= NEG_INF * 0.5, 0.0, pc)
             lc = jnp.sum(pc, axis=1, keepdims=True)
             if lo:
                 lc = jnp.concatenate(
@@ -165,6 +181,8 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
             s = _causal_mask(s, 0, 0, s_q, s_k)
         m = jnp.max(s, axis=1, keepdims=True)
         p = jnp.exp(s - m)
+        if use_bias:
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         l = jnp.sum(p, axis=1, keepdims=True)
     o = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -176,14 +194,22 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
     lse_ref[0] = lse.reshape(1, -1)
 
 
-def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret):
+def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
+                h=None):
     bh = qb.shape[0]
     kernel = functools.partial(_fwd_single_kernel, sm_scale=sm_scale,
-                               causal=causal)
+                               causal=causal, use_bias=kbias is not None)
+    in_specs = [pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0))] * 3
+    inputs = [qb, kb, vb]
+    if kbias is not None:
+        # kbias is [B, 1, S]; the grid runs over B*H — index by batch
+        in_specs.append(pl.BlockSpec((1, 1, s),
+                                     lambda i, h=h: (i // h, 0, 0)))
+        inputs.append(kbias)
     return pl.pallas_call(
         kernel,
         grid=(bh,),
-        in_specs=[pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0))] * 3,
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
             pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
@@ -195,20 +221,21 @@ def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
-    )(qb, kb, vb)
+    )(*inputs)
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False):
-    if use_mask:
-        (q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref,
-         m_scr, l_scr, acc_scr) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-        m_ref = None
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False,
+                use_bias=False):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    m_ref = next(it) if use_mask else None
+    b_ref = next(it) if use_bias else None
+    o_ref, lse_ref = next(it), next(it)
+    m_scr, l_scr, acc_scr = next(it), next(it), next(it)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -238,6 +265,8 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False):
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if m_ref is not None:
             s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
+        if b_ref is not None:
+            s = s + b_ref[0]                                  # [1, BK] bcast
 
         m_prev = m_scr[:, :1]                                 # [BQ, 1]
         l_prev = l_scr[:, :1]
@@ -245,8 +274,8 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False):
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                       # [BQ, 1]
         p = jnp.exp(s - m_new)                                # [BQ, BK]
-        if m_ref is not None:
-            # rows with EVERY entry layout-masked would otherwise see
+        if m_ref is not None or b_ref is not None:
+            # rows with EVERY entry masked would otherwise see
             # exp(s - max) == 1 uniformly; zero masked entries so l==0
             # flags the dead row (poisoned-lse convention)
             p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
@@ -284,7 +313,7 @@ def _mask_spec(h, n_fine_q, n_fine_k):
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
-         layout=None):
+         layout=None, kbias=None):
     b, s, h, d = q.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
 
@@ -299,7 +328,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         # whole sequence in one block: the online-softmax machinery is
         # pure overhead — run the specialized straight-softmax kernel
         out, lse = _fwd_single(qb, kb, vb, causal, sm_scale, s, d,
-                               _interpret())
+                               _interpret(), kbias=kbias, h=h)
         out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
         return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
 
@@ -308,7 +337,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k,
-                               use_mask=layout is not None)
+                               use_mask=layout is not None,
+                               use_bias=kbias is not None)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
@@ -318,6 +348,10 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     if layout is not None:
         in_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
         inputs.append(layout)
+    if kbias is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
+        inputs.append(kbias)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -347,13 +381,21 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
 # backward — single-block specialization (fused dq/dk/dv)
 # ---------------------------------------------------------------------------
 
-def _bwd_single_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dq_ref, dk_ref, dv_ref, *, sm_scale, causal):
+def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
     """Whole-sequence tile: ONE pass computes dq, dk AND dv — the split
     dkv/dq kernels each recompute s and p, so fusing saves a full QKᵀ
     matmul, a dO·Vᵀ matmul, and an exp pass per layer. Causal tiles
     process column strips: dead sub-blocks skip exp/multiply AND their
-    share of the dv/dk/dq matmul flops."""
+    share of the dv/dk/dq matmul flops. With ``use_bias`` the additive
+    per-key row is re-applied pre-exp (p = exp(s + bias - lse) is then
+    exactly the forward's probabilities; masked entries exp to 0)."""
+    if use_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+        b_ref = None
     q = q_ref[0]                                              # [S, D]
     k = k_ref[0]
     v = v_ref[0]
@@ -364,6 +406,8 @@ def _bwd_single_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale        # [Sq, Sk]
+    if b_ref is not None:
+        s = s + b_ref[0]                                      # [1, Sk] bcast
     dp_full = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                   # [Sq, Sk]
@@ -421,21 +465,27 @@ def _bwd_single_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
-                interpret):
+                interpret, kbias=None, h=None):
     bh = qb.shape[0]
     kernel = functools.partial(_bwd_single_kernel, sm_scale=sm_scale,
-                               causal=causal)
+                               causal=causal, use_bias=kbias is not None)
+    in_specs = [
+        pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
+    ]
+    inputs = [qb, kb, vb, do, lse, delta]
+    if kbias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, s),
+                                     lambda i, h=h: (i // h, 0, 0)))
+        inputs.append(kbias)
     return pl.pallas_call(
         kernel,
         grid=(bh,),
-        in_specs=[
-            pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0))] * 3,
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
@@ -445,7 +495,7 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
-    )(qb, kb, vb, do, lse, delta)
+    )(*inputs)
 
 
 # ---------------------------------------------------------------------------
@@ -453,14 +503,13 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
-                    use_mask=False):
-    if use_mask:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-        m_ref = None
+                    use_mask=False, use_bias=False):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    m_ref = next(it) if use_mask else None
+    b_ref = next(it) if use_bias else None
+    dk_ref, dv_ref, dk_scr, dv_scr = next(it), next(it), next(it), next(it)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -485,6 +534,8 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if m_ref is not None:
             s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
+        if b_ref is not None:
+            s = s + b_ref[0]                                 # [1, BK] bcast
         p = jnp.exp(s - lse_ref[0].reshape(-1, 1))           # [BQ, BK] f32
         do = do_ref[0]                                       # [BQ, D]
         # dV += Pᵀ dO  (P quantized to the wire dtype for MXU rate,
@@ -509,14 +560,13 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
-                   use_mask=False):
-    if use_mask:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, m_ref, dq_ref,
-         dq_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-         dq_scr) = refs
-        m_ref = None
+                   use_mask=False, use_bias=False):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    m_ref = next(it) if use_mask else None
+    b_ref = next(it) if use_bias else None
+    dq_ref, dq_scr = next(it), next(it)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -540,6 +590,8 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
             s = _causal_mask(s, qi, ki, block_q, block_k)
         if m_ref is not None:
             s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
+        if b_ref is not None:
+            s = s + b_ref[0]
         p = jnp.exp(s - lse_ref[0].reshape(-1, 1))
         do = do_ref[0]
         dp = jax.lax.dot_general(
@@ -555,7 +607,8 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None):
+def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
+         kbias=None):
     qb, kb, vb, out, lse = res
     bh, s, d = qb.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
@@ -573,10 +626,12 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None):
 
     n_q, n_k = s // block_q, s // block_k
     use_mask = layout is not None
+    use_bias = kbias is not None
 
     if n_q == 1 and n_k == 1 and not use_mask:
         dq, dk, dv = _bwd_single(qb, kb, vb, do, lse, delta, causal,
-                                 sm_scale, s, d, _interpret())
+                                 sm_scale, s, d, _interpret(),
+                                 kbias=kbias, h=h)
 
         def from_bh1(x):
             return x.reshape(bdim, h, s, d).transpose(0, 2, 1, 3)
@@ -585,7 +640,8 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None):
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
-                                   block_k=block_k, use_mask=use_mask)
+                                   block_k=block_k, use_mask=use_mask,
+                                   use_bias=use_bias)
     dkv_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
@@ -598,6 +654,10 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None):
     if use_mask:
         dkv_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
         dkv_inputs.append(layout)
+    if use_bias:
+        dkv_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda bh, ki, qi, h=h: (bh // h, 0, ki)))
+        dkv_inputs.append(kbias)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, n_k, n_q),
@@ -620,7 +680,8 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None):
 
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
-                                  block_k=block_k, use_mask=use_mask)
+                                  block_k=block_k, use_mask=use_mask,
+                                  use_bias=use_bias)
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
@@ -633,6 +694,10 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None):
     if use_mask:
         dq_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
         dq_inputs.append(layout)
+    if use_bias:
+        dq_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
+        dq_inputs.append(kbias)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, n_q, n_k),
@@ -671,6 +736,53 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_kbias(q, k, v, kbias, causal=False, sm_scale=None,
+                          block_q=BLOCK_Q, block_k=BLOCK_K):
+    """Flash attention with an additive PER-KEY bias fused into the
+    softmax — the TPU-native form of the reference's mask-taking fused
+    softmax kernel (`csrc/transformer/softmax_kernels.cu:18-140`,
+    ``attn_softmax(vals, attn_mask, ...)``): key-padding / attention
+    masks ride the tiled online softmax instead of materializing a
+    [B, H, S, S] score tensor.
+
+    kbias: [B, S] float32, added to every query row's scores for that
+    batch (0 = keep; ~-1e30 = masked; finite values act as biases).
+    Rows whose keys are ALL masked produce zero output and zero grads
+    (poisoned-lse convention shared with the layout-mask kernels).
+
+    NOT differentiable w.r.t. kbias: its cotangent is hardwired to zero
+    (it is an input mask/bias, not a parameter — the reference's
+    attn_mask operand has the same contract). Do NOT route a TRAINABLE
+    bias (ALiBi/relative-position tables) through kbias: jax.grad would
+    silently return zeros for it. Wrap such biases into the scores
+    outside the kernel, or extend the bwd kernels with the
+    d(kbias) = Σ_h,q p·(dp − δ) reduction first.
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    kb3 = kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, kbias=kb3)
+    return out
+
+
+def _flash_kbias_fwd(q, k, v, kbias, causal, sm_scale, block_q, block_k):
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    kb3 = kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)
+    out, res = _fwd(q, k, v, causal, scale, block_q, block_k, kbias=kb3)
+    return out, (res, kbias)
+
+
+def _flash_kbias_bwd(causal, sm_scale, block_q, block_k, res_kb, g):
+    res, kbias = res_kb
+    kb3 = kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)
+    dq, dk, dv = _bwd(causal, sm_scale, block_q, block_k, res, g,
+                      kbias=kb3)
+    return dq, dk, dv, jnp.zeros_like(kbias)
+
+
+flash_attention_kbias.defvjp(_flash_kbias_fwd, _flash_kbias_bwd)
 
 
 def make_masked_flash_attention(layout128, causal=False, sm_scale=None,

@@ -24,7 +24,9 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..pallas.flash_attention import flash_attention, flash_attention_supported
+from ..pallas.flash_attention import (flash_attention,
+                                      flash_attention_kbias,
+                                      flash_attention_supported)
 
 
 class TransformerConfig:
@@ -185,18 +187,42 @@ class DeepSpeedTransformerLayer:
         q, k, v = (t.reshape(b, s, heads, hd)
                    for t in jnp.split(qkv, 3, axis=-1))
 
+        # Per-key masks ([B, S] keep-masks and [B, 1, 1, S] additive — every
+        # BERT/SQuAD batch) reduce to a [B, S] additive row that the flash
+        # kernel fuses pre-max (reference: attn_softmax taking attn_mask,
+        # csrc/transformer/softmax_kernels.cu:18-140). Only full [B, H, S, S]
+        # biases fall back to the materialized path.
         additive_mask = None
+        kbias = None
         if attention_mask is not None:
             am = jnp.asarray(attention_mask)
-            if am.ndim == 2:  # [B, S] keep-mask
-                additive_mask = jnp.where(am[:, None, None, :] > 0, 0.0,
-                                          -1e30)
+            if am.ndim == 2:  # [B or 1, S] keep-mask
+                kb = jnp.where(am > 0, 0.0, -1e30).astype(jnp.float32)
+                kbias = jnp.broadcast_to(kb, (b, s))
+                additive_mask = kbias[:, None, None, :]
+            elif am.ndim == 4 and am.shape[1] == 1 and am.shape[2] == 1:
+                # [B or 1, 1, 1, S] additive (HF convention); batch-
+                # shared masks broadcast up to the kernel's [B, S] form
+                kbias = jnp.broadcast_to(
+                    am.reshape(am.shape[0], s).astype(jnp.float32),
+                    (b, s))
+                additive_mask = kbias[:, None, None, :]
             else:
                 additive_mask = am.astype(jnp.float32)
 
-        if additive_mask is None and \
+        # The fused path covers per-key masks; attention-prob dropout,
+        # when ACTIVE, still needs the materialized probabilities, so
+        # training with attn_dropout > 0 falls back (the reference fuses
+        # dropout into its kernel — candidate for a pltpu.prng kernel).
+        attn_drop_active = (not deterministic and
+                            cfg.attn_dropout_ratio > 0 and rng is not None)
+        if (additive_mask is None or kbias is not None) and \
+                not attn_drop_active and \
                 flash_attention_supported((b, s, heads, hd)):
-            ctx = flash_attention(q, k, v, False)
+            if kbias is None:
+                ctx = flash_attention(q, k, v, False)
+            else:
+                ctx = flash_attention_kbias(q, k, v, kbias, False)
         else:
             scale = 1.0 / math.sqrt(hd)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,

@@ -11,10 +11,10 @@ import jax
 import jax.numpy as jnp
 
 from deeperspeed_tpu.ops.pallas.flash_attention import (
-    flash_attention, flash_attention_supported)
+    flash_attention, flash_attention_kbias, flash_attention_supported)
 
 
-def reference_attention(q, k, v, causal=True):
+def reference_attention(q, k, v, causal=True, kbias=None):
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
@@ -22,6 +22,8 @@ def reference_attention(q, k, v, causal=True):
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))
         logits = jnp.where(mask[None, None], logits, -1e30)
+    if kbias is not None:
+        logits = logits + kbias[:, None, None, :]
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs,
                       v.astype(jnp.float32)).astype(q.dtype)
@@ -69,6 +71,98 @@ def test_bf16_forward():
     q, k, v = make_qkv(dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, True)
     ref = reference_attention(q, k, v, True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# additive key-bias (fused attention-mask) — reference parity target is
+# the mask-taking fused softmax (csrc/transformer/softmax_kernels.cu)
+# ---------------------------------------------------------------------------
+
+def make_key_padding_bias(b, s, valid_lens):
+    """[B, S] additive bias: 0 for keys < valid_len, -1e30 beyond."""
+    cols = np.arange(s)[None, :]
+    keep = cols < np.asarray(valid_lens)[:, None]
+    return jnp.asarray(np.where(keep, 0.0, -1e30), jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("blocks", [(1024, 1024), (128, 128)])
+def test_kbias_forward_parity(causal, blocks):
+    # blocks (1024,1024) → single-block path at s=256; (128,128) → tiled
+    b, s = 3, 256
+    q, k, v = make_qkv(b=b, s=s)
+    kbias = make_key_padding_bias(b, s, [256, 192, 64])
+    bq, bk = blocks
+    out = flash_attention_kbias(q, k, v, kbias, causal, None, bq, bk)
+    ref = reference_attention(q, k, v, causal, kbias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kbias_finite_bias_forward():
+    # finite per-key biases (not just -inf masks) must flow through too
+    b, s = 2, 256
+    q, k, v = make_qkv(b=b, s=s)
+    kbias = jax.random.normal(jax.random.PRNGKey(7), (b, s), jnp.float32)
+    out = flash_attention_kbias(q, k, v, kbias, False)
+    ref = reference_attention(q, k, v, False, kbias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("blocks", [(1024, 1024), (128, 128)])
+def test_kbias_backward_parity(blocks):
+    b, s = 2, 256
+    q, k, v = make_qkv(b=b, s=s)
+    kbias = make_key_padding_bias(b, s, [256, 128])
+    bq, bk = blocks
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention_kbias(q, k, v, kbias, False, None, bq, bk) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, False, kbias) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_kbias_fully_masked_batch_zeros():
+    # a batch whose keys are ALL masked: zero output + zero grads (the
+    # poisoned-lse convention), where a naive softmax would emit mean(v)
+    b, s = 2, 256
+    q, k, v = make_qkv(b=b, s=s)
+    kbias = make_key_padding_bias(b, s, [256, 0])
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention_kbias(q, k, v, kbias, False) ** 2)
+
+    out = flash_attention_kbias(q, k, v, kbias, False)
+    assert np.all(np.asarray(out[1]) == 0.0)
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert np.all(np.asarray(dq[1]) == 0.0)
+    assert np.all(np.asarray(dk[1]) == 0.0)
+    assert np.all(np.asarray(dv[1]) == 0.0)
+    # the live batch is unaffected
+    ref = reference_attention(q[:1], k[:1], v[:1], False, kbias[:1])
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kbias_bf16():
+    b, s = 2, 256
+    q, k, v = make_qkv(b=b, s=s, dtype=jnp.bfloat16)
+    kbias = make_key_padding_bias(b, s, [200, 96])
+    out = flash_attention_kbias(q, k, v, kbias, False)
+    ref = reference_attention(q, k, v, False, kbias)
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         atol=2e-2, rtol=2e-2)
