@@ -848,6 +848,14 @@ def to_layer_specs(cfg, use_pallas=True):
     """LayerSpec list for PipelineModule (reference: GPT-NeoX's pipelined
     model description)."""
     from ..runtime.pipe import LayerSpec, TiedLayerSpec
+    if getattr(cfg, "moe_num_experts", 0):
+        # block_forward returns (x, aux_loss) under MoE; the pipeline
+        # stage functions carry a single hidden buffer between stages
+        # and would silently drop (or trace-fail on) the aux loss
+        raise NotImplementedError(
+            "MoE layers cannot be pipelined yet: the expert aux loss is "
+            "not threaded through the inter-stage buffers. Use MoE with "
+            "data/tensor/expert parallelism, or pipeline a dense model")
     specs = []
     if cfg.tie_word_embeddings:
         specs.append(TiedLayerSpec("embed", EmbeddingPipe, cfg,

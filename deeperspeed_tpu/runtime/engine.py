@@ -237,6 +237,14 @@ class DeepSpeedEngine:
         # SP attention from the JSON alone (VERDICT: user config, no
         # library imports, trains both axes)
         if self._config.moe_enabled or self._config.sequence_parallel_enabled:
+            from .pipe.module import PipelineModule
+            if self._config.moe_enabled and \
+                    isinstance(model, PipelineModule):
+                raise DeepSpeedConfigError(
+                    "moe + pipeline parallelism is unsupported: the "
+                    "expert aux loss is not threaded through the "
+                    "inter-stage buffers (use data/tensor/expert "
+                    "parallelism for MoE models)")
             if not hasattr(model, "apply_ds_config"):
                 raise DeepSpeedConfigError(
                     "config enables moe/sequence_parallel but the model "
@@ -898,12 +906,27 @@ class DeepSpeedEngine:
         if pld_theta is not None and self._pld_in_loss:
             kw["pld_theta"] = pld_theta
 
-        def scaled_loss(p):
-            loss = self.loss_fn(self._compute_view(p), batch, rng, **kw)
-            return loss * scale.astype(loss.dtype), loss
+        direct = getattr(self.loss_fn, "loss_and_grads", None)
+        # gated on flat-padded params: the slow path's VJP through
+        # _compute_view re-packs grads into the padded flat master
+        # layout; the direct path returns natural-shaped grads that
+        # would mismatch _grad_sh / the masters under padding
+        if direct is not None and not kw and \
+                not getattr(self, "_any_param_pad", False):
+            # pipeline-SPMD path: fp32 grads straight from the 1F1B
+            # accumulators (a custom_vjp cotangent would round them to
+            # the param dtype — ADVICE r3: the fp32 accumulation the
+            # tick loop paid for must reach the master update)
+            loss, grads = direct(self._compute_view(params), batch, rng,
+                                 scale=scale)
+        else:
+            def scaled_loss(p):
+                loss = self.loss_fn(self._compute_view(p), batch, rng,
+                                    **kw)
+                return loss * scale.astype(loss.dtype), loss
 
-        (scaled, loss), grads = jax.value_and_grad(
-            scaled_loss, has_aux=True)(params)
+            (_, loss), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(params)
         if self.zero_rules.stage >= 2:
             grads = jax.tree_util.tree_map(
                 jax.lax.with_sharding_constraint, grads, self._grad_sh)
@@ -1463,6 +1486,12 @@ class DeepSpeedEngine:
 
         # pass B: step + emit, one segment at a time
         for name in seg_names:
+            if not spill.leaf_slices.get(name):
+                # no grads spilled for this segment (frozen subtree /
+                # partial step): leave its params-of-record untouched —
+                # writing the np.empty staging buffer would overwrite the
+                # NVMe store with heap garbage
+                continue
             seg_g = spill.read(name)
             staging = np.empty(self._coord.segment_nbytes(name), np.uint8)
             plan_rows = []  # (lid, grad slice or None, dst u8 view)
@@ -1509,6 +1538,8 @@ class DeepSpeedEngine:
             # sync per segment: queueing all staging buffers async would
             # hold every segment's bytes at once — a model-sized DRAM
             # spike (measured; this loop must stay segment-bounded)
+            assert off == staging.size, \
+                f"segment {name}: staged {off} of {staging.size} bytes"
             self._coord.write_segment(name, flat_u8=staging,
                                       async_op=False)
         return self._host_step_epilogue(True, grad_norm, scale,

@@ -96,6 +96,15 @@ class OnebitLamb(FusedLamb):
 
         packed = (self.packed_transport and self.dp_world > 1
                   and axis_name is not None)
+        if self.packed_transport and self.dp_world > 1 and \
+                axis_name is None and compress:
+            # see onebit/adam.py: packed state is [world, wire_pad]
+            raise ValueError(
+                "packed_transport error buffers are per-rank "
+                "[world, wire_pad] arrays: update() must run inside "
+                "shard_map over the data axis with axis_name set "
+                "(the engine's packed 1-bit step does this); dense "
+                "updates on this state are not meaningful")
         # compress=False: the engine's warmup program — compression
         # results would be discarded by the in_warmup select, but XLA
         # cannot DCE collectives, so skip the wire statically

@@ -379,8 +379,11 @@ class GatheredParameters:
     def _selected(self, path):
         if self._select is None:
             return True
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path)
+        # DictKey → .key, SequenceKey → .idx, GetAttrKey → .name
+        key = "/".join(
+            str(getattr(p, "key",
+                        getattr(p, "idx", getattr(p, "name", p))))
+            for p in path)
         return self._select(key)
 
     def __enter__(self):

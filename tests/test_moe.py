@@ -242,3 +242,44 @@ def test_sequence_parallel_config_drivable(devices):
     base = run(False)
     got = run(True)
     np.testing.assert_allclose(got, base, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_pipeline_guarded(devices):
+    """MoE × pipeline is rejected loudly at every entry (round-4 VERDICT
+    #4): no valid config may silently drop the expert aux loss."""
+    from deeperspeed_tpu.models.gpt_neox import (GPTNeoXConfig,
+                                                 to_layer_specs)
+    from deeperspeed_tpu.parallel.pipeline_spmd import GPTNeoXPipeSPMD
+
+    moe_cfg = GPTNeoXConfig.tiny(moe_num_experts=4)
+    with pytest.raises(NotImplementedError, match="aux loss"):
+        to_layer_specs(moe_cfg)
+
+    mesh = Mesh(np.asarray(devices[:4]).reshape(4), ("pipe",))
+    with pytest.raises(NotImplementedError, match="aux loss"):
+        GPTNeoXPipeSPMD(moe_cfg, mesh, n_micro=2)
+
+
+def test_moe_pipeline_json_config_guarded(devices):
+    """A JSON config with both `moe` and a PipelineModule model raises a
+    DeepSpeedConfigError before any training is possible."""
+    from deeperspeed_tpu import LayerSpec, PipelineModule
+    from deeperspeed_tpu.runtime.config import DeepSpeedConfigError
+
+    class Tiny:
+        def init(self, rng, x=None):
+            return {"w": jnp.ones((4, 4))}
+
+        def apply(self, params, x, rng=None):
+            return x @ params["w"]
+
+    module = PipelineModule([LayerSpec(Tiny)], num_stages=1,
+                            loss_fn=lambda y, t: jnp.mean((y - t) ** 2))
+    with pytest.raises(DeepSpeedConfigError, match="moe"):
+        deeperspeed_tpu.initialize(
+            model=module, model_parameters=None,
+            config_params={
+                "train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "moe": {"num_experts": 4},
+            }, rng=jax.random.PRNGKey(0))
