@@ -133,6 +133,13 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
 
 def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
                                    client_state, save_latest):
+    if jax.process_count() > 1:
+        # every process would race the same segment/opt file copies and
+        # the `latest` write; the NVMe store of record is per-process
+        # local state with no shard-merge story yet
+        raise NotImplementedError(
+            "streamed-NVMe checkpointing is single-process; "
+            "multi-process save on this tier is not supported")
     state = engine.state
     seg_names = [n for n, _ in engine._stream_plan.segments]
     engine._coord.synchronize_writes()
@@ -152,9 +159,32 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
     else:
         # DRAM master tier (fits by definition): keep it in the shard
         opt_meta["host_state"] = engine._host_state
+    # Param manifest: gid-ordered full-tree paths/shapes/dtypes plus the
+    # per-segment byte layout — everything an OFFLINE consumer
+    # (utils/zero_to_fp32.py) needs to map the raw .swp files back to
+    # named parameters (reference ships zero_to_fp32 inside every
+    # checkpoint for the same any-checkpoint-is-recoverable guarantee,
+    # `engine.py:1800-1808`).
+    from .serialization import _path_key
+    flat, _ = jax.tree_util.tree_flatten_with_path(state.params)
+    leaf_paths = [_path_key(p) for p, _ in flat]
+    leaf_shapes = [tuple(l.shape) for _, l in flat]
+    leaf_dtypes = [str(np.dtype(l.dtype)) for _, l in flat]
+    segment_layout = {}
+    for name in seg_names:
+        _, specs = engine._coord._templates[name]
+        segment_layout[name] = [
+            [int(gid), [int(x) for x in shape], str(np.dtype(dt))]
+            for gid, (shape, dt) in zip(engine._seg_idx[name], specs)]
     meta = {
         "streamed_nvme": True,
         "segments": seg_names,
+        "param_manifest": {
+            "leaf_paths": leaf_paths,
+            "leaf_shapes": [list(s) for s in leaf_shapes],
+            "leaf_dtypes": leaf_dtypes,
+            "segment_layout": segment_layout,
+        },
         "optimizer": opt_meta,
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,

@@ -26,9 +26,20 @@ Output is a ``{param_path: np.float32 ndarray}`` dict saved with torch
 (falls back to pickle), loadable anywhere.
 """
 
+import os
+import sys
+
+if __package__ in (None, ""):
+    # Run as a standalone script: python puts THIS directory first on
+    # sys.path, where sibling modules (logging.py, timer.py) shadow the
+    # stdlib and break third-party imports (torch's `import logging`
+    # resolves to ours). The script is self-contained — drop the dir.
+    _here = os.path.dirname(os.path.abspath(__file__))
+    sys.path[:] = [p for p in sys.path
+                   if os.path.abspath(p or os.getcwd()) != _here]
+
 import argparse
 import glob
-import os
 import pickle
 import re
 
@@ -96,13 +107,102 @@ def _merge_sliced(per_rank, dims, saved_dp, flat_shapes=None):
     return merged
 
 
+def _decode_raw(buf_u8, dtype_str):
+    """Raw little-endian bytes → fp32, framework-free: bfloat16 (the
+    usual compute dtype) is decoded by bit-shifting into fp32 — no
+    ml_dtypes/jax needed, keeping the script's runs-anywhere contract."""
+    if dtype_str == "bfloat16":
+        u16 = np.frombuffer(buf_u8, np.uint16)
+        return (u16.astype(np.uint32) << 16).view(np.float32)
+    return np.frombuffer(buf_u8, np.dtype(dtype_str)).astype(np.float32)
+
+
+def _streamed_nvme_state_dict(checkpoint_dir, meta):
+    """Consolidate a streamed-NVMe checkpoint (written by
+    `_save_streamed_nvme_checkpoint`: raw `param_seg_*.swp` /
+    `opt_{gid}_*.swp` files + a param manifest in the model-states meta)
+    into {path: fp32 ndarray} with O(one leaf / one segment) memory —
+    the export path for beyond-DRAM models.
+    """
+    man = meta.get("param_manifest")
+    if man is None:
+        raise RuntimeError(
+            "streamed-NVMe checkpoint has no param_manifest (saved by a "
+            "pre-round-4 framework version); re-save the checkpoint to "
+            "make it offline-convertible")
+    paths = man["leaf_paths"]
+    shapes = [tuple(s) for s in man["leaf_shapes"]]
+    out = {}
+
+    # 1) exact fp32 masters, DRAM tier: stored inline in the meta
+    host_state = (meta.get("optimizer") or {}).get("host_state")
+    if host_state is not None:
+        for gid, (path, shape) in enumerate(zip(paths, shapes)):
+            out[path] = np.asarray(
+                host_state["master"][gid], np.float32).reshape(shape)
+        return out
+
+    # 2) exact fp32 masters, NVMe tier: one raw flat file per leaf.
+    # PARTIAL master sets mean a truncated/corrupted checkpoint — error
+    # with the missing file rather than silently downgrading precision.
+    have = [os.path.isfile(
+        os.path.join(checkpoint_dir, f"opt_{gid}_master.swp"))
+        for gid in range(len(paths))]
+    if all(have):
+        for gid, (path, shape) in enumerate(zip(paths, shapes)):
+            f = os.path.join(checkpoint_dir, f"opt_{gid}_master.swp")
+            out[path] = np.fromfile(f, np.float32).reshape(shape)
+        return out
+    if any(have):
+        missing = [f"opt_{g}_master.swp" for g, h in enumerate(have)
+                   if not h]
+        raise RuntimeError(
+            f"incomplete streamed checkpoint: {len(missing)} fp32 master "
+            f"file(s) missing (e.g. {missing[:3]}); refusing to silently "
+            f"downgrade to the lossy compute-dtype param upcast. If the "
+            f"masters are truly gone, delete ALL opt_*_master.swp files "
+            f"to opt in to the param-segment fallback")
+
+    # 3) fallback: upcast the compute-dtype param segments themselves
+    for seg, rows in man["segment_layout"].items():
+        f = os.path.join(checkpoint_dir, f"param_seg_{seg}.swp")
+        with open(f, "rb") as fh:
+            raw = fh.read()
+        off = 0
+        for gid, shape, dtype_str in rows:
+            itemsize = 2 if dtype_str == "bfloat16" else \
+                np.dtype(dtype_str).itemsize
+            n = int(np.prod(shape)) if shape else 1
+            nbytes = n * itemsize
+            if paths[gid] not in out:  # tied leaves: first segment wins
+                out[paths[gid]] = _decode_raw(
+                    raw[off:off + nbytes], dtype_str).reshape(shape)
+            off += nbytes
+    missing = [p for p in paths if p not in out]
+    if missing:
+        raise RuntimeError(
+            f"streamed checkpoint covers {len(out)}/{len(paths)} "
+            f"parameters; missing e.g. {missing[:3]}")
+    return out
+
+
 def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, mp_rank=0):
     """Return {param_path: fp32 ndarray} for the checkpoint.
 
     Prefers the fp32 masters from the zero shards (exact optimizer view);
     falls back to upcasting the bf16/fp16 module weights when the
     checkpoint carries no masters (fp32 training without ZeRO).
+    Streamed-NVMe checkpoints (ZeRO-Infinity beyond-DRAM tier) are
+    consolidated from their raw segment/master files via the manifest.
     """
+    # streamed-NVMe checkpoints are recognizable by their raw segment
+    # files — only then is the (potentially huge) model-states file
+    # loaded early to read the manifest
+    if glob.glob(os.path.join(checkpoint_dir, "param_seg_*.swp")):
+        meta = _load(get_model_state_file(checkpoint_dir, mp_rank))
+        if isinstance(meta, dict) and meta.get("streamed_nvme"):
+            return _streamed_nvme_state_dict(checkpoint_dir, meta)
+
     zero_files = get_zero_files(checkpoint_dir, mp_rank)
     if zero_files:
         shards = [_load(f) for f in zero_files]

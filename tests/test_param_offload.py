@@ -215,3 +215,94 @@ def test_param_offload_nvme_checkpoint_roundtrip(tmp_path, devices):
     engine2.load_checkpoint(str(tmp_path / "ckpt"))
     got = _train(engine2, steps=2, seed=7)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# offline export (round-4 VERDICT #5): streamed-NVMe ckpt → fp32 state dict
+# ---------------------------------------------------------------------------
+
+def _export_keys_match(sd, engine):
+    from deeperspeed_tpu.checkpoint.serialization import _path_key
+    nat = engine.params_to_natural(engine.state.params)
+    flat, _ = jax.tree_util.tree_flatten_with_path(nat)
+    assert set(sd) == {_path_key(p) for p, _ in flat}
+    return flat
+
+
+def test_streamed_ckpt_zero_to_fp32_dram_masters(tmp_path, devices):
+    """NVMe param store + DRAM optimizer tier: the export reads the
+    exact fp32 masters out of the checkpoint meta."""
+    from deeperspeed_tpu.checkpoint.serialization import _path_key
+    from deeperspeed_tpu.utils.zero_to_fp32 import (
+        get_fp32_state_dict_from_zero_checkpoint)
+    engine = _engine(NVME(tmp_path / "swap"))
+    _train(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path / "ckpt"), tag="t")
+    sd = get_fp32_state_dict_from_zero_checkpoint(
+        str(tmp_path / "ckpt" / "t"))
+    flat = _export_keys_match(sd, engine)
+    # exact fp32 masters, not upcast bf16 params
+    masters = engine._host_state["master"]
+    for gid, (path, leaf) in enumerate(flat):
+        np.testing.assert_array_equal(
+            sd[_path_key(path)].ravel(), masters[gid],
+            err_msg=_path_key(path))
+
+
+def test_streamed_ckpt_zero_to_fp32_nvme_masters_and_fallback(
+        tmp_path, devices):
+    """NVMe param + NVMe optimizer tier: export reads the raw master
+    files; with the master files gone it falls back to upcasting the
+    param segments (close to masters within the compute dtype)."""
+    import os as _os
+    from deeperspeed_tpu.checkpoint.serialization import _path_key
+    from deeperspeed_tpu.utils.zero_to_fp32 import (
+        get_fp32_state_dict_from_zero_checkpoint)
+    cfg = {"zero_optimization": {
+        "stage": 3,
+        "offload_optimizer": {"device": "nvme",
+                              "nvme_path": str(tmp_path / "opt")},
+        "offload_param": {"device": "nvme",
+                          "nvme_path": str(tmp_path / "swap")}}}
+    engine = _engine(cfg)
+    _train(engine, steps=2)
+    ckpt = tmp_path / "ckpt"
+    engine.save_checkpoint(str(ckpt), tag="t")
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(ckpt / "t"))
+    flat = _export_keys_match(sd, engine)
+    for gid, (path, leaf) in enumerate(flat):
+        g = engine._host_swapper.load_group(gid)
+        np.testing.assert_array_equal(
+            sd[_path_key(path)].ravel(), g["master"],
+            err_msg=_path_key(path))
+
+    # drop the master files → segment-upcast fallback
+    for f in glob.glob(str(ckpt / "t" / "opt_*_master.swp")):
+        _os.remove(f)
+    sd2 = get_fp32_state_dict_from_zero_checkpoint(str(ckpt / "t"))
+    _export_keys_match(sd2, engine)
+    for path, leaf in flat:
+        key = _path_key(path)
+        np.testing.assert_allclose(sd2[key], sd[key], rtol=1e-2,
+                                   atol=1e-2, err_msg=key)
+
+
+def test_streamed_ckpt_partial_masters_refused(tmp_path, devices):
+    """A truncated master set must error, not silently downgrade to the
+    lossy param upcast."""
+    import os as _os
+    from deeperspeed_tpu.utils.zero_to_fp32 import (
+        get_fp32_state_dict_from_zero_checkpoint)
+    cfg = {"zero_optimization": {
+        "stage": 3,
+        "offload_optimizer": {"device": "nvme",
+                              "nvme_path": str(tmp_path / "opt")},
+        "offload_param": {"device": "nvme",
+                          "nvme_path": str(tmp_path / "swap")}}}
+    engine = _engine(cfg)
+    _train(engine, steps=1)
+    ckpt = tmp_path / "ckpt"
+    engine.save_checkpoint(str(ckpt), tag="t")
+    _os.remove(str(ckpt / "t" / "opt_0_master.swp"))
+    with pytest.raises(RuntimeError, match="incomplete"):
+        get_fp32_state_dict_from_zero_checkpoint(str(ckpt / "t"))
