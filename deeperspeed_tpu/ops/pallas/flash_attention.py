@@ -97,6 +97,36 @@ def _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k):
     return s + penalty
 
 
+def _dropout_keep(seed, pid, row0, col0, shape, rate):
+    """Deterministic keep-mask for in-kernel attention-probability
+    dropout: a 2-round avalanche hash of (seed, batch*head, absolute
+    row, absolute col). The same call sites in the backward kernels
+    regenerate the exact forward mask — the Pallas analogue of the
+    reference's curand Philox-offset scheme
+    (`csrc/transformer/dropout_kernels.cu`). Pure int32 jnp ops
+    (wrapping mul/xor/shift): lowers on Mosaic AND in interpret mode
+    (pltpu.prng_* has no CPU lowering). Comparison uses the low 31 bits
+    so int32 arithmetic stays sign-safe."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + row0
+    cols = jax.lax.broadcasted_iota(jnp.int32, shape, 1) + col0
+    x = rows * (-1640531527) ^ cols * (-2048144789)   # 0x9E3779B9/0x85EBCA6B
+    x = x ^ (seed + pid * (-1028477387))              # 0xC2B2AE35
+    x = (x ^ ((x >> 16) & 0xFFFF)) * 0x7FEB352D
+    x = (x ^ ((x >> 15) & 0x1FFFF)) * (-2073452917)   # 0x846CA68B
+    x = x ^ ((x >> 16) & 0xFFFF)
+    thresh = jnp.int32(int(min(max(rate, 0.0), 1.0) * 2147483647))
+    return (x & 0x7FFFFFFF) >= thresh
+
+
+def _apply_dropout(p, seed, pid, row0, col0, rate):
+    """Scale-at-train dropout on (unnormalized) probabilities: the
+    softmax denominator is computed from the UNdropped p, so this equals
+    torch's dropout(softmax(s)) — dropped entries are zeroed, survivors
+    scaled by 1/keep, no renormalization."""
+    keep = _dropout_keep(seed, pid, row0, col0, p.shape, rate)
+    return jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+
+
 # ---------------------------------------------------------------------------
 # forward — single-block specialization
 # ---------------------------------------------------------------------------
@@ -104,7 +134,8 @@ def _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k):
 CAUSAL_STRIPS = 8  # column strips for dead-sub-block exp skipping
 
 
-def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
+def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
+                       dropout_rate=0.0):
     """One (q, k) block covers the whole sequence: straight (non-online)
     softmax — no running max/denominator scratch, no alpha rescale, no
     accumulator round-trips. For causal tiles the columns are processed
@@ -116,11 +147,11 @@ def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
     scores pre-max — the TPU equivalent of the reference's mask-taking
     fused softmax (`csrc/transformer/softmax_kernels.cu` attn_softmax
     taking attn_mask): key-padding masks never materialize [S, S]."""
-    if use_bias:
-        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
-        b_ref = None
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    o_ref, lse_ref = next(it), next(it)
     q = q_ref[0]                                              # [S, D]
     k = k_ref[0]
     v = v_ref[0]
@@ -184,6 +215,13 @@ def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
         if use_bias:
             p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         l = jnp.sum(p, axis=1, keepdims=True)
+    if dropout_rate > 0.0:
+        # post-l: the denominator sums the undropped probabilities
+        # (torch dropout(softmax(s)) semantics). Coordinates are the
+        # full-tile globals — the strips branch concatenates back to
+        # full [Sq, Sk] layout first, so fwd/bwd coords agree.
+        p = _apply_dropout(p, seed_ref[0], pl.program_id(0), 0, 0,
+                           dropout_rate)
     o = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -195,10 +233,11 @@ def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
 
 
 def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
-                h=None):
+                h=None, dropout_rate=0.0, seed=None):
     bh = qb.shape[0]
     kernel = functools.partial(_fwd_single_kernel, sm_scale=sm_scale,
-                               causal=causal, use_bias=kbias is not None)
+                               causal=causal, use_bias=kbias is not None,
+                               dropout_rate=dropout_rate)
     in_specs = [pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0))] * 3
     inputs = [qb, kb, vb]
     if kbias is not None:
@@ -206,6 +245,9 @@ def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
         in_specs.append(pl.BlockSpec((1, 1, s),
                                      lambda i, h=h: (i // h, 0, 0)))
         inputs.append(kbias)
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(seed)
     return pl.pallas_call(
         kernel,
         grid=(bh,),
@@ -229,11 +271,12 @@ def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False,
-                use_bias=False):
+                use_bias=False, dropout_rate=0.0):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
     o_ref, lse_ref = next(it), next(it)
     m_scr, l_scr, acc_scr = next(it), next(it), next(it)
     qi = pl.program_id(1)
@@ -283,6 +326,11 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False,
 
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        if dropout_rate > 0.0:
+            # post-l (denominator sums undropped p); absolute tile
+            # coordinates so the backward kernels regenerate this mask
+            p = _apply_dropout(p, seed_ref[0], pl.program_id(0),
+                               qi * block_q, ki * block_k, dropout_rate)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [BQ, D]
@@ -313,7 +361,7 @@ def _mask_spec(h, n_fine_q, n_fine_k):
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
-         layout=None, kbias=None):
+         layout=None, kbias=None, dropout_rate=0.0, seed=None):
     b, s, h, d = q.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
 
@@ -328,7 +376,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         # whole sequence in one block: the online-softmax machinery is
         # pure overhead — run the specialized straight-softmax kernel
         out, lse = _fwd_single(qb, kb, vb, causal, sm_scale, s, d,
-                               _interpret(), kbias=kbias, h=h)
+                               _interpret(), kbias=kbias, h=h,
+                               dropout_rate=dropout_rate, seed=seed)
         out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
         return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
 
@@ -338,7 +387,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
                                causal=causal, block_q=block_q,
                                block_k=block_k,
                                use_mask=layout is not None,
-                               use_bias=kbias is not None)
+                               use_bias=kbias is not None,
+                               dropout_rate=dropout_rate)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
@@ -352,6 +402,9 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         in_specs.append(pl.BlockSpec(
             (1, 1, block_k), lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
         inputs.append(kbias)
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(seed)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -381,7 +434,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
 # backward — single-block specialization (fused dq/dk/dv)
 # ---------------------------------------------------------------------------
 
-def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
+def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
+                       dropout_rate=0.0):
     """Whole-sequence tile: ONE pass computes dq, dk AND dv — the split
     dkv/dq kernels each recompute s and p, so fusing saves a full QKᵀ
     matmul, a dO·Vᵀ matmul, and an exp pass per layer. Causal tiles
@@ -389,13 +443,12 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
     share of the dv/dk/dq matmul flops. With ``use_bias`` the additive
     per-key row is re-applied pre-exp (p = exp(s + bias - lse) is then
     exactly the forward's probabilities; masked entries exp to 0)."""
-    if use_bias:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b_ref,
-         dq_ref, dk_ref, dv_ref) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dk_ref, dv_ref) = refs
-        b_ref = None
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    dq_ref, dk_ref, dv_ref = next(it), next(it), next(it)
     q = q_ref[0]                                              # [S, D]
     k = k_ref[0]
     v = v_ref[0]
@@ -424,11 +477,20 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
             cols = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1) + lo
             sc = jnp.where(rows >= cols, sc, NEG_INF)
             pc = jnp.exp(sc - lse[lo:])                       # [Sq-lo, w]
-            dsc = pc * (dp_full[lo:, c * w:(c + 1) * w] -
-                        delta[lo:]) * sm_scale
+            dpc = dp_full[lo:, c * w:(c + 1) * w]
+            pc_v = pc
+            if dropout_rate > 0.0:
+                # regenerate the forward mask at this strip's absolute
+                # coordinates (rows lo.., cols c*w..)
+                keep_c = _dropout_keep(seed_ref[0], pl.program_id(0),
+                                       lo, c * w, pc.shape, dropout_rate)
+                inv = 1.0 / (1.0 - dropout_rate)
+                pc_v = jnp.where(keep_c, pc * inv, 0.0)
+                dpc = jnp.where(keep_c, dpc * inv, 0.0)
+            dsc = pc * (dpc - delta[lo:]) * sm_scale
             do_alive = do[lo:]
             dv_parts.append(jax.lax.dot_general(
-                pc.astype(do.dtype), do_alive, (((0,), (0,)), ((), ())),
+                pc_v.astype(do.dtype), do_alive, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))          # [w, D]
             dk_parts.append(jax.lax.dot_general(
                 dsc.astype(q.dtype), q[lo:], (((0,), (0,)), ((), ())),
@@ -448,9 +510,16 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
         if causal:
             s = _causal_mask(s, 0, 0, s_q, s_k)
         p = jnp.exp(s - lse)
+        p_v = p
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], pl.program_id(0), 0, 0,
+                                 p.shape, dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p * inv, 0.0)
+            dp_full = jnp.where(keep, dp_full * inv, 0.0)
         ds = p * (dp_full - delta) * sm_scale
         dv = jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk = jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -465,10 +534,12 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False):
 
 
 def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
-                interpret, kbias=None, h=None):
+                interpret, kbias=None, h=None, dropout_rate=0.0,
+                seed=None):
     bh = qb.shape[0]
     kernel = functools.partial(_bwd_single_kernel, sm_scale=sm_scale,
-                               causal=causal, use_bias=kbias is not None)
+                               causal=causal, use_bias=kbias is not None,
+                               dropout_rate=dropout_rate)
     in_specs = [
         pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
         pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
@@ -482,6 +553,9 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
         in_specs.append(pl.BlockSpec((1, 1, s),
                                      lambda i, h=h: (i // h, 0, 0)))
         inputs.append(kbias)
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(seed)
     return pl.pallas_call(
         kernel,
         grid=(bh,),
@@ -503,12 +577,13 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
-                    use_mask=False, use_bias=False):
+                    use_mask=False, use_bias=False, dropout_rate=0.0):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
     dk_ref, dv_ref, dk_scr, dv_scr = next(it), next(it), next(it), next(it)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -538,15 +613,25 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
             s = s + b_ref[0]                                 # [1, BK] bcast
         p = jnp.exp(s - lse_ref[0].reshape(-1, 1))           # [BQ, BK] f32
         do = do_ref[0]                                       # [BQ, D]
-        # dV += Pᵀ dO  (P quantized to the wire dtype for MXU rate,
-        # matching the reference's fp16 kernel precision)
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # dS = P ∘ (dO Vᵀ − delta)
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [BQ, BK]
+        p_v = p
+        if dropout_rate > 0.0:
+            # note grid order (bh, ki, qi): program_id(0) is still bh
+            # and the absolute (row, col) coords match the fwd tiles
+            keep = _dropout_keep(seed_ref[0], pl.program_id(0),
+                                 qi * block_q, ki * block_k, p.shape,
+                                 dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        # dV += P_dropᵀ dO  (P quantized to the wire dtype for MXU rate,
+        # matching the reference's fp16 kernel precision)
+        dv_scr[:] += jax.lax.dot_general(
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dS = P ∘ (M ∘ dO Vᵀ / keep − delta)
         ds = p * (dp - delta_ref[0].reshape(-1, 1)) * sm_scale
         # dK += dSᵀ Q
         dk_scr[:] += jax.lax.dot_general(
@@ -560,12 +645,13 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
-                   use_mask=False, use_bias=False):
+                   use_mask=False, use_bias=False, dropout_rate=0.0):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
     dq_ref, dq_scr = next(it), next(it)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -597,6 +683,11 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0], pl.program_id(0),
+                                 qi * block_q, ki * block_k, p.shape,
+                                 dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta_ref[0].reshape(-1, 1)) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -608,7 +699,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
 
 
 def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
-         kbias=None):
+         kbias=None, dropout_rate=0.0, seed=None):
     qb, kb, vb, out, lse = res
     bh, s, d = qb.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
@@ -631,7 +722,8 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
     if n_q == 1 and n_k == 1 and not use_mask:
         dq, dk, dv = _bwd_single(qb, kb, vb, do, lse, delta, causal,
                                  sm_scale, s, d, _interpret(),
-                                 kbias=kbias, h=h)
+                                 kbias=kbias, h=h,
+                                 dropout_rate=dropout_rate, seed=seed)
 
         def from_bh1(x):
             return x.reshape(bdim, h, s, d).transpose(0, 2, 1, 3)
@@ -641,7 +733,8 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, use_mask=use_mask,
-                                   use_bias=use_bias)
+                                   use_bias=use_bias,
+                                   dropout_rate=dropout_rate)
     dkv_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
@@ -658,6 +751,9 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
         dkv_specs.append(pl.BlockSpec(
             (1, 1, block_k), lambda bh, ki, qi, h=h: (bh // h, 0, ki)))
         dkv_inputs.append(kbias)
+    if dropout_rate > 0.0:
+        dkv_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_inputs.append(seed)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, n_k, n_q),
@@ -681,7 +777,8 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
                                   block_k=block_k, use_mask=use_mask,
-                                  use_bias=use_bias)
+                                  use_bias=use_bias,
+                                  dropout_rate=dropout_rate)
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
@@ -698,6 +795,9 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
         dq_specs.append(pl.BlockSpec(
             (1, 1, block_k), lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
         dq_inputs.append(kbias)
+    if dropout_rate > 0.0:
+        dq_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_inputs.append(seed)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, n_q, n_k),
@@ -783,6 +883,58 @@ def _flash_kbias_bwd(causal, sm_scale, block_q, block_k, res_kb, g):
 
 
 flash_attention_kbias.defvjp(_flash_kbias_fwd, _flash_kbias_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_train(q, k, v, kbias, seed, causal=False,
+                          sm_scale=None, block_q=BLOCK_Q, block_k=BLOCK_K,
+                          dropout_rate=0.0):
+    """Training-mode flash attention: fused additive per-key mask AND
+    in-kernel attention-probability dropout — the full fused stack of
+    the reference's training transformer kernel (attn_softmax +
+    attn_prob_dropout, `csrc/transformer/softmax_kernels.cu` /
+    `dropout_kernels.cu`) with O(S) memory.
+
+    kbias: [B, S] f32 additive mask/bias (see flash_attention_kbias —
+    same non-differentiable contract) or None to skip the bias refs
+    entirely (unmasked training pays no bias overhead).
+    seed: int32 [1] array; the dropout mask is a deterministic hash of
+    (seed, batch*head, row, col), so the backward pass regenerates the
+    forward's mask exactly. Derive a fresh seed per step from the step
+    rng. Dropout semantics are torch's dropout(softmax(s)): the
+    denominator sums the undropped probabilities and survivors scale by
+    1/keep. kbias and seed receive zero cotangents.
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    kb3 = None if kbias is None else \
+        kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, kbias=kb3,
+                  dropout_rate=dropout_rate, seed=seed)
+    return out
+
+
+def _flash_train_fwd(q, k, v, kbias, seed, causal, sm_scale, block_q,
+                     block_k, dropout_rate):
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    kb3 = None if kbias is None else \
+        kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)
+    out, res = _fwd(q, k, v, causal, scale, block_q, block_k, kbias=kb3,
+                    dropout_rate=dropout_rate, seed=seed)
+    return out, (res, kbias, seed)
+
+
+def _flash_train_bwd(causal, sm_scale, block_q, block_k, dropout_rate,
+                     res_kb, g):
+    res, kbias, seed = res_kb
+    kb3 = None if kbias is None else \
+        kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)
+    dq, dk, dv = _bwd(causal, sm_scale, block_q, block_k, res, g,
+                      kbias=kb3, dropout_rate=dropout_rate, seed=seed)
+    dkb = None if kbias is None else jnp.zeros_like(kbias)
+    return dq, dk, dv, dkb, jnp.zeros_like(seed)
+
+
+flash_attention_train.defvjp(_flash_train_fwd, _flash_train_bwd)
 
 
 def make_masked_flash_attention(layout128, causal=False, sm_scale=None,

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 
 from ..pallas.flash_attention import (flash_attention,
                                       flash_attention_kbias,
-                                      flash_attention_supported)
+                                      flash_attention_supported,
+                                      flash_attention_train)
 
 
 class TransformerConfig:
@@ -210,16 +211,21 @@ class DeepSpeedTransformerLayer:
             else:
                 additive_mask = am.astype(jnp.float32)
 
-        # The fused path covers per-key masks; attention-prob dropout,
-        # when ACTIVE, still needs the materialized probabilities, so
-        # training with attn_dropout > 0 falls back (the reference fuses
-        # dropout into its kernel — candidate for a pltpu.prng kernel).
+        # The fused path covers per-key masks AND in-kernel attention
+        # dropout (flash_attention_train mirrors the reference's fused
+        # attn_softmax + attn_prob_dropout); only full-rank [B, H, S, S]
+        # biases fall back to the materialized path.
         attn_drop_active = (not deterministic and
                             cfg.attn_dropout_ratio > 0 and rng is not None)
         if (additive_mask is None or kbias is not None) and \
-                not attn_drop_active and \
                 flash_attention_supported((b, s, heads, hd)):
-            if kbias is None:
+            if attn_drop_active:
+                seed = jax.random.randint(rng, (1,), 0, 2**31 - 1,
+                                          dtype=jnp.int32)
+                ctx = flash_attention_train(
+                    q, k, v, kbias, seed,
+                    dropout_rate=float(cfg.attn_dropout_ratio))
+            elif kbias is None:
                 ctx = flash_attention(q, k, v, False)
             else:
                 ctx = flash_attention_kbias(q, k, v, kbias, False)

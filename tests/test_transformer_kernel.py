@@ -254,3 +254,34 @@ def test_hf_additive_mask_shape_routes_to_flash():
     jaxpr = str(jax.make_jaxpr(loss)(x))
     ssq = f"{BATCH},{FLASH_HEADS},{FLASH_SEQ},{FLASH_SEQ}"
     assert ssq not in jaxpr
+
+
+def test_training_dropout_stays_fused():
+    """attn_dropout > 0 + training + mask: the layer uses the in-kernel
+    dropout path — still no [B, H, S, S] tensor in fwd+bwd, output
+    deterministic per rng and different across rngs."""
+    layer = flash_shaped_layer(attn_dropout_ratio=0.2,
+                               hidden_dropout_ratio=0.0, training=True)
+    params = layer.init(jax.random.PRNGKey(11))
+    x = jax.random.normal(jax.random.PRNGKey(12),
+                          (BATCH, FLASH_SEQ, FLASH_HIDDEN)) * 0.5
+    keep = jnp.ones((BATCH, FLASH_SEQ), jnp.float32)
+
+    def loss(params, x, rng):
+        return jnp.sum(layer.apply(params, x, attention_mask=keep,
+                                   rng=rng, deterministic=False) ** 2)
+
+    rng = jax.random.PRNGKey(0)
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))(
+        params, x, rng))
+    ssq = f"{BATCH},{FLASH_HEADS},{FLASH_SEQ},{FLASH_SEQ}"
+    assert ssq not in jaxpr, "training dropout path materialized scores"
+
+    o1 = layer.apply(params, x, attention_mask=keep,
+                     rng=jax.random.PRNGKey(5), deterministic=False)
+    o2 = layer.apply(params, x, attention_mask=keep,
+                     rng=jax.random.PRNGKey(5), deterministic=False)
+    o3 = layer.apply(params, x, attention_mask=keep,
+                     rng=jax.random.PRNGKey(6), deterministic=False)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert np.abs(np.asarray(o1) - np.asarray(o3)).max() > 1e-4
