@@ -161,6 +161,42 @@ def compressed_allreduce_two_phase(x, worker_error, server_error,
     return out * valid, new_worker_error, new_server_error
 
 
+def packed_flat_two_phase(m_list, valid_sizes, worker_error, server_error,
+                          axis_name, world):
+    """ONE packed wire for a whole optimizer step: every leaf's VALID
+    momentum prefix concatenates into a single flat buffer, one
+    two-phase sign allreduce runs (one all_to_all + one all_gather pair
+    per step), and the result splits back per leaf (pad tails restored
+    as zeros). The reference compresses one flattened fused buffer the
+    same way (`onebit/adam.py:158-175`); per-leaf wires pay
+    per-collective latency on every bias/LN scale.
+
+    Args (inside shard_map over `axis_name`):
+      m_list: leaf momentum arrays (natural or flat-pad shapes).
+      valid_sizes: static per-leaf count of REAL elements (pad_info
+        numel for flat-padded ZeRO leaves, size otherwise).
+      worker_error: [wire_pad(total, world)] flat buffer.
+      server_error: [wire_pad(total, world) // world] buffer.
+    Returns (synced leaf list, new_worker_error, new_server_error).
+    """
+    total = int(sum(valid_sizes))
+    pad = wire_pad(total, world)
+    flat = jnp.concatenate(
+        [jnp.ravel(m)[:nv] for m, nv in zip(m_list, valid_sizes)])
+    flat = jnp.pad(flat, (0, pad - total))
+    out, e2, s2 = compressed_allreduce_two_phase(
+        flat, worker_error, server_error, axis_name, world,
+        n_valid=total)
+    outs, off = [], 0
+    for m, nv in zip(m_list, valid_sizes):
+        seg = out[off:off + nv]
+        if nv < m.size:
+            seg = jnp.pad(seg, (0, m.size - nv))
+        outs.append(seg.reshape(m.shape))
+        off += nv
+    return outs, e2, s2
+
+
 def compressed_allreduce_two_phase_host(buffers, worker_errors,
                                         server_errors, n_valid=None):
     """Single-process reference of the two-phase math (one array per

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from ....ops.adam.fused_adam import FusedAdam
 from ...comm.compressed import (compressed_allreduce_dense_two_phase,
-                                compressed_allreduce_two_phase, wire_pad)
+                                wire_pad)
 
 
 class OnebitAdamState(NamedTuple):
@@ -60,6 +60,15 @@ class OnebitAdam(FusedAdam):
         # excluded from compression scales and stay exactly 0.
         self.pad_info = None
 
+    def _wire_valid_sizes(self, master_params):
+        """Static per-leaf REAL element counts (flat-pad tails excluded;
+        pad_info is set by the engine before init_state)."""
+        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
+        flat_i = (treedef.flatten_up_to(self.pad_info)
+                  if self.pad_info is not None else [None] * len(flat_p))
+        return [int(i.numel) if i else int(p.size)
+                for p, i in zip(flat_p, flat_i)]
+
     def init_state(self, master_params):
         base = super().init_state(master_params)
 
@@ -70,24 +79,18 @@ class OnebitAdam(FusedAdam):
                 lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
 
         if self.packed_transport and self.dp_world > 1:
-            # Per-RANK error feedback: leading [world] dim, sharded over
-            # the data axis by the engine so each rank round-trips its
-            # own residuals. Worker errors span the wire-padded flat
-            # length; server errors cover this rank's server chunk.
+            # ONE flat wire for the whole step (reference compresses a
+            # single flattened fused buffer, `onebit/adam.py:158-175`):
+            # error feedback is a single [world, wire_pad(total)] buffer
+            # pair, sharded over the data axis by the engine so each
+            # rank round-trips its own residuals.
             w = self.dp_world
-
-            def rank_zeros(chunk_of_pad):
-                return jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(
-                        (w, chunk_of_pad(wire_pad(p.size, w))),
-                        jnp.float32),
-                    master_params)
-
+            pad = wire_pad(sum(self._wire_valid_sizes(master_params)), w)
             return OnebitAdamState(
                 step=base.step, exp_avg=base.exp_avg,
                 exp_avg_sq=base.exp_avg_sq,
-                worker_error=rank_zeros(lambda pad: pad),
-                server_error=rank_zeros(lambda pad: pad // w))
+                worker_error=jnp.zeros((w, pad), jnp.float32),
+                server_error=jnp.zeros((w, pad // w), jnp.float32))
         return OnebitAdamState(step=base.step, exp_avg=base.exp_avg,
                                exp_avg_sq=base.exp_avg_sq,
                                worker_error=zeros(), server_error=zeros())
@@ -102,8 +105,6 @@ class OnebitAdam(FusedAdam):
         step = state.step + 1
         in_warmup = step <= self.freeze_step
 
-        packed = (self.packed_transport and self.dp_world > 1
-                  and axis_name is not None)
         if self.packed_transport and self.dp_world > 1 and \
                 axis_name is None and compress:
             # state buffers are laid out [world, wire_pad] for the packed
@@ -117,6 +118,52 @@ class OnebitAdam(FusedAdam):
         # compress=False: the engine's warmup program — compression
         # results would be discarded by the in_warmup select, but XLA
         # cannot DCE collectives, so skip the wire statically
+
+        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.exp_avg)
+        flat_v = treedef.flatten_up_to(state.exp_avg_sq)
+        flat_i = (treedef.flatten_up_to(self.pad_info)
+                  if self.pad_info is not None else [None] * len(flat_p))
+        unfl = lambda lst: jax.tree_util.tree_unflatten(  # noqa: E731
+            treedef, lst)
+
+        packed_layout = self.packed_transport and self.dp_world > 1
+        if packed_layout:
+            # ONE flat wire per step (reference compresses one flattened
+            # fused buffer, `onebit/adam.py:158-175`). Local moments
+            # first, then a single packed two-phase sync, then the
+            # elementwise update.
+            from ...comm.compressed import packed_flat_two_phase
+            p32s, m_news, v_news = [], [], []
+            for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+                g = g.astype(jnp.float32)
+                p32 = p.astype(jnp.float32)
+                if weight_decay != 0.0:
+                    g = g + weight_decay * p32
+                p32s.append(p32)
+                m_news.append(beta1 * m + (1 - beta1) * g)
+                v_news.append(jnp.where(
+                    in_warmup, beta2 * v + (1 - beta2) * jnp.square(g),
+                    v))
+            err, serr = state.worker_error, state.server_error
+            m_fin = m_news
+            if compress:
+                # same helper init_state sized the wire buffers with —
+                # the two MUST agree or the packed step shape-mismatches
+                valid = self._wire_valid_sizes(master_params)
+                m_comp, e2, s2 = packed_flat_two_phase(
+                    m_news, valid, err[0], serr[0], axis_name,
+                    self.dp_world)
+                m_fin = [jnp.where(in_warmup, mn, mc)
+                         for mn, mc in zip(m_news, m_comp)]
+                err = jnp.where(in_warmup, err, e2[None])
+                serr = jnp.where(in_warmup, serr, s2[None])
+            new_p = [p - lr * (m / (jnp.sqrt(v) + eps))
+                     for p, m, v in zip(p32s, m_fin, v_news)]
+            return unfl(new_p), OnebitAdamState(
+                step=step, exp_avg=unfl(m_fin), exp_avg_sq=unfl(v_news),
+                worker_error=err, server_error=serr)
 
         def leaf(p, g, m, v, err, serr, info=None):
             g = g.astype(jnp.float32)
@@ -133,42 +180,21 @@ class OnebitAdam(FusedAdam):
             if not compress:
                 update = m_new / (jnp.sqrt(v_new) + eps)
                 return p - lr * update, m_new, v_new, err, serr
-            if packed:
-                # the reference's actual wire path: sign bytes via
-                # all_to_all + all_gather (err/serr carry this rank's
-                # residuals under a leading [world] dim sliced to [1,..])
-                n = m_new.size
-                pad = wire_pad(n, self.dp_world)
-                flat = jnp.pad(jnp.ravel(m_new), (0, pad - n))
-                out, e2, s2 = compressed_allreduce_two_phase(
-                    flat, err[0], serr[0], axis_name, self.dp_world,
-                    n_valid=info.numel if info else n)
-                m_comp = out[:n].reshape(m_new.shape)
-                err_new, serr_new = e2[None], s2[None]
-            else:
-                m_comp, err_new, serr_new = \
-                    compressed_allreduce_dense_two_phase(
-                        m_new, err, serr, axis_name,
-                        n_valid=info.numel if info else None)
+            m_comp, err_new, serr_new = \
+                compressed_allreduce_dense_two_phase(
+                    m_new, err, serr, axis_name,
+                    n_valid=info.numel if info else None)
             m_new = jnp.where(in_warmup, m_new, m_comp)
             err = jnp.where(in_warmup, err, err_new)
             serr = jnp.where(in_warmup, serr, serr_new)
             update = m_new / (jnp.sqrt(v_new) + eps)
             return p - lr * update, m_new, v_new, err, serr
 
-        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(state.exp_avg)
-        flat_v = treedef.flatten_up_to(state.exp_avg_sq)
         flat_e = treedef.flatten_up_to(state.worker_error)
         flat_s = treedef.flatten_up_to(state.server_error)
-        flat_i = (treedef.flatten_up_to(self.pad_info)
-                  if self.pad_info is not None else [None] * len(flat_p))
-
         outs = [leaf(p, g, m, v, e, s, i) for p, g, m, v, e, s, i in
                 zip(flat_p, flat_g, flat_m, flat_v, flat_e, flat_s, flat_i)]
-        unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
-            treedef, [o[i] for o in outs])
+        unf = lambda i: unfl([o[i] for o in outs])  # noqa: E731
         return unf(0), OnebitAdamState(step=step, exp_avg=unf(1),
                                        exp_avg_sq=unf(2),
                                        worker_error=unf(3),

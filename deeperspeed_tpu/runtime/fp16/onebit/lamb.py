@@ -49,6 +49,13 @@ class OnebitLamb(FusedLamb):
         self.factor_min = factor_min
         self.factor_threshold = factor_threshold
 
+    def _wire_valid_sizes(self, master_params):
+        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
+        flat_i = (treedef.flatten_up_to(self.pad_info)
+                  if self.pad_info is not None else [None] * len(flat_p))
+        return [int(i.numel) if i else int(p.size)
+                for p, i in zip(flat_p, flat_i)]
+
     def init_state(self, master_params):
         base = super().init_state(master_params)
 
@@ -60,13 +67,10 @@ class OnebitLamb(FusedLamb):
         if self.packed_transport and self.dp_world > 1:
             from ...comm.compressed import wire_pad
             w = self.dp_world
-            worker = jax.tree_util.tree_map(
-                lambda p: jnp.zeros((w, wire_pad(p.size, w)), jnp.float32),
-                master_params)
-            server = jax.tree_util.tree_map(
-                lambda p: jnp.zeros((w, wire_pad(p.size, w) // w),
-                                    jnp.float32),
-                master_params)
+            # ONE flat wire buffer pair (see onebit/adam.py init_state)
+            pad = wire_pad(sum(self._wire_valid_sizes(master_params)), w)
+            worker = jnp.zeros((w, pad), jnp.float32)
+            server = jnp.zeros((w, pad // w), jnp.float32)
             ones_t = jax.tree_util.tree_map(
                 lambda p: jnp.ones((), jnp.float32), master_params)
             return OnebitLambState(step=base.step, exp_avg=base.exp_avg,
@@ -94,8 +98,6 @@ class OnebitLamb(FusedLamb):
         step = state.step + 1
         in_warmup = step <= self.freeze_step
 
-        packed = (self.packed_transport and self.dp_world > 1
-                  and axis_name is not None)
         if self.packed_transport and self.dp_world > 1 and \
                 axis_name is None and compress:
             # see onebit/adam.py: packed state is [world, wire_pad]
@@ -109,6 +111,72 @@ class OnebitLamb(FusedLamb):
         # results would be discarded by the in_warmup select, but XLA
         # cannot DCE collectives, so skip the wire statically
 
+        def lamb_epilogue(p, m_new, v_new, fs):
+            """Trust-ratio update on the (possibly synced) momentum:
+            frozen at the compression boundary, clamped drift after
+            (reference lamb.py scaling)."""
+            update = m_new / (jnp.sqrt(v_new) + eps)
+            if weight_decay != 0.0:
+                update = update + weight_decay * p
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(update.reshape(-1))
+            trust = jnp.where((p_norm > 0) & (u_norm > 0),
+                              jnp.clip(p_norm / u_norm, min_coeff,
+                                       max_coeff),
+                              1.0)
+            fs_new = jnp.where(in_warmup,
+                               self.coeff_beta * fs +
+                               (1 - self.coeff_beta) * trust, fs)
+            trust = jnp.where(
+                in_warmup, trust,
+                jnp.clip(trust, fs_new * self.factor_min,
+                         fs_new * self.factor_max))
+            return p - lr * trust * update, fs_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.exp_avg)
+        flat_v = treedef.flatten_up_to(state.exp_avg_sq)
+        flat_f = treedef.flatten_up_to(state.frozen_scale)
+        flat_i = (treedef.flatten_up_to(self.pad_info)
+                  if self.pad_info is not None else [None] * len(flat_p))
+        unfl = lambda lst: jax.tree_util.tree_unflatten(  # noqa: E731
+            treedef, lst)
+
+        packed_layout = self.packed_transport and self.dp_world > 1
+        if packed_layout:
+            # ONE flat wire per step (see onebit/adam.py)
+            from ...comm.compressed import packed_flat_two_phase
+            p32s, m_news, v_news = [], [], []
+            for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+                g = g.astype(jnp.float32)
+                p32s.append(p.astype(jnp.float32))
+                m_news.append(beta1 * m + (1 - beta1) * g)
+                v_news.append(jnp.where(
+                    in_warmup, beta2 * v + (1 - beta2) * jnp.square(g),
+                    v))
+            err, serr = state.worker_error, state.server_error
+            m_fin = m_news
+            if compress:
+                # same helper init_state sized the wire buffers with
+                valid = self._wire_valid_sizes(master_params)
+                m_comp, e2, s2 = packed_flat_two_phase(
+                    m_news, valid, err[0], serr[0], axis_name,
+                    self.dp_world)
+                m_fin = [jnp.where(in_warmup, mn, mc)
+                         for mn, mc in zip(m_news, m_comp)]
+                err = jnp.where(in_warmup, err, e2[None])
+                serr = jnp.where(in_warmup, serr, s2[None])
+            new_p, fs_news = [], []
+            for p32, m, v, fs in zip(p32s, m_fin, v_news, flat_f):
+                np_, fs_new = lamb_epilogue(p32, m, v, fs)
+                new_p.append(np_)
+                fs_news.append(fs_new)
+            return unfl(new_p), OnebitLambState(
+                step=step, exp_avg=unfl(m_fin), exp_avg_sq=unfl(v_news),
+                worker_error=err, server_error=serr,
+                frozen_scale=unfl(fs_news))
+
         def leaf(p, g, m, v, err, serr, fs, info=None):
             g = g.astype(jnp.float32)
             p = p.astype(jnp.float32)
@@ -118,17 +186,6 @@ class OnebitLamb(FusedLamb):
             # two-phase semantics post-warmup (see onebit/adam.py)
             if not compress:
                 m_comp, err_new, serr_new = m_new, err, serr
-            elif packed:
-                from ...comm.compressed import (
-                    compressed_allreduce_two_phase, wire_pad)
-                n = m_new.size
-                pad = wire_pad(n, self.dp_world)
-                flat = jnp.pad(jnp.ravel(m_new), (0, pad - n))
-                out, e2, s2 = compressed_allreduce_two_phase(
-                    flat, err[0], serr[0], axis_name, self.dp_world,
-                    n_valid=info.numel if info else n)
-                m_comp = out[:n].reshape(m_new.shape)
-                err_new, serr_new = e2[None], s2[None]
             else:
                 m_comp, err_new, serr_new = \
                     compressed_allreduce_dense_two_phase(
@@ -137,35 +194,15 @@ class OnebitLamb(FusedLamb):
             m_new = jnp.where(in_warmup, m_new, m_comp)
             err = jnp.where(in_warmup, err, err_new)
             serr = jnp.where(in_warmup, serr, serr_new)
-            update = m_new / (jnp.sqrt(v_new) + eps)
-            if weight_decay != 0.0:
-                update = update + weight_decay * p
-            p_norm = jnp.linalg.norm(p.reshape(-1))
-            u_norm = jnp.linalg.norm(update.reshape(-1))
-            trust = jnp.where((p_norm > 0) & (u_norm > 0),
-                              jnp.clip(p_norm / u_norm, min_coeff, max_coeff),
-                              1.0)
-            # Freeze trust scaling at the compression boundary; afterwards
-            # clamp drift within factor bounds (reference lamb.py scaling).
-            fs_new = jnp.where(in_warmup,
-                               self.coeff_beta * fs +
-                               (1 - self.coeff_beta) * trust, fs)
-            trust = jnp.where(
-                in_warmup, trust,
-                jnp.clip(trust, fs_new * self.factor_min,
-                         fs_new * self.factor_max))
-            return p - lr * trust * update, m_new, v_new, err, serr, fs_new
+            new_p, fs_new = lamb_epilogue(p, m_new, v_new, fs)
+            return new_p, m_new, v_new, err, serr, fs_new
 
-        flat_p, treedef = jax.tree_util.tree_flatten(master_params)
-        flat = [treedef.flatten_up_to(t) for t in
-                (grads, state.exp_avg, state.exp_avg_sq, state.worker_error,
-                 state.server_error, state.frozen_scale)]
-        flat.append(treedef.flatten_up_to(self.pad_info)
-                    if self.pad_info is not None else [None] * len(flat_p))
+        flat_e = treedef.flatten_up_to(state.worker_error)
+        flat_s = treedef.flatten_up_to(state.server_error)
         outs = [leaf(p, g, m, v, e, s, f, i) for p, g, m, v, e, s, f, i in
-                zip(flat_p, *flat)]
-        unf = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
-            treedef, [o[i] for o in outs])
+                zip(flat_p, flat_g, flat_m, flat_v, flat_e, flat_s,
+                    flat_f, flat_i)]
+        unf = lambda i: unfl([o[i] for o in outs])  # noqa: E731
         return unf(0), OnebitLambState(step=step, exp_avg=unf(1),
                                        exp_avg_sq=unf(2), worker_error=unf(3),
                                        server_error=unf(4),

@@ -1,0 +1,66 @@
+"""Worker for the launcher-driven multi-process test: consumes ONLY the
+environment `launcher/launch.py` exports (RANK / WORLD_SIZE / MASTER_* /
+DS_SLOTS — the reference's launch.py:69 env handoff), initializes
+jax.distributed from it, and trains a 2-process engine. Launched via the
+real `deeperspeed_tpu.launcher.launch` module by
+tests/test_multiprocess.py, proving the deepspeed-CLI → launch.py →
+env → engine bring-up chain end to end."""
+
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    addr = os.environ["MASTER_ADDR"]
+    port = os.environ["MASTER_PORT"]
+    slots = os.environ.get("DS_SLOTS")
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=f"{addr}:{port}",
+                               num_processes=world, process_id=rank)
+    assert jax.process_count() == world
+
+    import numpy as np
+
+    import deeperspeed_tpu
+    import jax.numpy as jnp
+
+    D = 8
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        return jnp.mean((jnp.tanh(x @ params["w"]) - y) ** 2)
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (D, D)) * 0.3}
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 1000},
+        dist_init_required=False)
+
+    rng = np.random.default_rng(0)  # same data every process
+    losses = []
+    for _ in range(3):
+        x = rng.normal(size=(1, 8, D)).astype(np.float32)
+        y = rng.normal(size=(1, 8, D)).astype(np.float32)
+        losses.append(float(engine.train_batch(batch=(x, y))))
+
+    print("WORKER_RESULT " + json.dumps({
+        "rank": rank, "world": world, "slots": slots,
+        "dp_world": engine.dp_world_size, "losses": losses}))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -58,3 +58,82 @@ def test_two_process_train_checkpoint_restore(tmp_path):
                                rtol=1e-6, atol=1e-6)
     # only process 0 wrote the files; they exist exactly once
     assert (tmp_path / "latest").is_file()
+
+
+def test_launcher_driven_two_process_bringup(tmp_path):
+    """The real launcher chain (reference `launch.py:69`): spawn
+    `deeperspeed_tpu.launcher.launch` per node; IT spawns the user
+    script with the RANK/MASTER_* env handoff; the workers form the
+    cluster from env alone and train in lockstep."""
+    from deeperspeed_tpu.launcher.runner import encode_world_info
+    port = _free_port()
+    world_info = encode_world_info({"node0": 2, "node1": 2})
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=os.pathsep.join(
+            [os.getcwd()] + os.environ.get("PYTHONPATH", "").split(
+                os.pathsep)),
+    )
+    worker = os.path.join(os.path.dirname(__file__), "launch_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "deeperspeed_tpu.launcher.launch",
+         "--node_rank", str(i), "--master_addr", "127.0.0.1",
+         "--master_port", str(port), "--world_info", world_info, worker],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    results = {}
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        text = out.decode()
+        assert p.returncode == 0, text[-3000:]
+        for line in text.splitlines():
+            if line.startswith("WORKER_RESULT "):
+                r = json.loads(line[len("WORKER_RESULT "):])
+                results[r["rank"]] = r
+    assert set(results) == {0, 1}, results
+    for r in results.values():
+        assert r["world"] == 2
+        assert r["slots"] == "2"          # DS_SLOTS from the hostfile
+        assert r["dp_world"] == 2
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_launcher_signal_kills_child(tmp_path):
+    """SIGTERM on the launcher terminates its child process group — the
+    reference launch.py's signal-handling contract."""
+    import signal
+    import time
+    pidfile = tmp_path / "child.pid"
+    script = tmp_path / "sleeper.py"
+    script.write_text(
+        "import os, time, sys\n"
+        f"open({str(pidfile)!r}, 'w').write(str(os.getpid()))\n"
+        "sys.stdout.flush()\n"
+        "time.sleep(120)\n")
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+        [os.getcwd()] + os.environ.get("PYTHONPATH", "").split(
+            os.pathsep)))
+    p = subprocess.Popen(
+        [sys.executable, "-m", "deeperspeed_tpu.launcher.launch",
+         str(script)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    for _ in range(100):
+        if pidfile.is_file() and pidfile.read_text():
+            break
+        time.sleep(0.1)
+    child_pid = int(pidfile.read_text())
+    p.send_signal(signal.SIGTERM)
+    p.wait(timeout=30)
+    assert p.returncode != 0
+    for _ in range(100):
+        try:
+            os.kill(child_pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        os.kill(child_pid, signal.SIGKILL)
+        raise AssertionError("launcher left its child running")

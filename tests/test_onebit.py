@@ -387,3 +387,27 @@ def test_packed_engine_wire_bytes(devices):
     assert warm_bytes >= n_params * 4, (warm_bytes,)
     assert post_bytes > 0
     assert post_bytes * 4 <= warm_bytes, (post_bytes, warm_bytes)
+
+
+def test_packed_engine_single_wire_pair(devices):
+    """Round-4 VERDICT #7: the post-freeze program carries ONE packed
+    sign wire for the whole step — one u8 all_to_all + u8 all-gather
+    pair (plus scalar scale gathers), not one pair per gradient leaf."""
+    import re
+    engine = _packed_engine(2)
+    engine._onebit_post_phase = True
+    step = engine._train_step_body(1)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1, 16, 16)).astype(np.float32)
+    batch = jax.tree_util.tree_map(
+        lambda b: engine._shard_stacked_batch(b), (x, x))
+    hlo = jax.jit(step).lower(
+        engine.state, batch, jax.random.PRNGKey(0),
+        jnp.asarray(1e-2)).compile().as_text()
+    u8_collectives = [
+        ln for ln in hlo.splitlines()
+        if re.search(r"=\s*[^=]*u8\[[\d,]*\][^=]*\b"
+                     r"(all-to-all|all-gather)\(", ln)]
+    # one u8 all-to-all + one u8 all-gather for the WHOLE 2-leaf model;
+    # per-leaf wiring would show 4 op definitions
+    assert len(u8_collectives) == 2, "\n".join(u8_collectives)

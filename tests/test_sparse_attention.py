@@ -340,26 +340,39 @@ def test_bool_keep_mask_in_add_mode_rejected():
         ssa(q, q, q, key_padding_mask=kpm)
 
 
-def test_grouped_lut_bits_semantics():
-    """build_lut_grouped: union columns + per-sub-block activity bits
-    (bit r*g+c ⇔ fine row r active for fine col c)."""
+def test_row_union_lut_bits_semantics():
+    """build_row_union_lut: per row-group union of FINE column blocks,
+    padded to a fanout multiple; bit r of bits ⇔ fine row r of the
+    group attends that column block."""
     from deeperspeed_tpu.ops.pallas.block_sparse_attention import (
-        build_lut_grouped)
+        build_row_union_lut)
     layout = np.zeros((1, 4, 4), np.int64)
     layout[0, 0, 1] = 1   # row 0 → col 1
     layout[0, 1, 0] = 1   # row 1 → col 0
     layout[0, 2, 2] = 1
     layout[0, 3, 3] = 1
-    lut, bits, sentinel = build_lut_grouped(layout, 2, 2)
-    assert sentinel == 2
-    assert lut.shape == (1, 2, 1)  # one coarse col group per row group
-    # row-group 0 covers rows 0-1, both hit coarse col 0 (cols 0-1)
-    assert lut[0, 0, 0] == 0
-    # bits: row0/col1 → bit 0*2+1=1; row1/col0 → bit 1*2+0=2 → 0b0110
-    assert bits[0, 0, 0] == 0b0110
-    # row-group 1 (rows 2-3) hits coarse col 1; diag bits 0 and 3
-    assert lut[0, 1, 0] == 1
-    assert bits[0, 1, 0] == 0b1001
+    lut, bits, sentinel = build_row_union_lut(layout, 2, 2)
+    assert sentinel == 4
+    # row-group 0 (rows 0-1): fine cols {0, 1} — already a fanout
+    # multiple, no padding
+    assert lut.shape == (1, 2, 2)
+    assert list(lut[0, 0]) == [0, 1]
+    assert bits[0, 0, 0] == 0b10   # col 0 ← row 1
+    assert bits[0, 0, 1] == 0b01   # col 1 ← row 0
+    # row-group 1 (rows 2-3): fine cols {2, 3}, diagonal bits
+    assert list(lut[0, 1]) == [2, 3]
+    assert bits[0, 1, 0] == 0b01
+    assert bits[0, 1, 1] == 0b10
+
+    # padding: 3 active cols at fanout 4 → one sentinel slot
+    layout2 = np.zeros((1, 2, 4), np.int64)
+    layout2[0, 0, :3] = 1
+    layout2[0, 1, 0] = 1
+    lut2, bits2, sent2 = build_row_union_lut(layout2, 2, 4)
+    assert lut2.shape == (1, 1, 4)
+    assert list(lut2[0, 0]) == [0, 1, 2, 4]   # sentinel-padded
+    assert bits2[0, 0, 0] == 0b11             # col 0: both rows
+    assert bits2[0, 0, 3] == 0
 
 
 def test_grouped_kernel_empty_rows_emit_zero():
