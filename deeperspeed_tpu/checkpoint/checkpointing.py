@@ -131,21 +131,16 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     return True
 
 
-def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
-                                   client_state, save_latest):
-    if jax.process_count() > 1:
-        # every process would race the same segment/opt file copies and
-        # the `latest` write; the NVMe store of record is per-process
-        # local state with no shard-merge story yet
-        raise NotImplementedError(
-            "streamed-NVMe checkpointing is single-process; "
-            "multi-process save on this tier is not supported")
+def _streamed_process_payload(engine, dst_dir):
+    """Copy THIS process's NVMe store of record (param segment files +
+    optimizer group files) into `dst_dir` and return the per-process
+    meta (segments, manifest, optimizer) describing them."""
     state = engine.state
     seg_names = [n for n, _ in engine._stream_plan.segments]
     engine._coord.synchronize_writes()
     for name in seg_names:
         shutil.copyfile(engine._coord.swapper._path(name),
-                        os.path.join(ckpt_dir, f"param_seg_{name}.swp"))
+                        os.path.join(dst_dir, f"param_seg_{name}.swp"))
     opt_meta = {"step": engine._host_opt.step_count,
                 "param_groups": [dict(g) for g in
                                  engine.optimizer.param_groups]}
@@ -154,7 +149,7 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
             for key in info:
                 shutil.copyfile(
                     engine._host_swapper._path(gid, key),
-                    os.path.join(ckpt_dir, f"opt_{gid}_{key}.swp"))
+                    os.path.join(dst_dir, f"opt_{gid}_{key}.swp"))
         opt_meta["group_info"] = dict(engine._host_swapper.group_info)
     else:
         # DRAM master tier (fits by definition): keep it in the shard
@@ -176,8 +171,7 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
         segment_layout[name] = [
             [int(gid), [int(x) for x in shape], str(np.dtype(dt))]
             for gid, (shape, dt) in zip(engine._seg_idx[name], specs)]
-    meta = {
-        "streamed_nvme": True,
+    return {
         "segments": seg_names,
         "param_manifest": {
             "leaf_paths": leaf_paths,
@@ -186,6 +180,64 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
             "segment_layout": segment_layout,
         },
         "optimizer": opt_meta,
+    }
+
+
+def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
+                                   client_state, save_latest):
+    state = engine.state
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        # Every process owns a local NVMe store of record — each writes
+        # its own shard directory (the reference's every-rank
+        # zero-checkpoint write, `engine.py:1810-1818`, with
+        # zero_pp_rank_* naming); process 0 writes the union manifest
+        # and `latest` after the barrier.
+        pidx = jax.process_index()
+        shard_dir = os.path.join(ckpt_dir,
+                                 f"zero_pp_rank_{pidx}_mp_rank_00")
+        os.makedirs(shard_dir, exist_ok=True)
+        payload = _streamed_process_payload(engine, shard_dir)
+        save_obj(payload, os.path.join(shard_dir, "streamed_states.pt"),
+                 all_ranks=True)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("deeperspeed_streamed_save")
+        if pidx == 0:
+            meta = {
+                "streamed_nvme": True,
+                "process_count": n_proc,
+                "global_steps": engine.global_steps,
+                "global_samples": engine.global_samples,
+                "skipped_steps": engine.skipped_steps,
+                "micro_steps": engine.micro_steps,
+                "loss_scale_state": {
+                    "cur_scale": float(state.scale.cur_scale),
+                    "cur_iter": int(state.scale.cur_iter),
+                    "last_overflow_iter": int(
+                        state.scale.last_overflow_iter),
+                    "cur_hysteresis": int(state.scale.cur_hysteresis),
+                },
+                "lr_scheduler": (engine.lr_scheduler.state_dict()
+                                 if engine.lr_scheduler is not None
+                                 else None),
+                "ds_version": "0.3.15+tpu",
+            }
+            meta.update(client_state)
+            save_obj(meta, os.path.join(ckpt_dir, _model_states_name(0)))
+            if save_latest:
+                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                    f.write(str(tag))
+        multihost_utils.sync_global_devices("deeperspeed_streamed_save2")
+        log_dist(f"Saved streamed-NVMe checkpoint {tag} to {ckpt_dir} "
+                 f"({n_proc} process shards)", ranks=[0])
+        return True
+
+    payload = _streamed_process_payload(engine, ckpt_dir)
+    meta = {
+        "streamed_nvme": True,
+        "segments": payload["segments"],
+        "param_manifest": payload["param_manifest"],
+        "optimizer": payload["optimizer"],
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
         "skipped_steps": engine.skipped_steps,
@@ -211,7 +263,27 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
 
 
 def _load_streamed_nvme_checkpoint(engine, ckpt_dir, meta):
-    """Restore by streaming files back into the engine's NVMe store."""
+    """Restore by streaming files back into the engine's NVMe store.
+
+    Multi-process checkpoints (per-process `zero_pp_rank_*` shard dirs)
+    restore process-locally: each process reads back exactly the store
+    it wrote. Elastic re-slicing is not supported on this tier — the
+    NVMe store of record is process-local by construction."""
+    saved_procs = int(meta.get("process_count", 1))
+    if saved_procs > 1:
+        if saved_procs != jax.process_count():
+            raise RuntimeError(
+                f"streamed-NVMe checkpoint was saved by {saved_procs} "
+                f"processes but {jax.process_count()} are running; "
+                "elastic resume is not supported on this tier (restore "
+                "with the saving process count, then re-save)")
+        shard_dir = os.path.join(
+            ckpt_dir, f"zero_pp_rank_{jax.process_index()}_mp_rank_00")
+        payload = load_obj(os.path.join(shard_dir, "streamed_states.pt"))
+        counters = dict(meta)
+        counters.pop("process_count")   # shard payload is single-process
+        counters.update(payload)        # segments/manifest/optimizer
+        return _load_streamed_nvme_checkpoint(engine, shard_dir, counters)
     for name in meta["segments"]:
         shutil.copyfile(os.path.join(ckpt_dir, f"param_seg_{name}.swp"),
                         engine._coord.swapper._path(name))
@@ -249,7 +321,8 @@ def _load_streamed_nvme_checkpoint(engine, ckpt_dir, meta):
         skipped_steps=jnp.asarray(engine.skipped_steps, jnp.int32))
     client_state = {k: v for k, v in meta.items()
                     if k not in ("streamed_nvme", "segments", "optimizer",
-                                 "loss_scale_state", "lr_scheduler")}
+                                 "loss_scale_state", "lr_scheduler",
+                                 "param_manifest", "process_count")}
     log_dist(f"Loaded streamed-NVMe checkpoint from {ckpt_dir}", ranks=[0])
     return ckpt_dir, client_state
 
@@ -444,8 +517,26 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
         elif model_state.get("optimizer"):
             opt_natural = engine.opt_layout_to_natural(
                 engine.state.opt_state)
-            opt_np = state_dict_to_tree(model_state["optimizer"]["state"],
-                                        like=opt_natural)
+            try:
+                opt_np = state_dict_to_tree(
+                    model_state["optimizer"]["state"], like=opt_natural)
+            except (KeyError, ValueError, TypeError) as e:
+                if getattr(engine.optimizer, "packed_transport", False):
+                    # layout break: packed_transport error-feedback state
+                    # changed from per-leaf trees to one flat
+                    # [world, wire_pad] buffer pair (round 4); old
+                    # checkpoints cannot restore onto the packed wire
+                    raise RuntimeError(
+                        "optimizer state restore failed and this engine "
+                        "runs a 1-bit optimizer with packed_transport: "
+                        "checkpoints saved before the packed-wire layout "
+                        "(error feedback as one flat [world, wire_pad] "
+                        "buffer pair) cannot be restored. Re-save the "
+                        "checkpoint with packed_transport disabled, or "
+                        "resume without optimizer states "
+                        f"(load_optimizer_states=False). Cause: {e}"
+                    ) from e
+                raise
             opt_state = engine.opt_natural_to_layout(
                 opt_np, engine.state.opt_state)
             engine.optimizer.param_groups = [

@@ -20,11 +20,14 @@ except Exception:  # pragma: no cover
     _HAVE_TORCH = False
 
 
-def save_obj(obj, path):
+def save_obj(obj, path, all_ranks=False):
     # Multi-process: every process computes the (collectively gathered)
     # state, but only process 0 touches the filesystem (reference
     # `engine.py` rank-0 save gating). Callers barrier afterwards.
-    if jax.process_index() != 0:
+    # all_ranks=True writes from EVERY process — for per-process shard
+    # files (the reference's every-rank zero-shard write,
+    # `engine.py:1810-1818`); the path must then be rank-unique.
+    if not all_ranks and jax.process_index() != 0:
         return
     if _HAVE_TORCH:
         torch.save(obj, path)

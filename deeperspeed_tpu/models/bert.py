@@ -48,6 +48,12 @@ class BertConfig:
     initializer_range: float = 0.02
     pre_layer_norm: bool = True      # reference kernels default preLN
     param_dtype: object = jnp.float32
+    # MLM logits rest in the activation dtype (bf16) by default: the
+    # [B, S, V] tensor is the program's largest and fp32 storage doubles
+    # its HBM cost. Each bf16 logit loses ~8 mantissa bits — a small
+    # systematic shift in loss/grads at vocab 30k. Set True for exact
+    # loss-curve parity with the reference's fp32 logits.
+    fp32_mlm_logits: bool = False
 
     @classmethod
     def base(cls, **kw):
@@ -248,14 +254,16 @@ class BertForPreTraining:
         t = _layer_norm(t, c["ln_scale"], c["ln_bias"], cfg.layernorm_eps)
         # decoder tied to word embeddings (reference modeling.py ties
         # cls.predictions.decoder.weight to word_embeddings.weight).
-        # Logits REST in the activation dtype — [B, S, V] is the largest
-        # tensor in the program and fp32 storage doubles its HBM cost;
-        # the loss upcasts inside its reductions (fp32 accumulation).
+        # Logits REST in the activation dtype (cfg.fp32_mlm_logits
+        # keeps them fp32 for loss-curve parity) — [B, S, V] is the
+        # largest tensor in the program and fp32 storage doubles its
+        # HBM cost; the loss upcasts inside its reductions anyway.
+        logit_dtype = jnp.float32 if cfg.fp32_mlm_logits else t.dtype
         mlm_logits = jnp.einsum(
             "bsh,vh->bsv", t,
             params["embeddings"]["word"].astype(t.dtype),
-            preferred_element_type=jnp.float32).astype(t.dtype) + \
-            c["decoder_bias"].astype(t.dtype)
+            preferred_element_type=jnp.float32).astype(logit_dtype) + \
+            c["decoder_bias"].astype(logit_dtype)
         pooled = self.bert.pool(params, seq)
         nsp_logits = pooled @ c["nsp_w"].astype(pooled.dtype) + \
             c["nsp_b"].astype(pooled.dtype)

@@ -22,6 +22,7 @@ norm → (tied or untied) output head.
 """
 
 import math
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional
@@ -56,6 +57,7 @@ class GPTNeoXConfig:
     moe_capacity_factor: float = 1.25
     moe_jitter_eps: float = 0.0
     moe_aux_loss_coef: float = 0.01
+    moe_num_groups: int = 0     # GShard G dim; 0 = auto-size groups
 
     @property
     def head_dim(self):
@@ -307,7 +309,8 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
             params["mlp"], ln2.reshape(B * S, h),
             capacity_factor=cfg.moe_capacity_factor,
             top_k=cfg.moe_top_k, rng=rng,
-            jitter_eps=cfg.moe_jitter_eps)
+            jitter_eps=cfg.moe_jitter_eps,
+            groups=getattr(cfg, "moe_num_groups", 0))
         moe_out = y.reshape(ln2.shape)
         if cfg.use_parallel_residual:
             return x + reduce_fn(attn_partial) + out_b + moe_out, aux
@@ -445,7 +448,7 @@ def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
     return logits
 
 
-def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=4096):
+def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=None):
     """Next-token cross entropy fused with the LM head, chunked over rows.
 
     Never materializes the full [B, S, V] fp32 logits (6 GB at
@@ -456,7 +459,13 @@ def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=4096):
     (`csrc/transformer/softmax_kernels.cu`), achieved as an XLA scan.
 
     x: [B, S, H] final-norm hidden states; wte: [V, H]; labels: [B, S].
+    chunk_rows tunes the scan tile (default 4096; DS_CE_CHUNK_ROWS env
+    overrides — a perf knob like the reference's gemm algo selection,
+    `csrc/includes/gemm_test.h`): bigger tiles amortize scan overhead,
+    smaller ones cap the [chunk, V] fp32 logits tile's HBM.
     """
+    if chunk_rows is None:
+        chunk_rows = int(os.environ.get("DS_CE_CHUNK_ROWS", "4096"))
     B, S, H = x.shape
     xs = x[:, :-1, :].reshape(-1, H)
     ts = labels[:, 1:].reshape(-1)
@@ -526,7 +535,8 @@ class GPTNeoX:
                 moe_top_k=moe["top_k"],
                 moe_capacity_factor=moe["capacity_factor"],
                 moe_jitter_eps=moe["jitter_eps"],
-                moe_aux_loss_coef=moe["aux_loss_coef"])
+                moe_aux_loss_coef=moe["aux_loss_coef"],
+                moe_num_groups=moe.get("num_groups", 0))
         sp = getattr(ds_config, "sequence_parallel_params", None)
         if sp:
             from ..parallel.sequence import SequenceParallel

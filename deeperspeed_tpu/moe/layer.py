@@ -12,6 +12,13 @@ design is the GShard/Switch pattern, TPU-first:
   residual itself). Static shapes — the dispatch is a dense [T, E, C]
   one-hot combine/dispatch pair, exactly the formulation GShard lowers
   to XLA.
+- **token groups** (`groups`): GShard's G dimension. Tokens split into
+  `g` independent routing groups with per-group capacity C/g, shrinking
+  the dispatch/combine tensors from O(T·E·C) to O(T·E·C/g) — the
+  ungrouped form OOMs a 16 GB chip at T=8k/H=768, the grouped form is
+  O(group_size) and stays pure einsum (MXU work, no scatter). `groups=1`
+  is the exact ungrouped oracle; `groups=0` ("auto") picks the smallest
+  divisor of T with group size ≤ 1024 (`_AUTO_GROUP_TOKENS`).
 - **expert parallelism**: experts shard over an ``expert`` mesh axis
   inside `shard_map`; token shards are exchanged with `all_to_all`
   (dispatch) and returned (combine), both riding ICI.
@@ -25,6 +32,39 @@ exactly (tested on the 8-device mesh).
 
 import jax
 import jax.numpy as jnp
+
+# auto-grouping target: the largest per-group token count. 1024 keeps the
+# per-layer dispatch+combine pair ≈ E·C·T·4B ≈ tens of MB at GPT scales
+# while each group is still large enough for balanced routing statistics.
+_AUTO_GROUP_TOKENS = 1024
+
+
+def _resolve_groups(groups, tokens):
+    """0/'auto' → the divisor of `tokens` whose group size is nearest
+    `_AUTO_GROUP_TOKENS` (never below 128: a token count with only tiny
+    divisors near the target — e.g. 2·1031 — would otherwise shrink
+    capacity to ~1 and silently drop routed tokens); otherwise validate
+    the explicit count."""
+    if groups in (0, None, "auto"):
+        best_g, best_cost = 1, abs(tokens - _AUTO_GROUP_TOKENS)
+        d = 1
+        while d * d <= tokens:
+            if tokens % d == 0:
+                for g in (d, tokens // d):
+                    size = tokens // g
+                    if size < 128:
+                        continue
+                    cost = abs(size - _AUTO_GROUP_TOKENS)
+                    if cost < best_cost or (cost == best_cost
+                                            and g > best_g):
+                        best_g, best_cost = g, cost
+            d += 1
+        return best_g
+    groups = int(groups)
+    if groups < 1 or tokens % groups:
+        raise ValueError(f"groups={groups} must be ≥1 and divide the "
+                         f"token count {tokens}")
+    return groups
 
 
 def _choice_dispatch(onehot, capacity, base_counts=None):
@@ -99,69 +139,94 @@ def _expert_ffn(w_in, b_in, w_out, b_out, x):
     return h @ w_out.astype(x.dtype) + b_out.astype(x.dtype)
 
 
+def _route_groups(gate, xg, capacity, top_k, rng, jitter_eps):
+    """Route each group independently: xg [g, Tg, H] →
+    (dispatch [g, Tg, E, C], combine [g, Tg, E, C], aux mean-over-groups).
+    Dispatch/combine are cast to the compute dtype — dispatch is exactly
+    0/1 (lossless); combine rounds like every other activation."""
+    logits = (xg @ gate.astype(xg.dtype)).astype(jnp.float32)
+    if rng is not None and jitter_eps > 0.0:
+        route = jax.vmap(lambda lg, r: _one_hot_dispatch(
+            lg, capacity, top_k=top_k, rng=r, jitter_eps=jitter_eps))
+        dispatch, combine, aux = route(logits,
+                                       jax.random.split(rng, xg.shape[0]))
+    else:
+        route = jax.vmap(lambda lg: _one_hot_dispatch(
+            lg, capacity, top_k=top_k))
+        dispatch, combine, aux = route(logits)
+    return (dispatch.astype(xg.dtype), combine.astype(xg.dtype),
+            jnp.mean(aux))
+
+
 def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
-                  jitter_eps=0.0):
+                  jitter_eps=0.0, groups=1):
     """Reference semantics on one device. params: stacked expert weights
     {"w_in" [E, H, I], "b_in" [E, I], "w_out" [E, I, H], "b_out" [E, H],
-    "gate" [H, E]}; x [T, H] → (y [T, H], aux_loss)."""
+    "gate" [H, E]}; x [T, H] → (y [T, H], aux_loss). `groups` splits the
+    tokens into independent routing groups (GShard's G dim) — capacity
+    becomes per-group, dispatch memory drops by the group factor."""
     T, H = x.shape
     E = params["w_in"].shape[0]
-    capacity = max(1, int(capacity_factor * top_k * T / E))
-    logits = (x @ params["gate"].astype(x.dtype)).astype(jnp.float32)
-    dispatch, combine, aux = _one_hot_dispatch(logits, capacity,
-                                               top_k=top_k, rng=rng,
-                                               jitter_eps=jitter_eps)
+    g = _resolve_groups(groups, T)
+    tg = T // g
+    capacity = max(1, int(capacity_factor * top_k * tg / E))
+    xg = x.reshape(g, tg, H)
+    dispatch, combine, aux = _route_groups(params["gate"], xg, capacity,
+                                           top_k, rng, jitter_eps)
 
-    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
+    expert_in = jnp.einsum("gtec,gth->egch", dispatch, xg)   # [E, g, C, H]
     expert_out = jax.vmap(_expert_ffn)(
         params["w_in"], params["b_in"], params["w_out"], params["b_out"],
-        expert_in)                                          # [E, C, H]
-    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
-    return y, aux
+        expert_in.reshape(E, g * capacity, H))              # [E, g*C, H]
+    y = jnp.einsum("gtec,egch->gth", combine,
+                   expert_out.reshape(E, g, capacity, H))
+    return y.reshape(T, H), aux
 
 
 def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25,
-                            top_k=1, rng=None, jitter_eps=0.0):
+                            top_k=1, rng=None, jitter_eps=0.0, groups=1):
     """Inside shard_map: x is this rank's token shard [T_local, H];
     params carry this rank's experts ({"w_in" [E/ep, H, I], ...}) with
     the gate replicated. all_to_all exchanges expert-major token blocks
     so each rank runs only its own experts; a second all_to_all returns
-    the outputs. Matches `moe_ffn_dense` run per-shard exactly."""
+    the outputs. Matches `moe_ffn_dense` run per-shard exactly (with the
+    same `groups`: capacity is per local routing group)."""
     T, H = x.shape
     e_local = params["w_in"].shape[0]
     E = e_local * ep
-    capacity = max(1, int(capacity_factor * top_k * T / E))
-    logits = (x @ params["gate"].astype(x.dtype)).astype(jnp.float32)
+    g = _resolve_groups(groups, T)
+    tg = T // g
+    capacity = max(1, int(capacity_factor * top_k * tg / E))
     if rng is not None:
         # decorrelate jitter across ranks: a replicated key would give
         # every rank's tokens identical noise (1/ep of the exploration)
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
-    dispatch, combine, aux = _one_hot_dispatch(logits, capacity,
-                                               top_k=top_k, rng=rng,
-                                               jitter_eps=jitter_eps)
+    xg = x.reshape(g, tg, H)
+    dispatch, combine, aux = _route_groups(params["gate"], xg, capacity,
+                                           top_k, rng, jitter_eps)
 
-    # [T, E, C] → [E, C, H] expert-major buffers, then exchange:
-    # split E = ep × e_local; all_to_all gives [ep, e_local, C, H] where
-    # dim 0 is the source rank.
-    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
-    expert_in = expert_in.reshape(ep, e_local, capacity, H)
+    # [g, Tg, E, C] → [E, g·C, H] expert-major buffers, then exchange:
+    # split E = ep × e_local; all_to_all gives [ep, e_local, g·C, H]
+    # where dim 0 is the source rank.
+    expert_in = jnp.einsum("gtec,gth->egch", dispatch, xg)
+    expert_in = expert_in.reshape(ep, e_local, g * capacity, H)
     expert_in = jax.lax.all_to_all(expert_in, axis_name, 0, 0,
-                                   tiled=False)             # [ep, eL, C, H]
+                                   tiled=False)          # [ep, eL, g·C, H]
 
     flat_in = jnp.moveaxis(expert_in, 0, 1).reshape(
-        e_local, ep * capacity, H)
+        e_local, ep * g * capacity, H)
     expert_out = jax.vmap(_expert_ffn)(
         params["w_in"], params["b_in"], params["w_out"], params["b_out"],
-        flat_in)                                            # [eL, ep*C, H]
+        flat_in)                                         # [eL, ep·g·C, H]
     expert_out = jnp.moveaxis(
-        expert_out.reshape(e_local, ep, capacity, H), 1, 0)
+        expert_out.reshape(e_local, ep, g * capacity, H), 1, 0)
 
     expert_out = jax.lax.all_to_all(expert_out, axis_name, 0, 0,
-                                    tiled=False)            # [ep, eL, C, H]
-    expert_out = expert_out.reshape(E, capacity, H)
-    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+                                    tiled=False)         # [ep, eL, g·C, H]
+    expert_out = expert_out.reshape(E, g, capacity, H)
+    y = jnp.einsum("gtec,egch->gth", combine, expert_out)
     # aux is per-shard; average over the expert(-data) axis
-    return y, jax.lax.pmean(aux, axis_name)
+    return y.reshape(T, H), jax.lax.pmean(aux, axis_name)
 
 
 class MoELayer:
@@ -170,13 +235,15 @@ class MoELayer:
 
     def __init__(self, hidden_size, intermediate_size, num_experts,
                  capacity_factor=1.25, mesh=None, axis_name="expert",
-                 param_dtype=jnp.float32, top_k=1, jitter_eps=0.0):
+                 param_dtype=jnp.float32, top_k=1, jitter_eps=0.0,
+                 groups=1):
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.top_k = top_k          # 1 = Switch, 2 = GShard default
         self.jitter_eps = jitter_eps
+        self.groups = groups        # 0 = auto (per-call token count)
         self.axis_name = axis_name
         self.ep = int(mesh.shape[axis_name]) \
             if mesh is not None and axis_name in mesh.axis_names else 1
@@ -211,7 +278,7 @@ class MoELayer:
         flat = x.reshape(-1, self.hidden_size)
         kw = dict(capacity_factor=self.capacity_factor, top_k=self.top_k,
                   rng=rng, jitter_eps=self.jitter_eps if rng is not None
-                  else 0.0)
+                  else 0.0, groups=self.groups)
         if self.ep > 1:
             y, aux = moe_ffn_expert_parallel(
                 params, flat, self.axis_name, self.ep, **kw)

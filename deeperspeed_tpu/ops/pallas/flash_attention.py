@@ -905,6 +905,7 @@ def flash_attention_train(q, k, v, kbias, seed, causal=False,
     denominator sums the undropped probabilities and survivors scale by
     1/keep. kbias and seed receive zero cotangents.
     """
+    _check_dropout_rate(dropout_rate)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     kb3 = None if kbias is None else \
         kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)
@@ -913,8 +914,17 @@ def flash_attention_train(q, k, v, kbias, seed, causal=False,
     return out
 
 
+def _check_dropout_rate(rate):
+    """The survivor scale 1/(1-rate) is meaningless at rate >= 1 (inf/
+    NaN outputs rather than an error) and negative rates silently keep
+    everything — reject both at the entry point."""
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {rate}")
+
+
 def _flash_train_fwd(q, k, v, kbias, seed, causal, sm_scale, block_q,
                      block_k, dropout_rate):
+    _check_dropout_rate(dropout_rate)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     kb3 = None if kbias is None else \
         kbias.astype(jnp.float32).reshape(kbias.shape[0], 1, -1)

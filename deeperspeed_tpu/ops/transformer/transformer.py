@@ -131,6 +131,12 @@ class DeepSpeedTransformerLayer:
     layer_id = 0
 
     def __init__(self, config, initial_weights=None, initial_biases=None):
+        for name in ("attn_dropout_ratio", "hidden_dropout_ratio"):
+            rate = getattr(config, name, -1)
+            # -1/negative = unset (reference default); >= 1 would make
+            # the survivor scale 1/(1-rate) inf/NaN instead of erroring
+            if rate >= 1.0:
+                raise ValueError(f"{name} must be < 1.0, got {rate}")
         self.config = config
         self.config.layer_id = DeepSpeedTransformerLayer.layer_id
         DeepSpeedTransformerLayer.layer_id += 1

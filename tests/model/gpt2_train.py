@@ -1,0 +1,87 @@
+"""Minimal Megatron-GPT2-style training driver for the model-level test
+harness (reference: `tests/model/Megatron_GPT2/` drives pretrain scripts
+whose stdout carries per-step ``LM loss`` lines; the test scripts grep
+and compare them, `run_checkpoint_test.py:24-40`).
+
+Prints one ``LM loss: <float>`` line per step — the contract
+`run_func_test.py` / `run_checkpoint_test.py` grep against. Determinism:
+fixed seeds, fixed synthetic batches.
+
+Usage:
+    python tests/model/gpt2_train.py --ds-config '{"zero_optimization":...}'
+        [--steps N] [--model gpt2|gpt_neox] [--save DIR] [--load DIR]
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--ds-config", default="{}",
+                   help="JSON overrides merged into the base config "
+                        "(or @path to a json file)")
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--model", choices=("gpt2", "gpt_neox"), default="gpt2")
+    p.add_argument("--save", default=None, help="save checkpoint here "
+                                                "after the run")
+    p.add_argument("--load", default=None, help="resume from checkpoint "
+                                                "before the run")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    overrides = args.ds_config
+    if overrides.startswith("@"):
+        with open(overrides[1:]) as f:
+            overrides = f.read()
+    overrides = json.loads(overrides)
+
+    import jax
+    import numpy as np
+
+    import deeperspeed_tpu
+
+    if args.model == "gpt2":
+        from deeperspeed_tpu.models import GPT2 as Model
+        from deeperspeed_tpu.models import GPT2Config as Config
+    else:
+        from deeperspeed_tpu.models import GPTNeoX as Model
+        from deeperspeed_tpu.models import GPTNeoXConfig as Config
+
+    cfg = Config.tiny()
+    model = Model(cfg, use_pallas=False)
+    config = {"train_batch_size": 16, "steps_per_print": 100_000,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    config.update(overrides)
+    gas = config.get("gradient_accumulation_steps", 1)
+
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(args.seed)),
+        config_params=config, rng=jax.random.PRNGKey(args.seed))
+
+    if args.load:
+        path, _ = engine.load_checkpoint(args.load)
+        if path is None:
+            print("ERROR: no checkpoint found", file=sys.stderr)
+            return 1
+
+    # fixed batch cycle (memorizable; the reference func tests likewise
+    # compare losses on identical data between baseline and test runs)
+    rng = np.random.default_rng(1)
+    batches = [rng.integers(0, cfg.vocab_size, (gas, 16 // gas, 32),
+                            np.int32) for _ in range(4)]
+    start = engine.global_steps
+    for i in range(args.steps):
+        b = batches[(start + i) % len(batches)]
+        loss = float(engine.train_batch(batch=(b, b)))
+        print(f"LM loss: {loss:.6f}", flush=True)
+
+    if args.save:
+        engine.save_checkpoint(args.save)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Model-level checkpoint test (reference:
+`tests/model/Megatron_GPT2/run_checkpoint_test.py:24-40` — train,
+checkpoint, resume in a FRESH process, and compare the grepped
+``LM loss`` trajectories of the resumed run against an uninterrupted
+one).
+
+Usage: PYTHONPATH=. python tests/model/run_checkpoint_test.py
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_func_test import CONFIGS, close, run_train  # noqa: E402
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=4,
+                        help="steps before AND after the checkpoint")
+    parser.add_argument("--config", default="zero2",
+                        choices=sorted(CONFIGS))
+    args = parser.parse_args(argv)
+    overrides = CONFIGS[args.config]
+
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "ckpt")
+        # uninterrupted 2N-step reference
+        full = run_train(overrides, 2 * args.steps)
+        # N steps + save
+        first = run_train(overrides, args.steps,
+                          extra_args=("--save", ckpt))
+        # fresh process: load + N more steps
+        second = run_train(overrides, args.steps,
+                           extra_args=("--load", ckpt))
+
+    if not close(first, full[:args.steps], 2e-4):
+        print(f"  FAIL  pre-save diverges: {first} vs "
+              f"{full[:args.steps]}")
+        failures.append("pre-save")
+    if not close(second, full[args.steps:], 2e-4):
+        print(f"  FAIL  resumed run diverges: {second} vs "
+              f"{full[args.steps:]}")
+        failures.append("resume")
+
+    if failures:
+        print(f"FAILURES: {failures}")
+        return 1
+    print(f"CHECKPOINT TEST PASSES ({args.config}: "
+          f"{full[0]:.4f} -> {full[-1]:.4f}, resume exact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Model-level functional test matrix (reference:
+`tests/model/Megatron_GPT2/run_func_test.py` — runs the pretrain script
+per ds_config, greps ``LM loss`` from the logs, and checks approximate
+equality between the baseline and test runs).
+
+Each config runs `gpt2_train.py` in its OWN subprocess (the reference
+launches fresh training processes per config); the parent greps the
+``LM loss:`` lines, compares every config against the in-run baseline,
+and also validates the baseline itself against the COMMITTED trajectory
+in `baselines.json` (guards cross-round numerical drift — tolerance is
+loose enough for BLAS reassociation, tight enough to catch math bugs).
+
+Usage: PYTHONPATH=. python tests/model/run_func_test.py [--steps N]
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+CONFIGS = {
+    "baseline": {},
+    "zero1": {"zero_optimization": {"stage": 1}},
+    "zero2": {"zero_optimization": {"stage": 2}},
+    "zero3": {"zero_optimization": {"stage": 3}},
+    "gas2": {"gradient_accumulation_steps": 2},
+    "zero2-offload": {"zero_optimization": {
+        "stage": 2, "offload_optimizer": {"device": "cpu"}}},
+}
+# pure-device re-shardings of the same math: must match to fp32 noise
+EXACT = {"zero1", "zero2", "zero3", "gas2"}
+CLOSE = {"zero2-offload": 5e-4}   # native C++ host Adam rounds differently
+
+
+def grep_lm_loss(text):
+    """The reference's log-grep contract (`run_checkpoint_test.py:24-40`:
+    grep "LM loss" → float column)."""
+    return [float(m.group(1))
+            for m in re.finditer(r"^LM loss:\s*([\d.eE+-]+)", text,
+                                 re.MULTILINE)]
+
+
+def run_train(args, steps, extra_args=()):
+    cmd = [sys.executable, os.path.join(HERE, "gpt2_train.py"),
+           "--ds-config", json.dumps(args), "--steps", str(steps),
+           *extra_args]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=420)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"training run failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}")
+    losses = grep_lm_loss(proc.stdout)
+    if len(losses) != steps:
+        raise RuntimeError(
+            f"expected {steps} 'LM loss' lines, got {len(losses)}:\n"
+            f"{proc.stdout[-2000:]}")
+    return losses
+
+
+def close(a, b, atol):
+    return all(abs(x - y) <= atol for x, y in zip(a, b)) and \
+        len(a) == len(b)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument("--update-baselines", action="store_true",
+                        help="rewrite baselines.json from this run")
+    args = parser.parse_args(argv)
+
+    failures = []
+    results = {}
+    for name, overrides in CONFIGS.items():
+        try:
+            results[name] = run_train(overrides, args.steps)
+            print(f"  ran   {name}: {results[name][0]:.4f} -> "
+                  f"{results[name][-1]:.4f}")
+        except Exception as e:  # noqa: BLE001 - report the whole matrix
+            print(f"  FAIL  {name}: {e}")
+            failures.append(name)
+
+    baseline = results.get("baseline")
+    if baseline is None:
+        print("FAILURES: baseline did not run")
+        return 1
+    if baseline[-1] >= baseline[0]:
+        print("  FAIL  baseline loss did not decrease")
+        failures.append("baseline")
+
+    for name in CONFIGS:
+        if name == "baseline" or name not in results:
+            continue
+        tol = CLOSE.get(name, 2e-4 if name in EXACT else None)
+        if tol is None:
+            continue
+        if close(results[name], baseline, tol):
+            print(f"  ok    {name} == baseline (atol {tol})")
+        else:
+            print(f"  FAIL  {name} diverges from baseline: "
+                  f"{results[name]} vs {baseline}")
+            failures.append(name)
+
+    # committed-trajectory check (cross-round drift guard)
+    baseline_path = os.path.join(HERE, "baselines.json")
+    if args.update_baselines:
+        with open(baseline_path, "w") as f:
+            json.dump({"gpt2_tiny_baseline": baseline}, f, indent=1)
+        print(f"  wrote {baseline_path}")
+    elif os.path.isfile(baseline_path):
+        with open(baseline_path) as f:
+            committed = json.load(f)["gpt2_tiny_baseline"]
+        n = min(len(committed), len(baseline))
+        if close(baseline[:n], committed[:n], 1e-3):
+            print("  ok    baseline matches committed trajectory")
+        else:
+            print(f"  FAIL  baseline drifted from committed: "
+                  f"{baseline[:n]} vs {committed[:n]}")
+            failures.append("committed-baseline")
+
+    if failures:
+        print(f"FAILURES: {sorted(set(failures))}")
+        return 1
+    print("ALL FUNC TESTS PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

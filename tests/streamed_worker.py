@@ -1,0 +1,75 @@
+"""Worker for the multi-process streamed-NVMe checkpoint test
+(test_multiprocess.py): two real processes train a param-offload
+(NVMe store-of-record) engine, save a checkpoint (per-process
+zero_pp_rank_* shard dirs + union manifest), then restore into a FRESH
+engine and verify the training trajectory continues identically.
+
+Reference behavior being matched: every-rank zero-checkpoint write
+(`deepspeed/runtime/engine.py:1810-1818`)."""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    workdir = sys.argv[3]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=2, process_id=pid)
+
+    import numpy as np
+
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    def make_engine(tag):
+        nvme = os.path.join(workdir, f"nvme_{tag}_p{pid}")
+        os.makedirs(nvme, exist_ok=True)
+        model = GPTNeoX(GPTNeoXConfig.tiny(), use_pallas=False)
+        params = model.init_params(jax.random.PRNGKey(0))
+        engine, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config_params={
+                "train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000,
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_optimizer": {"device": "cpu"},
+                    "offload_param": {"device": "nvme",
+                                      "nvme_path": nvme}},
+            }, dist_init_required=False)
+        return engine
+
+    V = 256
+    rng = np.random.default_rng(7)
+    batches = [rng.integers(0, V, (1, 8, 32), np.int32) for _ in range(3)]
+
+    engine = make_engine("a")
+    losses = [float(engine.train_batch(batch=(b, b))) for b in batches[:2]]
+    engine.save_checkpoint(ckpt_dir, tag="step2")
+    cont = float(engine.train_batch(batch=(batches[2], batches[2])))
+
+    # fresh engine (different init path irrelevant — state is restored)
+    engine2 = make_engine("b")
+    path, _ = engine2.load_checkpoint(ckpt_dir, tag="step2")
+    assert path is not None, "restore returned no checkpoint"
+    resumed = float(engine2.train_batch(batch=(batches[2], batches[2])))
+
+    print("WORKER_RESULT " + json.dumps({
+        "pid": pid, "losses": losses, "cont": cont, "resumed": resumed}))
+
+
+if __name__ == "__main__":
+    main()

@@ -283,3 +283,79 @@ def test_moe_pipeline_json_config_guarded(devices):
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
                 "moe": {"num_experts": 4},
             }, rng=jax.random.PRNGKey(0))
+
+
+# --- grouped dispatch (GShard G dim; VERDICT round-4 #5) ------------------
+
+def test_grouped_dense_matches_ungrouped_with_ample_capacity():
+    """With non-binding capacity, grouping only changes bookkeeping:
+    every token still reaches its top-k experts with the same combine
+    weights, so grouped == ungrouped output."""
+    params = _params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(6), (32, H), jnp.float32)
+    y1, aux1 = moe_ffn_dense(params, x, capacity_factor=float(E),
+                             top_k=2, groups=1)
+    y4, aux4 = moe_ffn_dense(params, x, capacity_factor=float(E),
+                             top_k=2, groups=4)
+    np.testing.assert_allclose(np.asarray(y4), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+    # aux statistics are per-group means of the same assignment counts
+    assert np.isfinite(float(aux4))
+
+
+def test_grouped_capacity_is_per_group():
+    """groups=T makes every token its own group with capacity ≥ 1:
+    nothing can overflow even at tiny capacity_factor (the degenerate
+    proof that capacity became per-group)."""
+    params = _params(jax.random.PRNGKey(0))
+    params["gate"] = jnp.zeros_like(params["gate"]).at[:, 0].set(1.0)
+    x = jnp.ones((8, H), jnp.float32)
+    # ungrouped with capacity 1 drops 7 of 8 tokens (proved elsewhere);
+    # fully grouped keeps them all
+    y, _ = moe_ffn_dense(params, x, capacity_factor=E / 8, groups=8)
+    norms = np.linalg.norm(np.asarray(y), axis=-1)
+    assert np.all(norms > 1e-3)
+
+
+def test_groups_must_divide_tokens():
+    params = _params(jax.random.PRNGKey(0))
+    x = jnp.ones((10, H), jnp.float32)
+    with pytest.raises(ValueError):
+        moe_ffn_dense(params, x, groups=3)
+
+
+def test_auto_groups_picks_divisor():
+    from deeperspeed_tpu.moe.layer import _resolve_groups
+    assert _resolve_groups(0, 512) == 1
+    assert _resolve_groups(0, 4096) == 4
+    assert _resolve_groups("auto", 3 * 1024) == 3
+    # non-power-of-two token counts still get a divisor near the target
+    g = _resolve_groups(0, 6000)
+    assert 6000 % g == 0 and 128 <= 6000 // g <= 2048
+    # awkward factorizations never produce tiny groups (2062 = 2*1031:
+    # group size 1031, NOT 2 — tiny groups shrink capacity to ~1 and
+    # silently drop routed tokens)
+    assert _resolve_groups(0, 2062) == 2
+    assert _resolve_groups(0, 127) == 1   # below the floor: one group
+
+
+def test_grouped_expert_parallel_matches_grouped_dense(devices):
+    """EP with groups == per-shard grouped dense routing."""
+    ep = 4
+    mesh = Mesh(np.asarray(devices[:ep]), ("expert",))
+    layer = MoELayer(H, I, E, mesh=mesh, top_k=2, groups=2)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(7), (ep * 8, H), jnp.float32)
+
+    refs = [moe_ffn_dense(params, x[r * 8:(r + 1) * 8], top_k=2,
+                          groups=2)[0] for r in range(ep)]
+    ref = jnp.concatenate(refs, axis=0)
+
+    mapped = shard_map(
+        lambda p, x: moe_ffn_expert_parallel(p, x, "expert", ep, top_k=2,
+                                             groups=2),
+        mesh=mesh, in_specs=(layer.param_specs(), P("expert")),
+        out_specs=(P("expert"), P()), check_vma=False)
+    y, _ = jax.jit(mapped)(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
