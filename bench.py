@@ -36,7 +36,7 @@ import time
 import numpy as np
 
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
-ROW_TIMEOUT = {"gpt2xl": 540, "longseq": 480}
+ROW_TIMEOUT = {"gpt2xl": 800, "longseq": 600}
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -238,18 +238,37 @@ def row_gpt2xl():
     from deeperspeed_tpu.models.gpt2 import GPT2, GPT2Config
     xcfg = GPT2Config.megatron_1_5b()
 
-    def run(bs_per_chip, zero_cfg, steps=2, warmup=1):
+    def run(bs_per_chip, zero_cfg, steps=2, warmup=1, lean_state=False):
         def thunk():
-            xmodel = GPT2(xcfg, use_pallas=True, remat_blocks=True)
-            xparams = xmodel.init_params(jax.random.PRNGKey(3))
+            # scan_blocks: one compiled block body instead of 48 —
+            # the unrolled 48-layer remat program took ~20 min of XLA
+            # compile; the scanned one compiles in normal time
+            xmodel = GPT2(xcfg, use_pallas=True, remat_blocks=True,
+                          scan_blocks=True)
+            # init on the HOST cpu backend: the host-offload tier reads
+            # fp32 masters host-side anyway — initializing on the chip
+            # would round-trip 6.2 GB back over the (slow, tunneled)
+            # link and transiently double fp32 HBM
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                xparams = xmodel.init_params(jax.random.PRNGKey(3))
+            xparams = jax.tree_util.tree_map(np.asarray, xparams)
             bs = bs_per_chip * n_chips
+            fp16_cfg = {"enabled": True, "type": "bfloat16"}
+            opt_params = {"lr": 1e-4}
+            if lean_state:
+                # all-on-chip 1.5B: params-as-masters + bf16 moments
+                # (~12.4 GB state vs 24.9 GB classic) — the tunneled
+                # host link (~5 MB/s device→host measured) rules the
+                # ZeRO-Offload tier out for per-step traffic here
+                fp16_cfg["fp16_master_weights_and_grads"] = True
+                opt_params["state_dtype"] = "bfloat16"
             eng, *_ = deeperspeed_tpu.initialize(
                 model=xmodel, model_parameters=xparams,
                 config_params={
                     "train_batch_size": bs,
                     "steps_per_print": 10_000,
-                    "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-                    "fp16": {"enabled": True, "type": "bfloat16"},
+                    "optimizer": {"type": "Adam", "params": opt_params},
+                    "fp16": fp16_cfg,
                     "zero_optimization": zero_cfg,
                 })
             del xparams
@@ -274,10 +293,14 @@ def row_gpt2xl():
         return thunk
 
     host_opt = {"stage": 3, "offload_optimizer": {"device": "cpu"}}
-    bs0 = int(os.environ.get("DS_BENCH_XL_BS", "8"))
-    ladder_bs = [bs0] + [b for b in (4, 2) if b < bs0]
-    return _ladder([(f"z3_hostopt_bs{b}", run(b, host_opt))
-                    for b in ladder_bs], {}, "gpt2_xl_1p5b")
+    bs0 = int(os.environ.get("DS_BENCH_XL_BS", "4"))
+    ladder = [(f"onchip_lean_bs{b}", run(b, {"stage": 0}, steps=3,
+                                         warmup=2, lean_state=True))
+              for b in [bs0] + [b for b in (2,) if b < bs0]]
+    # ZeRO-Offload rung last: the reference path (13B-on-one-GPU tier),
+    # viable where the host link is PCIe — not over a 5 MB/s tunnel
+    ladder.append(("z3_hostopt_bs2", run(2, host_opt)))
+    return _ladder(ladder, {}, "gpt2_xl_1p5b")
 
 
 def row_longseq():

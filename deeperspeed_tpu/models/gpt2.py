@@ -120,24 +120,41 @@ def block_forward(cfg, params, x, use_pallas=True):
 
 
 def forward_hidden(cfg, params, tokens, use_pallas=True,
-                   remat_blocks=False):
-    """tokens [B, S] → final-norm hidden [B, S, H]."""
+                   remat_blocks=False, scan_blocks=False):
+    """tokens [B, S] → final-norm hidden [B, S, H].
+
+    `scan_blocks` runs the (identically-shaped) blocks as ONE
+    `lax.scan` over stacked parameters instead of a Python loop: the
+    compiled program contains a single block body, so XLA compile time
+    is O(1) in depth rather than O(L) — at GPT2-XL's 48 layers the
+    unrolled remat program took ~20 min to compile on a v5e, the
+    scanned one seconds. The stack is built inside the traced function;
+    grads flow back through it to the natural per-block list layout, so
+    engine state/checkpoints are unchanged."""
     S = tokens.shape[1]
     x = params["embed"]["wte"][tokens] + \
         params["embed"]["wpe"][:S][None]
     block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
     if remat_blocks:
         block_fn = jax.checkpoint(block_fn)
-    for bp in params["blocks"]:
-        x = block_fn(bp, x)
+    if scan_blocks and len(params["blocks"]) > 1:
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *params["blocks"])
+        x = jax.lax.scan(
+            lambda carry, bp: (block_fn(bp, carry), None),
+            x, stacked)[0]
+    else:
+        for bp in params["blocks"]:
+            x = block_fn(bp, x)
     return layer_norm(x, params["final_ln"]["scale"],
                       params["final_ln"]["bias"], cfg.layernorm_eps)
 
 
-def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False):
+def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False,
+            scan_blocks=False):
     """tokens [B, S] → logits [B, S, V] (tied embeddings)."""
     x = forward_hidden(cfg, params, tokens, use_pallas=use_pallas,
-                       remat_blocks=remat_blocks)
+                       remat_blocks=remat_blocks, scan_blocks=scan_blocks)
     return jnp.einsum("bsh,vh->bsv", x,
                       params["embed"]["wte"].astype(x.dtype),
                       preferred_element_type=jnp.float32)
@@ -159,10 +176,11 @@ class GPT2:
     """Engine-protocol wrapper: loss_fn / init_params / param_specs."""
 
     def __init__(self, config=None, use_pallas=True, remat_blocks=False,
-                 **kwargs):
+                 scan_blocks=False, **kwargs):
         self.config = config or GPT2Config(**kwargs)
         self.use_pallas = use_pallas
         self.remat_blocks = remat_blocks
+        self.scan_blocks = scan_blocks
 
     def init_params(self, rng):
         return init_params(self.config, rng)
@@ -176,12 +194,14 @@ class GPT2:
     def apply(self, params, tokens):
         return forward(self.config, params, tokens,
                        use_pallas=self.use_pallas,
-                       remat_blocks=self.remat_blocks)
+                       remat_blocks=self.remat_blocks,
+                       scan_blocks=self.scan_blocks)
 
     def loss_fn(self, params, batch, rng=None):
         tokens, labels = batch if isinstance(batch, (tuple, list)) \
             else (batch, batch)
         hidden = forward_hidden(self.config, params, tokens,
                                 use_pallas=self.use_pallas,
-                                remat_blocks=self.remat_blocks)
+                                remat_blocks=self.remat_blocks,
+                                scan_blocks=self.scan_blocks)
         return fused_lm_head_loss(hidden, params["embed"]["wte"], labels)

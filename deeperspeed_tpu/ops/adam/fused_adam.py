@@ -25,21 +25,22 @@ class AdamState(NamedTuple):
     exp_avg_sq: object    # pytree like params (fp32)
 
 
-def _tree_zeros_like_f32(params):
-    return jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), params)
-
-
 class FusedAdam:
     """Adam / AdamW ("adam_w_mode") with optional bias correction."""
 
     def __init__(self, params=None, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
-                 weight_decay=0.0, amsgrad=False, set_grad_none=True):
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True,
+                 state_dtype="float32"):
         if amsgrad:
             raise ValueError("FusedAdam does not support amsgrad "
                              "(reference parity: fused_adam.py:47)")
         self.adam_w_mode = adam_w_mode
+        # TPU-native extension beyond the reference: moments may REST in
+        # bfloat16 (math still runs fp32 per step). Halves optimizer
+        # bytes — with fp16_master_weights_and_grads it brings a 1.5B
+        # model's full training state inside a 16 GB chip.
+        self.state_dtype = jax.dtypes.canonicalize_dtype(state_dtype)
         self.param_groups = [{
             "lr": lr,
             "betas": tuple(betas),
@@ -52,10 +53,12 @@ class FusedAdam:
     # -- pure functional core (jit-safe) ----------------------------------
 
     def init_state(self, master_params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, self.state_dtype), master_params)
         return AdamState(
             step=jnp.asarray(0, jnp.int32),
-            exp_avg=_tree_zeros_like_f32(master_params),
-            exp_avg_sq=_tree_zeros_like_f32(master_params),
+            exp_avg=zeros,
+            exp_avg_sq=jax.tree_util.tree_map(jnp.copy, zeros),
         )
 
     def update(self, grads, state, master_params, lr=None):
@@ -78,6 +81,9 @@ class FusedAdam:
         def leaf_update(p, g, m, v):
             g = g.astype(jnp.float32)
             p = p.astype(jnp.float32)
+            store = m.dtype   # moments rest in state_dtype, math in fp32
+            m = m.astype(jnp.float32)
+            v = v.astype(jnp.float32)
             if weight_decay != 0.0 and not self.adam_w_mode:
                 g = g + weight_decay * p  # classic L2
             m_new = beta1 * m + (1 - beta1) * g
@@ -85,7 +91,8 @@ class FusedAdam:
             update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
             if weight_decay != 0.0 and self.adam_w_mode:
                 update = update + weight_decay * p  # decoupled decay
-            return p - lr * update, m_new, v_new
+            return (p - lr * update, m_new.astype(store),
+                    v_new.astype(store))
 
         flat_p, treedef = jax.tree_util.tree_flatten(master_params)
         flat_g = treedef.flatten_up_to(grads)

@@ -99,6 +99,16 @@ def _layer_norm(x, scale, bias, eps):
             bias.astype(jnp.float32)).astype(x.dtype)
 
 
+def _flash_min_seq():
+    """Shortest sequence the fused flash kernels take over the
+    materialized-[B,H,S,S] XLA path. At short S the score tensor is
+    small and XLA's fused einsum+softmax beats the kernel's per-instance
+    fixed costs; at long S flash's O(S) memory wins. Tunable like the
+    reference's gemm algo selection (`csrc/includes/gemm_test.h`)."""
+    import os
+    return int(os.environ.get("DS_FLASH_MIN_SEQ", "0"))
+
+
 def _dropout(x, rate, rng, deterministic):
     """Hash-mask dropout: one scalar threefry draw seeds an int32
     avalanche hash over element indices (the reference generates masks
@@ -237,6 +247,7 @@ class DeepSpeedTransformerLayer:
         attn_drop_active = (not deterministic and
                             cfg.attn_dropout_ratio > 0 and rng is not None)
         if (additive_mask is None or kbias is not None) and \
+                s >= _flash_min_seq() and \
                 flash_attention_supported((b, s, heads, hd)):
             if attn_drop_active:
                 seed = jax.random.randint(rng, (1,), 0, 2**31 - 1,

@@ -274,6 +274,12 @@ class DeepSpeedConfig:
         # bf16/fp32 never need loss scaling even when configured.
         self.loss_scaling_enabled = (self.fp16_enabled
                                      and needs_loss_scaling(self.precision))
+        # Later-DeepSpeed key (forward-port): drop the separate fp32
+        # master copy — optimizer math upcasts from the compute-dtype
+        # params and stores back. Halves per-param bytes-at-rest; the
+        # memory knob that puts GPT2-XL's on-chip rung inside 16 GB.
+        self.fp16_master_weights_and_grads = bool(
+            fp16.get("fp16_master_weights_and_grads", False))
 
         amp = d.get(c.AMP) or {}
         self.amp_enabled = bool(amp.get(c.AMP_ENABLED, c.AMP_ENABLED_DEFAULT))

@@ -145,8 +145,22 @@ class DeepSpeedEngine:
 
         # --- precision / zero --------------------------------------------
         self.compute_dtype = self._config.precision
-        self.keep_master = (self.compute_dtype != jnp.float32
-                            or self.zero_optimization())
+        lean_master = getattr(self._config,
+                              "fp16_master_weights_and_grads", False)
+        if lean_master and self.zero_optimization():
+            raise DeepSpeedConfigError(
+                "fp16_master_weights_and_grads with ZeRO stages is not "
+                "supported: ZeRO shards the fp32 master layout; use "
+                "stage 0, or drop the flag")
+        if lean_master and self._config.zero_config.offload_optimizer \
+                is not None:
+            raise DeepSpeedConfigError(
+                "fp16_master_weights_and_grads is a device-state knob; "
+                "the host-offload tier keeps fp32 masters in DRAM by "
+                "design (drop the flag or the offload block)")
+        self.keep_master = ((self.compute_dtype != jnp.float32
+                             or self.zero_optimization())
+                            and not lean_master)
         self.zero_rules = ZeroShardingRules(
             stage=self._config.zero_optimization_stage,
             mesh=self.mesh,
@@ -628,6 +642,12 @@ class DeepSpeedEngine:
         only the optimizer/swapper shells are built here."""
         from ..ops.adam.cpu_adam_native import NativeCPUAdam
 
+        if np.dtype(getattr(self.optimizer, "state_dtype",
+                            np.float32)) != np.float32:
+            raise DeepSpeedConfigError(
+                "optimizer state_dtype is a device-state knob; the "
+                "host tier's native C++ Adam keeps fp32 moments in "
+                "DRAM (drop state_dtype or the offload block)")
         leaves, treedef = jax.tree_util.tree_flatten(model_parameters)
         self._host_treedef = treedef
         self._host_shapes = [l.shape for l in leaves]
@@ -656,9 +676,18 @@ class DeepSpeedEngine:
             self._host_state = None
             return
 
-        # np.array(copy=True), NOT ascontiguousarray: when dtype/layout
-        # already match, ascontiguousarray returns the SAME (read-only,
-        # jax-owned) buffer and the native Adam would write into it.
+        # Overlap the device→host pulls: start every leaf's DMA before
+        # the first blocking read (on a tunneled chip ~500 sequential
+        # per-leaf round trips cost minutes; async-then-read pipelines
+        # them). np.array(copy=True), NOT ascontiguousarray: when
+        # dtype/layout already match, ascontiguousarray returns the SAME
+        # (read-only, jax-owned) buffer and the native Adam would write
+        # into it.
+        for l in leaves:
+            try:
+                l.copy_to_host_async()
+            except AttributeError:   # numpy/host leaves
+                pass
         masters = [np.array(np.asarray(l).reshape(-1), np.float32)
                    for l in leaves]
         moments_m = [np.zeros(m.shape, np.float32) for m in masters]
@@ -706,6 +735,47 @@ class DeepSpeedEngine:
         if self.param_offload:
             return self._init_streamed_state(model_parameters)
 
+        if self.host_offload:
+            # Device holds ONLY compute params; masters/moments are host-
+            # resident (see _init_host_state). Build compute params
+            # straight from the inputs — materializing the fp32 master
+            # tree on device first would transiently DOUBLE the model's
+            # fp32 bytes in HBM (caller's init + master copy + bf16
+            # params ≈ 15.5 GB for GPT2-XL on a 16 GB chip: the round-4
+            # gpt2_xl bench OOM was exactly this).
+            # _param_padinfo is all-False under the offload tiers
+            # (_compute_shardings), so compute params always keep their
+            # natural shapes here — no flat-pad handling needed.
+            def make_param_direct(p, sh):
+                return jax.device_put(
+                    jnp.array(p, dtype=self.compute_dtype, copy=True), sh)
+
+            params = jax.tree_util.tree_map(
+                make_param_direct, model_parameters, self._param_sh)
+            return EngineState(params=params, master=None, opt_state=(),
+                               scale=self._make_scale_state(),
+                               global_steps=jnp.asarray(0, jnp.int32),
+                               skipped_steps=jnp.asarray(0, jnp.int32))
+
+        if not self.keep_master and self.compute_dtype != jnp.float32:
+            # fp16_master_weights_and_grads: params ARE the masters —
+            # no fp32 master tree ever exists on device (optimizer math
+            # still upcasts per-element). Halves at-rest param bytes.
+            # (flag × ZeRO / offload combinations rejected in __init__)
+            params = jax.tree_util.tree_map(
+                lambda p, sh: jax.device_put(
+                    jnp.array(p, dtype=self.compute_dtype, copy=True),
+                    sh),
+                model_parameters, self._param_sh)
+            opt_state = self.optimizer.init_state(params)
+            opt_state = _place_opt_state(opt_state, params,
+                                         self._master_sh, self.mesh)
+            return EngineState(
+                params=params, master=None, opt_state=opt_state,
+                scale=self._make_scale_state(),
+                global_steps=jnp.asarray(0, jnp.int32),
+                skipped_steps=jnp.asarray(0, jnp.int32))
+
         # copy=True: the engine's state buffers must never alias the
         # caller's arrays or each other — the jitted step donates state.
         # Ragged leaves: the master is stored flat-padded (see
@@ -731,14 +801,6 @@ class DeepSpeedEngine:
         params = jax.tree_util.tree_map(
             make_param, master, self._param_sh, self._padinfo,
             self._param_padinfo)
-
-        if self.host_offload:
-            # Device holds only compute params; masters/moments are host-
-            # resident (see _init_host_state).
-            return EngineState(params=params, master=None, opt_state=(),
-                               scale=self._make_scale_state(),
-                               global_steps=jnp.asarray(0, jnp.int32),
-                               skipped_steps=jnp.asarray(0, jnp.int32))
 
         opt_state = self.optimizer.init_state(master)
         # Moments follow master sharding; scalar fields stay replicated.

@@ -11,6 +11,10 @@ from deeperspeed_tpu.ops.autotune import (Autotuner, FLASH_BLOCK_CANDIDATES,
                                           autotune_enabled,
                                           tuned_flash_blocks)
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 
 def test_picks_fastest_and_caches():
     clock = {"t": 0.0}

@@ -13,6 +13,10 @@ from deeperspeed_tpu.models.bert import (BertConfig, BertModel,
                                          BertForQuestionAnswering,
                                          to_layer_specs)
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 
 def _pretrain_batch(cfg, bs=4, seq=32, seed=0):
     rng = np.random.default_rng(seed)

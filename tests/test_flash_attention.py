@@ -175,6 +175,10 @@ def test_kbias_bf16():
 
 from deeperspeed_tpu.ops.pallas.flash_attention import flash_attention_train
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 
 def _zeros_bias(b, s):
     return jnp.zeros((b, s), jnp.float32)

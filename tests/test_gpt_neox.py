@@ -14,6 +14,10 @@ from deeperspeed_tpu.parallel.mesh import build_mesh
 from deeperspeed_tpu.parallel.topology import ProcessTopology
 from deeperspeed_tpu.runtime.pipe import PipelineModule
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 CFG = gpt_neox.GPTNeoXConfig.tiny()
 
 

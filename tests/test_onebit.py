@@ -19,6 +19,10 @@ from deeperspeed_tpu.runtime.comm.compressed import (
     wire_pad)
 from deeperspeed_tpu.runtime.fp16.onebit import OnebitAdam, OnebitLamb
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 
 def params8():
     return {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 16),

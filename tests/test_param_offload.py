@@ -18,6 +18,10 @@ import deeperspeed_tpu
 from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
 from deeperspeed_tpu.runtime.config_utils import DeepSpeedConfigError
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 STEPS = 4
 
 

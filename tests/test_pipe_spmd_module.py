@@ -18,6 +18,10 @@ from tests.simple_model import (LinearLayer, mse_loss, random_batches,
                                 simple_pipeline_module,
                                 tied_pipeline_module)
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 DIM = 16
 
 

@@ -14,6 +14,10 @@ from deeperspeed_tpu.parallel.pipeline_spmd import (GPTNeoXPipeSPMD,
                                                     pipeline_loss_fn,
                                                     spmd_pipeline)
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 DIM = 16
 
 

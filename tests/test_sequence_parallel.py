@@ -12,6 +12,10 @@ from jax.sharding import Mesh
 
 from deeperspeed_tpu.parallel.sequence import SequenceParallel
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 B, S, H, D = 2, 64, 8, 16
 
 

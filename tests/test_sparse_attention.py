@@ -18,6 +18,10 @@ from deeperspeed_tpu.ops.sparse_attention import (
 from deeperspeed_tpu.ops.sparse_attention.sparse_self_attention import (
     dense_masked_attention, layout_to_token_mask)
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 BLOCK = 128
 SEQ = 512
 HEADS = 2

@@ -12,6 +12,10 @@ from deeperspeed_tpu.ops.sparse_attention import (MatMul, Softmax,
                                                   dense_to_sparse,
                                                   sparse_to_dense)
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 Z, H, BLOCK = 2, 3, 16
 NQ, NK = 4, 5
 

@@ -12,6 +12,10 @@ import jax.numpy as jnp
 from deeperspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
                                              DeepSpeedTransformerLayer)
 
+# heavy jit/training integration file: excluded from the <3-min fast lane
+# (run the full suite, or -m slow, to include it)
+pytestmark = pytest.mark.slow
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
