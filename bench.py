@@ -159,8 +159,10 @@ def row_zero3():
             tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
                                   dtype=np.int32)
             eng = _neox_engine(model, params, batch, {"stage": 3})
-            dt, _ = timed_steps(eng, (tokens, tokens), steps=8, warmup=4)
-            tps = batch * seq * 8 / dt / n_chips
+            steps = 12
+            dt, _ = timed_steps(eng, (tokens, tokens), steps=steps,
+                                warmup=4)
+            tps = batch * seq * steps / dt / n_chips
             return {"zero3_tokens_per_sec_chip": round(tps, 1),
                     "zero3_mfu": round(
                         tps * _flops_per_token(cfg, seq) / peak, 4)}
@@ -454,8 +456,10 @@ def main():
     stacked = (tokens, tokens)
 
     engine = _neox_engine(model, params, batch, {"stage": 2})
-    elapsed, final_loss = timed_steps(engine, stacked, steps=10, warmup=3)
-    tokens_per_sec_chip = batch * seq * 10 / elapsed / n_chips
+    steps = int(os.environ.get("DS_BENCH_STEPS", "15"))
+    elapsed, final_loss = timed_steps(engine, stacked, steps=steps,
+                                      warmup=3)
+    tokens_per_sec_chip = batch * seq * steps / elapsed / n_chips
 
     flops_per_token = _flops_per_token(cfg, seq)
     achieved = tokens_per_sec_chip * flops_per_token

@@ -253,6 +253,15 @@ def causal_attention(q, k, v, use_pallas=True):
             if flash_attention_supported(q.shape):
                 from ..ops.autotune import autotune_enabled
                 from ..ops.autotune import tuned_flash_blocks
+                env_blocks = os.environ.get("DS_FLASH_BLOCKS")
+                if env_blocks:
+                    # explicit geometry override (perf A/B): "bq,bk" —
+                    # e.g. 512,512 trades online-softmax overhead for
+                    # causal dead-block skipping in the QK/PV matmuls
+                    bq, bk = (int(x) for x in env_blocks.split(","))
+                    return flash_attention(q, k, v, causal=True,
+                                           sm_scale=None, block_q=bq,
+                                           block_k=bk)
                 if autotune_enabled():
                     # measure-once block pick (reference gemm_test.h
                     # contract); cached per shape/device
@@ -483,13 +492,18 @@ def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=None):
     def body(carry, xt):
         loss_sum, count = carry
         xc, tc = xt
+        valid = tc != ignore_index
+        safe = jnp.where(valid, tc, 0)
         logits = jnp.einsum("ch,vh->cv", xc, wte.astype(xc.dtype),
                             preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
-        valid = tc != ignore_index
-        safe = jnp.where(valid, tc, 0)
-        picked = jnp.take_along_axis(logits, safe[:, None],
-                                     axis=-1).squeeze(-1)
+        # label logit as a row-dot against the gathered label embeddings
+        # ([chunk, H] — 6 MB) instead of take_along_axis on the logits
+        # tile: logsumexp is then the tile's ONLY consumer, so XLA can
+        # reduce it through the matmul output without materializing the
+        # [chunk, V] fp32 tile in HBM
+        picked = jnp.einsum("ch,ch->c", xc, wte[safe].astype(xc.dtype),
+                            preferred_element_type=jnp.float32)
         ll = (picked - lse) * valid
         return (loss_sum - jnp.sum(ll), count + jnp.sum(valid)), None
 

@@ -735,46 +735,38 @@ class DeepSpeedEngine:
         if self.param_offload:
             return self._init_streamed_state(model_parameters)
 
-        if self.host_offload:
-            # Device holds ONLY compute params; masters/moments are host-
-            # resident (see _init_host_state). Build compute params
-            # straight from the inputs — materializing the fp32 master
-            # tree on device first would transiently DOUBLE the model's
-            # fp32 bytes in HBM (caller's init + master copy + bf16
-            # params ≈ 15.5 GB for GPT2-XL on a 16 GB chip: the round-4
-            # gpt2_xl bench OOM was exactly this).
-            # _param_padinfo is all-False under the offload tiers
-            # (_compute_shardings), so compute params always keep their
-            # natural shapes here — no flat-pad handling needed.
+        if self.host_offload or (not self.keep_master
+                                 and self.compute_dtype != jnp.float32):
+            # Masterless device state — two tiers share this path:
+            #  * host offload: masters/moments are host-resident
+            #    (_init_host_state); building the fp32 master tree on
+            #    device first would transiently DOUBLE the model's fp32
+            #    bytes in HBM (caller's init + master copy + bf16
+            #    params ≈ 15.5 GB for GPT2-XL on a 16 GB chip — the
+            #    round-4 gpt2_xl bench OOM was exactly this)
+            #  * fp16_master_weights_and_grads: params ARE the masters;
+            #    optimizer math upcasts per element (flag × ZeRO /
+            #    offload combinations rejected in __init__)
+            # _param_padinfo is all-False in both (offload tiers /
+            # stage 0), so compute params keep their natural shapes —
+            # no flat-pad handling needed.
             def make_param_direct(p, sh):
                 return jax.device_put(
                     jnp.array(p, dtype=self.compute_dtype, copy=True), sh)
 
             params = jax.tree_util.tree_map(
                 make_param_direct, model_parameters, self._param_sh)
-            return EngineState(params=params, master=None, opt_state=(),
+            if self.host_offload:
+                opt_state = ()    # moments live host-side
+            else:
+                opt_state = self.optimizer.init_state(params)
+                opt_state = _place_opt_state(opt_state, params,
+                                             self._master_sh, self.mesh)
+            return EngineState(params=params, master=None,
+                               opt_state=opt_state,
                                scale=self._make_scale_state(),
                                global_steps=jnp.asarray(0, jnp.int32),
                                skipped_steps=jnp.asarray(0, jnp.int32))
-
-        if not self.keep_master and self.compute_dtype != jnp.float32:
-            # fp16_master_weights_and_grads: params ARE the masters —
-            # no fp32 master tree ever exists on device (optimizer math
-            # still upcasts per-element). Halves at-rest param bytes.
-            # (flag × ZeRO / offload combinations rejected in __init__)
-            params = jax.tree_util.tree_map(
-                lambda p, sh: jax.device_put(
-                    jnp.array(p, dtype=self.compute_dtype, copy=True),
-                    sh),
-                model_parameters, self._param_sh)
-            opt_state = self.optimizer.init_state(params)
-            opt_state = _place_opt_state(opt_state, params,
-                                         self._master_sh, self.mesh)
-            return EngineState(
-                params=params, master=None, opt_state=opt_state,
-                scale=self._make_scale_state(),
-                global_steps=jnp.asarray(0, jnp.int32),
-                skipped_steps=jnp.asarray(0, jnp.int32))
 
         # copy=True: the engine's state buffers must never alias the
         # caller's arrays or each other — the jitted step donates state.
