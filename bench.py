@@ -204,7 +204,7 @@ def _bert_row(seq_len, bs_ladder):
                  "attention_mask": mask,
                  "masked_lm_labels": labels,
                  "next_sentence_label": r.integers(0, 2, (1, bs), np.int32)}
-            steps = 6
+            steps = 10
             dt, _ = timed_steps(eng, b, steps=steps, warmup=3)
             tps = bs * seq_len * steps / dt / n_chips
             H, L, V = bcfg.hidden_size, bcfg.num_layers, bcfg.vocab_size
@@ -229,7 +229,7 @@ def row_bert128():
 
 
 def row_bert512():
-    return _bert_row(512, [16, 12, 8])
+    return _bert_row(512, [20, 16, 12, 8])
 
 
 def row_gpt2xl():

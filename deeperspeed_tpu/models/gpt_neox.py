@@ -258,7 +258,17 @@ def causal_attention(q, k, v, use_pallas=True):
                     # explicit geometry override (perf A/B): "bq,bk" —
                     # e.g. 512,512 trades online-softmax overhead for
                     # causal dead-block skipping in the QK/PV matmuls
-                    bq, bk = (int(x) for x in env_blocks.split(","))
+                    try:
+                        bq, bk = (int(x) for x in env_blocks.split(","))
+                    except ValueError as e:
+                        raise ValueError(
+                            f"DS_FLASH_BLOCKS must be 'bq,bk' ints, got "
+                            f"{env_blocks!r}") from e
+                    if not flash_attention_supported(q.shape, bq, bk):
+                        raise ValueError(
+                            f"DS_FLASH_BLOCKS={env_blocks} does not fit "
+                            f"seq {q.shape[1]} (needs a 128-multiple "
+                            f"block dividing the sequence)")
                     return flash_attention(q, k, v, causal=True,
                                            sm_scale=None, block_q=bq,
                                            block_k=bk)

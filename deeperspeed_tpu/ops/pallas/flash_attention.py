@@ -134,9 +134,9 @@ def _apply_dropout(p, seed, pid, row0, col0, rate):
 CAUSAL_STRIPS = 8  # column strips for dead-sub-block exp skipping
 
 
-def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
-                       dropout_rate=0.0):
-    """One (q, k) block covers the whole sequence: straight (non-online)
+def _head_fwd(q, k, v, bias_row, seed, pid, *, sm_scale, causal,
+              use_bias, dropout_rate):
+    """One head's whole-sequence attention: straight (non-online)
     softmax — no running max/denominator scratch, no alpha rescale, no
     accumulator round-trips. For causal tiles the columns are processed
     in strips so exp/sum only touch rows at or below each strip (the
@@ -146,22 +146,18 @@ def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
     With ``use_bias`` an additive per-key row [1, S] is fused into the
     scores pre-max — the TPU equivalent of the reference's mask-taking
     fused softmax (`csrc/transformer/softmax_kernels.cu` attn_softmax
-    taking attn_mask): key-padding masks never materialize [S, S]."""
-    it = iter(refs)
-    q_ref, k_ref, v_ref = next(it), next(it), next(it)
-    b_ref = next(it) if use_bias else None
-    seed_ref = next(it) if dropout_rate > 0.0 else None
-    o_ref, lse_ref = next(it), next(it)
-    q = q_ref[0]                                              # [S, D]
-    k = k_ref[0]
-    v = v_ref[0]
+    taking attn_mask): key-padding masks never materialize [S, S].
+
+    q/k/v [S, D]; bias_row [1, S] or None; pid keys the dropout hash
+    (must match the backward's regeneration). Returns
+    (o/l [S, D] fp32, lse [S, 1] fp32)."""
     s_q, s_k = q.shape[0], k.shape[0]
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale        # [Sq, Sk]
-    if b_ref is not None:
-        s = s + b_ref[0]                                      # [1, Sk] bcast
+    if use_bias:
+        s = s + bias_row                                      # [1, Sk] bcast
     # NOTE: per-strip matmuls (skipping dead sub-blocks' MXU work) were
     # measured SLOWER than one dense matmul — ragged [S-lo, w] shapes
     # cost the MXU more than the skipped flops save. Strips only gate
@@ -220,23 +216,120 @@ def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
         # (torch dropout(softmax(s)) semantics). Coordinates are the
         # full-tile globals — the strips branch concatenates back to
         # full [Sq, Sk] layout first, so fwd/bwd coords agree.
-        p = _apply_dropout(p, seed_ref[0], pl.program_id(0), 0, 0,
-                           dropout_rate)
+        p = _apply_dropout(p, seed, pid, 0, 0, dropout_rate)
     o = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
     lse = jnp.where(l == 0.0, -NEG_INF, m + jnp.log(l_safe))
+    return o / l_safe, lse
+
+
+def _fwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
+                       dropout_rate=0.0):
+    """Grid (B·H,): one head per instance (see `_head_fwd`)."""
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    o_ref, lse_ref = next(it), next(it)
+    o, lse = _head_fwd(
+        q_ref[0], k_ref[0], v_ref[0],
+        b_ref[0] if use_bias else None,
+        seed_ref[0] if dropout_rate > 0.0 else None,
+        pl.program_id(0), sm_scale=sm_scale, causal=causal,
+        use_bias=use_bias, dropout_rate=dropout_rate)
+    o_ref[0] = o.astype(o_ref.dtype)
     lse_ref[0] = lse.reshape(1, -1)
+
+
+def _fwd_single_mh_kernel(*refs, sm_scale, causal, use_bias, dropout_rate,
+                          hb, h_total):
+    """Grid (B, H/hb): a BLOCK of hb heads per instance. At short
+    sequences the per-head tiles are tiny and the per-instance fixed
+    cost dominates a (B·H,) launch; batching heads amortizes it while
+    every tile stays VMEM-resident (the reference's fused short-seq
+    kernel — its flagship seq-128 BERT benchmark — has the same
+    batching, `csrc/transformer/softmax_kernels.cu` launches over
+    batch×heads in one kernel). Dropout hash pid = global b·H + head —
+    identical formula in `_bwd_single_mh_kernel`."""
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    o_ref, lse_ref = next(it), next(it)
+    for j in range(hb):
+        pid = pl.program_id(0) * h_total + pl.program_id(1) * hb + j
+        o, lse = _head_fwd(
+            q_ref[0, j], k_ref[0, j], v_ref[0, j],
+            b_ref[0] if use_bias else None,
+            seed_ref[0] if dropout_rate > 0.0 else None,
+            pid, sm_scale=sm_scale, causal=causal,
+            use_bias=use_bias, dropout_rate=dropout_rate)
+        o_ref[0, j] = o.astype(o_ref.dtype)
+        lse_ref[0, j] = lse.reshape(1, -1)
+
+
+MH_MAX_SEQ = 256           # above this, per-head tiles amortize launches
+                           # (S=512 hb=2 measured SLOWER than hb=1)
+_MH_VMEM_BUDGET = 6 << 20  # conservative per-instance VMEM bound
+
+
+def _mh_heads(s, d, h):
+    """Heads per grid instance for the heads-batched single-block
+    kernels: the largest divisor of `h` whose fwd+bwd working set
+    (q/k/v/do tiles + two [S, S] fp32 score tensors + grad tiles) fits
+    the VMEM budget. 1 = use the plain per-(b·h) kernels."""
+    if s > MH_MAX_SEQ or h <= 1:
+        return 1
+    per_head = 4 * s * d * 2 + 3 * s * s * 4 + 3 * s * d * 4
+    hb = max(1, min(h, _MH_VMEM_BUDGET // per_head))
+    while h % hb:
+        hb -= 1
+    return hb
 
 
 def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
                 h=None, dropout_rate=0.0, seed=None):
     bh = qb.shape[0]
+    use_bias = kbias is not None
+    hb = _mh_heads(s, d, h or 1)
+    if hb > 1:
+        b = bh // h
+        kernel = functools.partial(
+            _fwd_single_mh_kernel, sm_scale=sm_scale, causal=causal,
+            use_bias=use_bias, dropout_rate=dropout_rate, hb=hb,
+            h_total=h)
+        in_specs = [pl.BlockSpec((1, hb, s, d),
+                                 lambda b, hg: (b, hg, 0, 0))] * 3
+        inputs = [t.reshape(b, h, s, d) for t in (qb, kb, vb)]
+        if use_bias:
+            in_specs.append(pl.BlockSpec((1, 1, s),
+                                         lambda b, hg: (b, 0, 0)))
+            inputs.append(kbias)
+        if dropout_rate > 0.0:
+            in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            inputs.append(seed)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(b, h // hb),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, hb, s, d), lambda b, hg: (b, hg, 0, 0)),
+                pl.BlockSpec((1, hb, 1, s), lambda b, hg: (b, hg, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, s, d), qb.dtype),
+                jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(*inputs)
+        return out.reshape(bh, s, d), lse.reshape(bh, 1, s)
     kernel = functools.partial(_fwd_single_kernel, sm_scale=sm_scale,
-                               causal=causal, use_bias=kbias is not None,
+                               causal=causal, use_bias=use_bias,
                                dropout_rate=dropout_rate)
     in_specs = [pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0))] * 3
     inputs = [qb, kb, vb]
@@ -449,18 +542,54 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
     b_ref = next(it) if use_bias else None
     seed_ref = next(it) if dropout_rate > 0.0 else None
     dq_ref, dk_ref, dv_ref = next(it), next(it), next(it)
-    q = q_ref[0]                                              # [S, D]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0].reshape(-1, 1)                           # [S, 1]
-    delta = delta_ref[0].reshape(-1, 1)
+    dq, dk, dv = _head_bwd(
+        q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+        lse_ref[0].reshape(-1, 1), delta_ref[0].reshape(-1, 1),
+        b_ref[0] if use_bias else None,
+        seed_ref[0] if dropout_rate > 0.0 else None,
+        pl.program_id(0), sm_scale=sm_scale, causal=causal,
+        use_bias=use_bias, dropout_rate=dropout_rate)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_single_mh_kernel(*refs, sm_scale, causal, use_bias, dropout_rate,
+                          hb, h_total):
+    """Heads-batched counterpart of `_fwd_single_mh_kernel` (same pid
+    formula for the dropout hash)."""
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    b_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    dq_ref, dk_ref, dv_ref = next(it), next(it), next(it)
+    for j in range(hb):
+        pid = pl.program_id(0) * h_total + pl.program_id(1) * hb + j
+        dq, dk, dv = _head_bwd(
+            q_ref[0, j], k_ref[0, j], v_ref[0, j], do_ref[0, j],
+            lse_ref[0, j].reshape(-1, 1),
+            delta_ref[0, j].reshape(-1, 1),
+            b_ref[0] if use_bias else None,
+            seed_ref[0] if dropout_rate > 0.0 else None,
+            pid, sm_scale=sm_scale, causal=causal, use_bias=use_bias,
+            dropout_rate=dropout_rate)
+        dq_ref[0, j] = dq.astype(dq_ref.dtype)
+        dk_ref[0, j] = dk.astype(dk_ref.dtype)
+        dv_ref[0, j] = dv.astype(dv_ref.dtype)
+
+
+def _head_bwd(q, k, v, do, lse, delta, bias_row, seed, pid, *, sm_scale,
+              causal, use_bias, dropout_rate):
+    """One head's whole-sequence backward: recompute scores from the
+    saved lse, regenerate the dropout mask at the same (pid, coords),
+    and produce (dq, dk, dv) [S, D] fp32."""
     s_q, s_k = q.shape[0], k.shape[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale        # [Sq, Sk]
-    if b_ref is not None:
-        s = s + b_ref[0]                                      # [1, Sk] bcast
+    if use_bias:
+        s = s + bias_row                                      # [1, Sk] bcast
     dp_full = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                   # [Sq, Sk]
@@ -482,8 +611,8 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
             if dropout_rate > 0.0:
                 # regenerate the forward mask at this strip's absolute
                 # coordinates (rows lo.., cols c*w..)
-                keep_c = _dropout_keep(seed_ref[0], pl.program_id(0),
-                                       lo, c * w, pc.shape, dropout_rate)
+                keep_c = _dropout_keep(seed, pid, lo, c * w, pc.shape,
+                                       dropout_rate)
                 inv = 1.0 / (1.0 - dropout_rate)
                 pc_v = jnp.where(keep_c, pc * inv, 0.0)
                 dpc = jnp.where(keep_c, dpc * inv, 0.0)
@@ -512,8 +641,7 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
         p = jnp.exp(s - lse)
         p_v = p
         if dropout_rate > 0.0:
-            keep = _dropout_keep(seed_ref[0], pl.program_id(0), 0, 0,
-                                 p.shape, dropout_rate)
+            keep = _dropout_keep(seed, pid, 0, 0, p.shape, dropout_rate)
             inv = 1.0 / (1.0 - dropout_rate)
             p_v = jnp.where(keep, p * inv, 0.0)
             dp_full = jnp.where(keep, dp_full * inv, 0.0)
@@ -528,15 +656,50 @@ def _bwd_single_kernel(*refs, sm_scale, causal, use_bias=False,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    return dq, dk, dv
 
 
 def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
                 interpret, kbias=None, h=None, dropout_rate=0.0,
                 seed=None):
     bh = qb.shape[0]
+    use_bias = kbias is not None
+    hb = _mh_heads(s, d, h or 1)
+    if hb > 1:
+        b = bh // h
+        kernel = functools.partial(
+            _bwd_single_mh_kernel, sm_scale=sm_scale, causal=causal,
+            use_bias=use_bias, dropout_rate=dropout_rate, hb=hb,
+            h_total=h)
+        in_specs = [pl.BlockSpec((1, hb, s, d),
+                                 lambda b, hg: (b, hg, 0, 0))] * 4 + \
+            [pl.BlockSpec((1, hb, 1, s), lambda b, hg: (b, hg, 0, 0))] * 2
+        inputs = [t.reshape(b, h, s, d) for t in (qb, kb, vb, do)] + \
+            [t.reshape(b, h, 1, s) for t in (lse, delta)]
+        if use_bias:
+            in_specs.append(pl.BlockSpec((1, 1, s),
+                                         lambda b, hg: (b, 0, 0)))
+            inputs.append(kbias)
+        if dropout_rate > 0.0:
+            in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            inputs.append(seed)
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(b, h // hb),
+            in_specs=in_specs,
+            out_specs=[pl.BlockSpec((1, hb, s, d),
+                                    lambda b, hg: (b, hg, 0, 0))] * 3,
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, s, d), qb.dtype),
+                jax.ShapeDtypeStruct((b, h, s, d), kb.dtype),
+                jax.ShapeDtypeStruct((b, h, s, d), vb.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(*inputs)
+        return (dq.reshape(bh, s, d), dk.reshape(bh, s, d),
+                dv.reshape(bh, s, d))
     kernel = functools.partial(_bwd_single_kernel, sm_scale=sm_scale,
                                causal=causal, use_bias=kbias is not None,
                                dropout_rate=dropout_rate)
