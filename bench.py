@@ -36,7 +36,7 @@ import time
 import numpy as np
 
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
-ROW_TIMEOUT = {"gpt2xl": 800, "longseq": 600}
+ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800}
 ROW_TIMEOUT_DEFAULT = 420
 
 
