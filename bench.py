@@ -332,8 +332,10 @@ def row_longseq():
                     f"{tag}_mfu": round(tps * lftok / peak, 4)}
         return thunk
 
-    lbs = int(os.environ.get("DS_BENCH_LONG_BS", "1"))
-    out = _ladder([("bs1", run(16384, lbs))], {}, "longseq_16k")
+    lbs = int(os.environ.get("DS_BENCH_LONG_BS", "2"))
+    out = _ladder([(f"bs{lbs}", run(16384, lbs))] +
+                  ([("bs1", run(16384, 1))] if lbs > 1 else []),
+                  {}, "longseq_16k")
     if "longseq_16k_mfu" in out and \
             os.environ.get("DS_BENCH_32K", "1") not in ("0", "false"):
         # stretch row: 32k tokens (the reference claims ~10× longer
