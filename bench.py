@@ -29,6 +29,7 @@ import gc
 import json
 import os
 import resource
+import signal
 import subprocess
 import sys
 import time
@@ -506,16 +507,28 @@ def main():
     # deployment run rows via separate DS_BENCH_ROWS invocations)
     jax.clear_caches()
 
-    for name in rows_enabled():
-        run_row_subprocess(name, extra)
+    def emit():
+        print(json.dumps({
+            "metric": "gpt_neox_125m_tokens_per_sec_per_chip",
+            "value": round(tokens_per_sec_chip, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(mfu / 0.40, 4),
+            "extra": extra,
+        }), flush=True)
 
-    print(json.dumps({
-        "metric": "gpt_neox_125m_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec_chip, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.40, 4),
-        "extra": extra,
-    }))
+    # The headline is measured; never lose it to a driver time budget —
+    # on SIGTERM/SIGINT emit the JSON with every row finished so far
+    # (the interrupted row reports an error entry).
+    def _bail(signum, frame):  # noqa: ARG001
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _bail)
+    try:
+        for name in rows_enabled():
+            run_row_subprocess(name, extra)
+    except KeyboardInterrupt:
+        extra["rows_interrupted"] = "time budget hit; partial rows"
+    emit()
     return 0
 
 
