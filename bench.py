@@ -516,19 +516,26 @@ def main():
             "extra": extra,
         }), flush=True)
 
-    # The headline is measured; never lose it to a driver time budget —
-    # on SIGTERM/SIGINT emit the JSON with every row finished so far
-    # (the interrupted row reports an error entry).
+    # The headline is measured; never lose it to a driver time budget or
+    # a row-spawn failure — emit() runs on EVERY exit path, marking the
+    # row that was cut.
     def _bail(signum, frame):  # noqa: ARG001
         raise KeyboardInterrupt
 
     signal.signal(signal.SIGTERM, _bail)
     try:
         for name in rows_enabled():
-            run_row_subprocess(name, extra)
-    except KeyboardInterrupt:
-        extra["rows_interrupted"] = "time budget hit; partial rows"
-    emit()
+            try:
+                run_row_subprocess(name, extra)
+            except KeyboardInterrupt:
+                extra[f"{name}_row_error"] = "interrupted (time budget)"
+                extra["rows_interrupted"] = name
+                break
+            except Exception as e:  # noqa: BLE001 - spawn failures etc.
+                extra[f"{name}_row_error"] = \
+                    f"{type(e).__name__}: {e}"[:200]
+    finally:
+        emit()
     return 0
 
 

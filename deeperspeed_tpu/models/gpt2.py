@@ -124,13 +124,9 @@ def forward_hidden(cfg, params, tokens, use_pallas=True,
     """tokens [B, S] → final-norm hidden [B, S, H].
 
     `scan_blocks` runs the (identically-shaped) blocks as ONE
-    `lax.scan` over stacked parameters instead of a Python loop: the
-    compiled program contains a single block body, so XLA compile time
-    is O(1) in depth rather than O(L) — at GPT2-XL's 48 layers the
-    unrolled remat program took ~20 min to compile on a v5e, the
-    scanned one seconds. The stack is built inside the traced function;
-    grads flow back through it to the natural per-block list layout, so
-    engine state/checkpoints are unchanged."""
+    `lax.scan` over stacked parameters instead of a Python loop — see
+    `gpt_neox.scan_stacked_blocks` (shared helper): XLA compile time
+    O(1) in depth instead of O(L)."""
     S = tokens.shape[1]
     x = params["embed"]["wte"][tokens] + \
         params["embed"]["wpe"][:S][None]
@@ -138,11 +134,8 @@ def forward_hidden(cfg, params, tokens, use_pallas=True,
     if remat_blocks:
         block_fn = jax.checkpoint(block_fn)
     if scan_blocks and len(params["blocks"]) > 1:
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *params["blocks"])
-        x = jax.lax.scan(
-            lambda carry, bp: (block_fn(bp, carry), None),
-            x, stacked)[0]
+        from .gpt_neox import scan_stacked_blocks
+        x = scan_stacked_blocks(block_fn, x, params["blocks"])
     else:
         for bp in params["blocks"]:
             x = block_fn(bp, x)

@@ -197,3 +197,21 @@ def test_remat_blocks_matches_no_remat_under_jit():
     g = jax.jit(jax.grad(lambda p: m2.loss_fn(p, (toks, toks))))(p)
     assert all(np.isfinite(np.asarray(x)).all()
                for x in jax.tree_util.tree_leaves(g))
+
+
+def test_scan_blocks_matches_loop():
+    """lax.scan over stacked NeoX blocks == the Python loop (compile
+    time O(1) in depth for the 20B-shape rung)."""
+    import dataclasses
+    cfg = dataclasses.replace(gpt_neox.GPTNeoXConfig.tiny(), num_layers=3)
+    params = gpt_neox.init_params(cfg, jax.random.PRNGKey(0))
+    toks = np.arange(2 * 32, dtype=np.int32).reshape(2, 32) % cfg.vocab_size
+    loop = gpt_neox.forward(cfg, params, toks, use_pallas=False)
+    scan = gpt_neox.forward(cfg, params, toks, use_pallas=False,
+                            scan_blocks=True)
+    np.testing.assert_allclose(np.asarray(scan), np.asarray(loop),
+                               rtol=1e-5, atol=1e-5)
+    scan_r = gpt_neox.forward(cfg, params, toks, use_pallas=False,
+                              scan_blocks=True, remat_blocks=True)
+    np.testing.assert_allclose(np.asarray(scan_r), np.asarray(loop),
+                               rtol=1e-5, atol=1e-5)
