@@ -416,6 +416,10 @@ def run_row_subprocess(name, extra):
                                   timeout=timeout, env=os.environ.copy())
         except subprocess.TimeoutExpired:
             last_err = f"row timed out after {timeout}s"
+            # the killed child's HBM release lags the SIGKILL; an
+            # immediate retry OOMs against its zombie buffers (observed:
+            # a timed-out gpt2xl attempt poisoned all retry rungs)
+            time.sleep(30)
             continue
         for line in reversed(proc.stdout.splitlines()):
             line = line.strip()
