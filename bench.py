@@ -340,8 +340,11 @@ def row_longseq():
     if "longseq_16k_mfu" in out and \
             os.environ.get("DS_BENCH_32K", "1") not in ("0", "false"):
         # stretch row: 32k tokens (the reference claims ~10× longer
-        # sequences via sparse attention; dense-flash 32k beats it)
-        out = _ladder([("bs1", run(32768, lbs))], out, "longseq_32k")
+        # sequences via sparse attention; dense-flash 32k beats it).
+        # Tag matches what actually runs, with a true bs1 fallback rung.
+        out = _ladder([(f"bs{lbs}", run(32768, lbs))] +
+                      ([("bs1", run(32768, 1))] if lbs > 1 else []),
+                      out, "longseq_32k")
     return out
 
 

@@ -283,7 +283,11 @@ def _load_streamed_nvme_checkpoint(engine, ckpt_dir, meta):
         counters = dict(meta)
         counters.pop("process_count")   # shard payload is single-process
         counters.update(payload)        # segments/manifest/optimizer
-        return _load_streamed_nvme_checkpoint(engine, shard_dir, counters)
+        # return the rank-independent ckpt_dir (every other load path
+        # does), not the per-rank shard dir the recursion restored from
+        _, client_state = _load_streamed_nvme_checkpoint(
+            engine, shard_dir, counters)
+        return ckpt_dir, client_state
     for name in meta["segments"]:
         shutil.copyfile(os.path.join(ckpt_dir, f"param_seg_{name}.swp"),
                         engine._coord.swapper._path(name))

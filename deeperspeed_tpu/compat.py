@@ -1,0 +1,36 @@
+"""Shims over jax API renames so the framework runs on every jax the
+fleet actually has installed.
+
+Two symbols moved between the jax versions we support:
+
+- ``shard_map``: promoted from ``jax.experimental.shard_map`` to
+  top-level ``jax.shard_map`` (jax 0.6).
+- Pallas TPU compiler params: ``pltpu.TPUCompilerParams`` renamed to
+  ``pltpu.CompilerParams`` (jax 0.5).
+
+Import both from here; never from jax directly.
+"""
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.6
+except ImportError:                      # pragma: no cover - version dep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    # older jax spells the replication check `check_rep`
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+from jax.experimental.pallas import tpu as _pltpu
+
+# jax >= 0.5 spelling first; fall back to the long-stable old name.
+CompilerParams = getattr(_pltpu, "CompilerParams", None) or \
+    _pltpu.TPUCompilerParams

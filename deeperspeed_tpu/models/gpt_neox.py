@@ -57,7 +57,11 @@ class GPTNeoXConfig:
     moe_capacity_factor: float = 1.25
     moe_jitter_eps: float = 0.0
     moe_aux_loss_coef: float = 0.01
-    moe_num_groups: int = 0     # GShard G dim; 0 = auto-size groups
+    # GShard G dim; 1 (default) = single global-capacity group — the
+    # reference's routing numerics. 0 = auto-size groups (opt-in: capacity
+    # becomes per-group, changing token-drop patterns and aux loss).
+    # Matches MoELayer's groups=1 default so the two entry points agree.
+    moe_num_groups: int = 1
 
     @property
     def head_dim(self):
@@ -251,13 +255,13 @@ def causal_attention(q, k, v, use_pallas=True):
             from ..ops.pallas.flash_attention import flash_attention_supported
             from ..ops.pallas.flash_attention import flash_attention
             if flash_attention_supported(q.shape):
-                from ..ops.autotune import autotune_enabled
-                from ..ops.autotune import tuned_flash_blocks
+                from ..ops.autotune import flash_blocks_for
                 env_blocks = os.environ.get("DS_FLASH_BLOCKS")
                 if env_blocks:
                     # explicit geometry override (perf A/B): "bq,bk" —
                     # e.g. 512,512 trades online-softmax overhead for
-                    # causal dead-block skipping in the QK/PV matmuls
+                    # per-instance VMEM headroom (the compacted grid
+                    # already skips causal dead blocks at any geometry)
                     try:
                         bq, bk = (int(x) for x in env_blocks.split(","))
                     except ValueError as e:
@@ -272,13 +276,15 @@ def causal_attention(q, k, v, use_pallas=True):
                     return flash_attention(q, k, v, causal=True,
                                            sm_scale=None, block_q=bq,
                                            block_k=bk)
-                if autotune_enabled():
-                    # measure-once block pick (reference gemm_test.h
-                    # contract); cached per shape/device
-                    bq, bk = tuned_flash_blocks(q.shape, q.dtype, True)
+                # measure-once block pick (reference gemm_test.h
+                # contract), cached per shape/device: always for long
+                # sequences, opt-in (DS_TPU_AUTOTUNE=1) below that
+                blocks = flash_blocks_for(q.shape, q.dtype, True)
+                if blocks is not None:
                     return flash_attention(q, k, v, causal=True,
-                                           sm_scale=None, block_q=bq,
-                                           block_k=bk)
+                                           sm_scale=None,
+                                           block_q=blocks[0],
+                                           block_k=blocks[1])
                 return flash_attention(q, k, v, causal=True)
         except ImportError:
             pass
@@ -329,7 +335,7 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
             capacity_factor=cfg.moe_capacity_factor,
             top_k=cfg.moe_top_k, rng=rng,
             jitter_eps=cfg.moe_jitter_eps,
-            groups=getattr(cfg, "moe_num_groups", 0))
+            groups=getattr(cfg, "moe_num_groups", 1))
         moe_out = y.reshape(ln2.shape)
         if cfg.use_parallel_residual:
             return x + reduce_fn(attn_partial) + out_b + moe_out, aux
@@ -588,7 +594,7 @@ class GPTNeoX:
                 moe_capacity_factor=moe["capacity_factor"],
                 moe_jitter_eps=moe["jitter_eps"],
                 moe_aux_loss_coef=moe["aux_loss_coef"],
-                moe_num_groups=moe.get("num_groups", 0))
+                moe_num_groups=moe.get("num_groups", 1))
         sp = getattr(ds_config, "sequence_parallel_params", None)
         if sp:
             from ..parallel.sequence import SequenceParallel

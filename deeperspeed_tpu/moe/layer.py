@@ -17,8 +17,11 @@ design is the GShard/Switch pattern, TPU-first:
   the dispatch/combine tensors from O(T·E·C) to O(T·E·C/g) — the
   ungrouped form OOMs a 16 GB chip at T=8k/H=768, the grouped form is
   O(group_size) and stays pure einsum (MXU work, no scatter). `groups=1`
-  is the exact ungrouped oracle; `groups=0` ("auto") picks the smallest
-  divisor of T with group size ≤ 1024 (`_AUTO_GROUP_TOKENS`).
+  is the exact ungrouped oracle; `groups=0` ("auto") picks the divisor
+  of T whose group size is NEAREST `_AUTO_GROUP_TOKENS` (1024) and at
+  least 128 — the size may exceed 1024 when T has no nearby divisor
+  (e.g. T=2500 groups at 1250), trading a looser memory bound for
+  routing-statistics quality over tiny groups.
 - **expert parallelism**: experts shard over an ``expert`` mesh axis
   inside `shard_map`; token shards are exchanged with `all_to_all`
   (dispatch) and returned (combine), both riding ICI.

@@ -10,9 +10,13 @@ VMEM is tighter). `Autotuner.pick` times each candidate on the live
 device once per (key, device-kind) and caches the winner for the process
 lifetime, exactly the reference's measure-once-use-forever contract.
 
-Opt-in: autotuning runs real device work (a few warm-up launches per
-candidate), so callers enable it explicitly (`DS_TPU_AUTOTUNE=1` for the
-model-side attention hook).
+Activation: autotuning runs real device work (a few warm-up fwd+bwd
+launches per candidate), so it is opt-in (`DS_TPU_AUTOTUNE=1`) for
+ordinary shapes — EXCEPT long sequences: at or beyond
+`flash_tune_min_seq()` (default 8192, `DS_FLASH_TUNE_MIN_SEQ`) the
+`flash_blocks_for` dispatch always measures, because the one-time probe
+is noise next to a single long-context step and the static default
+geometry was the measured MFU cliff there (BENCH_r05).
 """
 
 import functools
@@ -88,14 +92,51 @@ class Autotuner:
 _global_tuner = Autotuner()
 
 # Candidate (block_q, block_k) geometries for the flash kernels, fattest
-# first (the v5e-measured winner ordering).
-FLASH_BLOCK_CANDIDATES = ((1024, 1024), (1024, 512), (512, 512),
-                          (512, 1024), (256, 256))
+# first (the v5e-measured winner ordering). Non-square entries exist for
+# the compacted causal grid: its trapezoid rows grow with qi, so a fat
+# block_q with a narrower block_k keeps per-instance VMEM bounded while
+# the schedule (not an in-kernel gate) already skips the dead tiles —
+# at 16k/32k the fp32 [BQ, BK] score tile is the VMEM limiter, which
+# square 1024² geometry hard-codes at 4 MB.
+FLASH_BLOCK_CANDIDATES = ((1024, 1024), (2048, 1024), (1024, 512),
+                          (2048, 512), (512, 512), (512, 1024),
+                          (1024, 256), (512, 256), (256, 512),
+                          (256, 256), (256, 128), (128, 128))
 
 
 # Above this, standalone benchmark launches aren't representative (and the
 # probe arrays would strain device memory) — fall back to the default.
 _MAX_TUNE_BYTES = 1 << 30
+
+# Sequences at or above this always take the measured block pick, even
+# without DS_TPU_AUTOTUNE=1: at 16k-32k the default square geometry was
+# the measured long-context MFU cliff (BENCH_r05 0.21 vs 0.61 at 1k) and
+# a one-time per-process probe is noise next to a single long-seq step.
+_TUNE_MIN_SEQ_ENV = "DS_FLASH_TUNE_MIN_SEQ"
+
+
+def flash_tune_min_seq():
+    return int(os.environ.get(_TUNE_MIN_SEQ_ENV, "8192"))
+
+
+def flash_blocks_for(shape, dtype, causal, tuner=None):
+    """Dispatch-time flash block geometry, or None for the built-in
+    default. Long sequences (≥ `flash_tune_min_seq()`, env-tunable) and
+    explicit `DS_TPU_AUTOTUNE=1` runs get `tuned_flash_blocks`'s
+    measured pick; everything else keeps the static default so short-seq
+    call sites pay zero probe launches. Multi-host and oversized shapes
+    degrade to the deterministic fattest candidate inside the tuner.
+
+    `DS_TPU_AUTOTUNE=0` set EXPLICITLY is a kill switch: no measurement
+    anywhere, long sequences included (determinism / trace-latency /
+    probe-crash escape hatch). Unset means auto (long-seq only)."""
+    env = os.environ.get(_TUNE_ENV)
+    if env is not None and env in ("0", "", "false", "False"):
+        return None
+    b, s, h, d = shape
+    if autotune_enabled() or s >= flash_tune_min_seq():
+        return tuned_flash_blocks(shape, dtype, causal, tuner=tuner)
+    return None
 
 
 def tuned_flash_blocks(shape, dtype, causal, tuner=None):
@@ -108,7 +149,12 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
     NOTE: that measurement runs EAGERLY during the first jit trace of any
     step calling this — budget the one-time latency accordingly.
     Oversized shapes and multi-host runs skip measurement and cache the
-    fattest default."""
+    fattest default.
+
+    The probe runs forward AND backward: the picked geometry feeds the
+    bwd dkv/dq kernels too, whose VMEM working set is larger — a
+    candidate that only fails (or only crawls) in backward must lose
+    here, not at the first jax.grad step of training."""
     from .pallas.flash_attention import (_fit_block, flash_attention,
                                          flash_attention_supported)
     import numpy as np
@@ -139,13 +185,24 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
     # Take the deterministic default instead of measuring.
     if jax.process_count() > 1:
         return tuner.store(key, candidates[0])
+    # x8: the fwd+bwd probe's live set is q/k/v/out + saved residuals +
+    # the cotangent and dq/dk/dv inside _bwd — about twice the old
+    # forward-only probe's four arrays
     itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
-    if b * s * h * d * itemsize * 4 > _MAX_TUNE_BYTES:
+    if b * s * h * d * itemsize * 8 > _MAX_TUNE_BYTES:
+        # not silent: the shapes most likely to hit this cap (big GSPMD
+        # global batches at 16k+) are exactly the ones tuning targets
+        from ..utils.logging import logger
+        logger.info(
+            f"flash autotune: shape {tuple(shape)} exceeds the probe "
+            f"memory cap; using default blocks {candidates[0]}")
         return tuner.store(key, candidates[0])
 
     zeros = jnp.zeros(shape, dtype)
 
     def run(cand):
-        return flash_attention(zeros, zeros, zeros, causal, None, *cand)
+        return jax.grad(lambda q: jnp.sum(
+            flash_attention(q, zeros, zeros, causal, None, *cand)
+            .astype(jnp.float32)))(zeros)
 
     return tuner.pick(key, candidates, run)

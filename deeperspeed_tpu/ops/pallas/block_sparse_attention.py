@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import CompilerParams
+
 from .flash_attention import LANES, NEG_INF, _interpret
 
 DEFAULT_BLOCK = 128
@@ -267,7 +269,7 @@ def sparse_attention_fwd(q, k, v, lut, bits, sentinel, causal, sm_scale,
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lut_flat, bits_flat, *inputs)
@@ -455,7 +457,7 @@ def sparse_attention_bwd(res, g, lut, bits, lut_t, bits_t, sentinel,
             jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
             jax.ShapeDtypeStruct((bh, s, d), vb.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lut_t_flat, bits_t_flat, *dkv_inputs)
@@ -497,7 +499,7 @@ def sparse_attention_bwd(res, g, lut, bits, lut_t, bits_t, sentinel,
     dq = pl.pallas_call(
         dq_kernel, grid_spec=dq_grid,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lut_flat, bits_flat, *dq_inputs)

@@ -22,6 +22,18 @@ fattest instances win — 1024-blocks measured ~20% faster than 512 at
 GPT-small shapes. Matmuls run at the input dtype (bf16 → full MXU rate)
 with fp32 accumulation; softmax math is fp32.
 
+Causal grids are COMPACTED (splash-attention style): instead of an
+n_q x n_k grid whose upper-triangle instances are gated off in-kernel
+(each still launched, still prefetching its K/V or Q/dO tiles over HBM,
+still paying the ~6us fixed cost), the (qi, ki) schedule is flattened
+host-side into one `arbitrary` grid dimension that enumerates ONLY the
+causally-alive tiles — ~n(n+1)/2 instances for n = n_q = n_k instead of
+n². Scalar-prefetch index maps (`pltpu.PrefetchScalarGridSpec` LUTs,
+the splash-attention mechanism) route each flat instance to its (qi, ki)
+blocks, so dead tiles generate no HBM traffic at all. See
+`causal_grid_maps` for the schedule and `docs/long-context.md` for the
+design.
+
 On non-TPU backends the kernels run in interpreter mode (slow, test-only).
 """
 
@@ -33,13 +45,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import CompilerParams
+
 BLOCK_Q = 1024
 BLOCK_K = 1024
 LANES = 128  # TPU minor-dim tile; in-kernel row stats are lane-broadcast
 NEG_INF = -1e30
 
-_DIMSEM = pltpu.CompilerParams(
+_DIMSEM = CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
+# compacted causal grids: (batch·head, flat trapezoid) — the flat dim
+# carries the per-row/-column sequential accumulation, so `arbitrary`
+_DIMSEM_FLAT = CompilerParams(
+    dimension_semantics=("parallel", "arbitrary"))
 
 
 def _interpret():
@@ -53,6 +71,95 @@ def _fit_block(block, s):
         if cand % 128 == 0 and s % cand == 0:
             return cand
     return 0
+
+
+# ---------------------------------------------------------------------------
+# compacted causal grid (trapezoidal schedule)
+# ---------------------------------------------------------------------------
+
+def causal_grid_maps(n_q, n_k, block_q, block_k, order="row"):
+    """The compacted causal (qi, ki) schedule: every tile with
+    ki*block_k <= qi*block_q + block_q - 1, i.e. exactly the causally
+    alive blocks. Returns (qmap, kmap) int32 numpy arrays consumed as
+    scalar-prefetch LUTs by the kernels' BlockSpec index maps.
+
+    order="row" (fwd / dq): qi-major, ki ascending — each q row's
+    running softmax/accumulator scratch spans one contiguous run whose
+    output block stays VMEM-resident until the row finishes.
+    order="col" (dkv): ki-major, qi ascending — ditto for each k
+    column's dk/dv accumulators.
+
+    For n = n_q = n_k (equal blocks) the schedule has n(n+1)/2 entries
+    instead of the dense grid's n² — the compile-time-verifiable
+    invariant (`_LAST_GRIDS` records what each call launched)."""
+    import numpy as np
+    qs, ks = [], []
+    if order == "row":
+        for qi in range(n_q):
+            kmax = min(n_k - 1, (qi * block_q + block_q - 1) // block_k)
+            for ki in range(kmax + 1):
+                qs.append(qi)
+                ks.append(ki)
+    elif order == "col":
+        for ki in range(n_k):
+            for qi in range((ki * block_k) // block_q, n_q):
+                qs.append(qi)
+                ks.append(ki)
+    else:
+        raise ValueError(f"unknown order {order!r}")
+    return np.asarray(qs, np.int32), np.asarray(ks, np.int32)
+
+
+def causal_grid_size(s, block_q=BLOCK_Q, block_k=BLOCK_K):
+    """Instances a causal flash call launches per (batch·head) at seq s
+    (after block auto-fitting) — the trapezoid, not the square."""
+    bq, bk = _fit_block(block_q, s), _fit_block(block_k, s)
+    if not bq or not bk:
+        raise ValueError(f"no block fits seq {s}")
+    if s // bq == 1 and s // bk == 1:
+        return 1                       # single-block specialization
+    return len(causal_grid_maps(s // bq, s // bk, bq, bk)[0])
+
+
+# Test/debug observability: grid of the most recent tiled pallas_call per
+# kernel family ("fwd" / "dkv" / "dq"). The compaction invariant tests
+# assert on this instead of re-deriving lowering internals.
+_LAST_GRIDS = {}
+
+
+def _index_adapter(compact, kv_major=False):
+    """BlockSpec index maps are written once, in dense (bh, i, j) form;
+    this returns the wrapper that adapts them to the grid in use.
+    Identity for dense grids. For compacted grids the flat index t
+    resolves through the prefetched LUTs — (i, j) = (qi, ki) for the
+    row-major fwd/dq schedules, (ki, qi) for the column-major dkv
+    schedule (``kv_major``)."""
+    if not compact:
+        return lambda f: f
+    if kv_major:
+        return lambda f: lambda bh, t, qm, km: f(bh, km[t], qm[t])
+    return lambda f: lambda bh, t, qm, km: f(bh, qm[t], km[t])
+
+
+def _tiled_call(kernel, compact, grid, in_specs, out_specs, scratch,
+                out_shape, maps):
+    """One pallas_call for both grid flavors: compacted trapezoid
+    (scalar-prefetch LUT grid spec) or dense. Returns (call, prefetch
+    operands) — invoke as ``call(*prefetch, *inputs)``."""
+    if compact:
+        call_kw = dict(grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=grid, in_specs=in_specs,
+            out_specs=out_specs, scratch_shapes=scratch))
+        prefetch = tuple(jnp.asarray(m) for m in maps)
+    else:
+        call_kw = dict(grid=grid, in_specs=in_specs, out_specs=out_specs,
+                       scratch_shapes=scratch)
+        prefetch = ()
+    call = pl.pallas_call(
+        kernel, out_shape=out_shape,
+        compiler_params=_DIMSEM_FLAT if compact else _DIMSEM,
+        interpret=_interpret(), **call_kw)
+    return call, prefetch
 
 
 def flash_attention_supported(shape, block_q=BLOCK_Q, block_k=BLOCK_K):
@@ -323,7 +430,7 @@ def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
                 jax.ShapeDtypeStruct((b, h, s, d), qb.dtype),
                 jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=("parallel", "parallel")),
             interpret=interpret,
         )(*inputs)
@@ -353,7 +460,7 @@ def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
             jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*inputs)
@@ -363,18 +470,29 @@ def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False,
-                use_bias=False, dropout_rate=0.0):
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
+                use_mask=False, use_bias=False, dropout_rate=0.0,
+                compact=False):
     it = iter(refs)
+    if compact:
+        qmap_ref, kmap_ref = next(it), next(it)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
     seed_ref = next(it) if dropout_rate > 0.0 else None
     o_ref, lse_ref = next(it), next(it)
     m_scr, l_scr, acc_scr = next(it), next(it), next(it)
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    n_k = pl.num_programs(2)
+    if compact:
+        # flat trapezoidal schedule: (qi, ki) from the prefetched LUTs;
+        # the row ends at its causal k-extent, not at n_k - 1
+        t = pl.program_id(1)
+        qi, ki = qmap_ref[t], kmap_ref[t]
+        last_k = jnp.minimum(n_k - 1,
+                             (qi * block_q + block_q - 1) // block_k)
+    else:
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+        last_k = pl.num_programs(2) - 1
 
     @pl.when(ki == 0)
     def _init():
@@ -383,9 +501,10 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # Causal: block row qi attends to block cols ki with
-    # ki*block_k <= qi*block_q + block_q - 1.
+    # ki*block_k <= qi*block_q + block_q - 1. Compacted schedules only
+    # ever launch such tiles, so no gate is needed there.
     run = True
-    if causal:
+    if causal and not compact:
         run = ki * block_k <= qi * block_q + (block_q - 1)
 
     @pl.when(run)
@@ -429,7 +548,7 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False,
             preferred_element_type=jnp.float32)               # [BQ, D]
         acc_scr[:] = acc_scr[:] * alpha + pv
 
-    @pl.when(ki == n_k - 1)
+    @pl.when(ki == last_k)
     def _finalize():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -443,13 +562,14 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, use_mask=False,
         lse_ref[0] = lse.reshape(1, -1)
 
 
-def _mask_spec(h, n_fine_q, n_fine_k):
+def _mask_spec(h, n_fine_q, n_fine_k, ix=lambda f: f):
     """BlockSpec for the [H, S/128, S/128] layout mask: the WHOLE
     per-head map as one SMEM block (Mosaic requires trailing block dims
     to be 8/128-multiples or full-size; scalar SMEM reads then take
-    dynamic indices)."""
+    dynamic indices). `ix` adapts the index map to the grid in use
+    (`_index_adapter`)."""
     return pl.BlockSpec((1, n_fine_q, n_fine_k),
-                        lambda bh, i, j: (bh % h, 0, 0),
+                        ix(lambda bh, i, j: (bh % h, 0, 0)),
                         memory_space=pltpu.SMEM)
 
 
@@ -474,50 +594,62 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
         return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
 
-    grid = (b * h, n_q, n_k)
-
+    compact = causal   # causal ⇒ trapezoidal schedule (no dead launches)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
-                               block_k=block_k,
+                               block_k=block_k, n_k=n_k,
                                use_mask=layout is not None,
                                use_bias=kbias is not None,
-                               dropout_rate=dropout_rate)
+                               dropout_rate=dropout_rate,
+                               compact=compact)
+    if compact:
+        qmap, kmap = causal_grid_maps(n_q, n_k, block_q, block_k, "row")
+        grid = (b * h, len(qmap))
+    else:
+        qmap = kmap = None
+        grid = (b * h, n_q, n_k)
+    ix = _index_adapter(compact)
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_q, d),
+                     ix(lambda bh, qi, ki: (bh, qi, 0))),
+        pl.BlockSpec((1, block_k, d),
+                     ix(lambda bh, qi, ki: (bh, ki, 0))),
+        pl.BlockSpec((1, block_k, d),
+                     ix(lambda bh, qi, ki: (bh, ki, 0))),
+    ]
+    bias_spec = pl.BlockSpec(
+        (1, 1, block_k), ix(lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
+    out_specs = [
+        pl.BlockSpec((1, block_q, d),
+                     ix(lambda bh, qi, ki: (bh, qi, 0))),
+        pl.BlockSpec((1, 1, block_q),
+                     ix(lambda bh, qi, ki: (bh, 0, qi))),
     ]
     inputs = [qb, kb, vb]
     if layout is not None:
-        in_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
+        in_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN,
+                                   ix))
         inputs.append(layout)
     if kbias is not None:
-        in_specs.append(pl.BlockSpec(
-            (1, 1, block_k), lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
+        in_specs.append(bias_spec)
         inputs.append(kbias)
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         inputs.append(seed)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
-            pltpu.VMEM((block_q, LANES), jnp.float32),   # running denom
-            pltpu.VMEM((block_q, d), jnp.float32),       # out accumulator
-        ],
-        compiler_params=_DIMSEM,
-        interpret=_interpret(),
-    )(*inputs)
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+        pltpu.VMEM((block_q, LANES), jnp.float32),   # running denom
+        pltpu.VMEM((block_q, d), jnp.float32),       # out accumulator
+    ]
+    _LAST_GRIDS["fwd"] = grid
+    call, prefetch = _tiled_call(
+        kernel, compact, grid, in_specs, out_specs, scratch_shapes,
+        out_shape, (qmap, kmap) if compact else ())
+    out, lse = call(*prefetch, *inputs)
 
     out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
@@ -694,7 +826,7 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
                 jax.ShapeDtypeStruct((b, h, s, d), kb.dtype),
                 jax.ShapeDtypeStruct((b, h, s, d), vb.dtype),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=("parallel", "parallel")),
             interpret=interpret,
         )(*inputs)
@@ -729,7 +861,7 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
             jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
             jax.ShapeDtypeStruct((bh, s, d), vb.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*inputs)
@@ -739,26 +871,38 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
-                    use_mask=False, use_bias=False, dropout_rate=0.0):
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, n_q=None,
+                    use_mask=False, use_bias=False, dropout_rate=0.0,
+                    compact=False):
     it = iter(refs)
+    if compact:
+        qmap_ref, kmap_ref = next(it), next(it)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
     seed_ref = next(it) if dropout_rate > 0.0 else None
     dk_ref, dv_ref, dk_scr, dv_scr = next(it), next(it), next(it), next(it)
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    if compact:
+        # column-major trapezoid: column ki starts at its first alive
+        # row (the diagonal) and always ends at the bottom row
+        t = pl.program_id(1)
+        qi, ki = qmap_ref[t], kmap_ref[t]
+        first_q = (ki * block_k) // block_q
+        last_q = n_q - 1
+    else:
+        ki = pl.program_id(1)
+        qi = pl.program_id(2)
+        first_q = 0
+        last_q = pl.num_programs(2) - 1
 
-    @pl.when(qi == 0)
+    @pl.when(qi == first_q)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     run = True
-    if causal:
+    if causal and not compact:
         run = ki * block_k <= qi * block_q + (block_q - 1)
 
     @pl.when(run)
@@ -801,31 +945,40 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(qi == last_q)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
-                   use_mask=False, use_bias=False, dropout_rate=0.0):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
+                   use_mask=False, use_bias=False, dropout_rate=0.0,
+                   compact=False):
     it = iter(refs)
+    if compact:
+        qmap_ref, kmap_ref = next(it), next(it)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
     seed_ref = next(it) if dropout_rate > 0.0 else None
     dq_ref, dq_scr = next(it), next(it)
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    n_k = pl.num_programs(2)
+    if compact:
+        t = pl.program_id(1)
+        qi, ki = qmap_ref[t], kmap_ref[t]
+        last_k = jnp.minimum(n_k - 1,
+                             (qi * block_q + block_q - 1) // block_k)
+    else:
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+        last_k = pl.num_programs(2) - 1
 
     @pl.when(ki == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     run = True
-    if causal:
+    if causal and not compact:
         run = ki * block_k <= qi * block_q + (block_q - 1)
 
     @pl.when(run)
@@ -856,7 +1009,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(ki == n_k - 1)
+    @pl.when(ki == last_k)
     def _finalize():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
@@ -893,85 +1046,124 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
 
         return from_bh1(dq), from_bh1(dk), from_bh1(dv)
 
+    compact = causal   # mirror the forward's trapezoidal schedule
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
-                                   block_k=block_k, use_mask=use_mask,
+                                   block_k=block_k, n_q=n_q,
+                                   use_mask=use_mask,
                                    use_bias=use_bias,
-                                   dropout_rate=dropout_rate)
+                                   dropout_rate=dropout_rate,
+                                   compact=compact)
+    if compact:
+        # dkv accumulates per k column → column-major trapezoid
+        dkv_qmap, dkv_kmap = causal_grid_maps(n_q, n_k, block_q, block_k,
+                                              "col")
+        dkv_grid = (bh, len(dkv_qmap))
+    else:
+        dkv_qmap = dkv_kmap = None
+        dkv_grid = (bh, n_k, n_q)
+    # dense dkv grid order is (bh, ki, qi) — kv_major adapter
+    ixc = _index_adapter(compact, kv_major=True)
     dkv_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-        pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
-        pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+        pl.BlockSpec((1, block_q, d),
+                     ixc(lambda bh, ki, qi: (bh, qi, 0))),
+        pl.BlockSpec((1, block_k, d),
+                     ixc(lambda bh, ki, qi: (bh, ki, 0))),
+        pl.BlockSpec((1, block_k, d),
+                     ixc(lambda bh, ki, qi: (bh, ki, 0))),
+        pl.BlockSpec((1, block_q, d),
+                     ixc(lambda bh, ki, qi: (bh, qi, 0))),
+        pl.BlockSpec((1, 1, block_q),
+                     ixc(lambda bh, ki, qi: (bh, 0, qi))),
+        pl.BlockSpec((1, 1, block_q),
+                     ixc(lambda bh, ki, qi: (bh, 0, qi))),
+    ]
+    dkv_bias_spec = pl.BlockSpec(
+        (1, 1, block_k), ixc(lambda bh, ki, qi, h=h: (bh // h, 0, ki)))
+    dkv_out_specs = [
+        pl.BlockSpec((1, block_k, d),
+                     ixc(lambda bh, ki, qi: (bh, ki, 0))),
+        pl.BlockSpec((1, block_k, d),
+                     ixc(lambda bh, ki, qi: (bh, ki, 0))),
     ]
     dkv_inputs = [qb, kb, vb, do, lse, delta]
     if use_mask:
-        dkv_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
+        dkv_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN,
+                                    ixc))
         dkv_inputs.append(layout)
     if use_bias:
-        dkv_specs.append(pl.BlockSpec(
-            (1, 1, block_k), lambda bh, ki, qi, h=h: (bh // h, 0, ki)))
+        dkv_specs.append(dkv_bias_spec)
         dkv_inputs.append(kbias)
     if dropout_rate > 0.0:
         dkv_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dkv_inputs.append(seed)
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(bh, n_k, n_q),
-        in_specs=dkv_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), vb.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
-        compiler_params=_DIMSEM,
-        interpret=_interpret(),
-    )(*dkv_inputs)
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
+        jax.ShapeDtypeStruct((bh, s, d), vb.dtype),
+    ]
+    dkv_scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    _LAST_GRIDS["dkv"] = dkv_grid
+    call, prefetch = _tiled_call(
+        dkv_kernel, compact, dkv_grid, dkv_specs, dkv_out_specs,
+        dkv_scratch, dkv_out_shape,
+        (dkv_qmap, dkv_kmap) if compact else ())
+    dk, dv = call(*prefetch, *dkv_inputs)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
-                                  block_k=block_k, use_mask=use_mask,
+                                  block_k=block_k, n_k=n_k,
+                                  use_mask=use_mask,
                                   use_bias=use_bias,
-                                  dropout_rate=dropout_rate)
+                                  dropout_rate=dropout_rate,
+                                  compact=compact)
+    if compact:
+        # dq accumulates per q row → row-major trapezoid (same as fwd)
+        dq_qmap, dq_kmap = causal_grid_maps(n_q, n_k, block_q, block_k,
+                                            "row")
+        dq_grid = (bh, len(dq_qmap))
+    else:
+        dq_qmap = dq_kmap = None
+        dq_grid = (bh, n_q, n_k)
+    ix = _index_adapter(compact)
     dq_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        pl.BlockSpec((1, block_q, d),
+                     ix(lambda bh, qi, ki: (bh, qi, 0))),
+        pl.BlockSpec((1, block_k, d),
+                     ix(lambda bh, qi, ki: (bh, ki, 0))),
+        pl.BlockSpec((1, block_k, d),
+                     ix(lambda bh, qi, ki: (bh, ki, 0))),
+        pl.BlockSpec((1, block_q, d),
+                     ix(lambda bh, qi, ki: (bh, qi, 0))),
+        pl.BlockSpec((1, 1, block_q),
+                     ix(lambda bh, qi, ki: (bh, 0, qi))),
+        pl.BlockSpec((1, 1, block_q),
+                     ix(lambda bh, qi, ki: (bh, 0, qi))),
     ]
+    dq_bias_spec = pl.BlockSpec(
+        (1, 1, block_k), ix(lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
+    dq_out_spec = pl.BlockSpec(
+        (1, block_q, d), ix(lambda bh, qi, ki: (bh, qi, 0)))
     dq_inputs = [qb, kb, vb, do, lse, delta]
     if use_mask:
-        dq_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN))
+        dq_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN,
+                                   ix))
         dq_inputs.append(layout)
     if use_bias:
-        dq_specs.append(pl.BlockSpec(
-            (1, 1, block_k), lambda bh, qi, ki, h=h: (bh // h, 0, ki)))
+        dq_specs.append(dq_bias_spec)
         dq_inputs.append(kbias)
     if dropout_rate > 0.0:
         dq_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         dq_inputs.append(seed)
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(bh, n_q, n_k),
-        in_specs=dq_specs,
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=_DIMSEM,
-        interpret=_interpret(),
-    )(*dq_inputs)
+    dq_out_shape = jax.ShapeDtypeStruct((bh, s, d), qb.dtype)
+    dq_scratch = [pltpu.VMEM((block_q, d), jnp.float32)]
+    _LAST_GRIDS["dq"] = dq_grid
+    call, prefetch = _tiled_call(
+        dq_kernel, compact, dq_grid, dq_specs, dq_out_spec, dq_scratch,
+        dq_out_shape, (dq_qmap, dq_kmap) if compact else ())
+    dq = call(*prefetch, *dq_inputs)
 
     def from_bh(x):
         return x.reshape(bdim, h, s, d).transpose(0, 2, 1, 3)

@@ -250,16 +250,24 @@ class DeepSpeedTransformerLayer:
         if (additive_mask is None or kbias is not None) and \
                 s >= _flash_min_seq() and \
                 flash_attention_supported((b, s, heads, hd)):
+            # measured block geometry for long sequences (and opt-in
+            # autotune runs); None keeps the static default — the fused
+            # 16k/32k paths previously hard-coded 1024x1024 here
+            from ..autotune import flash_blocks_for
+            from ..pallas.flash_attention import BLOCK_K, BLOCK_Q
+            blocks = flash_blocks_for((b, s, heads, hd), q.dtype, False)
+            bq, bk = blocks if blocks is not None else (BLOCK_Q, BLOCK_K)
             if attn_drop_active:
                 seed = jax.random.randint(rng, (1,), 0, 2**31 - 1,
                                           dtype=jnp.int32)
                 ctx = flash_attention_train(
-                    q, k, v, kbias, seed,
+                    q, k, v, kbias, seed, block_q=bq, block_k=bk,
                     dropout_rate=float(cfg.attn_dropout_ratio))
             elif kbias is None:
-                ctx = flash_attention(q, k, v, False)
+                ctx = flash_attention(q, k, v, False, None, bq, bk)
             else:
-                ctx = flash_attention_kbias(q, k, v, kbias, False)
+                ctx = flash_attention_kbias(q, k, v, kbias, False, None,
+                                            bq, bk)
         else:
             scale = 1.0 / math.sqrt(hd)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,

@@ -8,13 +8,21 @@ TPU-native long-context answer, first-class per the build goals:
   around the ``seq`` mesh axis via `ppermute` (ICI neighbor hops), with
   online-softmax merging of per-chunk partials — memory per chip is
   O(S/n · S/n) and the full sequence never materializes anywhere.
+- **Balanced causal ring** (`ring_attention_balanced`): striped/zigzag
+  shard assignment — rank r holds sequence chunks r and 2n-1-r (head +
+  tail paired), so every rank carries the same causal workload instead
+  of rank 0's shard being almost entirely masked. Off-diagonal ring
+  steps then compute exactly the two alive c×c tiles (half the dense
+  flops), selected data-dependently so the program is identical on all
+  ranks.
 - **Ulysses / all-to-all** (`ulysses_attention`): `all_to_all` swaps the
   sharded axis from sequence to heads, runs ordinary (flash) attention on
   full sequences for 1/n of the heads, and swaps back. Cheaper collectives
   when heads ≥ chips.
 
-Both are pure functions usable inside `shard_map` over a mesh axis, and
-`SequenceParallel` wraps mesh plumbing for whole-array callers.
+All are pure functions usable inside `shard_map` over a mesh axis, and
+`SequenceParallel` wraps mesh plumbing (including the zigzag permutation
+and its inverse) for whole-array callers.
 """
 
 import math
@@ -22,7 +30,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
@@ -58,25 +66,141 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
         logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
                             k_cur.astype(jnp.float32),
                             preferred_element_type=jnp.float32) * scale
-        rows = jnp.arange(s_local)[:, None] + my_idx * s_local
-        cols = jnp.arange(s_local)[None, :] + src * s_local
+        keep = None
         if causal:
+            rows = jnp.arange(s_local)[:, None] + my_idx * s_local
+            cols = jnp.arange(s_local)[None, :] + src * s_local
             keep = rows >= cols
-        else:
-            keep = jnp.full((s_local, s_local), True)
-        logits = jnp.where(keep[None, None], logits, NEG_INF)
-
-        m_c = jnp.max(logits, axis=-1)                 # [B,H,Sq]
-        m_new = jnp.maximum(m_run, m_c)
-        p = jnp.exp(logits - m_new[..., None])         # masked → 0
-        alpha = jnp.exp(m_run - m_new)
-        l_run = l_run * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
-            jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
-        m_run = m_new
+        m_run, l_run, acc = _osm_fold(m_run, l_run, acc, logits, v_cur,
+                                      keep)
         if step < n - 1:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    l_safe = jnp.maximum(l_run, 1e-30)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _osm_fold(m, l, acc, logits, v, mask=None):
+    """One online-softmax fold: merge a [B, H, R, C] logits tile (keys'
+    values v [B, C, H, D]) into the running (m [B, H, R], l, acc
+    [B, R, H, D]) statistics."""
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m_c = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m, m_c)
+    p = jnp.exp(logits - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + \
+        jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def zigzag_chunk_order(n):
+    """Global chunk order of the striped causal shard assignment: the
+    sequence splits into 2n chunks and rank r owns chunks (r, 2n-1-r) —
+    head and tail paired, so every rank carries the same causal load
+    (the plain contiguous split gives rank 0 an almost fully masked
+    shard and rank n-1 an almost dense one)."""
+    order = []
+    for r in range(n):
+        order += [r, 2 * n - 1 - r]
+    return order
+
+
+def ring_attention_balanced(q, k, v, axis_name, sm_scale=None,
+                            axis_size=None):
+    """Causal ring attention over ZIGZAG shards inside shard_map: the
+    local [B, S/n, H, D] shard holds global chunks (r, 2n-1-r) (see
+    `zigzag_chunk_order`; `SequenceParallel` applies the permutation).
+
+    Load balance: pairing head and tail chunks makes each rank's alive
+    causal area equal, and each off-diagonal ring step computes exactly
+    TWO unmasked c×c tiles instead of the dense 2c×2c four:
+
+    - (tail rows × head kv chunk): always fully alive — the tail chunk
+      index 2n-1-r is ≥ n, every kv head chunk index is < n.
+    - one of (head rows × head kv) or (tail rows × tail kv), picked by
+      whether the kv source rank precedes this rank in the stripe; the
+      pick is a data-dependent `where` on equal-shaped tiles so every
+      rank runs the same program (no per-rank lowering divergence).
+
+    Step 0 (own kv) folds the dense 2c×2c tile under the static zigzag
+    diagonal mask [[tril, 0], [1, tril]]. Total per-step flops are
+    rank-independent — the property the contiguous causal ring lacks.
+    """
+    n = axis_size
+    if not isinstance(n, int):
+        raise ValueError("ring_attention_balanced needs a static axis_size")
+    b, s_local, h, d = q.shape
+    if s_local % 2:
+        raise ValueError("zigzag shards need an even local sequence")
+    c = s_local // 2
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    m_run = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l_run = jnp.zeros((b, h, s_local), jnp.float32)
+    acc = jnp.zeros((b, s_local, h, d), jnp.float32)
+
+    # step 0: own kv — dense fold under the (rank-independent) zigzag
+    # diagonal mask: within-chunk tril on both halves, tail sees all of
+    # head (tail positions are globally later than every head position)
+    tri = jnp.tril(jnp.ones((c, c), bool))
+    mask0 = jnp.concatenate([
+        jnp.concatenate([tri, jnp.zeros((c, c), bool)], axis=1),
+        jnp.concatenate([jnp.ones((c, c), bool), tri], axis=1),
+    ], axis=0)
+    logits0 = jnp.einsum("bqhd,bkhd->bhqk", q32, k.astype(jnp.float32),
+                         preferred_element_type=jnp.float32) * scale
+    m_run, l_run, acc = _osm_fold(m_run, l_run, acc, logits0, v, mask0)
+
+    k_cur, v_cur = k, v
+    q_head, q_tail = q32[:, :c], q32[:, c:]
+    for step in range(1, n):
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k32 = k_cur.astype(jnp.float32)
+        k_head, k_tail = k32[:, :c], k32[:, c:]
+        v_head, v_tail = v_cur[:, :c], v_cur[:, c:]
+
+        # tile A: tail rows × kv head chunk — always fully alive
+        m_t, l_t = m_run[:, :, c:], l_run[:, :, c:]
+        acc_t = acc[:, c:]
+        logits_a = jnp.einsum("bqhd,bkhd->bhqk", q_tail, k_head,
+                              preferred_element_type=jnp.float32) * scale
+        m_t, l_t, acc_t = _osm_fold(m_t, l_t, acc_t, logits_a, v_head)
+
+        # tile B: kv source rank src = (my - step) mod n precedes this
+        # rank (src < my ⇔ step ≤ my) → head rows × kv head chunk;
+        # otherwise tail rows × kv tail chunk. Same-shape `where` picks.
+        to_head = (my_idx >= step)
+        q_b = jnp.where(to_head, q_head, q_tail)
+        k_b = jnp.where(to_head, k_head, k_tail)
+        v_b = jnp.where(to_head, v_head, v_tail)
+        m_h, l_h = m_run[:, :, :c], l_run[:, :, :c]
+        acc_h = acc[:, :c]
+        m_sel = jnp.where(to_head, m_h, m_t)
+        l_sel = jnp.where(to_head, l_h, l_t)
+        acc_sel = jnp.where(to_head, acc_h, acc_t)
+        logits_b = jnp.einsum("bqhd,bkhd->bhqk", q_b, k_b,
+                              preferred_element_type=jnp.float32) * scale
+        m_sel, l_sel, acc_sel = _osm_fold(m_sel, l_sel, acc_sel,
+                                          logits_b, v_b)
+        m_h = jnp.where(to_head, m_sel, m_h)
+        l_h = jnp.where(to_head, l_sel, l_h)
+        acc_h = jnp.where(to_head, acc_sel, acc_h)
+        m_t = jnp.where(to_head, m_t, m_sel)
+        l_t = jnp.where(to_head, l_t, l_sel)
+        acc_t = jnp.where(to_head, acc_t, acc_sel)
+
+        m_run = jnp.concatenate([m_h, m_t], axis=2)
+        l_run = jnp.concatenate([l_h, l_t], axis=2)
+        acc = jnp.concatenate([acc_h, acc_t], axis=1)
 
     l_safe = jnp.maximum(l_run, 1e-30)
     out = acc / l_safe.transpose(0, 2, 1)[..., None]
@@ -117,20 +241,53 @@ def ulysses_attention(q, k, v, axis_name, attn_fn=None, causal=True,
 
 class SequenceParallel:
     """Whole-array wrapper: shards [B, S, H, D] over `axis` of `mesh` and
-    applies ring or Ulysses attention under shard_map."""
+    applies ring or Ulysses attention under shard_map.
 
-    def __init__(self, mesh, axis="seq", mode="ring", causal=True):
+    `balance` (causal ring only): zigzag/striped shard assignment so SP
+    ranks do equal causal work (`ring_attention_balanced`). Default None
+    = auto: balanced whenever the sequence splits into 2n chunks; set
+    False to force the contiguous assignment, True to require balancing
+    (raises if the sequence does not divide)."""
+
+    def __init__(self, mesh, axis="seq", mode="ring", causal=True,
+                 balance=None):
         if axis not in mesh.axis_names:
             raise ValueError(f"mesh has no axis {axis!r}")
+        if balance and mode != "ring":
+            # refuse rather than silently run unbalanced — the explicit
+            # request cannot be honored on this mode
+            raise ValueError(
+                f"balance=True applies to causal ring only, not "
+                f"mode={mode!r}")
         self.mesh = mesh
         self.axis = axis
         self.mode = mode
         self.causal = causal
+        self.balance = balance
         self.axis_size = int(mesh.shape[axis])
+
+    def _use_balance(self, s):
+        if not self.causal:
+            if self.balance:
+                raise ValueError("balance=True needs a causal ring")
+            return False
+        if self.axis_size == 1:
+            # balanced and contiguous assignments coincide on one rank;
+            # honor balance=True as a no-op so device-count-agnostic
+            # configs run unchanged in single-device debug runs
+            return False
+        fits = s % (2 * self.axis_size) == 0
+        if self.balance and not fits:
+            raise ValueError(
+                f"balance=True needs seq {s} divisible by "
+                f"2*axis_size={2 * self.axis_size}")
+        return fits if self.balance is None else bool(self.balance)
 
     def __call__(self, q, k, v):
         spec = P(None, self.axis, None, None)
         if self.mode == "ring":
+            if self._use_balance(q.shape[1]):
+                return self._balanced_ring(q, k, v, spec)
             fn = partial(ring_attention, axis_name=self.axis,
                          causal=self.causal, axis_size=self.axis_size)
         elif self.mode == "ulysses":
@@ -141,3 +298,21 @@ class SequenceParallel:
         mapped = shard_map(lambda q, k, v: fn(q, k, v), mesh=self.mesh,
                            in_specs=(spec, spec, spec), out_specs=spec)
         return mapped(q, k, v)
+
+    def _balanced_ring(self, q, k, v, spec):
+        """Permute the sequence into the zigzag chunk order, run the
+        balanced ring, and invert the permutation on the output (the
+        gather pair is O(S·H·D) data movement, amortized over the
+        O(S²/n·H·D) attention it balances)."""
+        import numpy as np
+        n = self.axis_size
+        c = q.shape[1] // (2 * n)
+        perm = np.concatenate(
+            [np.arange(c) + ch * c for ch in zigzag_chunk_order(n)])
+        inv = np.argsort(perm)
+        fn = partial(ring_attention_balanced, axis_name=self.axis,
+                     axis_size=n)
+        mapped = shard_map(lambda q, k, v: fn(q, k, v), mesh=self.mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+        out = mapped(*(jnp.take(t, perm, axis=1) for t in (q, k, v)))
+        return jnp.take(out, inv, axis=1)

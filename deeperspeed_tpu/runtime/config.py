@@ -352,7 +352,9 @@ class DeepSpeedConfig:
             "capacity_factor": float(moe.get("capacity_factor", 1.25)),
             "jitter_eps": float(moe.get("jitter_eps", 0.0)),
             "aux_loss_coef": float(moe.get("aux_loss_coef", 0.01)),
-            "num_groups": int(moe.get("num_groups", 0)),
+            # 1 = global capacity (reference numerics); 0 opts in
+            # to auto-sized groups
+            "num_groups": int(moe.get("num_groups", 1)),
         } if self.moe_enabled else False
         sp = d.get("sequence_parallel") or {}
         self.sequence_parallel_enabled = bool(sp.get("enabled", False))

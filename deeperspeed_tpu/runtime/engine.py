@@ -1162,7 +1162,7 @@ class DeepSpeedEngine:
         `freeze_step`. Error-feedback buffers carry a leading [world]
         dim sharded over data so each rank round-trips its own
         residuals."""
-        from jax import shard_map
+        from ..compat import shard_map
         axis = self.data_axis
         warm = not getattr(self, "_onebit_post_phase", False)
 

@@ -64,3 +64,23 @@ def test_disabled_by_default(monkeypatch):
     assert not autotune_enabled()
     monkeypatch.setenv("DS_TPU_AUTOTUNE", "1")
     assert autotune_enabled()
+
+
+def test_flash_blocks_for_tunes_long_sequences_only(monkeypatch):
+    """Without the autotune env, short sequences keep the static default
+    (None) and sequences past DS_FLASH_TUNE_MIN_SEQ get a measured pick
+    that divides the sequence — the long-context dispatch contract."""
+    from deeperspeed_tpu.ops.autotune import flash_blocks_for
+    monkeypatch.delenv("DS_TPU_AUTOTUNE", raising=False)
+    monkeypatch.setenv("DS_FLASH_TUNE_MIN_SEQ", "512")
+    tuner = Autotuner(warmup=0, iters=1)
+    assert flash_blocks_for((1, 256, 2, 64), jnp.float32, True,
+                            tuner=tuner) is None
+    bq, bk = flash_blocks_for((1, 512, 1, 64), jnp.float32, True,
+                              tuner=tuner)
+    assert 512 % bq == 0 and 512 % bk == 0
+    # explicit DS_TPU_AUTOTUNE=0 is a kill switch: no measurement even
+    # past the long-seq threshold
+    monkeypatch.setenv("DS_TPU_AUTOTUNE", "0")
+    assert flash_blocks_for((1, 1024, 1, 64), jnp.float32, True,
+                            tuner=Autotuner(warmup=0, iters=1)) is None

@@ -7,7 +7,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from deeperspeed_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import deeperspeed_tpu

@@ -9,7 +9,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from jax import shard_map
+from deeperspeed_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeperspeed_tpu.ops.adam.fused_adam import FusedAdam

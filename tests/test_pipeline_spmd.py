@@ -41,7 +41,7 @@ def test_spmd_pipeline_matches_sequential(pipe_mesh):
         y, _ = jax.lax.scan(one, x, w_local)
         return y
 
-    from jax import shard_map
+    from deeperspeed_tpu.compat import shard_map
 
     def run(ws, x_micro):
         outputs = spmd_pipeline(stage_fn, ws, x_micro, "pipe", n_stages,
@@ -145,7 +145,7 @@ def test_engine_with_spmd_pipeline(pipe_mesh):
 
 def test_block_forward_tp_matches_dense(devices):
     """Megatron TP block (explicit psum inside shard_map) == dense block."""
-    from jax import shard_map
+    from deeperspeed_tpu.compat import shard_map
     from deeperspeed_tpu.models import gpt_neox as M
 
     cfg = GPTNeoXConfig(vocab_size=64, hidden_size=32, num_layers=1,

@@ -84,6 +84,57 @@ def test_ulysses_head_divisibility(seq_mesh):
         jax.block_until_ready(sp(*bad))
 
 
+def test_ring_balanced_matches_single_device(seq_mesh):
+    """Zigzag/striped shard assignment (the causal-ring default when the
+    sequence splits into 2n chunks) must match single-device attention —
+    each rank holds head+tail chunks, so per-rank causal work is equal."""
+    q, k, v = make_qkv(seed=4)
+    sp = SequenceParallel(seq_mesh, axis="seq", mode="ring", causal=True,
+                          balance=True)
+    out = sp(q, k, v)
+    ref = reference_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # contiguous assignment still available via balance=False
+    sp_off = SequenceParallel(seq_mesh, axis="seq", mode="ring",
+                              causal=True, balance=False)
+    np.testing.assert_allclose(np.asarray(sp_off(q, k, v)),
+                               np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_balanced_grads(seq_mesh):
+    q, k, v = make_qkv(seed=5)
+    sp = SequenceParallel(seq_mesh, axis="seq", mode="ring", causal=True,
+                          balance=True)
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(sp(q, k, v) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-3,
+                                   err_msg=f"d{name}")
+
+
+def test_ring_balanced_requires_divisible_seq(seq_mesh):
+    sp = SequenceParallel(seq_mesh, axis="seq", mode="ring", causal=True,
+                          balance=True)
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    bad = tuple(jax.random.normal(kk, (1, 40, 8, D)) for kk in ks)
+    with pytest.raises(ValueError):
+        sp(*bad)
+
+
+def test_zigzag_order_pairs_head_and_tail():
+    from deeperspeed_tpu.parallel.sequence import zigzag_chunk_order
+    order = zigzag_chunk_order(4)
+    assert order == [0, 7, 1, 6, 2, 5, 3, 4]
+    # every rank's chunk pair sums to 2n-1 → equal causal area
+    for r in range(4):
+        assert order[2 * r] + order[2 * r + 1] == 7
+
+
 def test_ring_long_sequence_memory_shape(seq_mesh):
     """Ring attention never materializes [S, S]; spot-check a longer
     sequence still works and matches."""

@@ -10,7 +10,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from deeperspeed_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deeperspeed_tpu.parallel.mesh import PipelineParallelGrid, build_mesh
