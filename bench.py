@@ -233,6 +233,29 @@ def row_bert512():
     return _bert_row(512, [20, 16, 12, 8])
 
 
+def _xl_prescreen(jax, xcfg, policy, nckpt, bs):
+    """(fits, stats) for one (remat policy × batch) rung: AOT-compile the
+    bf16 grad program over abstract shapes (`memory_analysis()`, no HBM
+    touched) and add the resident optimizer state the program doesn't
+    see (lean state: params-as-masters + 2 bf16 Adam moments)."""
+    import jax.numpy as jnp
+    from deeperspeed_tpu.models.gpt2 import GPT2
+    from deeperspeed_tpu.ops.autotune import memory_feasible
+    model = GPT2(xcfg, use_pallas=True, scan_blocks=True,
+                 remat_policy=policy, number_checkpoints=nckpt)
+    pshapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+    pshapes = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16), pshapes)
+    toks = jax.ShapeDtypeStruct((bs, 1024), jnp.int32)
+
+    def grad_step(p, t):
+        return jax.grad(lambda q: model.loss_fn(q, (t, t)))(p)
+
+    moments = 2 * xcfg.num_params() * 2  # 2 bf16 moments rest in HBM
+    return memory_feasible(grad_step, (pshapes, toks),
+                           extra_bytes=moments)
+
+
 def row_gpt2xl():
     jax = _setup_jax()
     n_chips = len(jax.devices())
@@ -241,13 +264,16 @@ def row_gpt2xl():
     from deeperspeed_tpu.models.gpt2 import GPT2, GPT2Config
     xcfg = GPT2Config.megatron_1_5b()
 
-    def run(bs_per_chip, zero_cfg, steps=2, warmup=1, lean_state=False):
+    def run(bs_per_chip, zero_cfg, steps=2, warmup=1, lean_state=False,
+            remat_policy=None, number_checkpoints=None):
         def thunk():
             # scan_blocks: one compiled block body instead of 48 —
             # the unrolled 48-layer remat program took ~20 min of XLA
             # compile; the scanned one compiles in normal time
-            xmodel = GPT2(xcfg, use_pallas=True, remat_blocks=True,
-                          scan_blocks=True)
+            xmodel = GPT2(xcfg, use_pallas=True,
+                          remat_blocks=remat_policy is None,
+                          scan_blocks=True, remat_policy=remat_policy,
+                          number_checkpoints=number_checkpoints)
             # init on the HOST cpu backend: the host-offload tier reads
             # fp32 masters host-side anyway — initializing on the chip
             # would round-trip 6.2 GB back over the (slow, tunneled)
@@ -289,21 +315,75 @@ def row_gpt2xl():
                 "gpt2_xl_1p5b_params_b": round(xn / 1e9, 3),
                 "gpt2_xl_1p5b_loss": xl_loss,
                 "gpt2_xl_1p5b_batch_per_chip": bs_per_chip,
+                # remat attribution: BENCH_*.json trajectories must say
+                # WHICH policy/batch produced an MFU move
+                "gpt2_xl_1p5b_remat_policy": remat_policy or "full",
+                "gpt2_xl_1p5b_number_checkpoints": number_checkpoints,
                 "gpt2_xl_1p5b_peak_rss_gb": round(
                     resource.getrusage(resource.RUSAGE_SELF).ru_maxrss /
                     1e6, 2),
             }
         return thunk
 
-    host_opt = {"stage": 3, "offload_optimizer": {"device": "cpu"}}
-    bs0 = int(os.environ.get("DS_BENCH_XL_BS", "4"))
-    ladder = [(f"onchip_lean_bs{b}", run(b, {"stage": 0}, steps=3,
-                                         warmup=2, lean_state=True))
-              for b in [bs0] + [b for b in (2,) if b < bs0]]
+    # ------------------------------------------------------------------
+    # (remat policy × batch) ladder, memory-screened: richer policies
+    # (save more, recompute less) at the largest batch that FITS, walked
+    # fattest-first; `memory_analysis()` on the AOT-compiled grad program
+    # rejects infeasible rungs before any timed run. The legacy
+    # full-remat bs4 rung stays as the floor, the ZeRO-Offload tier as
+    # the final fallback.
+    # ------------------------------------------------------------------
+    bs0 = int(os.environ.get("DS_BENCH_XL_BS", "8"))
+    # descending from bs0 (the env cap), never below the bs4 floor rung
+    bs_ladder = [b for b in dict.fromkeys((bs0, 6, 4)) if b <= bs0]
+    policies = [p.strip() for p in os.environ.get(
+        "DS_BENCH_XL_POLICIES", "dots,attn_residuals,full").split(",")
+        if p.strip()]
+    nckpt_env = os.environ.get("DS_BENCH_XL_NCKPT")
+    nckpt = int(nckpt_env) if nckpt_env and int(nckpt_env) > 0 else None
+
+    ladder, screened_out, screen_errors = [], [], []
+    # screening needs a known HBM budget; off-TPU the AOT compile would
+    # burn minutes to learn nothing (hbm_bytes_limit() is None there)
+    screen = os.environ.get("DS_BENCH_XL_SCREEN", "1") not in (
+        "0", "false", "") and jax.devices()[0].platform == "tpu"
+    for bs in bs_ladder:
+        for pol in policies:
+            if pol == "full" and bs == 4 and nckpt is None:
+                continue  # that's exactly the floor rung below
+            tag = f"onchip_{pol}_bs{bs}" + \
+                (f"_k{nckpt}" if nckpt else "")
+            if screen:
+                try:
+                    fits, stats = _xl_prescreen(jax, xcfg, pol, nckpt, bs)
+                except Exception as e:  # noqa: BLE001 - screen, don't die
+                    # the rung still RUNS (screening must never lose a
+                    # viable config); record the screen failure apart
+                    # from the genuinely excluded rungs
+                    fits, stats = True, None
+                    screen_errors.append(
+                        f"{tag}: {type(e).__name__}")
+                if not fits:
+                    screened_out.append(
+                        f"{tag}: peak {round(stats['peak'] / 2**30, 1)} "
+                        "GiB over budget")
+                    continue
+            ladder.append((tag, run(bs, {"stage": 0}, steps=3, warmup=2,
+                                    lean_state=True, remat_policy=pol,
+                                    number_checkpoints=nckpt)))
+    # floor: the pre-policy configuration (whole-block remat, bs4)
+    ladder.append(("onchip_lean_bs4", run(4, {"stage": 0}, steps=3,
+                                          warmup=2, lean_state=True)))
     # ZeRO-Offload rung last: the reference path (13B-on-one-GPU tier),
     # viable where the host link is PCIe — not over a 5 MB/s tunnel
+    host_opt = {"stage": 3, "offload_optimizer": {"device": "cpu"}}
     ladder.append(("z3_hostopt_bs2", run(2, host_opt)))
-    return _ladder(ladder, {}, "gpt2_xl_1p5b")
+    out = {}
+    if screened_out:
+        out["gpt2_xl_1p5b_screened_out"] = "; ".join(screened_out)[:400]
+    if screen_errors:
+        out["gpt2_xl_1p5b_screen_errors"] = "; ".join(screen_errors)[:300]
+    return _ladder(ladder, out, "gpt2_xl_1p5b")
 
 
 def row_longseq():
@@ -330,7 +410,9 @@ def row_longseq():
                 seq // 2   # causal: half the score tiles are dead
             tag = f"longseq_{seq // 1024}k"
             return {f"{tag}_tokens_per_sec_chip": round(tps, 1),
-                    f"{tag}_mfu": round(tps * lftok / peak, 4)}
+                    f"{tag}_mfu": round(tps * lftok / peak, 4),
+                    f"{tag}_remat_policy": "full",
+                    f"{tag}_batch_per_chip": bs_per_chip}
         return thunk
 
     lbs = int(os.environ.get("DS_BENCH_LONG_BS", "2"))

@@ -1,5 +1,5 @@
-"""Shims over jax API renames so the framework runs on every jax the
-fleet actually has installed.
+"""Shims over jax API renames (and version-specific miscompiles) so the
+framework runs on every jax the fleet actually has installed.
 
 Two symbols moved between the jax versions we support:
 
@@ -9,6 +9,10 @@ Two symbols moved between the jax versions we support:
   ``pltpu.CompilerParams`` (jax 0.5).
 
 Import both from here; never from jax directly.
+
+One workaround for a jax 0.4.37 GSPMD bug lives here too: ``pad_tail``
+(see its docstring) — use it instead of ``jnp.concatenate`` whenever a
+possibly-sharded array gets a constant tail appended.
 """
 
 import functools
@@ -34,3 +38,23 @@ from jax.experimental.pallas import tpu as _pltpu
 # jax >= 0.5 spelling first; fall back to the long-stable old name.
 CompilerParams = getattr(_pltpu, "CompilerParams", None) or \
     _pltpu.TPUCompilerParams
+
+
+def pad_tail(x, n_pad, value):
+    """Append ``n_pad`` rows of ``value`` along axis 0 — via ``jnp.pad``,
+    NEVER ``jnp.concatenate``.
+
+    jax 0.4.37's SPMD partitioner miscompiles
+    ``concatenate([reshape(slice(sharded)), replicated_fill])``: the
+    sharded operand is read back with a strided/garbled element order, so
+    the padded array's REAL values are wrong (measured on the CPU backend
+    with a ``data``-sharded [B, S] batch: element i comes back as 2i).
+    The ``pad`` HLO lowers correctly on every jax we support. This bug
+    corrupted the fused LM-head loss labels on any multi-axis mesh — the
+    TP/SP trajectory-parity failures tracked since PR 1 were exactly this.
+    """
+    import jax.numpy as jnp
+    if n_pad == 0:
+        return x
+    widths = [(0, n_pad)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, widths, constant_values=value)

@@ -111,10 +111,23 @@ def _layer_norm(x, scale, bias, eps):
 class BertModel:
     """Embeddings + encoder + pooler (reference `modeling.py` BertModel)."""
 
-    def __init__(self, config=None, **kw):
+    def __init__(self, config=None, remat_policy=None,
+                 number_checkpoints=None, **kw):
         self.config = config or BertConfig(**kw)
         self.layer = DeepSpeedTransformerLayer(
             self.config.transformer_config())
+        # Remat knobs (gpt_neox.resolve_remat semantics): a named
+        # jax.checkpoint policy, and number_checkpoints segment spans
+        # over the encoder stack. Config-drivable via apply_ds_config.
+        self.remat_policy = remat_policy
+        self.number_checkpoints = number_checkpoints
+        self._ckpt_boundary_fn = None
+
+    def apply_ds_config(self, ds_config, mesh=None):
+        from .gpt_neox import (apply_activation_checkpointing_config,
+                               reject_unsupported_ds_blocks)
+        reject_unsupported_ds_blocks(ds_config, "BERT")
+        apply_activation_checkpointing_config(self, ds_config, mesh)
 
     # -- params -----------------------------------------------------------
 
@@ -162,11 +175,39 @@ class BertModel:
                collect_hidden=False):
         """Run embeddings + encoder; with `collect_hidden` also return
         the per-layer outputs (the activation-capture path shares this
-        exact forward)."""
+        exact forward).
+
+        With remat knobs set (and no hidden collection) the encoder runs
+        as `number_checkpoints` checkpoint spans — each span recomputes
+        its layers in backward under the named policy; explicit dropout
+        keys replay identically by construction."""
+        from .gpt_neox import resolve_remat
         x = self.embed(params, input_ids, token_type_ids)
         hidden = [x] if collect_hidden else None
-        rngs = (jax.random.split(rng, self.config.num_layers)
-                if rng is not None else [None] * self.config.num_layers)
+        L = self.config.num_layers
+        rngs = (list(jax.random.split(rng, L))
+                if rng is not None else [None] * L)
+        do_remat, policy, n_ckpt = (False, None, None) if collect_hidden \
+            else resolve_remat(False, self.remat_policy,
+                               self.number_checkpoints)
+        if do_remat:
+            def seg_fn(x, seg_params, seg_rngs, mask):
+                for lp, r in zip(seg_params, seg_rngs):
+                    x = self.layer.apply(lp, x, attention_mask=mask,
+                                         rng=r,
+                                         deterministic=deterministic)
+                return x
+
+            from .gpt_neox import segment_sizes
+            ck = jax.checkpoint(seg_fn, policy=policy)
+            edge = self._ckpt_boundary_fn or (lambda c: c)
+            sizes = segment_sizes(L, n_ckpt if n_ckpt is not None else L)
+            idx = 0
+            for size in sizes:
+                x = ck(edge(x), params["layers"][idx:idx + size],
+                       rngs[idx:idx + size], attention_mask)
+                idx += size
+            return x
         for lp, r in zip(params["layers"], rngs):
             x = self.layer.apply(lp, x, attention_mask=attention_mask,
                                  rng=r, deterministic=deterministic)
@@ -216,6 +257,9 @@ class BertForPreTraining:
     def __init__(self, config=None, **kw):
         self.bert = BertModel(config, **kw)
         self.config = self.bert.config
+
+    def apply_ds_config(self, ds_config, mesh=None):
+        self.bert.apply_ds_config(ds_config, mesh)
 
     def init_params(self, rng):
         cfg = self.config
@@ -332,6 +376,9 @@ class BertForQuestionAnswering:
     def __init__(self, config=None, **kw):
         self.bert = BertModel(config, **kw)
         self.config = self.bert.config
+
+    def apply_ds_config(self, ds_config, mesh=None):
+        self.bert.apply_ds_config(ds_config, mesh)
 
     def init_params(self, rng):
         k1, k2 = jax.random.split(rng)

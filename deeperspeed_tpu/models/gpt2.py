@@ -120,34 +120,52 @@ def block_forward(cfg, params, x, use_pallas=True):
 
 
 def forward_hidden(cfg, params, tokens, use_pallas=True,
-                   remat_blocks=False, scan_blocks=False):
+                   remat_blocks=False, scan_blocks=False,
+                   remat_policy=None, number_checkpoints=None,
+                   boundary_fn=None):
     """tokens [B, S] → final-norm hidden [B, S, H].
 
     `scan_blocks` runs the (identically-shaped) blocks as ONE
     `lax.scan` over stacked parameters instead of a Python loop — see
     `gpt_neox.scan_stacked_blocks` (shared helper): XLA compile time
-    O(1) in depth instead of O(L)."""
+    O(1) in depth instead of O(L). Remat knobs (`remat_policy`,
+    `number_checkpoints`, `boundary_fn`) follow `gpt_neox.forward_hidden`
+    — same resolution (`gpt_neox.resolve_remat`), same segmented-scan
+    checkpointing (`gpt_neox.segmented_scan_blocks`)."""
+    from .gpt_neox import (resolve_remat, scan_stacked_blocks,
+                           segmented_scan_blocks)
     S = tokens.shape[1]
     x = params["embed"]["wte"][tokens] + \
         params["embed"]["wpe"][:S][None]
+    do_remat, policy, n_ckpt = resolve_remat(remat_blocks, remat_policy,
+                                             number_checkpoints)
     block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
-    if remat_blocks:
-        block_fn = jax.checkpoint(block_fn)
-    if scan_blocks and len(params["blocks"]) > 1:
-        from .gpt_neox import scan_stacked_blocks
-        x = scan_stacked_blocks(block_fn, x, params["blocks"])
+    if n_ckpt is not None and len(params["blocks"]) > 1:
+        x = segmented_scan_blocks(lambda bp, x: block_fn(bp, x), x,
+                                  params["blocks"], n_ckpt, policy=policy,
+                                  boundary_fn=boundary_fn)
     else:
-        for bp in params["blocks"]:
-            x = block_fn(bp, x)
+        if do_remat:
+            ck = jax.checkpoint(block_fn, policy=policy)
+            # partition_activations constrains every saved block carry
+            edge = boundary_fn if boundary_fn is not None else (lambda c: c)
+            block_fn = lambda bp, x: ck(bp, edge(x))  # noqa: E731
+        if scan_blocks and len(params["blocks"]) > 1:
+            x = scan_stacked_blocks(block_fn, x, params["blocks"])
+        else:
+            for bp in params["blocks"]:
+                x = block_fn(bp, x)
     return layer_norm(x, params["final_ln"]["scale"],
                       params["final_ln"]["bias"], cfg.layernorm_eps)
 
 
 def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False,
-            scan_blocks=False):
+            scan_blocks=False, remat_policy=None, number_checkpoints=None):
     """tokens [B, S] → logits [B, S, V] (tied embeddings)."""
     x = forward_hidden(cfg, params, tokens, use_pallas=use_pallas,
-                       remat_blocks=remat_blocks, scan_blocks=scan_blocks)
+                       remat_blocks=remat_blocks, scan_blocks=scan_blocks,
+                       remat_policy=remat_policy,
+                       number_checkpoints=number_checkpoints)
     return jnp.einsum("bsh,vh->bsv", x,
                       params["embed"]["wte"].astype(x.dtype),
                       preferred_element_type=jnp.float32)
@@ -169,11 +187,24 @@ class GPT2:
     """Engine-protocol wrapper: loss_fn / init_params / param_specs."""
 
     def __init__(self, config=None, use_pallas=True, remat_blocks=False,
-                 scan_blocks=False, **kwargs):
+                 scan_blocks=False, remat_policy=None,
+                 number_checkpoints=None, **kwargs):
         self.config = config or GPT2Config(**kwargs)
         self.use_pallas = use_pallas
         self.remat_blocks = remat_blocks
         self.scan_blocks = scan_blocks
+        self.remat_policy = remat_policy
+        self.number_checkpoints = number_checkpoints
+        self._ckpt_boundary_fn = None
+
+    def apply_ds_config(self, ds_config, mesh=None):
+        """Wire the JSON `activation_checkpointing` block into the remat
+        knobs; moe/sequence_parallel stay loud failures (shared helpers
+        with the NeoX family)."""
+        from .gpt_neox import (apply_activation_checkpointing_config,
+                               reject_unsupported_ds_blocks)
+        reject_unsupported_ds_blocks(ds_config, "GPT2")
+        apply_activation_checkpointing_config(self, ds_config, mesh)
 
     def init_params(self, rng):
         return init_params(self.config, rng)
@@ -188,7 +219,9 @@ class GPT2:
         return forward(self.config, params, tokens,
                        use_pallas=self.use_pallas,
                        remat_blocks=self.remat_blocks,
-                       scan_blocks=self.scan_blocks)
+                       scan_blocks=self.scan_blocks,
+                       remat_policy=self.remat_policy,
+                       number_checkpoints=self.number_checkpoints)
 
     def loss_fn(self, params, batch, rng=None):
         tokens, labels = batch if isinstance(batch, (tuple, list)) \
@@ -196,5 +229,8 @@ class GPT2:
         hidden = forward_hidden(self.config, params, tokens,
                                 use_pallas=self.use_pallas,
                                 remat_blocks=self.remat_blocks,
-                                scan_blocks=self.scan_blocks)
+                                scan_blocks=self.scan_blocks,
+                                remat_policy=self.remat_policy,
+                                number_checkpoints=self.number_checkpoints,
+                                boundary_fn=self._ckpt_boundary_fn)
         return fused_lm_head_loss(hidden, params["embed"]["wte"], labels)

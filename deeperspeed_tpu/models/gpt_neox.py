@@ -249,7 +249,14 @@ def causal_attention(q, k, v, use_pallas=True):
 
     Uses the Pallas flash-attention kernel on TPU when shapes allow;
     XLA-fused fallback otherwise (the fallback still fuses well — softmax
-    and the PV matmul land on the MXU)."""
+    and the PV matmul land on the MXU).
+
+    Every path tags its output with the `attn_residuals` remat name (the
+    flash custom_vjp additionally tags its saved out/LSE residuals), so
+    the `attn_residuals` policy pins attention results across remat
+    boundaries on kernel and fallback paths alike."""
+    from ..runtime.activation_checkpointing.checkpointing import \
+        tag_attn_residual
     if use_pallas:
         try:
             from ..ops.pallas.flash_attention import flash_attention_supported
@@ -295,7 +302,7 @@ def causal_attention(q, k, v, use_pallas=True):
     mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
     logits = jnp.where(mask[None, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return tag_attn_residual(jnp.einsum("bhqk,bkhd->bqhd", probs, v))
 
 
 def _block_qkv(cfg, params, x, cos, sin, rot_dim, nh_local):
@@ -427,9 +434,87 @@ def scan_stacked_blocks(block_fn, x, blocks):
         lambda carry, bp: (block_fn(bp, carry), None), x, stacked)[0]
 
 
+def segment_sizes(n_layers, n_segments):
+    """Span lengths for segmented checkpointing: n_segments as-equal-as-
+    possible groups over n_layers (earlier spans get the remainder).
+    Shared by the scan (NeoX/GPT-2) and loop (BERT) segment paths so the
+    partitioning can never drift between families."""
+    n = max(1, min(int(n_segments), n_layers))
+    return [n_layers // n + (1 if i < n_layers % n else 0)
+            for i in range(n)]
+
+
+def segmented_scan_blocks(block_fn, x, blocks, n_segments, policy=None,
+                          boundary_fn=None):
+    """Segmented-scan checkpointing: remat at SEGMENT boundaries instead
+    of per block (the reference's `number_checkpoints` semantics —
+    `deepspeed/runtime/activation_checkpointing/checkpointing.py:687`
+    splits the layer stack into `num_checkpoints` recompute spans).
+
+    The L blocks are grouped into `n_segments` spans; each span is ONE
+    `jax.checkpoint(policy=...)` region whose interior is a `lax.scan`
+    over its k stacked block params — so only segment-boundary carries
+    (plus whatever the policy names) are saved, and backward recomputes
+    k blocks per span. With L % n == 0 the segments themselves ride an
+    outer `lax.scan`, keeping compile time O(1) in depth (composes with
+    `scan_stacked_blocks`); ragged layer counts fall back to a Python
+    loop over segments (≤ 2 distinct span lengths → ≤ 2 traced bodies).
+
+    `boundary_fn` (optional) transforms the carry at every segment edge —
+    the hook `partition_activations` uses to shard saved residuals over
+    the `model` axis. `block_fn(block_params, x) -> x` must be uniform
+    across blocks (no MoE aux threading, no hidden collection).
+    """
+    L = len(blocks)
+    sizes = segment_sizes(L, n_segments)
+    n = len(sizes)
+    edge = boundary_fn if boundary_fn is not None else (lambda c: c)
+
+    def seg_body(carry, seg_stacked):
+        return jax.lax.scan(
+            lambda c, bp: (block_fn(bp, c), None), carry, seg_stacked)[0]
+
+    ck = jax.checkpoint(seg_body, policy=policy)
+
+    if L % n == 0:
+        k = L // n
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+        grouped = jax.tree_util.tree_map(
+            lambda a: a.reshape((n, k) + a.shape[1:]), stacked)
+        return jax.lax.scan(
+            lambda c, gp: (ck(edge(c), gp), None), x, grouped)[0]
+
+    idx = 0
+    for size in sizes:
+        seg = blocks[idx:idx + size]
+        idx += size
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *seg)
+        x = ck(edge(x), stacked)
+    return x
+
+
+def resolve_remat(remat_blocks, remat_policy, number_checkpoints):
+    """Shared knob resolution for the model families: returns
+    (do_remat, policy_object, number_checkpoints). `remat_blocks=True`
+    with no explicit policy keeps today's whole-block save-nothing remat
+    ('full'); a policy or segment count alone also switches remat on
+    ('none' resolves to no remat at all — save everything)."""
+    from ..runtime.activation_checkpointing.checkpointing import \
+        make_remat_policy
+    do_remat = bool(remat_blocks or remat_policy is not None
+                    or number_checkpoints is not None)
+    if not do_remat:
+        return False, None, None
+    policy, is_remat = make_remat_policy(remat_policy)
+    if not is_remat and number_checkpoints is None:
+        return False, None, None   # 'none': saving everything == no remat
+    return True, policy, number_checkpoints
+
+
 def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
                    collect_hidden=False, rng=None, attn_fn=None,
-                   scan_blocks=False):
+                   scan_blocks=False, remat_policy=None,
+                   number_checkpoints=None, boundary_fn=None):
     """tokens [B, S] int32 → final-norm hidden states [B, S, H]; with
     `collect_hidden` also returns [embed, block outputs..., final norm]
     (the activation-capture path shares this exact forward). With MoE
@@ -439,28 +524,52 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
     `lax.scan` body — XLA compile time O(1) in depth (the GPT-NeoX-20B
     shape has 44 layers; see gpt2.forward_hidden for the measured
     unrolled-compile pathology). Falls back to the Python loop when the
-    per-block structure varies (collect_hidden / MoE aux threading)."""
+    per-block structure varies (collect_hidden / MoE aux threading).
+
+    Remat knobs (see `resolve_remat`): `remat_policy` names a
+    `jax.checkpoint` policy ('none'/'full'/'dots'/'attn_residuals'/
+    'offload_dots'); `number_checkpoints` switches from per-block remat
+    to `segmented_scan_blocks` (k-grouped spans, remat at group
+    boundaries); `boundary_fn` constrains segment-boundary carries
+    (partition_activations)."""
     moe = bool(getattr(cfg, "moe_num_experts", 0))
+    do_remat, policy, n_ckpt = resolve_remat(remat_blocks, remat_policy,
+                                             number_checkpoints)
     x = params["embed"]["wte"][tokens]
     cos, sin, rot_dim = _rotary_cache(cfg, tokens.shape[1])
     hidden = [x] if collect_hidden else None
 
-    if remat_blocks:
+    plain_block = lambda bp, x, r: block_forward(       # noqa: E731
+        cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
+        rng=r, attn_fn=attn_fn)
+    if do_remat and n_ckpt is None:
         # rot_dim must stay a STATIC python int: routed through
         # jax.checkpoint's traced args it becomes an int32 tracer and
         # the rotary slice bound blows up; close over it instead
         ck = jax.checkpoint(
             lambda bp, x, cos, sin, r: block_forward(
                 cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
-                rng=r, attn_fn=attn_fn))
-        block_fn = lambda bp, x, r: ck(bp, x, cos, sin, r)  # noqa: E731
+                rng=r, attn_fn=attn_fn), policy=policy)
+        # boundary_fn on every block input: per-block remat saves each
+        # block's carry, so partition_activations constrains them all
+        edge = boundary_fn if boundary_fn is not None else (lambda c: c)
+        block_fn = lambda bp, x, r: ck(bp, edge(x), cos, sin, r)  # noqa: E731,E501
     else:
-        block_fn = lambda bp, x, r: block_forward(         # noqa: E731
-            cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
-            rng=r, attn_fn=attn_fn)
+        block_fn = plain_block
     aux_total = jnp.asarray(0.0, jnp.float32)
-    if scan_blocks and not moe and not collect_hidden and \
-            len(params["blocks"]) > 1:
+    uniform = not moe and not collect_hidden
+    if n_ckpt is not None and not uniform:
+        raise ValueError(
+            "number_checkpoints (segmented-scan checkpointing) needs a "
+            "uniform block stack: incompatible with MoE aux-loss "
+            "threading and collect_hidden — drop number_checkpoints or "
+            "use per-block remat (a policy alone)")
+    if n_ckpt is not None:
+        # segment spans own the remat; blocks inside run bare
+        x = segmented_scan_blocks(
+            lambda bp, x: plain_block(bp, x, None), x, params["blocks"],
+            n_ckpt, policy=policy, boundary_fn=boundary_fn)
+    elif scan_blocks and uniform and len(params["blocks"]) > 1:
         x = scan_stacked_blocks(lambda bp, x: block_fn(bp, x, None),
                                 x, params["blocks"])
     else:
@@ -488,10 +597,12 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
 
 
 def forward(cfg, params, tokens, use_pallas=True, remat_blocks=False,
-            scan_blocks=False):
+            scan_blocks=False, remat_policy=None, number_checkpoints=None):
     """tokens [B, S] int32 → logits [B, S, V]."""
     x = forward_hidden(cfg, params, tokens, use_pallas=use_pallas,
-                       remat_blocks=remat_blocks, scan_blocks=scan_blocks)
+                       remat_blocks=remat_blocks, scan_blocks=scan_blocks,
+                       remat_policy=remat_policy,
+                       number_checkpoints=number_checkpoints)
     if getattr(cfg, "moe_num_experts", 0):
         x, _ = x
     out_embed = params.get("embed_out", params["embed"])["wte"]
@@ -524,10 +635,11 @@ def fused_lm_head_loss(x, wte, labels, ignore_index=-100, chunk_rows=None):
     n = xs.shape[0]
     n_pad = (-n) % chunk_rows
     if n_pad:
-        xs = jnp.concatenate(
-            [xs, jnp.zeros((n_pad, H), xs.dtype)], axis=0)
-        ts = jnp.concatenate(
-            [ts, jnp.full((n_pad,), ignore_index, ts.dtype)], axis=0)
+        # pad_tail, NOT concatenate: jax 0.4.37's partitioner miscompiles
+        # concat-with-replicated-fill on sharded operands (see compat.py)
+        from ..compat import pad_tail
+        xs = pad_tail(xs, n_pad, 0)
+        ts = pad_tail(ts, n_pad, ignore_index)
     n_chunks = xs.shape[0] // chunk_rows
     xs = xs.reshape(n_chunks, chunk_rows, H)
     ts = ts.reshape(n_chunks, chunk_rows)
@@ -569,21 +681,97 @@ def lm_loss(logits, labels, ignore_index=-100):
     return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1)
 
 
+def make_partition_boundary(mesh, model_axis=MODEL_AXIS):
+    """Segment-boundary carry constraint for `partition_activations`:
+    saved [B, S, H] residuals shard their sequence dim over the `model`
+    axis, so each MP rank stores 1/mp of every checkpoint (the
+    reference's partitioned-activation layout). None when the mesh has
+    no (or a trivial) model axis — nothing to partition over."""
+    if mesh is None or model_axis not in mesh.axis_names or \
+            mesh.shape[model_axis] <= 1:
+        return None
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(mesh, P(None, model_axis, None))
+
+    def constrain(x):
+        if getattr(x, "ndim", 0) == 3:
+            try:
+                return jax.lax.with_sharding_constraint(x, sharding)
+            except Exception:
+                return x
+        return x
+
+    return constrain
+
+
+def reject_unsupported_ds_blocks(ds_config, family):
+    """Families without MoE / sequence-parallel support must fail LOUDLY
+    when a config enables them — the engine calls `apply_ds_config`
+    expecting the blocks to be consumed, and accepting the call would
+    silently train a dense/non-SP model. Shared by GPT-2 and BERT."""
+    if getattr(ds_config, "moe_params", None) or \
+            getattr(ds_config, "sequence_parallel_params", None):
+        raise NotImplementedError(
+            f"{family} does not implement the moe/sequence_parallel "
+            "config blocks; use models.gpt_neox.GPTNeoX")
+
+
+def apply_activation_checkpointing_config(model, ds_config, mesh=None):
+    """Thread the JSON `activation_checkpointing` block into a model
+    wrapper's remat knobs (shared by the GPT-NeoX / GPT-2 / BERT
+    families — the engine calls this through `apply_ds_config`).
+
+    Mapping of the reference keys: `number_checkpoints` → segmented-scan
+    spans; `cpu_checkpointing` → host-offload remat policy
+    (`offload_dots`); `partition_activations` → model-axis sharding
+    constraint on segment-boundary carries; fork key `policy` → named
+    `jax.checkpoint` policy. Validates `number_checkpoints` against the
+    model's layer count (parse time cannot — it doesn't know it).
+
+    An active block always implies remat (the reference block is a
+    checkpointing block): with no explicit policy, knobs like
+    `partition_activations` get whole-block 'full' remat with their
+    constraint applied to every saved carry."""
+    ac = getattr(ds_config, "activation_checkpointing_config", None)
+    if ac is None or not getattr(ac, "active", False):
+        return
+    from ..runtime.activation_checkpointing.checkpointing import \
+        resolve_policy_name
+    from ..runtime.config_utils import DeepSpeedConfigError
+    n_layers = getattr(model.config, "num_layers", None)
+    if ac.number_checkpoints is not None and n_layers is not None and \
+            ac.number_checkpoints > n_layers:
+        raise DeepSpeedConfigError(
+            f"activation_checkpointing.number_checkpoints "
+            f"({ac.number_checkpoints}) exceeds the model's num_layers "
+            f"({n_layers})")
+    policy = resolve_policy_name(ac.policy, ac.cpu_checkpointing)
+    model.remat_policy = policy if policy is not None else "full"
+    model.number_checkpoints = ac.number_checkpoints
+    if ac.partition_activations:
+        model._ckpt_boundary_fn = make_partition_boundary(mesh)
+
+
 class GPTNeoX:
     """Engine-protocol wrapper: loss_fn / init_params / param_specs."""
 
     def __init__(self, config=None, use_pallas=True, remat_blocks=False,
-                 scan_blocks=False, **kwargs):
+                 scan_blocks=False, remat_policy=None,
+                 number_checkpoints=None, **kwargs):
         self.config = config or GPTNeoXConfig(**kwargs)
         self.use_pallas = use_pallas
         self.remat_blocks = remat_blocks
         self.scan_blocks = scan_blocks
+        self.remat_policy = remat_policy
+        self.number_checkpoints = number_checkpoints
+        self._ckpt_boundary_fn = None  # partition_activations constraint
         self._attn_fn = None   # set by apply_ds_config (sequence parallel)
 
     def apply_ds_config(self, ds_config, mesh=None):
-        """Wire the JSON `moe` / `sequence_parallel` blocks into the
-        model — the engine calls this before parameter init, so a user
-        config alone (no library imports) drives both axes."""
+        """Wire the JSON `moe` / `sequence_parallel` /
+        `activation_checkpointing` blocks into the model — the engine
+        calls this before parameter init, so a user config alone (no
+        library imports) drives all three axes."""
         import dataclasses
         moe = getattr(ds_config, "moe_params", None)
         if moe:
@@ -604,6 +792,7 @@ class GPTNeoX:
                     f"{sp['axis']!r}")
             self._attn_fn = SequenceParallel(mesh, axis=sp["axis"],
                                              mode=sp["mode"])
+        apply_activation_checkpointing_config(self, ds_config, mesh)
 
     def init_params(self, rng):
         return init_params(self.config, rng)
@@ -635,7 +824,9 @@ class GPTNeoX:
         return forward(self.config, params, tokens,
                        use_pallas=self.use_pallas,
                        remat_blocks=self.remat_blocks,
-                       scan_blocks=self.scan_blocks)
+                       scan_blocks=self.scan_blocks,
+                       remat_policy=self.remat_policy,
+                       number_checkpoints=self.number_checkpoints)
 
     def loss_fn(self, params, batch, rng=None):
         if isinstance(batch, (tuple, list)):
@@ -646,7 +837,10 @@ class GPTNeoX:
                                 use_pallas=self.use_pallas,
                                 remat_blocks=self.remat_blocks,
                                 rng=rng, attn_fn=self._attn_fn,
-                                scan_blocks=self.scan_blocks)
+                                scan_blocks=self.scan_blocks,
+                                remat_policy=self.remat_policy,
+                                number_checkpoints=self.number_checkpoints,
+                                boundary_fn=self._ckpt_boundary_fn)
         aux = None
         if self.config.moe_num_experts:
             hidden, aux = hidden

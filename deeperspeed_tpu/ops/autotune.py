@@ -119,6 +119,103 @@ def flash_tune_min_seq():
     return int(os.environ.get(_TUNE_MIN_SEQ_ENV, "8192"))
 
 
+# ---------------------------------------------------------------------------
+# Compile-time memory screening (tentpole: the (remat policy × batch)
+# bench ladder pre-screens rungs with `compiled.memory_analysis()` before
+# spending a timed run — an AOT lower+compile over abstract shapes costs
+# seconds and zero HBM, an OOM'd rung costs a subprocess, a 30 s zombie-
+# buffer grace, and a retry).
+# ---------------------------------------------------------------------------
+
+# Per-generation HBM capacities (spec sheet), used when the runtime does
+# not report `bytes_limit` (e.g. tunneled backends).
+_HBM_BYTES_BY_KIND = {
+    "v5 lite": 16 << 30, "v5e": 16 << 30,
+    "v5p": 95 << 30,
+    "v4": 32 << 30,
+    "v6": 32 << 30, "v6e": 32 << 30,
+}
+
+
+def hbm_bytes_limit(device=None):
+    """Usable device-memory budget in bytes, or None when unknown (CPU
+    backends report no limit — screening is then skipped)."""
+    try:
+        device = device or jax.devices()[0]
+    except Exception:
+        return None
+    try:
+        stats = device.memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    kind = (getattr(device, "device_kind", "") or str(device)).lower()
+    if getattr(device, "platform", "") != "tpu":
+        return None
+    for key, val in _HBM_BYTES_BY_KIND.items():
+        if key in kind:
+            return val
+    # unknown TPU kind: no budget rather than a guess — screening must
+    # never block a rung it cannot reason about (memory_feasible treats
+    # None as "skip the screen")
+    return None
+
+
+def compiled_memory_stats(fn, abstract_args):
+    """AOT-compile `fn` over `jax.ShapeDtypeStruct` args (nothing is
+    materialized or executed) and return its `memory_analysis()` as a
+    dict: argument/output/temp/alias bytes plus a `peak` estimate
+    (args + outputs + temps − donated aliases). Returns None when the
+    backend provides no analysis."""
+    compiled = jax.jit(fn).lower(*abstract_args).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return None
+
+    def field(name):
+        v = getattr(ma, name, 0) or 0
+        return int(v)
+
+    stats = {
+        "argument_bytes": field("argument_size_in_bytes"),
+        "output_bytes": field("output_size_in_bytes"),
+        "temp_bytes": field("temp_size_in_bytes"),
+        "alias_bytes": field("alias_size_in_bytes"),
+        "generated_code_bytes": field("generated_code_size_in_bytes"),
+    }
+    stats["peak"] = max(
+        stats["argument_bytes"] + stats["output_bytes"]
+        + stats["temp_bytes"] - stats["alias_bytes"], 0)
+    return stats
+
+
+def memory_feasible(fn, abstract_args, budget_bytes=None, safety=0.92,
+                    extra_bytes=0):
+    """Pre-screen a candidate program: does its compiled peak (plus
+    `extra_bytes` of resident state the program does not see, e.g.
+    optimizer moments) fit the device budget?
+
+    Returns (fits, stats). Unknown budgets or backends without
+    `memory_analysis` return (True, stats_or_None) — screening never
+    blocks a rung it cannot reason about; the ladder's subprocess
+    isolation still catches real OOMs. `safety` holds back headroom for
+    fragmentation and the runtime's own buffers."""
+    if budget_bytes is None:
+        budget_bytes = hbm_bytes_limit()
+    try:
+        stats = compiled_memory_stats(fn, abstract_args)
+    except Exception as e:  # noqa: BLE001 - screening must not kill rungs
+        from ..utils.logging import logger
+        logger.info(f"memory screen: AOT compile failed "
+                    f"({type(e).__name__}: {e}); skipping screen")
+        return True, None
+    if stats is None or budget_bytes is None:
+        return True, stats
+    need = stats["peak"] + int(extra_bytes)
+    return need <= budget_bytes * safety, stats
+
+
 def flash_blocks_for(shape, dtype, causal, tuner=None):
     """Dispatch-time flash block geometry, or None for the built-in
     default. Long sequences (≥ `flash_tune_min_seq()`, env-tunable) and

@@ -573,6 +573,26 @@ def _mask_spec(h, n_fine_q, n_fine_k, ix=lambda f: f):
                         memory_space=pltpu.SMEM)
 
 
+def _tag_residuals(out, lse):
+    """Name the forward results BEFORE they fan out to the primal output
+    and the custom_vjp residuals: under `jax.checkpoint` with the
+    `attn_residuals` policy (`save_only_these_names(ds_attn_out,
+    ds_attn_lse)`), both survive the remat boundary, so the backward
+    kernels consume saved tensors and this forward kernel never re-runs
+    during the backward replay.
+
+    Inside `shard_map` with the replication check on (the SP ring
+    call sites), jax 0.4.37 has no rep rule for the `name` primitive —
+    the tags are dropped there and `attn_residuals` degrades to
+    recompute for that region."""
+    from jax.ad_checkpoint import checkpoint_name
+    try:
+        return (checkpoint_name(out, "ds_attn_out"),
+                checkpoint_name(lse, "ds_attn_lse"))
+    except NotImplementedError:
+        return out, lse
+
+
 def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
          layout=None, kbias=None, dropout_rate=0.0, seed=None):
     b, s, h, d = q.shape
@@ -591,6 +611,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         out, lse = _fwd_single(qb, kb, vb, causal, sm_scale, s, d,
                                _interpret(), kbias=kbias, h=h,
                                dropout_rate=dropout_rate, seed=seed)
+        out, lse = _tag_residuals(out, lse)
         out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
         return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
 
@@ -650,6 +671,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         kernel, compact, grid, in_specs, out_specs, scratch_shapes,
         out_shape, (qmap, kmap) if compact else ())
     out, lse = call(*prefetch, *inputs)
+    out, lse = _tag_residuals(out, lse)
 
     out4 = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out4, (qb, kb, vb, out, lse.reshape(b * h, s))

@@ -14,8 +14,11 @@ On TPU each concern maps to a JAX-native mechanism:
   tracker API is kept for Megatron-style callers.
 - partition_activations         → saved residuals carry a `model`-axis
   sharding constraint, so each MP rank stores 1/mp of every checkpoint.
-- cpu_checkpointing             → remat policy offloads saved dots to
-  host memory (`save_and_offload_only_these_names` / device_put policy).
+- cpu_checkpointing             → 'offload_dots' remat policy: saved
+  matmul results rest in pinned host memory
+  (`offload_dot_with_no_batch_dims`). Host-offload transfers only exist
+  under `jax.jit` — eager `jax.grad` over an offloading span raises
+  (real training is always jitted).
 - contiguous_memory_optimization / synchronize_checkpoint_boundary →
   no-ops: XLA owns allocation and scheduling.
 """
@@ -26,17 +29,81 @@ import jax
 import jax.numpy as jnp
 
 from ...utils.logging import logger
-from .config import DeepSpeedActivationCheckpointingConfig
+from .config import REMAT_POLICY_CHOICES, DeepSpeedActivationCheckpointingConfig
 
 _config = DeepSpeedActivationCheckpointingConfig()
 _mpu = None
 _configured = False
 
-# Offload saved residuals to host when cpu_checkpointing is on.
-_CPU_POLICY = jax.checkpoint_policies.save_and_offload_only_these_names(
-    names_which_can_be_saved=[],
-    names_which_can_be_offloaded=["ds_checkpoint"],
-    offload_src="device", offload_dst="pinned_host")
+# ---------------------------------------------------------------------------
+# Named remat policies. The JSON `activation_checkpointing.policy` key (and
+# the model families' `remat_policy=` knob) select one by name; the model
+# forward threads the resolved policy into every `jax.checkpoint` span.
+#
+# Residual-name tags: the flash-attention custom_vjp fwd marks its saved
+# output/LSE with these names so `attn_residuals` can pin exactly the
+# tensors the Pallas backward kernels consume — the bwd then never re-runs
+# the forward kernel under remat.
+# ---------------------------------------------------------------------------
+
+ATTN_OUT_NAME = "ds_attn_out"
+ATTN_LSE_NAME = "ds_attn_lse"
+
+
+def tag_attn_residual(x, name=ATTN_OUT_NAME):
+    """Mark an attention residual for name-based remat policies. A no-op
+    outside `jax.checkpoint` spans (and for policies that ignore names).
+
+    Inside `shard_map` with the replication check on, jax 0.4.37 has no
+    rep rule for the `name` primitive and raises at trace time — the tag
+    is dropped there (name-based policies then degrade to recompute for
+    that region; every other policy is unaffected)."""
+    from jax.ad_checkpoint import checkpoint_name
+    try:
+        return checkpoint_name(x, name)
+    except NotImplementedError:
+        return x
+
+
+def make_remat_policy(name, offload_src="device", offload_dst="pinned_host"):
+    """Named policy -> `jax.checkpoint` policy callable.
+
+    Returns `(policy, is_remat)`: `policy` feeds jax.checkpoint's
+    `policy=` (None = save nothing, today's whole-block behavior);
+    `is_remat=False` only for 'none', which saves everything — callers may
+    skip the checkpoint wrapper entirely.
+
+    - none:           save every intermediate (remat disabled).
+    - full:           save nothing; recompute the whole span in backward.
+    - dots:           save matmul results excluding batch dims (the
+                      classic activations-not-weights split).
+    - attn_residuals: save only the flash-attention outputs + LSE
+                      (`ATTN_OUT_NAME`/`ATTN_LSE_NAME` tags) so the
+                      Pallas bwd kernel never re-runs its forward.
+    - offload_dots:   'dots', but saved dots rest in host memory
+                      (ZeRO-Offload for activations; honors
+                      `cpu_checkpointing`).
+    """
+    if name is None or name == "full":
+        return None, True
+    cp = jax.checkpoint_policies
+    if name == "none":
+        return cp.everything_saveable, False
+    if name == "dots":
+        return cp.dots_with_no_batch_dims_saveable, True
+    if name == "attn_residuals":
+        return cp.save_only_these_names(ATTN_OUT_NAME, ATTN_LSE_NAME), True
+    if name == "offload_dots":
+        offload = getattr(cp, "offload_dot_with_no_batch_dims", None)
+        if offload is None:  # pragma: no cover - old-jax fallback
+            logger.warning(
+                "offload_dot_with_no_batch_dims unavailable on this jax; "
+                "remat policy 'offload_dots' degrades to on-device 'dots'")
+            return cp.dots_with_no_batch_dims_saveable, True
+        return offload(offload_src, offload_dst), True
+    raise ValueError(
+        f"unknown remat policy {name!r}; valid choices: "
+        f"{', '.join(REMAT_POLICY_CHOICES)}")
 
 
 def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
@@ -72,10 +139,21 @@ def is_configured():
     return _configured
 
 
+def resolve_policy_name(policy, cpu_checkpointing):
+    """The effective policy name for a config block: `cpu_checkpointing`
+    promotes the (default/'dots') on-device policy to its host-offload
+    form — the reference key spills checkpoints to CPU memory."""
+    if cpu_checkpointing and policy in (None, "dots", "offload_dots"):
+        return "offload_dots"
+    return policy
+
+
 def _policy():
-    if _config.cpu_checkpointing:
-        return _CPU_POLICY
-    return None  # full remat: save nothing, recompute everything
+    name = resolve_policy_name(getattr(_config, "policy", None),
+                               _config.cpu_checkpointing)
+    if name is None:
+        return None  # full remat: save nothing, recompute everything
+    return make_remat_policy(name)[0]
 
 
 def checkpoint(function, *args):

@@ -10,7 +10,7 @@ and scheduling).
 
 from dataclasses import dataclass
 
-from ..config_utils import get_scalar_param
+from ..config_utils import DeepSpeedConfigError, get_scalar_param
 
 ACT_CHKPT = "activation_checkpointing"
 ACT_CHKPT_PARTITION_ACTIVATIONS = "partition_activations"
@@ -19,6 +19,9 @@ ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION = "contiguous_memory_optimization"
 ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY = "synchronize_checkpoint_boundary"
 ACT_CHKPT_PROFILE = "profile"
 ACT_CHKPT_CPU_CHECKPOINTING = "cpu_checkpointing"
+# Fork key: named jax.checkpoint rematerialization policy (see
+# checkpointing.make_remat_policy for semantics).
+ACT_CHKPT_POLICY = "policy"
 
 ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT = False
 ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT = None
@@ -26,6 +29,37 @@ ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT = False
 ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT = False
 ACT_CHKPT_PROFILE_DEFAULT = False
 ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT = False
+ACT_CHKPT_POLICY_DEFAULT = None
+
+REMAT_POLICY_CHOICES = ("none", "full", "dots", "attn_residuals",
+                        "offload_dots")
+
+
+def _validate_number_checkpoints(value):
+    """Parse-time check: a positive int or None. The model-side cap
+    (<= num_layers) is enforced where the layer count is known
+    (`apply_ds_config`)."""
+    if value is None:
+        return None
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise DeepSpeedConfigError(
+            f"{ACT_CHKPT}.{ACT_CHKPT_NUMBER_CHECKPOINTS} must be a "
+            f"positive int or null, got {value!r}")
+    if value <= 0:
+        raise DeepSpeedConfigError(
+            f"{ACT_CHKPT}.{ACT_CHKPT_NUMBER_CHECKPOINTS} must be > 0, "
+            f"got {value}")
+    return value
+
+
+def _validate_policy(value):
+    if value is None:
+        return None
+    if value not in REMAT_POLICY_CHOICES:
+        raise DeepSpeedConfigError(
+            f"{ACT_CHKPT}.{ACT_CHKPT_POLICY}: unknown remat policy "
+            f"{value!r}; valid choices: {', '.join(REMAT_POLICY_CHOICES)}")
+    return value
 
 
 @dataclass(frozen=True)
@@ -38,17 +72,40 @@ class DeepSpeedActivationCheckpointingConfig:
         ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT)
     profile: bool = ACT_CHKPT_PROFILE_DEFAULT
     cpu_checkpointing: bool = ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT
+    policy: object = ACT_CHKPT_POLICY_DEFAULT
+
+    @property
+    def active(self):
+        """True when the block asks for anything beyond the defaults that
+        the engine must thread into the model forward."""
+        return (self.policy is not None
+                or self.number_checkpoints is not None
+                or self.partition_activations
+                or self.cpu_checkpointing)
 
     @classmethod
     def from_dict(cls, param_dict):
         d = param_dict.get(ACT_CHKPT) or {}
+        policy = _validate_policy(get_scalar_param(
+            d, ACT_CHKPT_POLICY, ACT_CHKPT_POLICY_DEFAULT))
+        cpu = bool(get_scalar_param(d, ACT_CHKPT_CPU_CHECKPOINTING,
+                                    ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT))
+        if cpu and policy in ("none", "full", "attn_residuals"):
+            # cpu_checkpointing promotes the (default/'dots') policy to
+            # its host-offload form; with these policies there is no
+            # offloadable save set — silently ignoring either knob would
+            # hide a misconfiguration
+            raise DeepSpeedConfigError(
+                f"{ACT_CHKPT}: cpu_checkpointing=true conflicts with "
+                f"policy={policy!r} (nothing it saves can offload); use "
+                "policy 'dots'/'offload_dots' or drop cpu_checkpointing")
         return cls(
             partition_activations=bool(get_scalar_param(
                 d, ACT_CHKPT_PARTITION_ACTIVATIONS,
                 ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT)),
-            number_checkpoints=get_scalar_param(
-                d, ACT_CHKPT_NUMBER_CHECKPOINTS,
-                ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT),
+            number_checkpoints=_validate_number_checkpoints(
+                get_scalar_param(d, ACT_CHKPT_NUMBER_CHECKPOINTS,
+                                 ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT)),
             contiguous_memory_optimization=bool(get_scalar_param(
                 d, ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION,
                 ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT)),
@@ -57,7 +114,6 @@ class DeepSpeedActivationCheckpointingConfig:
                 ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT)),
             profile=bool(get_scalar_param(
                 d, ACT_CHKPT_PROFILE, ACT_CHKPT_PROFILE_DEFAULT)),
-            cpu_checkpointing=bool(get_scalar_param(
-                d, ACT_CHKPT_CPU_CHECKPOINTING,
-                ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT)),
+            cpu_checkpointing=cpu,
+            policy=policy,
         )

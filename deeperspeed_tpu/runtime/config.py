@@ -237,6 +237,11 @@ class DeepSpeedConfig:
         self.zero_optimization_stage = self.zero_config.stage
         self.zero_enabled = self.zero_config.enabled
 
+        # Parse validates knob types and the remat-policy name against
+        # the registry (unknown names raise with the valid choices);
+        # `number_checkpoints <= num_layers` is enforced model-side where
+        # the layer count is known (models.gpt_neox.
+        # apply_activation_checkpointing_config).
         self.activation_checkpointing_config = (
             DeepSpeedActivationCheckpointingConfig.from_dict(d))
         self.aio_config = DeepSpeedAIOConfig.from_dict(d)

@@ -246,10 +246,12 @@ class DeepSpeedEngine:
                     "runtime/zero/param_offload.StreamPlan — "
                     "models.gpt_neox.GPTNeoX implements it)")
 
-        # --- config-drivable model features (moe / sequence parallel):
-        # applied BEFORE param init so the model builds expert weights /
-        # SP attention from the JSON alone (VERDICT: user config, no
-        # library imports, trains both axes)
+        # --- config-drivable model features (moe / sequence parallel /
+        # activation checkpointing): applied BEFORE param init so the
+        # model builds expert weights / SP attention / remat-policy spans
+        # from the JSON alone (VERDICT: user config, no library imports,
+        # trains both axes)
+        act_ckpt = self._config.activation_checkpointing_config
         if self._config.moe_enabled or self._config.sequence_parallel_enabled:
             from .pipe.module import PipelineModule
             if self._config.moe_enabled and \
@@ -265,6 +267,18 @@ class DeepSpeedEngine:
                     "does not implement apply_ds_config(config, mesh) "
                     "(models.gpt_neox.GPTNeoX does)")
             model.apply_ds_config(self._config, self.mesh)
+        elif act_ckpt.active and hasattr(model, "apply_ds_config"):
+            # remat policy / number_checkpoints / partition_activations /
+            # cpu_checkpointing — the model families map these to
+            # jax.checkpoint policies and segmented-scan spans (models
+            # without the hook keep the Megatron-style checkpoint() API
+            # below; that path reads the same module config)
+            model.apply_ds_config(self._config, self.mesh)
+        if act_ckpt.active:
+            # keep the module-level Megatron API in sync for models that
+            # call activation_checkpointing.checkpoint() directly
+            from .activation_checkpointing import checkpointing as _ckpt
+            _ckpt.configure(mpu_=mpu, deepspeed_config=self._config)
 
         # --- state --------------------------------------------------------
         if model_parameters is None and hasattr(model, "init_params"):

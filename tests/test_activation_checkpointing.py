@@ -56,7 +56,11 @@ def test_checkpoint_matches_plain_forward_and_grads():
     g2 = jax.grad(loss_ckpt)(params)
     for a, b in zip(jax.tree_util.tree_leaves(g1),
                     jax.tree_util.tree_leaves(g2)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+        # atol floor: remat reassociates fp32 reductions; near-zero grad
+        # elements legitimately differ at the 1e-7 level (failed the old
+        # atol=0 bound on some hosts with the SEED code already)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_checkpoint_inside_jit():
@@ -76,7 +80,10 @@ def test_checkpoint_inside_jit():
 
 
 def test_cpu_checkpointing_policy():
-    """cpu_checkpointing selects the offload-to-host remat policy."""
+    """cpu_checkpointing selects the offload-to-host remat policy
+    (promoted to `offload_dots` — saved matmul results rest in host
+    memory). Host-offload transfers only exist inside jit, so the grad
+    must be jitted (eager remat has no TransferToMemoryKind)."""
     checkpointing.configure(deepspeed_config={
         "activation_checkpointing": {"cpu_checkpointing": True}})
     params = make_params()
@@ -86,7 +93,7 @@ def test_cpu_checkpointing_policy():
     def loss(p):
         return jnp.sum(checkpointing.checkpoint(mlp_block, p, x, key) ** 2)
 
-    g = jax.grad(loss)(params)
+    g = jax.jit(jax.grad(loss))(params)
     assert all(np.isfinite(np.asarray(l)).all()
                for l in jax.tree_util.tree_leaves(g))
 
