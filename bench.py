@@ -23,6 +23,9 @@ KEYS (default all):
              tests/model/Megatron_GPT2)
   - longseq  (longseq_16k: 16k-token causal flash row)
   - moe      (moe_top2: GShard top-2 MoE row, grouped dispatch)
+  - ckpt     (checkpoint-induced step stall, sync vs async
+             snapshot-then-commit save; opt-in via DS_BENCH_CKPT=1 —
+             disk-heavy)
 """
 
 import gc
@@ -37,7 +40,7 @@ import time
 import numpy as np
 
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
-ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800}
+ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800, "ckpt": 600}
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -467,9 +470,68 @@ def row_moe():
                    "moe_top2")
 
 
+def row_ckpt():
+    """Checkpoint-induced training stall, sync vs async: how long the
+    step loop blocks for a full engine save (NeoX-125M, ZeRO-2 — fp32
+    masters + both Adam moments on disk). The async row also counts how
+    many train steps complete while the commit is in flight. Opt-in via
+    DS_BENCH_CKPT (disk-heavy; writes ~1.5 GB per save)."""
+    import shutil
+    import tempfile
+
+    jax = _setup_jax()
+    n_chips = len(jax.devices())
+    cfg, model, params = _headline_setup(jax)
+    seq = 1024
+
+    def run(bs_per_chip):
+        def thunk():
+            batch = bs_per_chip * n_chips
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                                  dtype=np.int32)
+            stacked = (tokens, tokens)
+            eng = _neox_engine(model, params, batch, {"stage": 2})
+            steps = 6
+            dt, _ = timed_steps(eng, stacked, steps=steps, warmup=3)
+            step_ms = dt / steps * 1e3
+            tmp = tempfile.mkdtemp(prefix="ds_ckpt_bench_")
+            try:
+                # sync: the whole snapshot+serialize+commit blocks the loop
+                t0 = time.perf_counter()
+                eng.save_checkpoint(tmp, tag="sync")
+                sync_ms = (time.perf_counter() - t0) * 1e3
+                # async: only the host snapshot blocks; commit overlaps
+                t0 = time.perf_counter()
+                eng.save_checkpoint_async(tmp, tag="async")
+                async_ms = (time.perf_counter() - t0) * 1e3
+                overlapped = 0
+                while eng.checkpoint_manager.in_flight and overlapped < 64:
+                    eng.train_batch(batch=stacked)
+                    overlapped += 1
+                force(eng.state.params)
+                eng.checkpoint_manager.wait()
+                mgr = eng.checkpoint_manager
+                return {
+                    "ckpt_step_ms": round(step_ms, 1),
+                    "ckpt_sync_stall_ms": round(sync_ms, 1),
+                    "ckpt_async_stall_ms": round(async_ms, 1),
+                    "ckpt_async_overlap_steps": overlapped,
+                    "ckpt_bytes_mb": round(mgr.total_bytes / 2**20, 1),
+                    "ckpt_stall_ratio": round(
+                        async_ms / sync_ms, 4) if sync_ms else None,
+                }
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+        return thunk
+
+    bs0 = int(os.environ.get("DS_BENCH_CKPT_BS", "16"))
+    return _ladder([(f"bs{bs0}", run(bs0)), ("bs8", run(8))], {}, "ckpt")
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
-           "longseq": row_longseq, "moe": row_moe}
+           "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt}
 
 
 # ---------------------------------------------------------------------------
@@ -478,14 +540,21 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
 
 def rows_enabled():
     sel = os.environ.get("DS_BENCH_ROWS", "all")
+    order = list(ROW_ORDER)
+    # checkpoint-stall row is opt-in (DS_BENCH_CKPT=1 or an explicit
+    # DS_BENCH_ROWS pick): each save writes ~1.5 GB to local disk
+    if os.environ.get("DS_BENCH_CKPT", "0") not in ("0", "", "false"):
+        order.append("ckpt")
     if sel in ("all", ""):
-        return list(ROW_ORDER)
+        return order
     if sel == "none":               # headline only (perf iteration)
         return []
     picked = {r.strip() for r in sel.split(",")}
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
-    return [r for r in ROW_ORDER if r in picked]
+    if "ckpt" in picked and "ckpt" not in order:
+        order.append("ckpt")
+    return [r for r in order if r in picked]
 
 
 def run_row_subprocess(name, extra):

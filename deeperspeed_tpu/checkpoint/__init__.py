@@ -1,0 +1,4 @@
+from . import manifest  # noqa: F401
+from .async_manager import AsyncCheckpointManager  # noqa: F401
+from .checkpointing import (load_checkpoint, save_checkpoint,  # noqa: F401
+                            snapshot_checkpoint, write_and_commit)

@@ -5,6 +5,7 @@ Layout written:
 
     {save_dir}/{tag}/mp_rank_{mp:02d}_model_states.pt
     {save_dir}/{tag}/zero_pp_rank_{dp}_mp_rank_{mp:02d}_optim_states.pt
+    {save_dir}/{tag}/manifest.json
     {save_dir}/latest
 
 Model-states files hold module params + scheduler/counter state; when ZeRO
@@ -13,6 +14,16 @@ GSPMD-convention slices along each leaf's sharded dim, and reassembled (and
 re-placed with the *current* shardings) on load — which is exactly the
 reference's elastic checkpointing: a job restarted at a different dp world
 size merges the saved partitions and re-slices (`stage2.py:1825-1894`).
+
+Saves are two-phase (snapshot-then-commit, see `manifest.py` for the
+commit protocol): `snapshot_checkpoint` materializes every array on the
+host — the only part that stalls training — and `write_and_commit` turns
+the resulting payloads into a crash-consistent checkpoint directory. The
+sync `save_checkpoint` runs both phases inline; `async_manager.
+AsyncCheckpointManager` runs the commit in a background writer thread so
+training overlaps the serialization + disk I/O. `load_checkpoint`
+verifies the manifest and falls back to the newest previously-committed
+checkpoint on corruption.
 """
 
 import os
@@ -25,11 +36,12 @@ import jax.numpy as jnp
 
 from ..runtime.fp16.loss_scaler import LossScaleState
 from ..utils.logging import log_dist, logger
+from . import manifest as mf
 from .serialization import (load_obj, save_obj, shard_slice,
                             state_dict_to_tree, tree_to_state_dict,
                             unshard_concat)
 
-LATEST_FILE = "latest"
+LATEST_FILE = mf.LATEST_FILE
 
 
 def _model_states_name(mp_rank):
@@ -47,26 +59,27 @@ def _sharded_dim(spec):
     return None
 
 
-def save_checkpoint(engine, save_dir, tag=None, client_state=None,
-                    save_latest=True):
-    client_state = client_state or {}
-    if tag is None:
-        tag = f"global_step{engine.global_steps}"
-    ckpt_dir = os.path.join(save_dir, str(tag))
-    os.makedirs(ckpt_dir, exist_ok=True)
+# ---------------------------------------------------------------------------
+# phase 1: snapshot (device → host; the only training stall)
+# ---------------------------------------------------------------------------
 
+def snapshot_checkpoint(engine, client_state=None):
+    """Build the full ``{relative_path: payload}`` dict for a checkpoint
+    of the engine's CURRENT state, with every array materialized on the
+    host. After this returns, the payloads are immutable host data —
+    training may continue (and mutate ``engine.state``) while a writer
+    commits them to disk. Payloads are either picklable objects (written
+    via `save_obj`) or raw ``bytes``."""
     if getattr(engine, "_grad_spill", None) is not None:
-        # NVMe store-of-record tier: the segment + optimizer-group files
-        # ARE the model state — checkpoint by streaming file copies
-        # (O(1) memory), never assembling the tree in DRAM. Beyond-DRAM
-        # models can therefore persist/restore; the standard
-        # natural-layout format remains for models that fit.
-        return _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir,
-                                              tag, client_state,
-                                              save_latest)
-
-    # --- model states (params + host-side training state) ----------------
+        raise RuntimeError(
+            "snapshot-then-commit saves are not supported on the "
+            "streamed-NVMe store-of-record tier: its checkpoint IS the "
+            "live segment files (O(1) memory file copies); use the "
+            "synchronous save_checkpoint")
+    client_state = client_state or {}
     state = engine.state
+    dataloader = getattr(engine, "training_dataloader", None)
+    gns = getattr(engine, "gradient_noise_scale", None)
     model_state = {
         # natural layout on disk: storage layouts (ZeRO flat-pad, packed
         # pipeline rows) depend on the mesh and must not leak into files
@@ -78,6 +91,14 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
         "batch_size_scheduler": (engine.batch_size_scheduler.state_dict()
                                  if engine.batch_size_scheduler is not None
                                  else None),
+        # full-state resume: dataloader position (epoch/offset + sampler
+        # seed) and the gradient-noise-scale accumulators ride along so a
+        # preempted job restarts on the exact sample stream
+        "dataloader": (dataloader.state_dict()
+                       if dataloader is not None
+                       and hasattr(dataloader, "state_dict") else None),
+        "gradient_noise_scale": (gns.state_dict()
+                                 if gns is not None else None),
         "csr_tensor_module_names": [],
         "skipped_steps": engine.skipped_steps,
         "global_steps": engine.global_steps,
@@ -102,32 +123,95 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
             "param_groups": [dict(g) for g in
                              engine.optimizer.param_groups],
         }
-    save_obj(model_state, os.path.join(ckpt_dir, _model_states_name(0)))
+    payloads = {_model_states_name(0): model_state}
 
-    # --- zero partitions --------------------------------------------------
     if engine.zero_optimization() or engine.keep_master or \
             getattr(engine, "host_offload", False):
-        _save_zero_checkpoint(engine, ckpt_dir)
+        payloads.update(_zero_payloads(engine))
 
     # Ship the recovery script with the checkpoint so fp32 weights can be
     # reconstructed later without the framework (reference
     # `engine.py:1800-1808` does the same with its zero_to_fp32.py).
     try:
-        if jax.process_index() == 0:
-            from ..utils import zero_to_fp32 as _z2f
-            shutil.copyfile(_z2f.__file__,
-                            os.path.join(ckpt_dir, "zero_to_fp32.py"))
+        from ..utils import zero_to_fp32 as _z2f
+        with open(_z2f.__file__, "rb") as f:
+            payloads["zero_to_fp32.py"] = f.read()
     except Exception:  # pragma: no cover
         pass
+    return payloads
 
-    if save_latest and jax.process_index() == 0:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(str(tag))
+
+# ---------------------------------------------------------------------------
+# phase 2: commit (pure file I/O — shared by the sync path and the
+# async writer thread; see manifest.py for the protocol)
+# ---------------------------------------------------------------------------
+
+def write_and_commit(payloads, save_dir, tag, step, save_latest=True):
+    """Write `payloads` into a staging dir, checksum-manifest + fsync +
+    atomically rename it to ``{save_dir}/{tag}``, barrier all hosts, then
+    flip ``latest``. Crash at any point leaves either the previous
+    committed state or the new one — never a torn pointer. Returns the
+    bytes written (0 on non-writer processes)."""
+    tag = str(tag)
+    nbytes = 0
+    if jax.process_index() == 0:
+        os.makedirs(save_dir, exist_ok=True)
+        staging = os.path.join(save_dir, mf.STAGING_PREFIX + tag)
+        if os.path.isdir(staging):  # leftover of a crashed earlier save
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        entries = {}
+        for rel, payload in payloads.items():
+            path = os.path.join(staging, rel)
+            parent = os.path.dirname(path)
+            if parent != staging:
+                os.makedirs(parent, exist_ok=True)
+            if isinstance(payload, (bytes, bytearray)):
+                with open(path, "wb") as f:
+                    f.write(payload)
+            else:
+                save_obj(payload, path)
+            mf._fsync_file(path)
+            # checksum NOW, while the bytes are still in the page cache —
+            # write_manifest would otherwise re-read the whole checkpoint
+            entries[rel] = mf.file_entry(path)
+            nbytes += entries[rel]["bytes"]
+        mf.commit_staged(save_dir, staging, tag, step, files=entries)
     if jax.process_count() > 1:
-        # writers finish before any process proceeds to read/continue
+        # every host's files are durable before anyone flips/reads latest
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("deeperspeed_ckpt_save")
-    log_dist(f"Saved checkpoint {tag} to {ckpt_dir}", ranks=[0])
+        multihost_utils.sync_global_devices("deeperspeed_ckpt_commit")
+    if save_latest and jax.process_index() == 0:
+        mf.write_latest(save_dir, tag)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("deeperspeed_ckpt_latest")
+    return nbytes
+
+
+def save_checkpoint(engine, save_dir, tag=None, client_state=None,
+                    save_latest=True):
+    client_state = client_state or {}
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+
+    if getattr(engine, "_grad_spill", None) is not None:
+        # NVMe store-of-record tier: the segment + optimizer-group files
+        # ARE the model state — checkpoint by streaming file copies
+        # (O(1) memory), never assembling the tree in DRAM. Beyond-DRAM
+        # models can therefore persist/restore; the standard
+        # natural-layout format remains for models that fit.
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+        return _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir,
+                                              tag, client_state,
+                                              save_latest)
+
+    payloads = snapshot_checkpoint(engine, client_state)
+    write_and_commit(payloads, save_dir, tag, step=engine.global_steps,
+                     save_latest=save_latest)
+    log_dist(f"Saved checkpoint {tag} to "
+             f"{os.path.join(save_dir, str(tag))}", ranks=[0])
     return True
 
 
@@ -224,10 +308,13 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
             }
             meta.update(client_state)
             save_obj(meta, os.path.join(ckpt_dir, _model_states_name(0)))
-            if save_latest:
-                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                    f.write(str(tag))
+        # all shard writers (and the meta write) are durable before the
+        # pointer flips — `latest` can never name a checkpoint some host
+        # never finished
         multihost_utils.sync_global_devices("deeperspeed_streamed_save2")
+        if save_latest and pidx == 0:
+            mf.write_latest(save_dir, tag)
+        multihost_utils.sync_global_devices("deeperspeed_streamed_latest")
         log_dist(f"Saved streamed-NVMe checkpoint {tag} to {ckpt_dir} "
                  f"({n_proc} process shards)", ranks=[0])
         return True
@@ -255,8 +342,7 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
     meta.update(client_state)
     save_obj(meta, os.path.join(ckpt_dir, _model_states_name(0)))
     if save_latest:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(str(tag))
+        mf.write_latest(save_dir, tag)
     log_dist(f"Saved streamed-NVMe checkpoint {tag} to {ckpt_dir}",
              ranks=[0])
     return True
@@ -337,10 +423,9 @@ def _flat_arrays(tree):
     return sd["arrays"]
 
 
-def _save_zero_checkpoint(engine, ckpt_dir):
+def _zero_payloads(engine):
     if getattr(engine, "host_offload", False):
-        _save_host_offload_checkpoint(engine, ckpt_dir)
-        return
+        return {_zero_ckpt_name(0, 0): _host_offload_payload(engine)}
     state = engine.state
     rules = engine.zero_rules
     dp = engine.dp_world_size if rules.stage >= 1 else 1
@@ -373,6 +458,7 @@ def _save_zero_checkpoint(engine, ckpt_dir):
         return {k: tuple(v.shape) for k, v in flat.items()
                 if dims[k] == "flat"}
 
+    payloads = {}
     for dp_rank in range(dp):
         def slice_flat(flat, dims):
             out = {}
@@ -386,7 +472,7 @@ def _save_zero_checkpoint(engine, ckpt_dir):
                     out[key] = shard_slice(arr, dp, dp_rank, dim)
             return out
 
-        shard = {
+        payloads[_zero_ckpt_name(dp_rank, 0)] = {
             "optimizer_state_dict": {
                 "state": slice_flat(opt_flat, opt_dims),
                 "shard_dims": opt_dims,
@@ -404,10 +490,10 @@ def _save_zero_checkpoint(engine, ckpt_dir):
             "partition_count": dp,
             "dp_rank": dp_rank,
         }
-        save_obj(shard, os.path.join(ckpt_dir, _zero_ckpt_name(dp_rank, 0)))
+    return payloads
 
 
-def _save_host_offload_checkpoint(engine, ckpt_dir):
+def _host_offload_payload(engine):
     """ZeRO-Offload: host-resident (or NVMe) masters/moments, one file."""
     if engine._host_swapper is not None:
         groups = {i: engine._host_swapper.load_group(i)
@@ -423,7 +509,7 @@ def _save_host_offload_checkpoint(engine, ckpt_dir):
     from .serialization import _path_key
     flat, _ = jax.tree_util.tree_flatten_with_path(engine.state.params)
     param_paths = [_path_key(path) for path, _ in flat]
-    shard = {
+    return {
         "optimizer_state_dict": {
             "host_offload": True,
             "master": masters,
@@ -440,7 +526,6 @@ def _save_host_offload_checkpoint(engine, ckpt_dir):
         "partition_count": 1,
         "dp_rank": 0,
     }
-    save_obj(shard, os.path.join(ckpt_dir, _zero_ckpt_name(0, 0)))
 
 
 def _load_host_offload_checkpoint(engine, shard):
@@ -476,22 +561,67 @@ def _load_host_offload_checkpoint(engine, shard):
 
 def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                     load_lr_scheduler_states=True):
+    explicit_tag = tag is not None
     if tag is None:
-        latest_path = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.isfile(latest_path):
-            logger.warning(f"No 'latest' file at {latest_path}; "
+        tag = mf.read_latest(load_dir)
+        if tag is None:
+            logger.warning(f"No '{LATEST_FILE}' file at "
+                           f"{os.path.join(load_dir, LATEST_FILE)}; "
                            "cannot resume")
             return None, {}
-        with open(latest_path) as f:
-            tag = f.read().strip()
-    ckpt_dir = os.path.join(load_dir, str(tag))
-    model_path = os.path.join(ckpt_dir, _model_states_name(0))
-    if not os.path.isfile(model_path):
-        logger.warning(f"Checkpoint file {model_path} not found")
-        return None, {}
 
-    model_state = load_obj(model_path)
+    # Candidate order: the requested tag first; when resuming from
+    # `latest`, every other committed checkpoint (newest first) backs it
+    # up — a torn/corrupt write of the newest save must cost at most one
+    # checkpoint interval, not the job.
+    candidates = [str(tag)]
+    if not explicit_tag:
+        candidates += [t for _, t in reversed(mf.committed_tags(load_dir))
+                       if t != str(tag)]
 
+    for cand in candidates:
+        ckpt_dir = os.path.join(load_dir, cand)
+        ok, problems = mf.verify_manifest(ckpt_dir)
+        if not ok:
+            if explicit_tag:
+                # the user named THIS checkpoint: corruption must be
+                # loud, not a silent (None, {}) that reads as "start
+                # fresh" to resume scripts
+                raise RuntimeError(
+                    f"checkpoint {cand} failed manifest verification: "
+                    f"{'; '.join(problems[:3])}")
+            logger.warning(
+                f"Checkpoint {cand} failed manifest verification "
+                f"({'; '.join(problems[:3])}); falling back to the "
+                "previous committed checkpoint")
+            continue
+        model_path = os.path.join(ckpt_dir, _model_states_name(0))
+        if not os.path.isfile(model_path):
+            logger.warning(f"Checkpoint file {model_path} not found")
+            continue
+        try:
+            model_state = load_obj(model_path)
+        except Exception as e:  # torn legacy write (no manifest to catch)
+            if explicit_tag:
+                raise RuntimeError(
+                    f"checkpoint {cand} is corrupt: failed to "
+                    f"deserialize {model_path}") from e
+            logger.warning(f"Failed to deserialize {model_path} "
+                           f"({type(e).__name__}: {e})")
+            continue
+        if cand != str(tag):
+            logger.warning(f"Resuming from fallback checkpoint {cand} "
+                           f"instead of corrupt {tag}")
+        return _apply_checkpoint(engine, load_dir, cand, ckpt_dir,
+                                 model_state, load_optimizer_states,
+                                 load_lr_scheduler_states)
+
+    logger.warning(f"No loadable checkpoint under {load_dir}")
+    return None, {}
+
+
+def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
+                      load_optimizer_states, load_lr_scheduler_states):
     if model_state.get("streamed_nvme"):
         if getattr(engine, "_grad_spill", None) is None:
             raise RuntimeError(
@@ -546,7 +676,7 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
             engine.optimizer.param_groups = [
                 dict(g) for g in model_state["optimizer"]["param_groups"]]
 
-    # --- schedulers / counters -------------------------------------------
+    # --- schedulers / counters / host-side training state ----------------
     if load_lr_scheduler_states and engine.lr_scheduler is not None and \
             model_state.get("lr_scheduler") is not None:
         engine.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
@@ -554,6 +684,22 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
             model_state.get("batch_size_scheduler") is not None:
         engine.batch_size_scheduler.load_state_dict(
             model_state["batch_size_scheduler"])
+    dataloader = getattr(engine, "training_dataloader", None)
+    if dataloader is not None and \
+            hasattr(dataloader, "load_state_dict") and \
+            model_state.get("dataloader") is not None:
+        try:
+            dataloader.load_state_dict(model_state["dataloader"])
+        except ValueError as e:
+            # elastic restarts legitimately change batch size / replica
+            # count: position restore is then impossible — continue with
+            # a fresh stream rather than aborting a half-applied load
+            logger.warning(f"dataloader position not restored ({e}); "
+                           "resuming from the start of the epoch")
+    gns = getattr(engine, "gradient_noise_scale", None)
+    if gns is not None and \
+            model_state.get("gradient_noise_scale") is not None:
+        gns.load_state_dict(model_state["gradient_noise_scale"])
 
     engine.global_steps = model_state.get("global_steps", 0)
     engine.global_samples = model_state.get("global_samples", 0)
@@ -576,7 +722,8 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
 
     client_state = {k: v for k, v in model_state.items()
                     if k not in ("module", "optimizer", "lr_scheduler",
-                                 "batch_size_scheduler")}
+                                 "batch_size_scheduler", "dataloader",
+                                 "gradient_noise_scale")}
     log_dist(f"Loaded checkpoint {tag} from {load_dir}", ranks=[0])
     return os.path.join(load_dir, str(tag)), client_state
 

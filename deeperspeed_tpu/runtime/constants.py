@@ -169,6 +169,19 @@ BS_SCHEDULE_PARAMS = "params"
 # ---------------------------------------------------------------------------
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"
+# Fault-tolerant async checkpointing subsystem (checkpoint/async_manager):
+CHECKPOINT_SAVE_DIR = "save_dir"
+CHECKPOINT_SAVE_DIR_DEFAULT = None
+CHECKPOINT_ASYNC_SAVE = "async_save"
+CHECKPOINT_ASYNC_SAVE_DEFAULT = True
+CHECKPOINT_SAVE_INTERVAL = "save_interval_steps"
+CHECKPOINT_SAVE_INTERVAL_DEFAULT = 0
+CHECKPOINT_KEEP_LAST_N = "keep_last_n"
+CHECKPOINT_KEEP_LAST_N_DEFAULT = 0
+CHECKPOINT_KEEP_EVERY_N_STEPS = "keep_every_n_steps"
+CHECKPOINT_KEEP_EVERY_N_STEPS_DEFAULT = 0
+CHECKPOINT_SAVE_ON_PREEMPTION = "save_on_preemption"
+CHECKPOINT_SAVE_ON_PREEMPTION_DEFAULT = False
 
 
 class ValidationMode:

@@ -65,8 +65,18 @@ class DeepSpeedDataLoader:
         self.num_replicas = (num_replicas if num_replicas is not None
                              else jax.process_count())
         self.rank = rank if rank is not None else jax.process_index()
+        # One-shot iterators (generator samplers, iter(x) is x) are
+        # consumed by the first traversal — `_num_batches()` below would
+        # exhaust them and `__iter__` would then yield zero batches.
+        # Materialize those once; re-iterable samplers (lists, torch-style
+        # sampler objects) are kept as-is so a per-epoch reshuffling
+        # sampler still yields a fresh order every epoch.
+        if data_sampler is not None and iter(data_sampler) is data_sampler:
+            data_sampler = list(data_sampler)
         self.data_sampler = data_sampler
         self.epoch = 0
+        self._batches_yielded = 0   # position within the current epoch
+        self._resume_offset = 0     # batches to skip on the next __iter__
         self.len = self._num_batches()
 
     def _local_indices(self):
@@ -93,13 +103,68 @@ class DeepSpeedDataLoader:
     def __len__(self):
         return self.len
 
+    def state_dict(self):
+        """Resume position for full-state checkpointing: epoch + batch
+        offset. The built-in sampler's shuffle RNG is derived from
+        (seed, epoch), so these two plus the seed restore the exact
+        sample stream; a materialized custom `data_sampler` is static
+        across epochs and needs only the offset."""
+        return {"epoch": self.epoch,
+                "batches_yielded": self._batches_yielded,
+                "seed": self.seed,
+                "shuffle": self.shuffle,
+                "batch_size": self.batch_size,
+                "num_replicas": self.num_replicas,
+                "rank": self.rank}
+
+    def load_state_dict(self, sd):
+        self.epoch = int(sd["epoch"])
+        self.seed = sd.get("seed", self.seed)
+        if sd.get("batch_size") not in (None, self.batch_size):
+            # a different batch size re-chunks the index stream; an
+            # offset in old-batch units would resume mid-batch silently
+            raise ValueError(
+                f"dataloader resume: checkpoint was cut at batch_size="
+                f"{sd['batch_size']} but this loader uses "
+                f"{self.batch_size}; restart the epoch or match sizes")
+        if "shuffle" in sd and bool(sd["shuffle"]) != bool(self.shuffle):
+            # the offset skip only lands on the right samples if the
+            # index ORDER matches — a flipped shuffle flag would replay
+            # some samples and never see others, silently
+            raise ValueError(
+                f"dataloader resume: checkpoint was cut with shuffle="
+                f"{sd['shuffle']} but this loader uses "
+                f"shuffle={self.shuffle}")
+        saved_topo = (sd.get("num_replicas", self.num_replicas),
+                      sd.get("rank", self.rank))
+        if saved_topo != (self.num_replicas, self.rank):
+            # process-strided index streams: a different replica count or
+            # rank re-deals the samples, so the offset would skip/replay
+            # the wrong ones (elastic restarts hit this — the engine
+            # downgrades it to a warning and a fresh epoch)
+            raise ValueError(
+                f"dataloader resume: checkpoint was cut at (num_replicas,"
+                f" rank)={saved_topo} but this loader runs "
+                f"{(self.num_replicas, self.rank)}")
+        self._resume_offset = int(sd.get("batches_yielded", 0))
+        self._batches_yielded = self._resume_offset
+        self.len = self._num_batches()
+
     def __iter__(self):
         if self.tput_timer:
             self.tput_timer.start()
         indices = self._local_indices()
-        for start in range(0, len(indices), self.batch_size):
+        skip, self._resume_offset = self._resume_offset, 0
+        self._batches_yielded = skip
+        for batch_idx, start in enumerate(
+                range(0, len(indices), self.batch_size)):
             chunk = indices[start:start + self.batch_size]
             if self.drop_last and len(chunk) < self.batch_size:
                 break
-            yield self.collate_fn([self.dataset[i] for i in chunk])
+            if batch_idx < skip:   # checkpoint resume: mid-epoch seek
+                continue
+            batch = self.collate_fn([self.dataset[i] for i in chunk])
+            self._batches_yielded = batch_idx + 1
+            yield batch
         self.epoch += 1
+        self._batches_yielded = 0

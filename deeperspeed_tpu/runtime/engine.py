@@ -218,6 +218,14 @@ class DeepSpeedEngine:
                 output_path=self._config.tensorboard_output_path,
                 job_name=self._config.tensorboard_job_name)
 
+        # Fault-tolerant async checkpointing (checkpoint/async_manager):
+        # snapshot-then-commit saves in a background writer, auto-save
+        # every N steps, retention GC, and SIGTERM/SIGINT emergency saves
+        # — all driven by the "checkpoint" config block.
+        from ..checkpoint.async_manager import AsyncCheckpointManager
+        self.checkpoint_manager = AsyncCheckpointManager(
+            self, **self._config.checkpoint_config)
+
         # --- offload tier -------------------------------------------------
         zc = self._config.zero_config
         self.host_offload = (zc.offload_optimizer is not None)
@@ -289,11 +297,6 @@ class DeepSpeedEngine:
                 "model_parameters (a pytree of arrays) is required")
         self.state = self._init_state(model_parameters)
 
-        # --- data ---------------------------------------------------------
-        self.training_dataloader = None
-        if training_data is not None:
-            self.training_dataloader = self.deepspeed_io(training_data)
-
         # --- bookkeeping --------------------------------------------------
         self.global_steps = 0
         self.global_samples = 0
@@ -304,6 +307,11 @@ class DeepSpeedEngine:
             batch_size=self.train_micro_batch_size_per_gpu(),
             num_workers=self.dp_world_size,
             steps_per_output=self._config.steps_per_print)
+
+        # --- data (after bookkeeping: deepspeed_io wires tput_timer) ------
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
         self._cached = None          # (batch, loss, grads) from forward()
         self._accum_grads = None
         self._accum_loss = None
@@ -2017,6 +2025,9 @@ class DeepSpeedEngine:
         if self.global_steps and \
                 self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
+        # step boundary: drain completed-save telemetry, honor preemption
+        # requests, fire the auto-save interval (no-ops when unconfigured)
+        self.checkpoint_manager.on_step_boundary(self)
 
     def train_batch(self, data_iter=None, batch=None, layers_to_hook=None):
         """Fused fast path: one jitted call per effective batch.
@@ -2235,18 +2246,37 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        # back-pressure against the async path: commits stay totally
+        # ordered even when sync and async saves interleave
+        self.checkpoint_manager.wait()
         from ..checkpoint.checkpointing import save_checkpoint as _save
         return _save(self, save_dir, tag=tag, client_state=client_state,
                      save_latest=save_latest)
+
+    def save_checkpoint_async(self, save_dir, tag=None, client_state=None,
+                              save_latest=True):
+        """Snapshot the train state now (the only stall) and commit in a
+        background writer thread — training continues during
+        serialization + disk I/O. At most one save is in flight; a second
+        call waits out the first (back-pressure). Returns the tag;
+        `engine.checkpoint_manager.wait()` blocks until the checkpoint is
+        durable on disk."""
+        return self.checkpoint_manager.save_async(
+            save_dir, tag=tag, client_state=client_state,
+            save_latest=save_latest)
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_module_strict=True,
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True):
         from ..checkpoint.checkpointing import load_checkpoint as _load
-        return _load(self, load_dir, tag=tag,
-                     load_optimizer_states=load_optimizer_states,
-                     load_lr_scheduler_states=load_lr_scheduler_states)
+        path, client_state = _load(
+            self, load_dir, tag=tag,
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states)
+        if path is not None:
+            self.checkpoint_manager.on_checkpoint_loaded(self)
+        return path, client_state
 
     def gathered_parameters(self, modifier_rank=0, select=None):
         """`zero.GatheredParameters` over the LIVE training state: yields

@@ -14,6 +14,7 @@ reference), else a TSV file with the same tag/value/sample rows — the data
 is never silently dropped.
 """
 
+import atexit
 import os
 
 import numpy as np
@@ -21,6 +22,7 @@ import numpy as np
 import jax
 
 from ..utils.logging import log_dist, logger
+from .utils import register_weak_atexit
 
 try:
     from tensorboardX import SummaryWriter as _TBWriter
@@ -69,6 +71,11 @@ class TensorBoardMonitor:
             self.writer = _TSVWriter(log_dir)
             logger.warning("tensorboardX unavailable; writing TSV events "
                            f"to {log_dir}/events.tsv")
+        # drain buffered scalars on interpreter shutdown: up to
+        # `flush_interval - 1` steps of events sit in `_pending` at any
+        # time and would be silently lost on an unclosed exit (weakly
+        # held — discarded monitors stay collectible)
+        self._atexit = register_weak_atexit(self, "close")
         log_dist(f"Monitor: writing events to {log_dir}", ranks=[0])
 
     def record(self, sample_count, scalars):
@@ -118,8 +125,27 @@ class TensorBoardMonitor:
         # the worker may still be mid-write on the last event it popped
         time.sleep(0.02)
 
+    def record_checkpoint(self, sample_count, stats):
+        """Goodput counters for one completed checkpoint save (reference
+        concern: preemptible-fleet goodput = time training vs time
+        stalled on persistence). `stats` comes from the
+        AsyncCheckpointManager writer: `stall_s` is the snapshot time the
+        training loop was blocked, `write_s` the background
+        serialization + commit, `bytes` the checkpoint size."""
+        if not self.enabled:
+            return
+        self.record(sample_count, {
+            "Train/Checkpoint/stall_ms": stats["stall_s"] * 1e3,
+            "Train/Checkpoint/write_ms": stats["write_s"] * 1e3,
+            "Train/Checkpoint/bytes_written": stats["bytes"],
+        })
+
     def close(self):
         if self.writer is not None:
             self.flush()
             self.writer.close()
             self.writer = None
+            try:
+                atexit.unregister(self._atexit)
+            except Exception:  # pragma: no cover
+                pass

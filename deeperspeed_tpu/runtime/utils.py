@@ -19,6 +19,26 @@ def noop_decorator(func):
     return func
 
 
+def register_weak_atexit(obj, method_name):
+    """Register `obj.<method_name>()` to run at interpreter exit, held
+    through a weakref: the atexit registry must not pin `obj` (engines,
+    monitors, checkpoint managers are constructed per test/per run) for
+    the process lifetime. Returns the registered hook for
+    `atexit.unregister`."""
+    import atexit
+    import weakref
+
+    obj_ref = weakref.ref(obj)
+
+    def hook():  # pragma: no cover - interpreter teardown
+        target = obj_ref()
+        if target is not None:
+            getattr(target, method_name)()
+
+    atexit.register(hook)
+    return hook
+
+
 def call_to_str(base, *args, **kwargs):
     """Construct a string representation of a call, e.g. ``f(1, b=2)``."""
     name = f"{base}("
@@ -326,3 +346,26 @@ class GradientNoiseScale:
             self.noise = float(noise)
             self.noise_scale = self.scale / self.noise if self.noise else None
         self.n_updates += 1
+
+    def state_dict(self):
+        """Accumulator state for full-state checkpoint resume. The
+        running grad sum rides as float32 numpy, EMAs as Python floats —
+        the round-trip is bit-exact."""
+        return {
+            "buffer": [np.asarray(b, np.float32) for b in self.buffer],
+            "ema_scale": self.ema_scale,
+            "ema_noise": self.ema_noise,
+            "scale": self.scale,
+            "noise": self.noise,
+            "noise_scale": self.noise_scale,
+            "n_updates": self.n_updates,
+        }
+
+    def load_state_dict(self, sd):
+        self.buffer = [jnp.asarray(b, jnp.float32) for b in sd["buffer"]]
+        self.ema_scale = sd["ema_scale"]
+        self.ema_noise = sd["ema_noise"]
+        self.scale = sd["scale"]
+        self.noise = sd["noise"]
+        self.noise_scale = sd["noise_scale"]
+        self.n_updates = int(sd["n_updates"])

@@ -1,0 +1,532 @@
+"""Fault-tolerant async checkpointing (checkpoint/async_manager.py +
+manifest.py): snapshot-then-commit overlap, crash-consistency fallback,
+retention GC, preemption handling, and full-state resume.
+
+Fast lane (runs under the tier-1 `-m 'not slow'` selection): everything
+here uses the tiny SimpleModel so the jitted steps compile in seconds on
+the 8-device virtual CPU mesh."""
+
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import deeperspeed_tpu
+from deeperspeed_tpu.checkpoint import manifest as mf
+from deeperspeed_tpu.checkpoint.serialization import load_obj
+from tests.simple_model import SimpleModel, random_batches, random_dataset
+
+HIDDEN = 16
+
+
+def cfg(**overrides):
+    base = {
+        "train_batch_size": 8,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    base.update(overrides)
+    return base
+
+
+def make_engine(config, seed=0, training_data=None):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=config,
+        training_data=training_data)
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# async overlap + sync/async equivalence (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_async_save_overlaps_training_and_matches_sync(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    it = random_batches(20, 8, HIDDEN, seed=3)
+    for _ in range(3):
+        engine.train_batch(data_iter=it)
+
+    engine.save_checkpoint(str(tmp_path), tag="sync3")
+
+    # Hold the background writer at the commit gate so the in-flight
+    # window is deterministic, then train THROUGH it.
+    gate = threading.Event()
+    entered = threading.Event()
+    engine.checkpoint_manager._pre_commit_hook = \
+        lambda: (entered.set(), gate.wait(30))
+    engine.save_checkpoint_async(str(tmp_path), tag="async3")
+    assert entered.wait(30)
+    assert engine.checkpoint_manager.in_flight
+
+    losses = [float(engine.train_batch(data_iter=it)) for _ in range(2)]
+    assert all(np.isfinite(losses))          # steps completed...
+    assert engine.checkpoint_manager.in_flight   # ...while save in flight
+
+    gate.set()
+    engine.checkpoint_manager._pre_commit_hook = None
+    engine.checkpoint_manager.wait()
+
+    # committed async checkpoint == the synchronous save of the same step
+    sync_state = load_obj(tmp_path / "sync3" / "mp_rank_00_model_states.pt")
+    async_state = load_obj(tmp_path / "async3" /
+                           "mp_rank_00_model_states.pt")
+    assert sync_state["global_steps"] == async_state["global_steps"] == 3
+    for key, arr in sync_state["module"]["arrays"].items():
+        np.testing.assert_array_equal(arr,
+                                      async_state["module"]["arrays"][key])
+
+    # crash-consistency invariants: committed manifest, atomic latest, no
+    # staging leftovers
+    ok, problems = mf.verify_manifest(str(tmp_path / "async3"))
+    assert ok, problems
+    assert mf.read_latest(str(tmp_path)) == "async3"
+    assert not [n for n in os.listdir(tmp_path)
+                if n.startswith(mf.STAGING_PREFIX)]
+
+    # goodput counters accumulated
+    assert engine.checkpoint_manager.saves_completed >= 1
+    assert engine.checkpoint_manager.total_bytes > 0
+    assert engine.checkpoint_manager.total_stall_s > 0
+
+
+def test_async_back_pressure_single_inflight(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    it = random_batches(8, 8, HIDDEN, seed=3)
+    engine.train_batch(data_iter=it)
+    gate = threading.Event()
+    engine.checkpoint_manager._pre_commit_hook = lambda: gate.wait(30)
+    engine.save_checkpoint_async(str(tmp_path), tag="a")
+    # second save must first wait out the first — release it from a timer
+    threading.Timer(0.2, gate.set).start()
+    engine.checkpoint_manager._pre_commit_hook = None
+    engine.save_checkpoint_async(str(tmp_path), tag="b")
+    engine.checkpoint_manager.wait()
+    assert {t for _, t in mf.committed_tags(str(tmp_path))} == {"a", "b"}
+    assert mf.read_latest(str(tmp_path)) == "b"
+
+
+def test_async_writer_failure_is_raised_on_wait(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    it = random_batches(4, 8, HIDDEN, seed=3)
+    engine.train_batch(data_iter=it)
+
+    def boom():
+        raise OSError("disk on fire")
+    engine.checkpoint_manager._pre_commit_hook = boom
+    engine.save_checkpoint_async(str(tmp_path), tag="t")
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        engine.checkpoint_manager.wait()
+    engine.checkpoint_manager._pre_commit_hook = None
+    # nothing was committed, nothing points anywhere
+    assert mf.committed_tags(str(tmp_path)) == []
+    assert mf.read_latest(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# crash consistency: torn writes fall back to the previous commit
+# ---------------------------------------------------------------------------
+
+def _two_checkpoints(tmp_path, engine):
+    it = random_batches(10, 8, HIDDEN, seed=7)
+    engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="g1")
+    engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="g2")
+    assert mf.read_latest(str(tmp_path)) == "g2"
+
+
+def test_corrupt_payload_falls_back_to_prior_commit(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    _two_checkpoints(tmp_path, engine)
+    path = tmp_path / "g2" / "mp_rank_00_model_states.pt"
+    data = path.read_bytes()
+    path.write_bytes(data[:len(data) // 2])   # torn write
+
+    fresh = make_engine(cfg(), seed=5)
+    loaded_path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert loaded_path is not None and loaded_path.endswith("g1")
+    assert fresh.global_steps == 1
+
+
+def test_corrupt_manifest_falls_back_to_prior_commit(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    _two_checkpoints(tmp_path, engine)
+    (tmp_path / "g2" / mf.MANIFEST_FILE).write_text("{torn json")
+
+    fresh = make_engine(cfg(), seed=5)
+    loaded_path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert loaded_path is not None and loaded_path.endswith("g1")
+    assert fresh.global_steps == 1
+
+
+def test_bitflip_checksum_mismatch_falls_back(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    _two_checkpoints(tmp_path, engine)
+    path = tmp_path / "g2" / "mp_rank_00_model_states.pt"
+    data = bytearray(path.read_bytes())
+    data[len(data) // 2] ^= 0xFF   # same size, different bytes
+    path.write_bytes(bytes(data))
+
+    fresh = make_engine(cfg(), seed=5)
+    loaded_path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert loaded_path is not None and loaded_path.endswith("g1")
+
+
+def test_explicit_tag_corruption_is_loud(tmp_path, devices):
+    """A user-named tag must never silently substitute another
+    checkpoint NOR read as 'no checkpoint, start fresh' — corruption
+    there raises."""
+    engine = make_engine(cfg(), seed=1)
+    _two_checkpoints(tmp_path, engine)
+    (tmp_path / "g2" / "mp_rank_00_model_states.pt").write_bytes(b"junk")
+    fresh = make_engine(cfg(), seed=5)
+    with pytest.raises(RuntimeError, match="manifest verification"):
+        fresh.load_checkpoint(str(tmp_path), tag="g2")
+    # a merely MISSING explicit tag still returns (None, {}) (seed
+    # behavior: nothing to resume)
+    loaded_path, _ = fresh.load_checkpoint(str(tmp_path), tag="nope")
+    assert loaded_path is None
+
+
+def test_staging_leftover_is_invisible_to_readers(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    _two_checkpoints(tmp_path, engine)
+    # simulate a crash mid-save: staging dir exists, never committed
+    staged = tmp_path / (mf.STAGING_PREFIX + "g3")
+    staged.mkdir()
+    (staged / "mp_rank_00_model_states.pt").write_bytes(b"partial")
+    assert [t for _, t in mf.committed_tags(str(tmp_path))] == ["g1", "g2"]
+    fresh = make_engine(cfg(), seed=5)
+    loaded_path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert loaded_path.endswith("g2")
+
+
+# ---------------------------------------------------------------------------
+# retention
+# ---------------------------------------------------------------------------
+
+def test_keep_last_n_gc(tmp_path, devices):
+    engine = make_engine(cfg(checkpoint={"save_dir": str(tmp_path),
+                                         "keep_last_n": 2}), seed=1)
+    it = random_batches(20, 8, HIDDEN, seed=7)
+    for i in range(4):
+        engine.train_batch(data_iter=it)
+        engine.save_checkpoint_async(str(tmp_path))
+    engine.checkpoint_manager.wait()
+    tags = [t for _, t in mf.committed_tags(str(tmp_path))]
+    assert tags == ["global_step3", "global_step4"]
+    assert mf.read_latest(str(tmp_path)) == "global_step4"
+
+
+def test_gc_never_deletes_latest_target(tmp_path, devices):
+    """Acceptance: keep_last_n GC never deletes the checkpoint `latest`
+    points to — even when retention alone would evict it."""
+    engine = make_engine(cfg(checkpoint={"save_dir": str(tmp_path),
+                                         "keep_last_n": 2}), seed=1)
+    it = random_batches(20, 8, HIDDEN, seed=7)
+    engine.train_batch(data_iter=it)
+    engine.save_checkpoint_async(str(tmp_path), tag="pinned",
+                                 save_latest=True)
+    engine.checkpoint_manager.wait()
+    for i in range(3):
+        engine.train_batch(data_iter=it)
+        # newer saves that do NOT flip latest: `pinned` stays the resume
+        # point and must survive GC
+        engine.save_checkpoint_async(str(tmp_path), save_latest=False)
+    engine.checkpoint_manager.wait()
+    tags = {t for _, t in mf.committed_tags(str(tmp_path))}
+    assert "pinned" in tags
+    assert tags == {"pinned", "global_step3", "global_step4"}
+    assert mf.read_latest(str(tmp_path)) == "pinned"
+
+
+def test_keep_every_n_steps(tmp_path, devices):
+    engine = make_engine(cfg(checkpoint={"save_dir": str(tmp_path),
+                                         "keep_last_n": 1,
+                                         "keep_every_n_steps": 2}), seed=1)
+    it = random_batches(20, 8, HIDDEN, seed=7)
+    for _ in range(4):
+        engine.train_batch(data_iter=it)
+        engine.save_checkpoint_async(str(tmp_path))
+    engine.checkpoint_manager.wait()
+    tags = [t for _, t in mf.committed_tags(str(tmp_path))]
+    # steps 2 and 4 are keep_every multiples; 4 is also the newest/latest
+    assert tags == ["global_step2", "global_step4"]
+
+
+def test_gc_ignores_uncommitted_dirs(tmp_path, devices):
+    (tmp_path / "not_a_checkpoint").mkdir()
+    (tmp_path / "not_a_checkpoint" / "data.bin").write_bytes(b"keep me")
+    engine = make_engine(cfg(checkpoint={"save_dir": str(tmp_path),
+                                         "keep_last_n": 1}), seed=1)
+    it = random_batches(20, 8, HIDDEN, seed=7)
+    for _ in range(3):
+        engine.train_batch(data_iter=it)
+        engine.save_checkpoint_async(str(tmp_path))
+    engine.checkpoint_manager.wait()
+    assert (tmp_path / "not_a_checkpoint" / "data.bin").exists()
+
+
+# ---------------------------------------------------------------------------
+# auto-save + preemption
+# ---------------------------------------------------------------------------
+
+def test_autosave_interval(tmp_path, devices):
+    engine = make_engine(cfg(checkpoint={"save_dir": str(tmp_path),
+                                         "save_interval_steps": 2}), seed=1)
+    it = random_batches(20, 8, HIDDEN, seed=4)
+    for _ in range(5):
+        engine.train_batch(data_iter=it)
+    engine.checkpoint_manager.wait()
+    tags = [t for _, t in mf.committed_tags(str(tmp_path))]
+    assert tags == ["global_step2", "global_step4"]
+    assert mf.read_latest(str(tmp_path)) == "global_step4"
+
+
+def test_autosave_interval_crossing_with_train_steps_window(tmp_path,
+                                                            devices):
+    """Auto-save is an interval-CROSSING test, not an exact modulo:
+    `train_steps` advances global_steps by the whole window per boundary
+    and must not skip save points."""
+    engine = make_engine(cfg(checkpoint={"save_dir": str(tmp_path),
+                                         "save_interval_steps": 2}), seed=1)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 1, 8, HIDDEN)).astype(np.float32)
+    y = rng.normal(size=(3, 1, 8, HIDDEN)).astype(np.float32)
+    engine.train_steps((x, y))   # one boundary, global_steps 0 -> 3
+    engine.checkpoint_manager.wait()
+    assert [t for _, t in mf.committed_tags(str(tmp_path))] == \
+        ["global_step3"]
+
+
+def test_autosave_clock_resyncs_after_resume(tmp_path, devices):
+    """Resuming jumps global_steps; the very next step must NOT fire a
+    near-duplicate auto-save (whose GC could even evict the checkpoints
+    a concurrent reader is using) — only a full interval later."""
+    config = cfg(checkpoint={"save_dir": str(tmp_path),
+                             "save_interval_steps": 5})
+    engine = make_engine(config, seed=1)
+    it = random_batches(30, 8, HIDDEN, seed=4)
+    for _ in range(6):
+        engine.train_batch(data_iter=it)
+    engine.checkpoint_manager.wait()
+    assert [t for _, t in mf.committed_tags(str(tmp_path))] == \
+        ["global_step5"]
+
+    fresh = make_engine(config, seed=5)
+    fresh.load_checkpoint(str(tmp_path))       # resumes at step 5
+    fresh.train_batch(data_iter=it)            # step 6: no save yet
+    fresh.checkpoint_manager.wait()
+    assert [t for _, t in mf.committed_tags(str(tmp_path))] == \
+        ["global_step5"]
+    for _ in range(4):                         # ...through step 10
+        fresh.train_batch(data_iter=it)
+    fresh.checkpoint_manager.wait()
+    assert [t for _, t in mf.committed_tags(str(tmp_path))] == \
+        ["global_step5", "global_step10"]
+
+
+def test_preemption_signal_saves_and_interrupts(tmp_path, devices):
+    engine = make_engine(cfg(checkpoint={"save_dir": str(tmp_path),
+                                         "save_on_preemption": True}),
+                         seed=1)
+    it = random_batches(10, 8, HIDDEN, seed=4)
+    engine.train_batch(data_iter=it)
+    os.kill(os.getpid(), signal.SIGINT)   # scheduler preempts us
+    with pytest.raises(KeyboardInterrupt):
+        engine.train_batch(data_iter=it)  # emergency save at the boundary
+    assert mf.read_latest(str(tmp_path)) == "global_step2"
+    ok, problems = mf.verify_manifest(str(tmp_path / "global_step2"))
+    assert ok, problems
+    # original handler restored — a second ctrl-C is a plain interrupt
+    assert signal.getsignal(signal.SIGINT) is signal.default_int_handler
+
+    fresh = make_engine(cfg(), seed=5)
+    loaded_path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert loaded_path.endswith("global_step2")
+    assert fresh.global_steps == 2
+
+
+# ---------------------------------------------------------------------------
+# full-state resume (dataloader / batch-size scheduler / GNS)
+# ---------------------------------------------------------------------------
+
+def test_full_state_resume(tmp_path, devices):
+    dataset = random_dataset(64, HIDDEN, seed=0)
+    config = cfg(batch_size_schedule={"enabled": True,
+                                      "params": {"warmup_num_steps": 8}})
+    engine = make_engine(config, seed=1, training_data=dataset)
+    engine.enable_gradient_noise_scale(n_batches=2)
+    stream = iter(engine.training_dataloader)
+    for _ in range(3):
+        batch = next(stream)
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+    engine.save_checkpoint(str(tmp_path), tag="mid")
+    dl_state = dict(engine.training_dataloader.state_dict())
+    bs_state = engine.batch_size_scheduler.state_dict()
+    gns_state = engine.gradient_noise_scale.state_dict()
+    assert dl_state["batches_yielded"] == 3   # mid-epoch position
+    assert bs_state["last_batch_iteration"] == 2
+
+    fresh = make_engine(config, seed=9, training_data=dataset)
+    fresh.enable_gradient_noise_scale(n_batches=2)
+    fresh.load_checkpoint(str(tmp_path), tag="mid")
+
+    assert fresh.training_dataloader.state_dict() == dl_state
+    assert fresh.batch_size_scheduler.state_dict() == bs_state
+    restored = fresh.gradient_noise_scale.state_dict()
+    # bit-exact accumulators
+    assert restored["n_updates"] == gns_state["n_updates"]
+    assert restored["ema_scale"] == gns_state["ema_scale"]
+    assert restored["ema_noise"] == gns_state["ema_noise"]
+    for a, b in zip(restored["buffer"], gns_state["buffer"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the resumed loader continues on the exact sample stream: its next
+    # batch is the 4th batch of the original epoch
+    expected = next(stream)
+    resumed = next(iter(fresh.training_dataloader))
+    np.testing.assert_array_equal(expected[0], resumed[0])
+    np.testing.assert_array_equal(expected[1], resumed[1])
+
+
+def test_elastic_resume_skips_dataloader_position_gracefully(tmp_path,
+                                                             devices):
+    """A resume with a changed global batch (elastic restart) cannot
+    restore the mid-epoch offset — the load must complete anyway, with
+    the dataloader starting fresh."""
+    dataset = random_dataset(64, HIDDEN, seed=0)
+    engine = make_engine(cfg(), seed=1, training_data=dataset)
+    stream = iter(engine.training_dataloader)
+    batch = next(stream)
+    engine.forward(batch)
+    engine.backward()
+    engine.step()
+    engine.save_checkpoint(str(tmp_path), tag="el")
+
+    fresh = make_engine(cfg(train_batch_size=16), seed=2,
+                        training_data=dataset)
+    loaded_path, _ = fresh.load_checkpoint(str(tmp_path), tag="el")
+    assert loaded_path is not None          # load completed
+    assert fresh.global_steps == 1          # counters restored
+    assert fresh.training_dataloader._resume_offset == 0  # fresh epoch
+
+
+def test_dataloader_resume_rejects_batch_size_change(devices):
+    from deeperspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    dataset = random_dataset(32, HIDDEN, seed=0)
+    src = DeepSpeedDataLoader(dataset, batch_size=8, num_replicas=1, rank=0)
+    next(iter(src))
+    dst = DeepSpeedDataLoader(dataset, batch_size=4, num_replicas=1, rank=0)
+    with pytest.raises(ValueError, match="batch_size"):
+        dst.load_state_dict(src.state_dict())
+    # flipped shuffle flag = differently-ordered stream: offset skip
+    # would replay/miss samples, so it must raise too
+    dst2 = DeepSpeedDataLoader(dataset, batch_size=8, shuffle=True,
+                               num_replicas=1, rank=0)
+    with pytest.raises(ValueError, match="shuffle"):
+        dst2.load_state_dict(src.state_dict())
+
+
+def test_reiterable_sampler_reshuffles_per_epoch(devices):
+    """Only one-shot iterators are materialized: a torch-style sampler
+    object that reshuffles on every __iter__ must still produce a fresh
+    order each epoch."""
+    from deeperspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    class ReshufflingSampler:
+        def __init__(self, n):
+            self.n = n
+            self.calls = 0
+
+        def __iter__(self):
+            self.calls += 1
+            rng = np.random.default_rng(self.calls)
+            return iter(rng.permutation(self.n).tolist())
+
+    dataset = list(range(12))
+    loader = DeepSpeedDataLoader(dataset, batch_size=12,
+                                 collate_fn=lambda xs: list(xs),
+                                 data_sampler=ReshufflingSampler(12),
+                                 num_replicas=1, rank=0)
+    epoch1 = next(iter(loader))
+    epoch2 = next(iter(loader))
+    assert sorted(epoch1) == sorted(epoch2) == dataset
+    assert epoch1 != epoch2
+
+
+def test_resave_same_tag_replaces_without_loss(tmp_path, devices):
+    """Re-committing an existing tag must swap via rename-aside: the new
+    state lands, nothing is left behind, and at no point is the tag
+    absent from disk."""
+    engine = make_engine(cfg(), seed=1)
+    it = random_batches(10, 8, HIDDEN, seed=7)
+    engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="pin")
+    engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="pin")
+    assert [t for _, t in mf.committed_tags(str(tmp_path))] == ["pin"]
+    assert not (tmp_path / "pin.replaced").exists()
+    state = load_obj(tmp_path / "pin" / "mp_rank_00_model_states.pt")
+    assert state["global_steps"] == 2
+    ok, problems = mf.verify_manifest(str(tmp_path / "pin"))
+    assert ok, problems
+
+
+def test_generator_sampler_not_exhausted(devices):
+    """A one-shot generator sampler used to be consumed by `__init__`'s
+    length computation, leaving zero batches for iteration."""
+    from deeperspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    dataset = random_dataset(16, HIDDEN, seed=0)
+    loader = DeepSpeedDataLoader(dataset, batch_size=4,
+                                 data_sampler=(i for i in range(12)),
+                                 num_replicas=1, rank=0)
+    assert len(loader) == 3
+    assert len(list(loader)) == 3
+    assert len(list(loader)) == 3   # epoch 2 reuses the materialized list
+
+
+# ---------------------------------------------------------------------------
+# manifest unit coverage
+# ---------------------------------------------------------------------------
+
+def test_manifest_roundtrip_and_verify(tmp_path):
+    ckpt = tmp_path / "c"
+    ckpt.mkdir()
+    (ckpt / "a.bin").write_bytes(b"hello")
+    (ckpt / "sub").mkdir()
+    (ckpt / "sub" / "b.bin").write_bytes(b"world")
+    manifest = mf.write_manifest(str(ckpt), tag="c", step=7)
+    assert set(manifest["files"]) == {"a.bin", os.path.join("sub", "b.bin")}
+    ok, problems = mf.verify_manifest(str(ckpt))
+    assert ok, problems
+    loaded = mf.load_manifest(str(ckpt))
+    assert loaded["step"] == 7 and loaded["tag"] == "c"
+    # legacy dir (no manifest) verifies vacuously
+    legacy = tmp_path / "legacy"
+    legacy.mkdir()
+    ok, _ = mf.verify_manifest(str(legacy))
+    assert ok
+
+
+def test_manifest_json_is_human_auditable(tmp_path, devices):
+    engine = make_engine(cfg(), seed=1)
+    it = random_batches(4, 8, HIDDEN, seed=7)
+    engine.train_batch(data_iter=it)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    manifest = json.loads((tmp_path / "t" / mf.MANIFEST_FILE).read_text())
+    assert manifest["format"] == mf.MANIFEST_FORMAT
+    assert manifest["step"] == 1
+    assert "mp_rank_00_model_states.pt" in manifest["files"]
+    entry = manifest["files"]["mp_rank_00_model_states.pt"]
+    assert entry["bytes"] == os.path.getsize(
+        tmp_path / "t" / "mp_rank_00_model_states.pt")

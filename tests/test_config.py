@@ -261,6 +261,53 @@ def test_checkpoint_tag_validation_modes():
     assert not cfg.checkpoint_tag_validation_enabled
 
 
+def test_checkpoint_block_defaults_and_knobs():
+    cfg = make_config({"train_batch_size": 1})
+    assert cfg.checkpoint_config == {
+        "save_dir": None, "async_save": True, "save_interval_steps": 0,
+        "keep_last_n": 0, "keep_every_n_steps": 0,
+        "save_on_preemption": False}
+    cfg = make_config({"train_batch_size": 1,
+                       "checkpoint": {"save_dir": "/ckpt",
+                                      "async_save": False,
+                                      "save_interval_steps": 100,
+                                      "keep_last_n": 3,
+                                      "keep_every_n_steps": 1000,
+                                      "save_on_preemption": True}})
+    assert cfg.checkpoint_config == {
+        "save_dir": "/ckpt", "async_save": False,
+        "save_interval_steps": 100, "keep_last_n": 3,
+        "keep_every_n_steps": 1000, "save_on_preemption": True}
+
+
+def test_checkpoint_block_parse_time_validation():
+    # unknown keys name the valid choices
+    with pytest.raises(DeepSpeedConfigError, match="save_interval_steps"):
+        make_config({"train_batch_size": 1,
+                     "checkpoint": {"save_interval": 10}})
+    with pytest.raises(DeepSpeedConfigError, match="tag_validation"):
+        make_config({"train_batch_size": 1,
+                     "checkpoint": {"tag_validation": "SOMETIMES"}})
+    with pytest.raises(DeepSpeedConfigError, match="keep_last_n"):
+        make_config({"train_batch_size": 1,
+                     "checkpoint": {"keep_last_n": -1}})
+    with pytest.raises(DeepSpeedConfigError, match="integ"):
+        make_config({"train_batch_size": 1,
+                     "checkpoint": {"save_interval_steps": 2.5,
+                                    "save_dir": "/ckpt"}})
+    with pytest.raises(DeepSpeedConfigError, match="boolean"):
+        make_config({"train_batch_size": 1,
+                     "checkpoint": {"async_save": "yes"}})
+    # auto/emergency saves need a destination at parse time, not at the
+    # first (hours-away) save
+    with pytest.raises(DeepSpeedConfigError, match="save_dir"):
+        make_config({"train_batch_size": 1,
+                     "checkpoint": {"save_interval_steps": 10}})
+    with pytest.raises(DeepSpeedConfigError, match="save_dir"):
+        make_config({"train_batch_size": 1,
+                     "checkpoint": {"save_on_preemption": True}})
+
+
 def test_elasticity_integration():
     cfg = make_config({
         "elasticity": {

@@ -109,6 +109,60 @@ def test_monitor_buffers_until_flush(tmp_path, devices):
     mon.close()
 
 
+def test_monitor_close_drains_pending(tmp_path, devices):
+    """`close()` must flush the buffered scalars (up to flush_interval-1
+    steps sit in `_pending`) — and be idempotent; an atexit hook calls it
+    on interpreter shutdown so a crash between flush intervals no longer
+    silently drops events."""
+    import atexit
+
+    mon = TensorBoardMonitor(output_path=str(tmp_path), job_name="cl",
+                             flush_interval=100)
+    assert callable(mon._atexit)   # registered for shutdown draining
+    mon.record(8, {"Train/Samples/train_loss": 2.0})
+    mon.close()
+    scalars = _read_scalars(os.path.join(str(tmp_path), "cl"))
+    assert scalars["Train/Samples/train_loss"] == [(8, 2.0)]
+    mon.close()   # second close is a no-op
+    atexit.unregister(mon._atexit)   # harmless double-unregister
+
+
+def test_monitor_checkpoint_goodput_counters(tmp_path, devices):
+    mon = TensorBoardMonitor(output_path=str(tmp_path), job_name="ck",
+                             flush_interval=100)
+    mon.record_checkpoint(32, {"tag": "global_step2", "step": 2,
+                               "stall_s": 0.05, "write_s": 1.5,
+                               "bytes": 4096})
+    mon.flush()
+    scalars = _read_scalars(os.path.join(str(tmp_path), "ck"))
+    assert scalars["Train/Checkpoint/stall_ms"] == [(32, 50.0)]
+    assert scalars["Train/Checkpoint/write_ms"] == [(32, 1500.0)]
+    assert scalars["Train/Checkpoint/bytes_written"] == [(32, 4096.0)]
+    mon.close()
+
+
+def test_engine_records_checkpoint_goodput(tmp_path, devices):
+    """End-to-end: an async save surfaces its stall/write/bytes scalars
+    through the engine's monitor at the next step boundary."""
+    engine = _engine(tmp_path)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        x = rng.normal(size=(1, 16, 8)).astype(np.float32)
+        y = rng.normal(size=(1, 16)).astype(np.float32)
+        return (x, y)
+
+    engine.train_batch(batch=batch())
+    engine.save_checkpoint_async(str(tmp_path / "ckpt"))
+    engine.checkpoint_manager.wait()
+    engine.train_batch(batch=batch())   # boundary drains the save stats
+    engine.monitor.flush()
+    scalars = _read_scalars(os.path.join(str(tmp_path), "unit"))
+    assert len(scalars["Train/Checkpoint/bytes_written"]) == 1
+    assert scalars["Train/Checkpoint/bytes_written"][0][1] > 0
+    assert scalars["Train/Checkpoint/write_ms"][0][1] > 0
+
+
 def test_train_steps_window_logs_losses(tmp_path, devices):
     engine = _engine(tmp_path)
     rng = np.random.default_rng(0)
