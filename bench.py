@@ -26,6 +26,8 @@ KEYS (default all):
   - ckpt     (checkpoint-induced step stall, sync vs async
              snapshot-then-commit save; opt-in via DS_BENCH_CKPT=1 —
              disk-heavy)
+  - sentinel (training-health sentinel detection overhead + injected-
+             fault recovery latency; opt-in via DS_BENCH_SENTINEL=1)
 """
 
 import gc
@@ -40,7 +42,8 @@ import time
 import numpy as np
 
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
-ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800, "ckpt": 600}
+ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800, "ckpt": 600,
+               "sentinel": 600}
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -529,9 +532,100 @@ def row_ckpt():
     return _ladder([(f"bs{bs0}", run(bs0)), ("bs8", run(8))], {}, "ckpt")
 
 
+def row_sentinel():
+    """Training-health sentinel cost + recovery latency (NeoX-125M,
+    ZeRO-2): step time with the sentinel off vs on (the in-jit probe +
+    the per-step flags read — the acceptance bar is < 1% overhead), then
+    an injected NaN-grad step under policy `rollback` measuring the full
+    detect -> restore-checkpoint -> continue wall time. Opt-in via
+    DS_BENCH_SENTINEL=1."""
+    import shutil
+    import tempfile
+
+    jax = _setup_jax()
+    n_chips = len(jax.devices())
+    cfg, model, params = _headline_setup(jax)
+    seq = 1024
+
+    def engine_with(batch, tmp=None, th=None):
+        import deeperspeed_tpu
+        config = {
+            "train_batch_size": batch,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 10_000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "fp16": {"enabled": True, "type": "bfloat16"},
+            "zero_optimization": {"stage": 2},
+        }
+        if tmp is not None:
+            config["checkpoint"] = {"save_dir": tmp}
+        if th is not None:
+            config["training_health"] = th
+        eng, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params=config)
+        return eng
+
+    def run(bs_per_chip):
+        def thunk():
+            batch = bs_per_chip * n_chips
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                                  dtype=np.int32)
+            stacked = (tokens, tokens)
+            steps = 8
+
+            eng = engine_with(batch)
+            dt_off, _ = timed_steps(eng, stacked, steps=steps, warmup=3)
+            del eng
+            gc.collect()
+
+            th_on = {"enabled": True, "policy": "skip_batch",
+                     "warmup_steps": 3}
+            eng = engine_with(batch, th=th_on)
+            dt_on, _ = timed_steps(eng, stacked, steps=steps, warmup=3)
+            del eng
+            gc.collect()
+            overhead = (dt_on - dt_off) / dt_off
+
+            # recovery latency: ckpt at step 3, NaN grads at step 4 ->
+            # the faulted train_batch call detects, quarantines, and
+            # restores the committed checkpoint before returning
+            tmp = tempfile.mkdtemp(prefix="ds_sentinel_bench_")
+            try:
+                th_rb = {"enabled": True, "policy": "rollback",
+                         "rollback_after": 1, "warmup_steps": 100,
+                         "fault_injection": {"faults": [
+                             {"kind": "nan_grads", "step": 4}]}}
+                eng = engine_with(batch, tmp=tmp, th=th_rb)
+                for _ in range(4):
+                    eng.train_batch(batch=stacked)
+                eng.save_checkpoint(tmp)
+                force(eng.state.params)
+                t0 = time.perf_counter()
+                eng.train_batch(batch=stacked)   # fault -> rollback
+                force(eng.state.params)
+                recovery_ms = (time.perf_counter() - t0) * 1e3
+                rollbacks = eng.sentinel.rollbacks
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            return {
+                "sentinel_step_ms_off": round(dt_off / steps * 1e3, 2),
+                "sentinel_step_ms_on": round(dt_on / steps * 1e3, 2),
+                "sentinel_overhead_pct": round(overhead * 100, 2),
+                "sentinel_recovery_ms": round(recovery_ms, 1),
+                "sentinel_rollbacks": rollbacks,
+            }
+        return thunk
+
+    bs0 = int(os.environ.get("DS_BENCH_SENTINEL_BS", "16"))
+    return _ladder([(f"bs{bs0}", run(bs0)), ("bs8", run(8))], {},
+                   "sentinel")
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
-           "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt}
+           "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
+           "sentinel": row_sentinel}
 
 
 # ---------------------------------------------------------------------------
@@ -545,6 +639,8 @@ def rows_enabled():
     # DS_BENCH_ROWS pick): each save writes ~1.5 GB to local disk
     if os.environ.get("DS_BENCH_CKPT", "0") not in ("0", "", "false"):
         order.append("ckpt")
+    if os.environ.get("DS_BENCH_SENTINEL", "0") not in ("0", "", "false"):
+        order.append("sentinel")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -552,8 +648,9 @@ def rows_enabled():
     picked = {r.strip() for r in sel.split(",")}
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
-    if "ckpt" in picked and "ckpt" not in order:
-        order.append("ckpt")
+    for opt_in in ("ckpt", "sentinel"):
+        if opt_in in picked and opt_in not in order:
+            order.append(opt_in)
     return [r for r in order if r in picked]
 
 

@@ -21,6 +21,7 @@ from .runtime.engine import DeepSpeedEngine
 from .runtime.lr_schedules import add_tuning_arguments
 from .runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec
 from .runtime.pipe.engine import PipelineEngine
+from .runtime.sentinel import TrainingHealthError
 from .utils.distributed import init_distributed
 from .utils.logging import log_dist, logger
 from .version import __version__

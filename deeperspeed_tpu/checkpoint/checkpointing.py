@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ..runtime.fp16.loss_scaler import LossScaleState
+from ..utils.distributed import barrier
 from ..utils.logging import log_dist, logger
 from . import manifest as mf
 from .serialization import (load_obj, save_obj, shard_slice,
@@ -177,15 +178,14 @@ def write_and_commit(payloads, save_dir, tag, step, save_latest=True):
             entries[rel] = mf.file_entry(path)
             nbytes += entries[rel]["bytes"]
         mf.commit_staged(save_dir, staging, tag, step, files=entries)
-    if jax.process_count() > 1:
-        # every host's files are durable before anyone flips/reads latest
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("deeperspeed_ckpt_commit")
+    # every host's files are durable before anyone flips/reads latest;
+    # barrier() honors the init_distributed(timeout=...) deadline so a
+    # host dying mid-save fails the commit fast instead of hanging the
+    # surviving hosts forever (no-op single-process)
+    barrier("deeperspeed_ckpt_commit")
     if save_latest and jax.process_index() == 0:
         mf.write_latest(save_dir, tag)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("deeperspeed_ckpt_latest")
+    barrier("deeperspeed_ckpt_latest")
     return nbytes
 
 
@@ -284,8 +284,7 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
         payload = _streamed_process_payload(engine, shard_dir)
         save_obj(payload, os.path.join(shard_dir, "streamed_states.pt"),
                  all_ranks=True)
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("deeperspeed_streamed_save")
+        barrier("deeperspeed_streamed_save")
         if pidx == 0:
             meta = {
                 "streamed_nvme": True,
@@ -311,10 +310,10 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
         # all shard writers (and the meta write) are durable before the
         # pointer flips — `latest` can never name a checkpoint some host
         # never finished
-        multihost_utils.sync_global_devices("deeperspeed_streamed_save2")
+        barrier("deeperspeed_streamed_save2")
         if save_latest and pidx == 0:
             mf.write_latest(save_dir, tag)
-        multihost_utils.sync_global_devices("deeperspeed_streamed_latest")
+        barrier("deeperspeed_streamed_latest")
         log_dist(f"Saved streamed-NVMe checkpoint {tag} to {ckpt_dir} "
                  f"({n_proc} process shards)", ranks=[0])
         return True
@@ -560,7 +559,8 @@ def _load_host_offload_checkpoint(engine, shard):
 
 
 def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
-                    load_lr_scheduler_states=True):
+                    load_lr_scheduler_states=True,
+                    load_dataloader_states=True):
     explicit_tag = tag is not None
     if tag is None:
         tag = mf.read_latest(load_dir)
@@ -614,14 +614,16 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
                            f"instead of corrupt {tag}")
         return _apply_checkpoint(engine, load_dir, cand, ckpt_dir,
                                  model_state, load_optimizer_states,
-                                 load_lr_scheduler_states)
+                                 load_lr_scheduler_states,
+                                 load_dataloader_states)
 
     logger.warning(f"No loadable checkpoint under {load_dir}")
     return None, {}
 
 
 def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
-                      load_optimizer_states, load_lr_scheduler_states):
+                      load_optimizer_states, load_lr_scheduler_states,
+                      load_dataloader_states=True):
     if model_state.get("streamed_nvme"):
         if getattr(engine, "_grad_spill", None) is None:
             raise RuntimeError(
@@ -684,8 +686,11 @@ def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
             model_state.get("batch_size_scheduler") is not None:
         engine.batch_size_scheduler.load_state_dict(
             model_state["batch_size_scheduler"])
+    # load_dataloader_states=False: sentinel rollback keeps the loader at
+    # its CURRENT position (already past the quarantined window) instead
+    # of rewinding it to the checkpoint's offset
     dataloader = getattr(engine, "training_dataloader", None)
-    if dataloader is not None and \
+    if load_dataloader_states and dataloader is not None and \
             hasattr(dataloader, "load_state_dict") and \
             model_state.get("dataloader") is not None:
         try:

@@ -279,6 +279,17 @@ class DeepSpeedConfig:
         # bf16/fp32 never need loss scaling even when configured.
         self.loss_scaling_enabled = (self.fp16_enabled
                                      and needs_loss_scaling(self.precision))
+        # Consecutive overflow-skipped steps tolerated at the
+        # min_loss_scale floor before a loud error (0 = warn-only; see
+        # fp16/loss_scaler.ScaleFloorWatch).
+        self.min_scale_patience = as_int(
+            fp16.get(c.FP16_MIN_SCALE_PATIENCE,
+                     c.FP16_MIN_SCALE_PATIENCE_DEFAULT),
+            f"fp16.{c.FP16_MIN_SCALE_PATIENCE}")
+        if self.min_scale_patience < 0:
+            raise DeepSpeedConfigError(
+                f"fp16.{c.FP16_MIN_SCALE_PATIENCE} must be >= 0 "
+                f"(0 = warn-only), got {self.min_scale_patience}")
         # Later-DeepSpeed key (forward-port): drop the separate fp32
         # master copy — optimizer math upcasts from the compute-dtype
         # params and stores back. Halves per-param bytes-at-rest; the
@@ -375,6 +386,7 @@ class DeepSpeedConfig:
             bs_sched.get(c.BS_SCHEDULE_PARAMS, {}))
 
         self._parse_checkpoint_block(d)
+        self._parse_training_health_block(d)
 
         # Fork additions: gradient storage for debugging.
         self.store_gradients = bool(
@@ -460,6 +472,112 @@ class DeepSpeedConfig:
             "keep_last_n": ints[c.CHECKPOINT_KEEP_LAST_N],
             "keep_every_n_steps": ints[c.CHECKPOINT_KEEP_EVERY_N_STEPS],
             "save_on_preemption": save_on_preemption,
+        }
+
+    def _parse_training_health_block(self, d):
+        """Parse + validate the "training_health" block (runtime/
+        sentinel.py + runtime/fault_injection.py). Same parse-time
+        strictness as the "checkpoint" block: a mistyped threshold or
+        policy must fail at startup, not at the first (hours-away)
+        anomaly. Runs AFTER _parse_checkpoint_block — the rollback policy
+        cross-validates against checkpoint.save_dir."""
+        th = d.get(c.TRAINING_HEALTH) or {}
+        known = {c.TRAINING_HEALTH_ENABLED, c.TRAINING_HEALTH_POLICY,
+                 c.TRAINING_HEALTH_LOSS_ZSCORE,
+                 c.TRAINING_HEALTH_GRAD_NORM_ZSCORE,
+                 c.TRAINING_HEALTH_EMA_BETA,
+                 c.TRAINING_HEALTH_WARMUP_STEPS,
+                 c.TRAINING_HEALTH_ROLLBACK_AFTER,
+                 c.TRAINING_HEALTH_ABORT_AFTER,
+                 c.TRAINING_HEALTH_MAX_ROLLBACKS,
+                 c.TRAINING_HEALTH_HANG_TIMEOUT,
+                 c.TRAINING_HEALTH_FAULT_INJECTION}
+        unknown = sorted(set(th) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown 'training_health' key(s) {unknown}; valid "
+                f"keys: {sorted(known)}")
+
+        enabled = th.get(c.TRAINING_HEALTH_ENABLED,
+                         c.TRAINING_HEALTH_ENABLED_DEFAULT)
+        if not isinstance(enabled, bool):
+            raise DeepSpeedConfigError(
+                f"training_health.{c.TRAINING_HEALTH_ENABLED} must be a "
+                f"boolean, got {enabled!r}")
+
+        from .sentinel import POLICIES
+        policy = th.get(c.TRAINING_HEALTH_POLICY,
+                        c.TRAINING_HEALTH_POLICY_DEFAULT)
+        if policy not in POLICIES:
+            raise DeepSpeedConfigError(
+                f"training_health.{c.TRAINING_HEALTH_POLICY} must be one "
+                f"of {list(POLICIES)}, got {policy!r}")
+
+        floats = {}
+        for key, default, lo, hi in (
+                (c.TRAINING_HEALTH_LOSS_ZSCORE,
+                 c.TRAINING_HEALTH_LOSS_ZSCORE_DEFAULT, 0.0, None),
+                (c.TRAINING_HEALTH_GRAD_NORM_ZSCORE,
+                 c.TRAINING_HEALTH_GRAD_NORM_ZSCORE_DEFAULT, 0.0, None),
+                (c.TRAINING_HEALTH_EMA_BETA,
+                 c.TRAINING_HEALTH_EMA_BETA_DEFAULT, 0.0, 1.0),
+                (c.TRAINING_HEALTH_HANG_TIMEOUT,
+                 c.TRAINING_HEALTH_HANG_TIMEOUT_DEFAULT, 0.0, None)):
+            value = th.get(key, default)
+            if not isinstance(value, (int, float)) or \
+                    isinstance(value, bool):
+                raise DeepSpeedConfigError(
+                    f"training_health.{key} must be a number, got "
+                    f"{value!r}")
+            value = float(value)
+            if value < lo or (hi is not None and value >= hi):
+                bound = f">= {lo}" if hi is None else f"in [{lo}, {hi})"
+                raise DeepSpeedConfigError(
+                    f"training_health.{key} must be {bound}, got {value}")
+            floats[key] = value
+
+        ints = {}
+        for key, default, lo in (
+                (c.TRAINING_HEALTH_WARMUP_STEPS,
+                 c.TRAINING_HEALTH_WARMUP_STEPS_DEFAULT, 0),
+                (c.TRAINING_HEALTH_ROLLBACK_AFTER,
+                 c.TRAINING_HEALTH_ROLLBACK_AFTER_DEFAULT, 1),
+                (c.TRAINING_HEALTH_ABORT_AFTER,
+                 c.TRAINING_HEALTH_ABORT_AFTER_DEFAULT, 1),
+                (c.TRAINING_HEALTH_MAX_ROLLBACKS,
+                 c.TRAINING_HEALTH_MAX_ROLLBACKS_DEFAULT, 0)):
+            value = as_int(th.get(key, default), f"training_health.{key}")
+            if value < lo:
+                raise DeepSpeedConfigError(
+                    f"training_health.{key} must be >= {lo}, got {value}")
+            ints[key] = value
+
+        if enabled and policy == "rollback" and \
+                self.checkpoint_config["save_dir"] is None:
+            raise DeepSpeedConfigError(
+                "training_health.policy 'rollback' requires "
+                "checkpoint.save_dir: recovery restores the last "
+                "committed checkpoint from there")
+
+        fault_spec = th.get(c.TRAINING_HEALTH_FAULT_INJECTION)
+        if fault_spec is not None:
+            from .fault_injection import validate_fault_spec
+            validate_fault_spec(fault_spec)   # parse-time strictness
+
+        self.training_health_enabled = enabled
+        self.training_health_config = {
+            "enabled": enabled,
+            "policy": policy,
+            "loss_zscore": floats[c.TRAINING_HEALTH_LOSS_ZSCORE],
+            "grad_norm_zscore":
+                floats[c.TRAINING_HEALTH_GRAD_NORM_ZSCORE],
+            "ema_beta": floats[c.TRAINING_HEALTH_EMA_BETA],
+            "warmup_steps": ints[c.TRAINING_HEALTH_WARMUP_STEPS],
+            "rollback_after": ints[c.TRAINING_HEALTH_ROLLBACK_AFTER],
+            "abort_after": ints[c.TRAINING_HEALTH_ABORT_AFTER],
+            "max_rollbacks": ints[c.TRAINING_HEALTH_MAX_ROLLBACKS],
+            "hang_timeout_seconds": floats[c.TRAINING_HEALTH_HANG_TIMEOUT],
+            "fault_injection": fault_spec,
         }
 
     # -- batch triad -------------------------------------------------------

@@ -40,7 +40,11 @@ def as_int(value, name):
     """Coerce JSON numerics like 5e8 to int; reject non-integral values."""
     if value is None or isinstance(value, bool):
         raise DeepSpeedConfigError(f"'{name}' must be an integer, got {value!r}")
-    ivalue = int(value)
+    try:
+        ivalue = int(value)
+    except (TypeError, ValueError):
+        raise DeepSpeedConfigError(
+            f"'{name}' must be an integer, got {value!r}") from None
     if float(ivalue) != float(value):
         raise DeepSpeedConfigError(
             f"'{name}' must be integral, got {value!r}")

@@ -198,6 +198,37 @@ CHECKPOINT_TAG_VALIDATION_MODES = [
 ]
 
 # ---------------------------------------------------------------------------
+# Training-health sentinel block (runtime/sentinel.py)
+# ---------------------------------------------------------------------------
+TRAINING_HEALTH = "training_health"
+TRAINING_HEALTH_ENABLED = "enabled"
+TRAINING_HEALTH_ENABLED_DEFAULT = False
+TRAINING_HEALTH_POLICY = "policy"
+TRAINING_HEALTH_POLICY_DEFAULT = "warn"
+TRAINING_HEALTH_LOSS_ZSCORE = "loss_zscore"
+TRAINING_HEALTH_LOSS_ZSCORE_DEFAULT = 6.0
+TRAINING_HEALTH_GRAD_NORM_ZSCORE = "grad_norm_zscore"
+TRAINING_HEALTH_GRAD_NORM_ZSCORE_DEFAULT = 6.0
+TRAINING_HEALTH_EMA_BETA = "ema_beta"
+TRAINING_HEALTH_EMA_BETA_DEFAULT = 0.98
+TRAINING_HEALTH_WARMUP_STEPS = "warmup_steps"
+TRAINING_HEALTH_WARMUP_STEPS_DEFAULT = 20
+TRAINING_HEALTH_ROLLBACK_AFTER = "rollback_after"
+TRAINING_HEALTH_ROLLBACK_AFTER_DEFAULT = 2
+TRAINING_HEALTH_ABORT_AFTER = "abort_after"
+TRAINING_HEALTH_ABORT_AFTER_DEFAULT = 5
+TRAINING_HEALTH_MAX_ROLLBACKS = "max_rollbacks"
+TRAINING_HEALTH_MAX_ROLLBACKS_DEFAULT = 2
+TRAINING_HEALTH_HANG_TIMEOUT = "hang_timeout_seconds"
+TRAINING_HEALTH_HANG_TIMEOUT_DEFAULT = 0.0
+TRAINING_HEALTH_FAULT_INJECTION = "fault_injection"
+
+# fp16 block: consecutive overflow-skipped steps tolerated while the
+# dynamic loss scale sits at min_loss_scale before erroring (0 = warn-only)
+FP16_MIN_SCALE_PATIENCE = "min_scale_patience"
+FP16_MIN_SCALE_PATIENCE_DEFAULT = 0
+
+# ---------------------------------------------------------------------------
 # Sparse attention block
 # ---------------------------------------------------------------------------
 SPARSE_ATTENTION = "sparse_attention"

@@ -91,6 +91,10 @@ class EngineState(NamedTuple):
     scale: LossScaleState     # loss-scale state machine
     global_steps: jnp.ndarray
     skipped_steps: jnp.ndarray
+    # Training-health probe state (sentinel.HealthState) when the
+    # "training_health" block is enabled; None otherwise — None is an
+    # empty pytree node, so every existing path traces unchanged.
+    health: Any = None
 
 
 class StepMetrics(NamedTuple):
@@ -253,6 +257,44 @@ class DeepSpeedEngine:
                     "(a layer-streaming decomposition; see "
                     "runtime/zero/param_offload.StreamPlan — "
                     "models.gpt_neox.GPTNeoX implements it)")
+
+        # --- training-health sentinel + fault-injection harness -----------
+        # (runtime/sentinel.py, runtime/fault_injection.py; the "training_
+        # health" block). Built BEFORE _init_state: the device probe's
+        # HealthState rides in EngineState and the in-jit quarantine is a
+        # trace-time decision.
+        from .fault_injection import FaultInjector
+        th_cfg = self._config.training_health_config
+        self._fault_injector = FaultInjector.from_config_env(
+            th_cfg.get("fault_injection"))
+        self.sentinel = None
+        if th_cfg.get("enabled"):
+            from .sentinel import TrainingHealthSentinel
+            if self._onebit_packed_active():
+                raise DeepSpeedConfigError(
+                    "training_health is unsupported with packed-transport "
+                    "1-bit optimizers: the probe state cannot ride the "
+                    "rank-local shard_map step (use warmup/stage-0 Adam "
+                    "or disable the sentinel)")
+            self.sentinel = TrainingHealthSentinel(
+                self, **{k: v for k, v in th_cfg.items()
+                         if k not in ("enabled", "fault_injection")})
+        if self._fault_injector is not None and \
+                self._fault_injector.has_device_faults and \
+                (self.host_offload or self.param_offload or
+                 self._onebit_packed_active()):
+            raise DeepSpeedConfigError(
+                "fault_injection nan_grads/loss_spike faults corrupt the "
+                "jitted device step; the host-optimizer offload tiers and "
+                "packed 1-bit steps do not run it (stall faults work "
+                "everywhere)")
+        self._scale_floor = None
+        if self.dynamic_loss_scale():
+            from .fp16.loss_scaler import ScaleFloorWatch
+            args = self._config.dynamic_loss_scale_args or {}
+            self._scale_floor = ScaleFloorWatch(
+                min_scale=args.get("min_loss_scale", 1),
+                patience=self._config.min_scale_patience)
 
         # --- config-drivable model features (moe / sequence parallel /
         # activation checkpointing): applied BEFORE param init so the
@@ -724,6 +766,14 @@ class DeepSpeedEngine:
             # NVMe holds the state; drop the DRAM copies.
             self._host_state = None
 
+    def _make_health_state(self):
+        """Fresh device-probe state when the sentinel runs in-jit; None
+        otherwise (host-optimizer tiers probe eagerly on the host)."""
+        if self.sentinel is None or not self.sentinel.device_probe:
+            return None
+        from .sentinel import init_health_state
+        return init_health_state()
+
     def _make_scale_state(self):
         """Initial loss-scale state from the config (shared by the device,
         host-offload, and param-streaming init paths)."""
@@ -788,7 +838,8 @@ class DeepSpeedEngine:
                                opt_state=opt_state,
                                scale=self._make_scale_state(),
                                global_steps=jnp.asarray(0, jnp.int32),
-                               skipped_steps=jnp.asarray(0, jnp.int32))
+                               skipped_steps=jnp.asarray(0, jnp.int32),
+                               health=self._make_health_state())
 
         # copy=True: the engine's state buffers must never alias the
         # caller's arrays or each other — the jitted step donates state.
@@ -828,7 +879,8 @@ class DeepSpeedEngine:
             params=params, master=master, opt_state=opt_state,
             scale=self._make_scale_state(),
             global_steps=jnp.asarray(0, jnp.int32),
-            skipped_steps=jnp.asarray(0, jnp.int32))
+            skipped_steps=jnp.asarray(0, jnp.int32),
+            health=self._make_health_state())
 
     def _init_streamed_state(self, model_parameters):
         """ZeRO-Infinity param offload: params NEVER fully materialize in
@@ -1008,8 +1060,14 @@ class DeepSpeedEngine:
                 jax.lax.with_sharding_constraint, grads, self._grad_sh)
         return loss, grads
 
-    def _apply_update(self, state, grads, lr, axis_name=None):
+    def _apply_update(self, state, grads, lr, axis_name=None, loss=None):
         """Unscale, clip, update masters, recast; skip cleanly on overflow.
+
+        `loss` (standard train_batch path) feeds the training-health
+        probe fused here: the sentinel's anomaly flags reuse the global
+        grad norm and overflow flag this function already computes, and
+        with policy >= skip_batch a flagged step's update is skipped by
+        the same branchless selects as the fp16 overflow skip.
 
         `axis_name` is set only by the packed 1-bit step, which runs this
         INSIDE shard_map over the data axis with rank-local grads: the
@@ -1053,13 +1111,32 @@ class DeepSpeedEngine:
         # -1.0 sentinel when skipped: a constant 0.0 reads as a measured
         # zero norm, and a NaN sentinel would trip jax_debug_nans on
         # every step (norms are never negative, so -1 is unambiguous).
-        if cfg.gradient_clipping > 0 or self._monitor_wants_grad_norm:
+        if cfg.gradient_clipping > 0 or self._monitor_wants_grad_norm \
+                or state.health is not None:
             grad_norm = global_norm(grads)
         else:
             grad_norm = jnp.asarray(-1.0, jnp.float32)
         if cfg.gradient_clipping > 0:
             grads, _ = clip_grad_norm_(grads, cfg.gradient_clipping,
                                        norm=grad_norm)
+
+        # Training-health probe (sentinel.py): a few scalar ops over
+        # values already in registers — flags non-finite loss/grads and
+        # EMA z-score spikes. `skip` widens the overflow skip to hard
+        # anomalies when the policy quarantines; with the sentinel off,
+        # `skip` IS `overflow` and the program is unchanged.
+        skip = overflow
+        new_health = state.health
+        if state.health is not None:
+            from .sentinel import grad_anomaly_in_jit, probe_update
+            new_health, hard_anom = probe_update(
+                state.health, loss, grad_norm,
+                grad_anomaly_in_jit(self, state.scale, grad_norm,
+                                    overflow),
+                self.sentinel.probe_config)
+            if self.sentinel.probe_config.quarantine:
+                skip = jnp.logical_or(jnp.asarray(overflow, jnp.bool_),
+                                      hard_anom)
 
         masters = state.master if state.master is not None else state.params
         # Ragged leaves: move grads into the flat-padded master layout so
@@ -1088,20 +1165,21 @@ class DeepSpeedEngine:
             new_master, new_opt = self.optimizer.update(
                 grads, state.opt_state, masters, lr=lr)
 
-        # Branchless skip: on overflow keep every moment/param unchanged.
-        # With overflow statically False the selects trace away entirely.
+        # Branchless skip: on overflow (or a quarantined anomaly) keep
+        # every moment/param unchanged. With `skip` statically False the
+        # selects trace away entirely.
         def select(new, old):
-            if overflow is False:
+            if skip is False:
                 return jax.tree_util.tree_map(
                     lambda n, o: n.astype(o.dtype), new, old)
             return jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n.astype(o.dtype)),
+                lambda n, o: jnp.where(skip, o, n.astype(o.dtype)),
                 new, old)
 
         new_master = select(new_master, masters)
-        if overflow is not False:
+        if skip is not False:
             new_opt = jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n), new_opt,
+                lambda n, o: jnp.where(skip, o, n), new_opt,
                 state.opt_state)
 
         new_params = jax.tree_util.tree_map(
@@ -1122,15 +1200,19 @@ class DeepSpeedEngine:
             new_scale = state.scale._replace(
                 cur_iter=state.scale.cur_iter + 1)
 
+        # `skipped_steps` stays the loss-scale skip counter (reference
+        # semantics); sentinel quarantines are counted separately in
+        # HealthState.quarantined. Neither advances `global_steps`.
         new_state = EngineState(
             params=new_params,
             master=new_master if state.master is not None else None,
             opt_state=new_opt,
             scale=new_scale,
             global_steps=state.global_steps +
-            jnp.where(overflow, 0, 1).astype(jnp.int32),
+            jnp.where(skip, 0, 1).astype(jnp.int32),
             skipped_steps=state.skipped_steps +
-            jnp.where(overflow, 1, 0).astype(jnp.int32))
+            jnp.where(overflow, 1, 0).astype(jnp.int32),
+            health=new_health)
         return new_state, StepMetrics(loss=jnp.asarray(0.0), grad_norm=grad_norm,
                                       overflow=overflow, loss_scale=scale)
 
@@ -1161,10 +1243,13 @@ class DeepSpeedEngine:
             return self._apply_update(state, grads, lr)
         return jax.jit(update_fn, donate_argnums=(0, 1))
 
-    def _build_train_step(self, accum_steps):
+    def _build_train_step(self, accum_steps, with_fault=False):
         """Fused step: scan over [accum, batch, ...] micro-batches, mean the
-        grads, apply the update — one compilation, zero host round-trips."""
-        return jax.jit(self._train_step_body(accum_steps),
+        grads, apply the update — one compilation, zero host round-trips.
+        `with_fault` compiles the fault-injection variant (an extra
+        (mode, factor) scalar pair; see runtime/fault_injection.py)."""
+        return jax.jit(self._train_step_body(accum_steps,
+                                             with_fault=with_fault),
                        donate_argnums=(0,))
 
     def _onebit_packed_active(self):
@@ -1301,11 +1386,22 @@ class DeepSpeedEngine:
 
         return jax.jit(window, donate_argnums=(0,))
 
-    def _train_step_body(self, accum_steps):
+    def _train_step_body(self, accum_steps, with_fault=False):
         if self._onebit_packed_active():
             return self._onebit_packed_step(accum_steps)
 
-        def train_step(state, batches, rng, lr):
+        def step_tail(state, loss, grads, lr, fault):
+            """Shared tail: optional fault injection, then the update
+            (the probe inside `_apply_update` sees the step loss)."""
+            if with_fault:
+                from .fault_injection import apply_fault
+                loss, grads = apply_fault(loss, grads, fault)
+            new_state, metrics = self._apply_update(state, grads, lr,
+                                                    loss=loss)
+            return new_state, metrics._replace(
+                loss=loss.astype(jnp.float32))
+
+        def train_step(state, batches, rng, lr, fault=None):
             scale = state.scale.cur_scale
             theta = self._pld_theta_in_jit(state.global_steps)
 
@@ -1316,9 +1412,7 @@ class DeepSpeedEngine:
                 mb = jax.tree_util.tree_map(lambda b: b[0], batches)
                 loss, grads = self._loss_and_grads(state.params, mb, rng,
                                                    scale, pld_theta=theta)
-                new_state, metrics = self._apply_update(state, grads, lr)
-                return new_state, metrics._replace(
-                    loss=loss.astype(jnp.float32))
+                return step_tail(state, loss, grads, lr, fault)
 
             def micro(carry, xs):
                 grads_acc, loss_acc = carry
@@ -1342,8 +1436,7 @@ class DeepSpeedEngine:
             grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
             mean_loss = loss_sum / accum_steps
 
-            new_state, metrics = self._apply_update(state, grads, lr)
-            return new_state, metrics._replace(loss=mean_loss)
+            return step_tail(state, mean_loss, grads, lr, fault)
 
         return train_step
 
@@ -1849,16 +1942,15 @@ class DeepSpeedEngine:
         self._accum_count += 1
         self.micro_steps += 1
         if self.gradient_noise_scale is not None:
-            # feed UNSCALED, finite-checked grads: the cached grads carry
-            # the loss scale, and overflow steps would poison the EMA
+            # feed UNSCALED grads: the cached grads carry the loss
+            # scale. Non-finite micro-batches (overflow steps) are
+            # skipped inside update() itself — one gate, one counter.
             scale = float(self.state.scale.cur_scale) \
                 if self._config.loss_scaling_enabled else 1.0
             host_g = jax.tree_util.tree_map(
                 lambda g: np.asarray(jax.device_get(g),
                                      np.float32) / scale, grads)
-            if all(np.isfinite(l).all()
-                   for l in jax.tree_util.tree_leaves(host_g)):
-                self.gradient_noise_scale.update(host_g)
+            self.gradient_noise_scale.update(host_g)
         if self.store_gradients:
             self.stored_gradients = jax.tree_util.tree_map(
                 lambda g: np.asarray(g) if self._config.store_gradients_cpu
@@ -1975,13 +2067,37 @@ class DeepSpeedEngine:
             overflow = bool(metrics.overflow)
         else:
             overflow = False
+        verdict = "ok"
+        if self.sentinel is not None:
+            try:
+                verdict = self.sentinel.after_step(self, metrics, overflow)
+            finally:
+                self.sentinel.watchdog_feed()
+            if verdict == "rollback":
+                # state + host counters were restored from the committed
+                # checkpoint; the poisoned step contributes nothing to
+                # schedules or telemetry
+                return
         if overflow:
             self.skipped_steps += 1
             log_dist(f"OVERFLOW! Skipping step; loss scale now "
                      f"{float(self.state.scale.cur_scale)}", ranks=[0])
+            if self._scale_floor is not None and \
+                    self._scale_floor.on_skip(
+                        float(self.state.scale.cur_scale)) and \
+                    self.monitor is not None:
+                self.monitor.record(self.global_samples, {
+                    "Train/Samples/loss_scale_floor_skips":
+                        self._scale_floor.consecutive})
             self._advance_host_schedules(taken=0)
         else:
-            self._advance_host_schedules(taken=1)
+            if self._scale_floor is not None:
+                self._scale_floor.on_step_taken()
+            # a quarantined anomaly skipped its update in-jit: host
+            # schedules must not advance either (mirrors the device's
+            # global_steps, which also stood still)
+            self._advance_host_schedules(
+                taken=0 if verdict == "quarantined" else 1)
         if self.monitor is not None:
             self._record_step_metrics(metrics)
 
@@ -2029,6 +2145,20 @@ class DeepSpeedEngine:
         # requests, fire the auto-save interval (no-ops when unconfigured)
         self.checkpoint_manager.on_step_boundary(self)
 
+    def _step_program_ready(self, gas, fault):
+        """Is the program the coming step will run already compiled?
+        (Gates the hang-watchdog deadline: tracing + XLA compilation on
+        a program's first call is slow but is not a hang.)"""
+        if self.param_offload:
+            return self.micro_steps > 0
+        if self.host_offload:
+            return ("grads", gas) in self._compiled_train
+        key = gas if fault is None else (gas, "fault")
+        if self._onebit_packed_active():
+            key = (gas,
+                   bool(self.global_steps >= self.optimizer.freeze_step))
+        return key in self._compiled_train
+
     def train_batch(self, data_iter=None, batch=None, layers_to_hook=None):
         """Fused fast path: one jitted call per effective batch.
 
@@ -2047,6 +2177,37 @@ class DeepSpeedEngine:
         self._assert_comm_precision()
         self._warn_gns_not_fed("train_batch")
 
+        fault = None
+        stall_s = 0.0
+        if self._fault_injector is not None:
+            mode, factor, stall_s = self._fault_injector.plan_next_step()
+            fault = (jax.device_put(np.int32(mode),
+                                    self._replicated_sharding),
+                     jax.device_put(np.float32(factor),
+                                    self._replicated_sharding))
+
+        # hang watchdog: this step must complete (through the sentinel's
+        # flags read in _after_step) before the deadline. Armed only once
+        # this step's program is compiled — a first-call XLA compile
+        # takes minutes and is not a hang.
+        if self.sentinel is not None and \
+                self._step_program_ready(gas, fault):
+            self.sentinel.watchdog_arm()
+        if stall_s > 0:
+            import time as _time
+            _time.sleep(stall_s)   # deterministic hung-step fault
+
+        try:
+            return self._train_batch_execute(batch, gas, fault)
+        except BaseException:
+            # the step DIED rather than hung: disarm, or the deadline
+            # would later fire a spurious stack dump + emergency-save
+            # request while the process handles the exception
+            if self.sentinel is not None:
+                self.sentinel.watchdog_feed()
+            raise
+
+    def _train_batch_execute(self, batch, gas, fault):
         if self.param_offload:
             # ZeRO-Infinity: params stream from host/NVMe segment by
             # segment — skip the whole-batch device upload and the
@@ -2088,19 +2249,27 @@ class DeepSpeedEngine:
             metrics = self._host_apply_update(grads)
             metrics = metrics._replace(loss=loss)
         else:
-            key = gas
+            key = gas if fault is None else (gas, "fault")
             if self._onebit_packed_active():
                 # two compiled programs: warmup (dp-mean grads, plain
                 # Adam) and post-freeze (rank-local grads, packed wire);
-                # switch by the host-side step counter
+                # switch by the host-side step counter. The packed step
+                # body takes no fault arg (device faults are rejected at
+                # init; a stall-only injector already slept above).
+                fault = None
                 post = self.global_steps >= self.optimizer.freeze_step
                 self._onebit_post_phase = bool(post)
                 key = (gas, bool(post))
             if key not in self._compiled_train:
-                self._compiled_train[key] = self._build_train_step(gas)
+                self._compiled_train[key] = self._build_train_step(
+                    gas, with_fault=fault is not None)
             lr = self._current_lr()
-            self.state, metrics = self._compiled_train[key](
-                self.state, sharded, self._next_rng(), lr)
+            if fault is not None:
+                self.state, metrics = self._compiled_train[key](
+                    self.state, sharded, self._next_rng(), lr, fault)
+            else:
+                self.state, metrics = self._compiled_train[key](
+                    self.state, sharded, self._next_rng(), lr)
         self.micro_steps += gas
         self._after_step(metrics)
         self.tput_timer.stop()
@@ -2143,6 +2312,21 @@ class DeepSpeedEngine:
                 f"got leading {lead[:2]}")
         self._assert_comm_precision()
         self.tput_timer.start()
+        if self.sentinel is not None and \
+                ("window", gas, n_steps) in self._compiled_train:
+            # one deadline for the whole fused window (n_steps device
+            # steps run in one dispatch — no per-step host hop exists);
+            # first call compiles and is exempt, as in train_batch
+            self.sentinel.watchdog_arm()
+        try:
+            return self._train_steps_execute(batches, gas, n_steps)
+        except BaseException:
+            # died, not hung: disarm (see train_batch)
+            if self.sentinel is not None:
+                self.sentinel.watchdog_feed()
+            raise
+
+    def _train_steps_execute(self, batches, gas, n_steps):
         # data axis on dim 2: dims 0/1 are the step and grad-accum scans
         sharded = self._shard_stacked_batch(batches, n_scan_dims=2)
         self._warn_gns_not_fed("train_steps")
@@ -2158,8 +2342,19 @@ class DeepSpeedEngine:
         self.state, losses = self._compiled_train[key](
             self.state, sharded, base_rng, ms0, lr)
         self.micro_steps += gas * n_steps
-        if self._config.loss_scaling_enabled:
-            # dynamic scale may have skipped steps; sync from device
+        if self.sentinel is not None:
+            # the in-jit probe/quarantine protected every step of the
+            # window; sync host mirrors + warn (escalation is per-step
+            # only on the train_batch loop)
+            try:
+                self.sentinel.after_window(self)
+            finally:
+                self.sentinel.watchdog_feed()
+        if self._config.loss_scaling_enabled or (
+                self.sentinel is not None
+                and self.sentinel.probe_config.quarantine):
+            # dynamic scale (or the sentinel's in-jit quarantine) may
+            # have skipped steps; sync from device
             taken = int(self.state.global_steps) - self.global_steps
         else:
             taken = n_steps
@@ -2202,8 +2397,14 @@ class DeepSpeedEngine:
     def _report_progress(self, step):
         lr = self.get_lr()
         mom = self.get_mom()
-        log_dist(f"step={step}, skipped={self.skipped_steps}, lr={lr}, "
-                 f"mom={mom}", ranks=[0])
+        msg = (f"step={step}, skipped={self.skipped_steps}, lr={lr}, "
+               f"mom={mom}")
+        if self.sentinel is not None:
+            s = self.sentinel
+            msg += (f", anomalies={s.anomalies}, "
+                    f"quarantined={s.quarantined}, "
+                    f"rollbacks={s.rollbacks}")
+        log_dist(msg, ranks=[0])
         if self.monitor is not None:
             self.monitor.flush(drain=False)  # periodic: stay non-blocking
 
@@ -2236,9 +2437,11 @@ class DeepSpeedEngine:
     @property
     def _monitor_wants_grad_norm(self):
         """grad_norm costs a full read pass over the gradient tree inside
-        the jitted step — compute it only when something reports it."""
+        the jitted step — compute it only when something reports it (the
+        training-health probe consumes it too)."""
         return (self._config.tensorboard_enabled
-                or self.gradient_noise_scale is not None)
+                or self.gradient_noise_scale is not None
+                or getattr(self, "sentinel", None) is not None)
 
     # ------------------------------------------------------------------
     # checkpointing (layout parity; see deeperspeed_tpu/checkpoint)
@@ -2268,12 +2471,14 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None,
                         load_module_strict=True,
                         load_optimizer_states=True,
-                        load_lr_scheduler_states=True):
+                        load_lr_scheduler_states=True,
+                        load_dataloader_states=True):
         from ..checkpoint.checkpointing import load_checkpoint as _load
         path, client_state = _load(
             self, load_dir, tag=tag,
             load_optimizer_states=load_optimizer_states,
-            load_lr_scheduler_states=load_lr_scheduler_states)
+            load_lr_scheduler_states=load_lr_scheduler_states,
+            load_dataloader_states=load_dataloader_states)
         if path is not None:
             self.checkpoint_manager.on_checkpoint_loaded(self)
         return path, client_state

@@ -1,0 +1,184 @@
+"""Deterministic fault injection for the training-health sentinel.
+
+The recovery machinery in `runtime/sentinel.py` is only trustworthy if
+every path can be driven on demand: this harness injects NaN gradients,
+loss spikes, and stalled steps at chosen steps so tests and the
+`DS_BENCH_SENTINEL=1` bench row exercise detect -> quarantine ->
+rollback -> abort and the hang watchdog end to end.
+
+Gating (zero overhead when off):
+
+- config: ``{"training_health": {"fault_injection": {"faults": [...]}}}``
+- env:    ``DS_FAULT_INJECT='{"faults": [...]}'`` (JSON, same schema)
+
+When neither is present the engine holds no injector and compiles the
+exact same program as before — no extra arguments, no extra ops. When
+active, the train step compiles ONE extra variant taking a tiny
+``(mode, factor)`` scalar pair; the per-step plan is pure host
+bookkeeping.
+
+Fault schema (all faults validated at parse time)::
+
+    {"kind": "nan_grads" | "loss_spike" | "stall",
+     "step": N,          # 0-based optimizer-step serial in this process
+     "times": 1,         # fires on steps [step, step+times)
+     "factor": 1e3,      # loss_spike only: loss multiplier
+     "seconds": 1.0}     # stall only: host-side sleep length
+
+``step`` counts train_batch invocations in THIS process (a monotonic
+serial, never rewound by rollback) — so a replayed window after a
+rollback does not re-trigger a one-shot fault, which is exactly the
+"transient corruption" scenario the recovery tests need.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+
+from .config_utils import DeepSpeedConfigError
+
+FAULT_KINDS = ("nan_grads", "loss_spike", "stall")
+
+# device-side injection modes (the (mode, factor) scalar pair)
+MODE_NONE = 0
+MODE_NAN_GRADS = 1
+MODE_LOSS_SPIKE = 2
+
+ENV_VAR = "DS_FAULT_INJECT"
+
+
+def validate_fault_spec(spec, where="training_health.fault_injection"):
+    """Validate an injection spec dict -> normalized list of fault dicts.
+    Raises DeepSpeedConfigError on any malformed entry (parse-time
+    strictness: a typo'd fault plan must fail at startup, not silently
+    never fire)."""
+    if not isinstance(spec, dict):
+        raise DeepSpeedConfigError(
+            f"{where} must be an object with a 'faults' list, got "
+            f"{type(spec).__name__}")
+    unknown = sorted(set(spec) - {"faults"})
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown {where} key(s) {unknown}; valid keys: ['faults']")
+    faults = spec.get("faults", [])
+    if not isinstance(faults, (list, tuple)):
+        raise DeepSpeedConfigError(
+            f"{where}.faults must be a list, got "
+            f"{type(faults).__name__}")
+    known = {"kind", "step", "times", "factor", "seconds"}
+    out = []
+    for i, fault in enumerate(faults):
+        if not isinstance(fault, dict):
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}] must be an object, got "
+                f"{type(fault).__name__}")
+        unknown = sorted(set(fault) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown {where}.faults[{i}] key(s) {unknown}; valid "
+                f"keys: {sorted(known)}")
+        kind = fault.get("kind")
+        if kind not in FAULT_KINDS:
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}].kind must be one of "
+                f"{list(FAULT_KINDS)}, got {kind!r}")
+        step = fault.get("step")
+        if not isinstance(step, int) or isinstance(step, bool) or step < 0:
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}].step must be an int >= 0, got "
+                f"{step!r}")
+        times = fault.get("times", 1)
+        if not isinstance(times, int) or isinstance(times, bool) \
+                or times < 1:
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}].times must be an int >= 1, got "
+                f"{times!r}")
+        factor = fault.get("factor", 1e3)
+        seconds = fault.get("seconds", 1.0)
+        for key, value in (("factor", factor), ("seconds", seconds)):
+            if not isinstance(value, (int, float)) or \
+                    isinstance(value, bool) or value <= 0:
+                raise DeepSpeedConfigError(
+                    f"{where}.faults[{i}].{key} must be a number > 0, "
+                    f"got {value!r}")
+        out.append({"kind": kind, "step": step, "times": times,
+                    "factor": float(factor), "seconds": float(seconds),
+                    "remaining": times})
+    return out
+
+
+class FaultInjector:
+    """Per-process deterministic fault plan.
+
+    `plan_next_step()` is called exactly once per optimizer-step attempt
+    and returns ``(mode, factor, stall_seconds)`` for that step; `mode`
+    and `factor` ride into the jitted step as scalars (see
+    `apply_fault`), `stall_seconds` is slept on the host."""
+
+    def __init__(self, faults):
+        self.faults = faults
+        self.serial = 0       # monotonic step-attempt counter
+        self.fired = []       # (serial, kind) audit trail
+
+    @classmethod
+    def from_config_env(cls, config_spec=None, env=None):
+        """Build from the config block and/or the DS_FAULT_INJECT env var
+        (faults from both are merged); None when neither is present."""
+        env = os.environ if env is None else env
+        faults = []
+        if config_spec:
+            faults += validate_fault_spec(config_spec)
+        raw = env.get(ENV_VAR)
+        if raw:
+            try:
+                spec = json.loads(raw)
+            except json.JSONDecodeError as e:
+                raise DeepSpeedConfigError(
+                    f"{ENV_VAR} is not valid JSON: {e}") from e
+            faults += validate_fault_spec(spec, where=ENV_VAR)
+        if not faults:
+            return None
+        return cls(faults)
+
+    @property
+    def has_device_faults(self):
+        return any(f["kind"] in ("nan_grads", "loss_spike")
+                   for f in self.faults)
+
+    def plan_next_step(self):
+        serial = self.serial
+        self.serial += 1
+        mode, factor, stall = MODE_NONE, 1.0, 0.0
+        for fault in self.faults:
+            if fault["remaining"] <= 0:
+                continue
+            if not (fault["step"] <= serial
+                    < fault["step"] + fault["times"]):
+                continue
+            fault["remaining"] -= 1
+            self.fired.append((serial, fault["kind"]))
+            if fault["kind"] == "nan_grads":
+                mode = MODE_NAN_GRADS
+            elif fault["kind"] == "loss_spike":
+                mode = MODE_LOSS_SPIKE
+                factor = fault["factor"]
+            elif fault["kind"] == "stall":
+                stall = max(stall, fault["seconds"])
+        return mode, factor, stall
+
+
+def apply_fault(loss, grads, fault):
+    """In-jit injection: corrupt the accumulated grads / the step loss
+    according to the ``(mode, factor)`` scalar pair. A `mode` of 0 is the
+    identity (the `where`s select the clean values)."""
+    import jax
+
+    mode, factor = fault
+    is_nan = mode == MODE_NAN_GRADS
+    grads = jax.tree_util.tree_map(
+        lambda g: jnp.where(is_nan, jnp.full(g.shape, jnp.nan, g.dtype), g),
+        grads)
+    loss = jnp.where(mode == MODE_LOSS_SPIKE,
+                     loss * jnp.asarray(factor, loss.dtype), loss)
+    return loss, grads

@@ -165,6 +165,61 @@ def update_loss_scale(state, overflow, scale_factor=2.0, scale_window=1000,
     )
 
 
+class LossScaleFloorError(RuntimeError):
+    """The dynamic loss scale is pinned at `min_scale` and every step is
+    being skipped — the run is burning compute without training."""
+
+
+class ScaleFloorWatch:
+    """Detect a loss scale stuck at its floor (host-side, O(1) per step).
+
+    The dynamic scaler halves on overflow but floors at `min_scale`; once
+    there, a run whose gradients stay non-finite skips EVERY step while
+    reporting normal-looking progress. This watch warns ONCE when the
+    floor is first hit on a skipped step, tracks the consecutive-skip run
+    length (the engine mirrors it to the monitor), and — after `patience`
+    consecutive skipped steps at the floor — raises `LossScaleFloorError`
+    instead of silently burning steps. `patience=0` keeps warn-only
+    behavior (the seed's semantics).
+    """
+
+    def __init__(self, min_scale=1.0, patience=0):
+        self.min_scale = float(min_scale)
+        self.patience = int(patience)
+        self.consecutive = 0
+        self.warned = False
+
+    def on_skip(self, cur_scale):
+        """Record an overflow-skipped step; True if the scale sits at the
+        floor. Raises after `patience` consecutive floor skips."""
+        if cur_scale > self.min_scale:
+            self.consecutive = 0
+            return False
+        self.consecutive += 1
+        if not self.warned:
+            self.warned = True
+            from ...utils.logging import logger
+            logger.warning(
+                f"dynamic loss scale has bottomed out at min_scale="
+                f"{self.min_scale} and the step was skipped; if this "
+                "persists the run is not training (set "
+                "fp16.min_scale_patience to fail fast)")
+        if self.patience and self.consecutive >= self.patience:
+            raise LossScaleFloorError(
+                f"loss scale pinned at min_scale={self.min_scale} for "
+                f"{self.consecutive} consecutive skipped steps "
+                f"(fp16.min_scale_patience={self.patience}): every "
+                "recent step overflowed and was dropped. The gradients "
+                "are persistently non-finite — check for bad data, a "
+                "diverged model, or enable the training_health sentinel "
+                "for automatic rollback.")
+        return True
+
+    def on_step_taken(self):
+        """A step actually applied: the skip run (if any) is over."""
+        self.consecutive = 0
+
+
 CLIP_GRAD = "clip_grad"
 
 

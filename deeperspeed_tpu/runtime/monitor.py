@@ -140,6 +140,17 @@ class TensorBoardMonitor:
             "Train/Checkpoint/bytes_written": stats["bytes"],
         })
 
+    def record_health(self, sample_count, counters):
+        """Training-health sentinel counters (runtime/sentinel.py):
+        cumulative anomalies, quarantined windows, rollbacks, the current
+        consecutive-anomaly run, and hang-watchdog fires. Recorded only
+        when something changed — healthy steady state writes nothing."""
+        if not self.enabled:
+            return
+        self.record(sample_count, {
+            f"Train/Sentinel/{tag}": value
+            for tag, value in counters.items()})
+
     def close(self):
         if self.writer is not None:
             self.flush()

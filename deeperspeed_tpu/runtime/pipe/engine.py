@@ -262,22 +262,27 @@ class PipelineEngine(DeepSpeedEngine):
                 "pipeline mode; see forward()")
         return super().backward(loss, **kwargs)
 
-    def _train_step_body(self, accum_steps):
+    def _train_step_body(self, accum_steps, with_fault=False):
         """Pipelined mode: the gradient-accumulation micro-batches ARE the
         pipeline micro-batches (one fused 1F1B schedule, reference
         `pipe/engine.py:264` — micro_batches == gas). Merge the stacked
         [gas, micro, ...] batch into one effective batch and run the
         pipelined loss once; the micro splitting happens inside it."""
         if not self._spmd_pipelined:
-            return super()._train_step_body(accum_steps)
+            return super()._train_step_body(accum_steps,
+                                            with_fault=with_fault)
 
-        def train_step(state, batches, rng, lr):
+        def train_step(state, batches, rng, lr, fault=None):
             scale = state.scale.cur_scale
             full = jax.tree_util.tree_map(
                 lambda b: b.reshape((-1,) + b.shape[2:]), batches)
             loss, grads = self._loss_and_grads(state.params, full, rng,
                                                scale)
-            new_state, metrics = self._apply_update(state, grads, lr)
+            if with_fault:
+                from ..fault_injection import apply_fault
+                loss, grads = apply_fault(loss, grads, fault)
+            new_state, metrics = self._apply_update(state, grads, lr,
+                                                    loss=loss)
             return new_state, metrics._replace(
                 loss=loss.astype(jnp.float32))
 

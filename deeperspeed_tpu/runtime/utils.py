@@ -302,6 +302,7 @@ class GradientNoiseScale:
         self.noise = None
         self.noise_scale = None
         self.n_updates = 0
+        self.skipped_nonfinite = 0
 
     def _ema(self, avg, value, i):
         avg = (avg or 0) * self.beta + (1 - self.beta) * value
@@ -324,6 +325,21 @@ class GradientNoiseScale:
 
     def update(self, grads):
         curr = self._flatten(grads)
+        # host-side check (np, not a device reduction): the estimator's
+        # consumers materialize `curr` on the host anyway
+        if not np.isfinite(np.asarray(curr)).all():
+            # One NaN/Inf micro-batch would poison the running sum AND
+            # both EMAs permanently (every later estimate stays NaN).
+            # Drop it: the step itself is handled by the loss-scaler /
+            # sentinel skip machinery; the estimator just sees one fewer
+            # sample.
+            self.skipped_nonfinite += 1
+            if self.skipped_nonfinite == 1:
+                logger.warning(
+                    "GradientNoiseScale: skipping a non-finite "
+                    "micro-batch gradient (would permanently poison the "
+                    "EMA estimates)")
+            return
         # running sum, not a buffer of n_batches full gradient copies —
         # only the mean is ever consumed, and buffering costs
         # n_batches x model-size fp32 of live memory
@@ -359,6 +375,7 @@ class GradientNoiseScale:
             "noise": self.noise,
             "noise_scale": self.noise_scale,
             "n_updates": self.n_updates,
+            "skipped_nonfinite": self.skipped_nonfinite,
         }
 
     def load_state_dict(self, sd):
@@ -369,3 +386,4 @@ class GradientNoiseScale:
         self.noise = sd["noise"]
         self.noise_scale = sd["noise_scale"]
         self.n_updates = int(sd["n_updates"])
+        self.skipped_nonfinite = int(sd.get("skipped_nonfinite", 0))

@@ -15,13 +15,36 @@ from .logging import logger
 
 _initialized = False
 
+# Default deadline (seconds) for host-coordination barriers; None waits
+# forever (the seed's behavior). Set via init_distributed(timeout=...) —
+# a dead host then fails the BARRIER fast instead of hanging every
+# surviving host until the scheduler gives up.
+_collective_timeout = None
+_barrier_serials = {}
+_warned_no_client = False
+
+
+def get_collective_timeout():
+    """The barrier/collective deadline configured via
+    init_distributed(timeout=...), in seconds (None = wait forever)."""
+    return _collective_timeout
+
 
 def init_distributed(dist_backend="xla", auto_mpi_discovery=True,
                      distributed_port=29500, verbose=True,
                      timeout=None, init_method=None):
     """Join the multi-host world if env/MPI rendezvous info is present;
-    single-host runs are a no-op (all local chips already visible)."""
-    global _initialized
+    single-host runs are a no-op (all local chips already visible).
+
+    `timeout` (seconds) bounds BOTH the rendezvous
+    (`jax.distributed.initialize(initialization_timeout=...)`) and every
+    later `barrier()` call — a dead host fails fast instead of hanging
+    the fleet forever."""
+    global _initialized, _collective_timeout
+    if timeout is not None:
+        # recorded even on the early-return paths: barrier() must honor
+        # the caller's deadline regardless of when the world formed
+        _collective_timeout = float(timeout)
     if _initialized:
         return
 
@@ -44,12 +67,69 @@ def init_distributed(dist_backend="xla", auto_mpi_discovery=True,
     if verbose:
         logger.info(
             f"Initializing jax.distributed: rank={rank}, "
-            f"world_size={world_size}, coordinator={addr}:{port}")
-    jax.distributed.initialize(
-        coordinator_address=f"{addr}:{port}",
-        num_processes=world_size,
-        process_id=rank)
+            f"world_size={world_size}, coordinator={addr}:{port}"
+            + (f", timeout={timeout}s" if timeout is not None else ""))
+    kwargs = {}
+    if timeout is not None:
+        kwargs["initialization_timeout"] = int(float(timeout))
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=world_size,
+            process_id=rank, **kwargs)
+    except TypeError:
+        # older jax without initialization_timeout: rendezvous is
+        # unbounded, but barrier() deadlines below still apply
+        if kwargs:
+            logger.warning("this jax version does not accept "
+                           "initialization_timeout; rendezvous will not "
+                           "time out")
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=world_size,
+            process_id=rank)
     _initialized = True
+
+
+def _distributed_client():
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - private-API drift
+        return None
+
+
+def barrier(tag, timeout=None):
+    """Multihost host-level barrier with a fail-fast deadline.
+
+    With a timeout (argument, or the `init_distributed(timeout=...)`
+    default) the barrier runs on the coordination service
+    (`wait_at_barrier`), which raises DEADLINE_EXCEEDED when any host is
+    missing — a preempted/dead host costs seconds, not an infinite hang
+    inside a device collective. Without one (or on jax builds without the
+    client API) it degrades to `sync_global_devices`, the seed's
+    unbounded device-collective barrier. Single-process: no-op."""
+    if jax.process_count() <= 1:
+        return
+    timeout = _collective_timeout if timeout is None else timeout
+    if timeout:
+        client = _distributed_client()
+        if client is not None:
+            # wait_at_barrier ids must be unique per rendezvous; every
+            # host derives the same serial for the same call site order
+            serial = _barrier_serials.get(tag, 0)
+            _barrier_serials[tag] = serial + 1
+            client.wait_at_barrier(f"{tag}:{serial}",
+                                   int(float(timeout) * 1000))
+            return
+        global _warned_no_client
+        if not _warned_no_client:  # pragma: no cover - env dependent
+            _warned_no_client = True
+            logger.warning("barrier timeout requested but no distributed "
+                           "client is available; falling back to the "
+                           "unbounded device-collective barrier")
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
 
 
 def _patch_azureml_env(verbose=True):

@@ -1,0 +1,613 @@
+"""Training-health sentinel (runtime/sentinel.py) + fault-injection
+harness (runtime/fault_injection.py): anomaly detection, policy-driven
+skip/rollback/abort recovery, hang watchdog, and the riding satellites
+(loss-scale floor patience, GNS non-finite skip, init_distributed
+timeout, checkpoint round-trip bit-exactness).
+
+Fast lane: SimpleModel on the 8-device virtual CPU mesh; every
+injection-driven test carries the `fault_injection` marker (the whole
+file still runs under the tier-1 `-m 'not slow'` selection)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deeperspeed_tpu
+from deeperspeed_tpu.runtime import fault_injection as fi
+from deeperspeed_tpu.runtime import sentinel as sn
+from deeperspeed_tpu.runtime.config import DeepSpeedConfig
+from deeperspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+from deeperspeed_tpu.runtime.fp16.loss_scaler import (LossScaleFloorError,
+                                                      ScaleFloorWatch)
+from deeperspeed_tpu.runtime.utils import GradientNoiseScale
+from tests.simple_model import SimpleModel, random_batches, random_dataset
+
+HIDDEN = 16
+BATCH = 8
+
+pytestmark = []
+
+
+def cfg(**overrides):
+    base = {
+        "train_batch_size": BATCH,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    base.update(overrides)
+    return base
+
+
+def th(**overrides):
+    base = {"enabled": True, "policy": "warn", "warmup_steps": 100}
+    base.update(overrides)
+    return base
+
+
+def make_engine(config, seed=1, training_data=None):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=config,
+        training_data=training_data)
+    return engine
+
+
+def stack1(batch):
+    """One micro-batch -> the [accum=1, batch, ...] stacked layout."""
+    return jax.tree_util.tree_map(lambda x: x[None], batch)
+
+
+def params_np(engine):
+    return jax.tree_util.tree_map(np.asarray, engine.module)
+
+
+def trees_equal(a, b):
+    return all(jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.array_equal, a, b)))
+
+
+# ---------------------------------------------------------------------------
+# config block validation (parse-time strictness)
+# ---------------------------------------------------------------------------
+
+def test_config_defaults_off():
+    config = DeepSpeedConfig(cfg(), world_size=1)
+    assert config.training_health_enabled is False
+    assert config.training_health_config["policy"] == "warn"
+    engine = make_engine(cfg())
+    assert engine.sentinel is None
+    assert engine._fault_injector is None
+    assert engine.state.health is None
+
+
+@pytest.mark.parametrize("block, match", [
+    ({"enabled": True, "bogus_knob": 1}, "bogus_knob"),
+    ({"enabled": True, "policy": "restart"}, "policy"),
+    ({"enabled": "yes"}, "boolean"),
+    ({"enabled": True, "loss_zscore": -1}, "loss_zscore"),
+    ({"enabled": True, "ema_beta": 1.0}, "ema_beta"),
+    ({"enabled": True, "rollback_after": 0}, "rollback_after"),
+    ({"enabled": True, "warmup_steps": "soon"}, "warmup_steps"),
+    ({"enabled": True, "hang_timeout_seconds": -2}, "hang_timeout"),
+])
+def test_config_rejects_bad_values(block, match):
+    with pytest.raises(DeepSpeedConfigError, match=match):
+        DeepSpeedConfig(cfg(training_health=block), world_size=1)
+
+
+def test_config_rollback_requires_checkpoint_dir():
+    with pytest.raises(DeepSpeedConfigError, match="save_dir"):
+        DeepSpeedConfig(cfg(training_health=th(policy="rollback")),
+                        world_size=1)
+    # with a save_dir it parses
+    config = DeepSpeedConfig(
+        cfg(training_health=th(policy="rollback"),
+            checkpoint={"save_dir": "/tmp/ckpt"}), world_size=1)
+    assert config.training_health_config["policy"] == "rollback"
+
+
+@pytest.mark.parametrize("faults, match", [
+    ([{"kind": "power_cut", "step": 1}], "kind"),
+    ([{"kind": "nan_grads"}], "step"),
+    ([{"kind": "nan_grads", "step": -1}], "step"),
+    ([{"kind": "nan_grads", "step": 1, "times": 0}], "times"),
+    ([{"kind": "stall", "step": 1, "seconds": 0}], "seconds"),
+    ([{"kind": "nan_grads", "step": 1, "whoops": 2}], "whoops"),
+])
+def test_fault_spec_validation(faults, match):
+    with pytest.raises(DeepSpeedConfigError, match=match):
+        fi.validate_fault_spec({"faults": faults})
+
+
+def test_fault_injector_from_env(monkeypatch):
+    monkeypatch.setenv(fi.ENV_VAR,
+                       '{"faults": [{"kind": "nan_grads", "step": 2}]}')
+    inj = fi.FaultInjector.from_config_env(None)
+    assert inj is not None and inj.has_device_faults
+    monkeypatch.setenv(fi.ENV_VAR, "not json")
+    with pytest.raises(DeepSpeedConfigError, match="JSON"):
+        fi.FaultInjector.from_config_env(None)
+
+
+def test_fault_injector_plan_is_deterministic():
+    inj = fi.FaultInjector(fi.validate_fault_spec({"faults": [
+        {"kind": "nan_grads", "step": 1},
+        {"kind": "loss_spike", "step": 3, "times": 2, "factor": 7.0},
+        {"kind": "stall", "step": 3, "seconds": 0.5},
+    ]}))
+    plans = [inj.plan_next_step() for _ in range(6)]
+    assert plans[0] == (fi.MODE_NONE, 1.0, 0.0)
+    assert plans[1] == (fi.MODE_NAN_GRADS, 1.0, 0.0)
+    assert plans[3] == (fi.MODE_LOSS_SPIKE, 7.0, 0.5)
+    assert plans[4] == (fi.MODE_LOSS_SPIKE, 7.0, 0.0)
+    assert plans[5] == (fi.MODE_NONE, 1.0, 0.0)
+    # one-shot: a second pass over the same serials never re-fires
+    assert [s for s, _ in inj.fired] == [1, 3, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# probe math (eager)
+# ---------------------------------------------------------------------------
+
+def _probe_cfg(**kw):
+    base = dict(loss_zscore=6.0, grad_norm_zscore=6.0, ema_beta=0.9,
+                warmup_steps=3, quarantine=True)
+    base.update(kw)
+    return sn.ProbeConfig(**base)
+
+
+def test_probe_flags_nonfinite_and_spikes():
+    cfg_ = _probe_cfg()
+    health = sn.init_health_state()
+    for _ in range(10):   # healthy warmup: loss ~1, gnorm ~2
+        health, hard = sn.probe_update(health, jnp.float32(1.0),
+                                       jnp.float32(2.0), False, cfg_)
+        assert int(health.flags) == 0 and not bool(hard)
+    # non-finite loss
+    h1, hard = sn.probe_update(health, jnp.float32(np.nan),
+                               jnp.float32(2.0), False, cfg_)
+    assert int(h1.flags) & sn.ANOM_NONFINITE_LOSS and bool(hard)
+    # non-finite grads: the caller's bad_grad verdict drives the flag
+    h2, _ = sn.probe_update(health, jnp.float32(1.0),
+                            jnp.float32(np.nan), True, cfg_)
+    assert int(h2.flags) & sn.ANOM_NONFINITE_GRAD
+    # fp16 scale-search exemption: a NaN norm with bad_grad=False (the
+    # dynamic scaler still has room to halve) must NOT flag, and must
+    # not pollute the EMAs either
+    h3, hard = sn.probe_update(health, jnp.float32(1.0),
+                               jnp.float32(np.nan), False, cfg_)
+    assert int(h3.flags) == 0 and not bool(hard)
+    assert float(h3.gnorm_ema) == float(health.gnorm_ema)
+    # loss spike (1000x) and grad-norm spike
+    h4, _ = sn.probe_update(health, jnp.float32(1000.0),
+                            jnp.float32(2.0), False, cfg_)
+    assert int(h4.flags) & sn.ANOM_LOSS_SPIKE
+    h5, _ = sn.probe_update(health, jnp.float32(1.0),
+                            jnp.float32(2000.0), False, cfg_)
+    assert int(h5.flags) & sn.ANOM_GRAD_SPIKE
+    assert sn.decode_flags(int(h5.flags)) == ["grad_norm_spike"]
+
+
+def test_probe_ema_not_poisoned_by_anomalies():
+    cfg_ = _probe_cfg()
+    health = sn.init_health_state()
+    for _ in range(10):
+        health, _ = sn.probe_update(health, jnp.float32(1.0),
+                                    jnp.float32(2.0), False, cfg_)
+    before = (float(health.loss_ema), float(health.gnorm_ema),
+              int(health.count))
+    # a NaN loss and a massive spike must leave the baselines untouched
+    health, _ = sn.probe_update(health, jnp.float32(np.nan),
+                                jnp.float32(2.0), False, cfg_)
+    health, _ = sn.probe_update(health, jnp.float32(1e9),
+                                jnp.float32(2.0), False, cfg_)
+    after = (float(health.loss_ema), float(health.gnorm_ema),
+             int(health.count))
+    assert before == after
+    assert int(health.anomalies) == 2
+    # normal traffic is still healthy afterwards
+    health, hard = sn.probe_update(health, jnp.float32(1.01),
+                                   jnp.float32(2.02), False, cfg_)
+    assert int(health.flags) == 0 and not bool(hard)
+
+
+def test_probe_no_false_positives_on_noise():
+    cfg_ = _probe_cfg(warmup_steps=2)
+    health = sn.init_health_state()
+    rng = np.random.default_rng(0)
+    for _ in range(200):   # +-10% jitter around the mean must never flag
+        loss = 1.0 + 0.1 * rng.standard_normal()
+        gn = 2.0 + 0.2 * rng.standard_normal()
+        health, _ = sn.probe_update(health, jnp.float32(loss),
+                                    jnp.float32(gn), False, cfg_)
+    assert int(health.anomalies) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine integration: detection + policy actions
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault_injection
+def test_nan_grads_quarantined_under_skip_batch(devices):
+    engine = make_engine(cfg(
+        training_health=th(
+            policy="skip_batch",
+            fault_injection={"faults": [{"kind": "nan_grads", "step": 3}]}),
+    ), training_data=random_dataset(64, HIDDEN))
+    it = iter(engine.training_dataloader)
+    for _ in range(3):
+        engine.train_batch(data_iter=it)
+    assert engine.global_steps == 3
+    before = params_np(engine)
+    engine.train_batch(data_iter=it)     # the faulted step
+    assert trees_equal(before, params_np(engine))   # update quarantined
+    assert engine.global_steps == 3                 # step did not count
+    assert engine.sentinel.anomalies == 1
+    assert engine.sentinel.quarantined == 1
+    assert int(np.asarray(engine.state.health.quarantined)) == 1
+    # provenance: PR 3's dataloader epoch/offset rode into the record
+    [record] = engine.sentinel.quarantined_windows
+    assert record["epoch"] == 0 and record["offset"] == 4
+    assert record["kinds"] == ["nonfinite_grad"]
+    # training continues and recovers on the next (clean) batch
+    engine.train_batch(data_iter=it)
+    assert engine.global_steps == 4
+    assert engine.sentinel.consecutive == 0
+
+
+@pytest.mark.fault_injection
+def test_warn_policy_detects_without_skipping(devices):
+    engine = make_engine(cfg(
+        training_health=th(
+            fault_injection={"faults": [{"kind": "nan_grads", "step": 1}]}),
+    ))
+    batches = list(random_batches(3, BATCH, HIDDEN, seed=3))
+    engine.train_batch(batch=stack1(batches[0]))
+    engine.train_batch(batch=stack1(batches[1]))   # faulted: detect only
+    assert engine.sentinel.anomalies == 1
+    assert engine.sentinel.quarantined == 0
+    # warn never blocks the update: the NaN reached the params (that is
+    # the point of escalating past "warn")
+    assert engine.global_steps == 2
+    assert not np.isfinite(
+        jax.tree_util.tree_leaves(params_np(engine))[0]).all()
+
+
+@pytest.mark.fault_injection
+def test_loss_spike_detected_after_warmup(devices):
+    engine = make_engine(cfg(
+        training_health=th(
+            policy="skip_batch", warmup_steps=3, loss_zscore=6.0,
+            fault_injection={"faults": [
+                {"kind": "loss_spike", "step": 6, "factor": 1e4}]}),
+    ))
+    batches = list(random_batches(8, BATCH, HIDDEN, seed=3))
+    losses = [float(engine.train_batch(batch=stack1(b))) for b in batches]
+    assert losses[6] > 100 * max(losses[:6])    # the spike was reported
+    assert engine.sentinel.anomalies == 1
+    assert engine.sentinel.last_flags == 0      # recovered afterwards
+    [record] = engine.sentinel.quarantined_windows
+    assert record["kinds"] == ["loss_spike"]
+
+
+@pytest.mark.fault_injection
+def test_rollback_recovery_bit_identical(tmp_path, devices):
+    """Acceptance criterion: injected NaN-grad at step N under policy
+    `rollback` restores the last committed checkpoint, the dataloader
+    continues past the bad window, and the post-recovery trajectory is
+    bit-identical (params AND optimizer moments) to a run that never saw
+    the fault. The clean run arms a never-firing fault so both engines
+    execute the same compiled program (different XLA fusion orders differ
+    by ulps)."""
+    batches = list(random_batches(8, BATCH, HIDDEN, seed=3))
+
+    def build(fault_step):
+        return make_engine(cfg(
+            checkpoint={"save_dir": str(tmp_path)},
+            training_health=th(
+                policy="rollback", rollback_after=1,
+                fault_injection={"faults": [
+                    {"kind": "nan_grads", "step": fault_step}]}),
+        ))
+
+    faulted = build(5)
+    for b in batches[:5]:
+        faulted.train_batch(batch=stack1(b))
+    faulted.save_checkpoint(str(tmp_path))
+    for b in batches[5:]:        # batch 5 faults -> rollback -> 6, 7
+        faulted.train_batch(batch=stack1(b))
+    assert faulted.sentinel.rollbacks == 1
+    assert faulted.global_steps == 7
+
+    clean = build(10_000)        # same program; the fault never fires
+    for b in batches[:5] + batches[6:]:   # never sees the bad window
+        clean.train_batch(batch=stack1(b))
+    assert clean.global_steps == 7
+
+    assert trees_equal(params_np(faulted), params_np(clean))
+    assert trees_equal(
+        jax.tree_util.tree_map(np.asarray, faulted.state.opt_state),
+        jax.tree_util.tree_map(np.asarray, clean.state.opt_state))
+    # loss-scale bookkeeping identical too (fp32 run: static scale)
+    assert int(faulted.state.scale.cur_iter) == \
+        int(clean.state.scale.cur_iter)
+
+
+@pytest.mark.fault_injection
+def test_rollback_budget_exhaustion_aborts(tmp_path, devices):
+    engine = make_engine(cfg(
+        checkpoint={"save_dir": str(tmp_path)},
+        training_health=th(
+            policy="rollback", rollback_after=1, max_rollbacks=1,
+            fault_injection={"faults": [
+                {"kind": "nan_grads", "step": 2, "times": 4}]}),
+    ))
+    batches = list(random_batches(8, BATCH, HIDDEN, seed=3))
+    for b in batches[:2]:
+        engine.train_batch(batch=stack1(b))
+    engine.save_checkpoint(str(tmp_path))
+    engine.train_batch(batch=stack1(batches[2]))   # rollback 1/1
+    assert engine.sentinel.rollbacks == 1
+    with pytest.raises(sn.TrainingHealthError, match="budget"):
+        engine.train_batch(batch=stack1(batches[3]))
+
+
+@pytest.mark.fault_injection
+def test_abort_after_consecutive_anomalies(devices):
+    engine = make_engine(cfg(
+        training_health=th(
+            policy="abort", abort_after=2,
+            fault_injection={"faults": [
+                {"kind": "nan_grads", "step": 2, "times": 3}]}),
+    ))
+    batches = list(random_batches(6, BATCH, HIDDEN, seed=3))
+    engine.train_batch(batch=stack1(batches[0]))
+    engine.train_batch(batch=stack1(batches[1]))
+    engine.train_batch(batch=stack1(batches[2]))   # anomaly 1: quarantined
+    with pytest.raises(sn.TrainingHealthError, match="abort_after=2"):
+        engine.train_batch(batch=stack1(batches[3]))
+
+
+@pytest.mark.fault_injection
+def test_watchdog_dumps_stacks_on_stalled_step(devices):
+    engine = make_engine(cfg(
+        training_health=th(
+            hang_timeout_seconds=0.25,
+            fault_injection={"faults": [
+                {"kind": "stall", "step": 2, "seconds": 0.7}]}),
+    ))
+    batches = list(random_batches(4, BATCH, HIDDEN, seed=3))
+    for b in batches:
+        engine.train_batch(batch=stack1(b))
+    # fired exactly once (first-call compile is exempt; the armed stall
+    # tripped the deadline) and captured every thread's stack
+    assert engine.sentinel.watchdog_fires == 1
+    assert "train_batch" in engine.sentinel.last_stack_dump
+    assert "MainThread" in engine.sentinel.last_stack_dump
+    # no preemption requested: save_on_preemption is unconfigured
+    assert not engine.checkpoint_manager.preemption_requested
+
+
+@pytest.mark.fault_injection
+def test_watchdog_requests_preemption_save(tmp_path, devices):
+    engine = make_engine(cfg(
+        checkpoint={"save_dir": str(tmp_path),
+                    "save_on_preemption": True},
+        training_health=th(
+            hang_timeout_seconds=0.25,
+            fault_injection={"faults": [
+                {"kind": "stall", "step": 1, "seconds": 0.7}]}),
+    ))
+    batches = list(random_batches(3, BATCH, HIDDEN, seed=3))
+    engine.train_batch(batch=stack1(batches[0]))
+    # the stalled step trips the watchdog, which requests the existing
+    # preemption-style emergency save; the next step boundary honors it
+    with pytest.raises(SystemExit):
+        engine.train_batch(batch=stack1(batches[1]))
+    assert engine.sentinel.watchdog_fires == 1
+    from deeperspeed_tpu.checkpoint import manifest as mf
+    assert mf.read_latest(str(tmp_path)) is not None
+    engine.checkpoint_manager.restore_signal_handlers()
+
+
+def test_injector_off_means_same_program(devices):
+    """Zero overhead when off: no injector object, no fault-variant
+    compile key, no health state in the engine pytree."""
+    engine = make_engine(cfg())
+    engine.train_batch(
+        batch=stack1(next(random_batches(1, BATCH, HIDDEN, seed=3))))
+    assert engine._fault_injector is None
+    assert list(engine._compiled_train) == [1]   # plain gas key
+    assert engine.state.health is None
+
+
+def test_sentinel_in_step_summary(devices):
+    import logging
+
+    from deeperspeed_tpu.utils.logging import logger as ds_logger
+
+    records = []
+
+    class Collect(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Collect()
+    ds_logger.addHandler(handler)   # ds logger does not propagate to root
+    try:
+        engine = make_engine(cfg(steps_per_print=2,
+                                 training_health=th()))
+        for b in random_batches(2, BATCH, HIDDEN, seed=3):
+            engine.train_batch(batch=stack1(b))
+    finally:
+        ds_logger.removeHandler(handler)
+    summary = [m for m in records if "anomalies=" in m]
+    assert summary and "quarantined=0" in summary[0] \
+        and "rollbacks=0" in summary[0] and "skipped=0" in summary[0]
+
+
+@pytest.mark.fault_injection
+def test_fp16_scale_search_overflow_is_not_an_anomaly(devices):
+    """A dynamic loss scaler with room to halve owns overflow recovery:
+    routine fp16 overflows (the startup scale search) must not escalate
+    the sentinel — only floor-pinned overflows are anomalies."""
+    fp16 = {"enabled": True, "initial_scale_power": 8, "min_loss_scale": 1}
+    engine = make_engine(cfg(
+        fp16=fp16,
+        training_health=th(
+            policy="abort", abort_after=1,
+            fault_injection={"faults": [
+                {"kind": "nan_grads", "step": 2}]}),
+    ))
+    batches = list(random_batches(5, BATCH, HIDDEN, seed=3))
+    for b in batches:   # overflow at step 2: scaler halves, NO abort
+        engine.train_batch(batch=stack1(b))
+    assert engine.skipped_steps == 1
+    assert engine.sentinel.anomalies == 0
+    # the scaler owned the event (hysteresis may absorb the first hit
+    # before halving); the scale never collapsed to the floor
+    assert float(engine.state.scale.cur_scale) > 1.0
+
+    # pinned at the floor the same overflow IS an anomaly -> abort
+    engine = make_engine(cfg(
+        fp16={"enabled": True, "initial_scale_power": 0,
+              "min_loss_scale": 1},
+        training_health=th(
+            policy="abort", abort_after=1,
+            fault_injection={"faults": [
+                {"kind": "nan_grads", "step": 2}]}),
+    ))
+    for b in batches[:2]:
+        engine.train_batch(batch=stack1(b))
+    with pytest.raises(sn.TrainingHealthError):
+        engine.train_batch(batch=stack1(batches[2]))
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault_injection
+def test_scale_floor_patience_raises(devices):
+    engine = make_engine(cfg(
+        fp16={"enabled": True, "initial_scale_power": 0,
+              "min_loss_scale": 1, "min_scale_patience": 3},
+        training_health={"fault_injection": {"faults": [
+            {"kind": "nan_grads", "step": 1, "times": 8}]}},
+    ))
+    batches = list(random_batches(8, BATCH, HIDDEN, seed=3))
+    engine.train_batch(batch=stack1(batches[0]))
+    with pytest.raises(LossScaleFloorError, match="min_scale_patience=3"):
+        for b in batches[1:]:
+            engine.train_batch(batch=stack1(b))
+    assert engine.skipped_steps == 3
+
+
+def test_scale_floor_watch_unit():
+    watch = ScaleFloorWatch(min_scale=1.0, patience=2)
+    assert not watch.on_skip(1024.0)      # above floor: no alarm
+    assert watch.on_skip(1.0)             # at floor: counted + warned
+    watch.on_step_taken()                 # a taken step resets the run
+    assert watch.consecutive == 0
+    watch.on_skip(1.0)
+    with pytest.raises(LossScaleFloorError):
+        watch.on_skip(1.0)
+    # patience=0 is warn-only forever
+    lax = ScaleFloorWatch(min_scale=1.0, patience=0)
+    for _ in range(50):
+        lax.on_skip(1.0)
+
+
+def test_gns_skips_nonfinite_micro_batch():
+    gns = GradientNoiseScale(batch_size_small=4, n_batches=2)
+    good = {"w": jnp.ones((8,), jnp.float32)}
+    bad = {"w": jnp.asarray([1.0, np.nan] + [1.0] * 6, jnp.float32)}
+    gns.update(good)
+    gns.update(bad)                      # ignored, not poisoning the EMA
+    assert gns.skipped_nonfinite == 1
+    assert gns.n_updates == 1
+    gns.update(good)                     # completes the pair
+    assert gns.noise_scale is None or np.isfinite(gns.scale)
+    assert np.isfinite(gns.ema_scale)
+    sd = gns.state_dict()
+    assert sd["skipped_nonfinite"] == 1
+    gns2 = GradientNoiseScale(batch_size_small=4, n_batches=2)
+    gns2.load_state_dict(sd)
+    assert gns2.skipped_nonfinite == 1
+
+
+def test_init_distributed_timeout_recorded():
+    from deeperspeed_tpu.utils import distributed as dist
+    # single-process: initialize is a no-op but the deadline is recorded
+    dist.init_distributed(timeout=7)
+    assert dist.get_collective_timeout() == 7.0
+    dist.barrier("test_barrier")          # single-process no-op
+    dist._collective_timeout = None       # leave global state clean
+
+
+@pytest.mark.fault_injection
+def test_ckpt_roundtrip_scale_state_and_skipped_steps_bitexact(
+        tmp_path, devices):
+    """Satellite acceptance: save/resume round-trips LossScaleState and
+    skipped_steps bit-exactly (including after real overflow skips)."""
+    engine = make_engine(cfg(
+        fp16={"enabled": True, "initial_scale_power": 4, "hysteresis": 2},
+        training_health={"fault_injection": {"faults": [
+            {"kind": "nan_grads", "step": 2, "times": 2}]}},
+    ))
+    batches = list(random_batches(6, BATCH, HIDDEN, seed=3))
+    for b in batches:
+        engine.train_batch(batch=stack1(b))
+    assert engine.skipped_steps == 2      # both injected overflows skipped
+    engine.save_checkpoint(str(tmp_path))
+
+    resumed = make_engine(cfg(
+        fp16={"enabled": True, "initial_scale_power": 4, "hysteresis": 2}),
+        seed=9)
+    resumed.load_checkpoint(str(tmp_path))
+    for field in ("cur_scale", "cur_iter", "last_overflow_iter",
+                  "cur_hysteresis"):
+        assert np.asarray(getattr(resumed.state.scale, field)) == \
+            np.asarray(getattr(engine.state.scale, field)), field
+    assert resumed.skipped_steps == engine.skipped_steps == 2
+    assert int(resumed.state.skipped_steps) == 2
+    assert resumed.global_steps == engine.global_steps
+
+
+@pytest.mark.fault_injection
+def test_resumed_run_after_rollback_matches_clean_trajectory(
+        tmp_path, devices):
+    """Satellite acceptance: a run resumed from disk AFTER a sentinel
+    rollback continues on the same trajectory as the in-process recovered
+    run, step for step."""
+    batches = list(random_batches(8, BATCH, HIDDEN, seed=3))
+
+    def build(fault_step):
+        return make_engine(cfg(
+            checkpoint={"save_dir": str(tmp_path)},
+            training_health=th(
+                policy="rollback", rollback_after=1,
+                fault_injection={"faults": [
+                    {"kind": "nan_grads", "step": fault_step}]}),
+        ))
+
+    engine = build(4)
+    for b in batches[:4]:
+        engine.train_batch(batch=stack1(b))
+    engine.save_checkpoint(str(tmp_path))
+    engine.train_batch(batch=stack1(batches[4]))   # fault -> rollback
+    assert engine.sentinel.rollbacks == 1
+    engine.train_batch(batch=stack1(batches[5]))
+    engine.save_checkpoint(str(tmp_path), tag="after_recovery")
+
+    # fresh process-equivalent: resume the recovered checkpoint and run
+    # the next batch; the in-process engine must match it bit for bit
+    resumed = build(10_000)
+    resumed.load_checkpoint(str(tmp_path), tag="after_recovery")
+    resumed.train_batch(batch=stack1(batches[6]))
+    engine.train_batch(batch=stack1(batches[6]))
+    assert trees_equal(params_np(engine), params_np(resumed))
+    assert engine.global_steps == resumed.global_steps
