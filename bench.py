@@ -22,7 +22,8 @@ KEYS (default all):
              + CPU-offload optimizer tier; reference
              tests/model/Megatron_GPT2)
   - longseq  (longseq_16k: 16k-token causal flash row)
-  - moe      (moe_top2: GShard top-2 MoE row, grouped dispatch)
+  - moe      (moe_top2: GShard top-2 MoE row; walks the einsum and
+             sort dispatch engines — DS_BENCH_MOE_DISPATCH narrows)
   - ckpt     (checkpoint-induced step stall, sync vs async
              snapshot-then-commit save; opt-in via DS_BENCH_CKPT=1 —
              disk-heavy)
@@ -43,7 +44,7 @@ import numpy as np
 
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800, "ckpt": 600,
-               "sentinel": 600}
+               "sentinel": 600, "moe": 800}  # moe walks both engines
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -437,17 +438,28 @@ def row_longseq():
 
 
 def row_moe():
+    """GShard top-2 MoE row, walked over both dispatch engines (einsum =
+    reference one-hot, sort = argsort + Pallas grouped matmul). Headline
+    `moe_top2_*` keys mirror the sort engine when it ran (the fast
+    path), einsum otherwise; `extra` records dispatch, capacity factor
+    and the configured a2a overlap depth. DS_BENCH_MOE_DISPATCH picks
+    one engine ("einsum"/"sort", default both)."""
     jax = _setup_jax()
     n_chips = len(jax.devices())
     peak = peak_flops_per_chip(jax.devices()[0])
     from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
 
-    def run(bs_per_chip):
+    cap_factor = float(os.environ.get("DS_BENCH_MOE_CF", "1.25"))
+    a2a_chunks = int(os.environ.get("DS_BENCH_MOE_A2A_CHUNKS", "1"))
+
+    def run(bs_per_chip, dispatch):
         def thunk():
             mcfg = GPTNeoXConfig(vocab_size=50304, hidden_size=768,
                                  num_layers=12, num_heads=12,
                                  max_seq_len=1024, moe_num_experts=8,
-                                 moe_top_k=2)
+                                 moe_top_k=2, moe_dispatch=dispatch,
+                                 moe_capacity_factor=cap_factor,
+                                 moe_a2a_overlap_chunks=a2a_chunks)
             mmodel = GPTNeoX(mcfg, use_pallas=True)
             mparams = mmodel.init_params(jax.random.PRNGKey(7))
             mbs = bs_per_chip * n_chips
@@ -463,14 +475,27 @@ def row_moe():
             trunk = L * 4 * H * H + mcfg.vocab_size * H
             expert = L * mcfg.moe_top_k * 8 * H * H
             mftok = 6 * (trunk + expert) + 12 * L * H * 1024
-            return {"moe_top2_tokens_per_sec_chip": round(tps, 1),
-                    "moe_top2_active_mfu": round(tps * mftok / peak, 4),
-                    "moe_top2_batch_per_chip": bs_per_chip}
+            p = f"moe_top2_{dispatch}"
+            return {f"{p}_tokens_per_sec_chip": round(tps, 1),
+                    f"{p}_active_mfu": round(tps * mftok / peak, 4),
+                    f"{p}_batch_per_chip": bs_per_chip}
         return thunk
 
+    sel = os.environ.get("DS_BENCH_MOE_DISPATCH", "both")
+    modes = ("einsum", "sort") if sel in ("both", "", "all") else (sel,)
     bs0 = int(os.environ.get("DS_BENCH_MOE_BS", "8"))
-    return _ladder([(f"bs{bs0}", run(bs0)), ("bs4", run(4))], {},
-                   "moe_top2")
+    out = {"moe_top2_capacity_factor": cap_factor,
+           "moe_top2_a2a_overlap_chunks": a2a_chunks}
+    for d in modes:
+        out = _ladder([(f"{d}_bs{bs0}", run(bs0, d)),
+                       (f"{d}_bs4", run(4, d))], out, f"moe_top2_{d}")
+    head = next((d for d in ("sort", "einsum")
+                 if f"moe_top2_{d}_active_mfu" in out), None)
+    if head is not None:
+        out["moe_top2_dispatch"] = head
+        for k in ("tokens_per_sec_chip", "active_mfu", "batch_per_chip"):
+            out[f"moe_top2_{k}"] = out[f"moe_top2_{head}_{k}"]
+    return out
 
 
 def row_ckpt():

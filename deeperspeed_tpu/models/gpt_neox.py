@@ -62,6 +62,13 @@ class GPTNeoXConfig:
     # becomes per-group, changing token-drop patterns and aux loss).
     # Matches MoELayer's groups=1 default so the two entry points agree.
     moe_num_groups: int = 1
+    # dispatch engine: "einsum" (reference GShard one-hot) or "sort"
+    # (argsort permutation + Pallas grouped matmul — the fast path)
+    moe_dispatch: str = "einsum"
+    # expert-parallel all_to_all/compute pipeline depth (sort engine)
+    moe_a2a_overlap_chunks: int = 1
+    # renormalize top-2 combine weights over capacity-surviving choices
+    moe_renorm_kept_choices: bool = False
 
     @property
     def head_dim(self):
@@ -342,7 +349,10 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
             capacity_factor=cfg.moe_capacity_factor,
             top_k=cfg.moe_top_k, rng=rng,
             jitter_eps=cfg.moe_jitter_eps,
-            groups=getattr(cfg, "moe_num_groups", 1))
+            groups=getattr(cfg, "moe_num_groups", 1),
+            dispatch=getattr(cfg, "moe_dispatch", "einsum"),
+            renorm_kept_choices=getattr(cfg, "moe_renorm_kept_choices",
+                                        False))
         moe_out = y.reshape(ln2.shape)
         if cfg.use_parallel_residual:
             return x + reduce_fn(attn_partial) + out_b + moe_out, aux
@@ -782,7 +792,22 @@ class GPTNeoX:
                 moe_capacity_factor=moe["capacity_factor"],
                 moe_jitter_eps=moe["jitter_eps"],
                 moe_aux_loss_coef=moe["aux_loss_coef"],
-                moe_num_groups=moe.get("num_groups", 1))
+                moe_num_groups=moe.get("num_groups", 1),
+                moe_dispatch=moe.get("dispatch", "einsum"),
+                moe_a2a_overlap_chunks=moe.get("a2a_overlap_chunks", 1),
+                moe_renorm_kept_choices=moe.get("renorm_kept_choices",
+                                                False))
+            if self.config.moe_a2a_overlap_chunks > 1:
+                # the GSPMD model path lets XLA insert the expert
+                # exchange — explicit a2a chunking only exists on the
+                # shard_map expert-parallel path (moe.MoELayer); don't
+                # let the knob look like it shaped this model's schedule
+                from ..utils.logging import logger
+                logger.warning(
+                    "moe.a2a_overlap_chunks > 1 has no effect on the "
+                    "GSPMD GPT-NeoX MoE path (XLA schedules the expert "
+                    "exchange); it applies to the explicit shard_map "
+                    "expert-parallel layer (deeperspeed_tpu.moe.MoELayer)")
         sp = getattr(ds_config, "sequence_parallel_params", None)
         if sp:
             from ..parallel.sequence import SequenceParallel

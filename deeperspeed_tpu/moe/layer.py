@@ -9,29 +9,56 @@ design is the GShard/Switch pattern, TPU-first:
   Overflow tokens combine to an exact-zero output — the surrounding
   transformer block's residual connection is what carries them through
   unchanged (standard Switch/GShard usage; this layer does NOT add the
-  residual itself). Static shapes — the dispatch is a dense [T, E, C]
-  one-hot combine/dispatch pair, exactly the formulation GShard lowers
-  to XLA.
+  residual itself). Static shapes throughout.
+- **two dispatch engines** (`dispatch=`):
+
+  * ``"einsum"`` (default — the reference numerics): the dense GShard
+    [T, E, C] one-hot dispatch/combine einsum pair, exactly the
+    formulation GShard lowers to XLA. Pure MXU work, no scatter — but at
+    top-2/cf=1.25 most of those flops multiply zeros.
+  * ``"sort"``: argsort the routed token copies by expert (stable sort
+    == GShard queue order: all first choices in token order, then all
+    second choices), compact them into per-expert contiguous spans with
+    capacity enforced by position-in-expert, run the expert FFN as ONE
+    Pallas grouped matmul over the packed buffer
+    (`ops/pallas/grouped_matmul.py` — ragged per-expert sizes, masked
+    tails, XLA-fallback off-TPU), and combine by gathering each token's
+    surviving rows with a weighted add. Same routing decisions, same
+    capacity semantics, numerical parity with the einsum engine — at a
+    fraction of the matmul flops.
+
 - **token groups** (`groups`): GShard's G dimension. Tokens split into
   `g` independent routing groups with per-group capacity C/g, shrinking
-  the dispatch/combine tensors from O(T·E·C) to O(T·E·C/g) — the
-  ungrouped form OOMs a 16 GB chip at T=8k/H=768, the grouped form is
-  O(group_size) and stays pure einsum (MXU work, no scatter). `groups=1`
+  the dispatch/combine tensors from O(T·E·C) to O(T·E·C/g). `groups=1`
   is the exact ungrouped oracle; `groups=0` ("auto") picks the divisor
   of T whose group size is NEAREST `_AUTO_GROUP_TOKENS` (1024) and at
-  least 128 — the size may exceed 1024 when T has no nearby divisor
-  (e.g. T=2500 groups at 1250), trading a looser memory bound for
-  routing-statistics quality over tiny groups.
+  least 128. The sort engine folds groups into E·g "virtual experts"
+  (expert-major) so grouping costs nothing extra there.
 - **expert parallelism**: experts shard over an ``expert`` mesh axis
   inside `shard_map`; token shards are exchanged with `all_to_all`
-  (dispatch) and returned (combine), both riding ICI.
+  (dispatch) and returned (combine), both riding ICI. With the sort
+  engine, `a2a_overlap_chunks > 1` splits the exchange along the local-
+  expert axis and software-pipelines `all_to_all(chunk i+1)` against
+  expert-FFN(chunk i), hiding ICI time under MXU time (decorrelated
+  jitter and the pmean'd aux loss are unchanged).
 - Gate math in fp32; an auxiliary load-balancing loss (mean_prob ×
   mean_assignment per expert, scaled by E) is returned for the trainer.
+- **top-2 combine weights**: `renorm_kept_choices=False` (default) keeps
+  the GShard paper normalization — over the pair *before* capacity —
+  which silently leaks the probability mass of an overflowed second
+  choice. `True` renormalizes over the choices that actually survived
+  capacity, so a token whose second choice overflowed carries full
+  weight on its first. Off by default: the legacy einsum path stays
+  bit-identical.
 
 `moe_ffn_dense` is the single-device reference semantics;
 `moe_ffn_expert_parallel` runs inside `shard_map` and matches it
-exactly (tested on the 8-device mesh).
+exactly (tested on the 8-device mesh), for either engine.
 """
+
+import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -41,13 +68,17 @@ import jax.numpy as jnp
 # while each group is still large enough for balanced routing statistics.
 _AUTO_GROUP_TOKENS = 1024
 
+DISPATCH_MODES = ("einsum", "sort")
 
+
+@functools.lru_cache(maxsize=None)
 def _resolve_groups(groups, tokens):
     """0/'auto' → the divisor of `tokens` whose group size is nearest
     `_AUTO_GROUP_TOKENS` (never below 128: a token count with only tiny
     divisors near the target — e.g. 2·1031 — would otherwise shrink
     capacity to ~1 and silently drop routed tokens); otherwise validate
-    the explicit count."""
+    the explicit count. Memoized per (groups, tokens): the O(√T) divisor
+    search used to run on every trace."""
     if groups in (0, None, "auto"):
         best_g, best_cost = 1, abs(tokens - _AUTO_GROUP_TOKENS)
         d = 1
@@ -89,7 +120,7 @@ def _choice_dispatch(onehot, capacity, base_counts=None):
 
 
 def _one_hot_dispatch(gate_logits, capacity, top_k=1, rng=None,
-                      jitter_eps=0.0):
+                      jitter_eps=0.0, renorm_kept_choices=False):
     """Top-k capacity routing (GShard: k=2 is the paper default; k=1 is
     Switch).
 
@@ -97,6 +128,9 @@ def _one_hot_dispatch(gate_logits, capacity, top_k=1, rng=None,
     combine [T, E, C] float = normalized gate prob on the kept slot,
     aux_loss). With `rng` and `jitter_eps`, logits get GShard's
     multiplicative uniform jitter (training-time exploration).
+    `renorm_kept_choices` normalizes the top-2 pair over the choices
+    that SURVIVED capacity instead of the pre-capacity pair (see module
+    docstring); False keeps the legacy math bit-identical.
     """
     T, E = gate_logits.shape
     if rng is not None and jitter_eps > 0.0:
@@ -125,11 +159,20 @@ def _one_hot_dispatch(gate_logits, capacity, top_k=1, rng=None,
     expert2 = jnp.argmax(probs2, axis=-1)
     onehot2 = jax.nn.one_hot(expert2, E, dtype=jnp.float32)
     g2 = jnp.take_along_axis(probs, expert2[:, None], axis=-1)[:, 0]
-    # normalize the pair (GShard combine weights)
-    denom = g1 + g2 + 1e-9
-    g1n, g2n = g1 / denom, g2 / denom
     dispatch2, _ = _choice_dispatch(onehot2, capacity,
                                     base_counts=counts1)
+    if renorm_kept_choices:
+        # normalize over the kept pair: an overflowed second choice's
+        # mass moves to the surviving first choice instead of leaking
+        kept1 = jnp.sum(dispatch1, axis=(1, 2))             # 1.0 or 0.0
+        kept2 = jnp.sum(dispatch2, axis=(1, 2))
+        w1, w2 = g1 * kept1, g2 * kept2
+        denom = w1 + w2 + 1e-9
+        g1n, g2n = w1 / denom, w2 / denom
+    else:
+        # normalize the pair (GShard combine weights)
+        denom = g1 + g2 + 1e-9
+        g1n, g2n = g1 / denom, g2 / denom
     dispatch = dispatch1 + dispatch2
     combine = dispatch1 * g1n[:, None, None] + \
         dispatch2 * g2n[:, None, None]
@@ -142,7 +185,8 @@ def _expert_ffn(w_in, b_in, w_out, b_out, x):
     return h @ w_out.astype(x.dtype) + b_out.astype(x.dtype)
 
 
-def _route_groups(gate, xg, capacity, top_k, rng, jitter_eps):
+def _route_groups(gate, xg, capacity, top_k, rng, jitter_eps,
+                  renorm_kept_choices=False):
     """Route each group independently: xg [g, Tg, H] →
     (dispatch [g, Tg, E, C], combine [g, Tg, E, C], aux mean-over-groups).
     Dispatch/combine are cast to the compute dtype — dispatch is exactly
@@ -150,50 +194,268 @@ def _route_groups(gate, xg, capacity, top_k, rng, jitter_eps):
     logits = (xg @ gate.astype(xg.dtype)).astype(jnp.float32)
     if rng is not None and jitter_eps > 0.0:
         route = jax.vmap(lambda lg, r: _one_hot_dispatch(
-            lg, capacity, top_k=top_k, rng=r, jitter_eps=jitter_eps))
+            lg, capacity, top_k=top_k, rng=r, jitter_eps=jitter_eps,
+            renorm_kept_choices=renorm_kept_choices))
         dispatch, combine, aux = route(logits,
                                        jax.random.split(rng, xg.shape[0]))
     else:
         route = jax.vmap(lambda lg: _one_hot_dispatch(
-            lg, capacity, top_k=top_k))
+            lg, capacity, top_k=top_k,
+            renorm_kept_choices=renorm_kept_choices))
         dispatch, combine, aux = route(logits)
     return (dispatch.astype(xg.dtype), combine.astype(xg.dtype),
             jnp.mean(aux))
 
 
+# ---------------------------------------------------------------------------
+# sort-based dispatch engine
+# ---------------------------------------------------------------------------
+
+class _SortRoute:
+    """Routing plan over V = E·g virtual experts (expert-major:
+    v = expert·g + group). Copy-major arrays are [k·T]: copy c < T is
+    token c's first choice, copy c ≥ T its second."""
+
+    def __init__(self, experts_v, pos, weights, counts, starts, order,
+                 aux):
+        self.experts_v = experts_v   # [kT] virtual expert per copy
+        self.pos = pos               # [kT] position-in-expert per copy
+        self.weights = weights       # tuple of [T] combine weights
+        self.counts = counts         # [V] routed copies per virtual expert
+        self.starts = starts         # [V] exclusive prefix of counts
+        self.order = order           # [kT] stable sort permutation
+        self.aux = aux
+
+
+def _jittered_probs(gate, xg, rng, jitter_eps):
+    """Gate probabilities [g, Tg, E] with the SAME per-group jitter
+    construction as the einsum engine (vmapped per-group key split) —
+    the two dispatch engines must draw identical noise so they route
+    identically."""
+    logits = (xg @ gate.astype(xg.dtype)).astype(jnp.float32)
+    if rng is not None and jitter_eps > 0.0:
+        keys = jax.random.split(rng, xg.shape[0])
+        noise = jax.vmap(lambda r: jax.random.uniform(
+            r, logits.shape[1:], minval=1.0 - jitter_eps,
+            maxval=1.0 + jitter_eps))(keys)
+        logits = logits * noise
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def _sort_route(probs, capacity, top_k, renorm_kept_choices):
+    """probs [g, Tg, E] fp32 → _SortRoute.
+
+    The stable argsort over (virtual-)expert ids reproduces the GShard
+    queue exactly: copies are enumerated choice-major (all first choices
+    in token order, then all second choices), so within each expert the
+    sorted order is first-choices-then-second-choices — identical
+    position-in-expert bookkeeping to `_choice_dispatch`'s cumsum +
+    base_counts offset, without the [T, E, C] one-hot tensors."""
+    g, tg, E = probs.shape
+    T = g * tg
+    p2 = probs.reshape(T, E)
+    gi = (jnp.arange(T, dtype=jnp.int32) // tg)
+
+    expert1 = jnp.argmax(p2, axis=-1)
+    onehot1 = jax.nn.one_hot(expert1, E, dtype=jnp.float32)
+    g1 = jnp.take_along_axis(p2, expert1[:, None], axis=-1)[:, 0]
+    # GShard aux loss, per group then averaged (matches _route_groups)
+    me = jnp.mean(probs, axis=1)                            # [g, E]
+    ce = jnp.mean(onehot1.reshape(g, tg, E), axis=1)        # [g, E]
+    aux = jnp.mean(E * jnp.sum(me * ce, axis=-1))
+
+    v1 = expert1.astype(jnp.int32) * g + gi
+    if top_k == 1:
+        experts_v = v1
+        gates = (g1,)
+    elif top_k == 2:
+        probs2 = p2 * (1.0 - onehot1)                       # mask top-1
+        expert2 = jnp.argmax(probs2, axis=-1)
+        g2 = jnp.take_along_axis(p2, expert2[:, None], axis=-1)[:, 0]
+        experts_v = jnp.concatenate([v1, expert2.astype(jnp.int32) * g + gi])
+        gates = (g1, g2)
+    else:
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+
+    kT = experts_v.shape[0]
+    V = E * g
+    order = jnp.argsort(experts_v)          # stable → GShard queue order
+    counts = jnp.zeros((V,), jnp.int32).at[experts_v].add(1)
+    starts = jnp.cumsum(counts) - counts    # exclusive prefix, no concat
+    pos_sorted = jnp.arange(kT, dtype=jnp.int32) - starts[experts_v[order]]
+    pos = jnp.zeros((kT,), jnp.int32).at[order].set(pos_sorted)
+    kept = pos < capacity
+
+    k1 = kept[:T].astype(jnp.float32)
+    if top_k == 1:
+        weights = (gates[0] * k1,)
+    else:
+        k2 = kept[T:].astype(jnp.float32)
+        g1, g2 = gates
+        if renorm_kept_choices:
+            w1, w2 = g1 * k1, g2 * k2
+            denom = w1 + w2 + 1e-9
+            weights = (w1 / denom, w2 / denom)
+        else:
+            denom = g1 + g2 + 1e-9
+            weights = (g1 / denom * k1, g2 / denom * k2)
+    return _SortRoute(experts_v, pos, weights, counts, starts, order, aux)
+
+
+def _pick_span(capacity, block_m=None):
+    from ..ops.pallas.grouped_matmul import pick_span
+    return pick_span(capacity, block_m)
+
+
+def _fill_buffer(x, route, capacity, span):
+    """Compact routed copies into the [V·span, H] expert-major buffer by
+    GATHER: buffer row (v, p) holds the p-th surviving copy of virtual
+    expert v (sorted order), zero when p ≥ min(count, capacity). Returns
+    (buffer, group_sizes [V])."""
+    T, H = x.shape
+    kT = route.order.shape[0]
+    V = route.counts.shape[0]
+    tok_sorted = route.order % T            # sorted copy → source token
+    p = jnp.arange(span, dtype=jnp.int32)
+    src = route.starts[:, None] + p[None, :]                # [V, span]
+    sizes = jnp.minimum(route.counts, capacity)
+    valid = p[None, :] < sizes[:, None]
+    tok = tok_sorted[jnp.clip(src, 0, kT - 1)]
+    buf = jnp.where(valid[..., None], x[tok], 0)            # [V, span, H]
+    return buf.reshape(V * span, H), sizes
+
+
+def _sort_ffn(params, buf, sizes, span, lut, n_w, rows_per_w, block_m,
+              block_n, backend):
+    """Expert FFN over the packed buffer as two grouped matmuls. Biases
+    ride a [n_w, rows, ·] reshape (no per-row gather). Masked tail rows
+    come out of the second matmul as exact zeros plus a bias term; the
+    combine never gathers them."""
+    from ..ops.pallas.grouped_matmul import grouped_matmul
+    dt = buf.dtype
+    w_in = params["w_in"].astype(dt)
+    b_in = params["b_in"].astype(dt)
+    w_out = params["w_out"].astype(dt)
+    b_out = params["b_out"].astype(dt)
+    inter = w_in.shape[-1]
+    h = grouped_matmul(buf, w_in, sizes, span, lut, block_m, block_n,
+                       backend)
+    h = jax.nn.gelu(h.reshape(n_w, rows_per_w, inter) + b_in[:, None, :])
+    out = grouped_matmul(h.reshape(-1, inter), w_out, sizes, span, lut,
+                         block_m, block_n, backend)
+    hidden = w_out.shape[-1]
+    out = out.reshape(n_w, rows_per_w, hidden) + b_out[:, None, :]
+    return out.reshape(-1, hidden)
+
+
+def _sort_combine(out_buf, route, span, T, dtype):
+    """y[t] = Σ_k weight_k[t] · out_buf[row of copy k] — the gather +
+    weighted-add replacement for the [T, E, C] combine einsum. Dropped
+    copies carry weight 0 (their clipped row gather is a no-op)."""
+    R = out_buf.shape[0]
+    rows = jnp.clip(route.experts_v * span + route.pos, 0, R - 1)
+    y = None
+    for c, wk in enumerate(route.weights):
+        term = wk.astype(dtype)[:, None] * out_buf[rows[c * T:(c + 1) * T]]
+        y = term if y is None else y + term
+    return y
+
+
+def _gmm_geometry(capacity, k_dim, n_dim, dtype, block_m, block_n,
+                  backend):
+    """Resolve (span, block_m, block_n) — autotuned on TPU when the
+    Pallas backend is in play, static defaults otherwise."""
+    if (block_m is None or block_n is None) and backend != "xla":
+        from ..ops.autotune import grouped_matmul_blocks
+        from ..ops.pallas.grouped_matmul import _interpret
+        if not _interpret():
+            bm, bn = grouped_matmul_blocks(capacity, k_dim, n_dim, dtype)
+            block_m = block_m or bm
+            block_n = block_n or bn
+    span, bm = _pick_span(capacity, block_m)
+    return span, bm, block_n
+
+
 def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
-                  jitter_eps=0.0, groups=1):
+                  jitter_eps=0.0, groups=1, dispatch="einsum",
+                  renorm_kept_choices=False, gmm_block_m=None,
+                  gmm_block_n=None, gmm_backend=None):
     """Reference semantics on one device. params: stacked expert weights
     {"w_in" [E, H, I], "b_in" [E, I], "w_out" [E, I, H], "b_out" [E, H],
     "gate" [H, E]}; x [T, H] → (y [T, H], aux_loss). `groups` splits the
     tokens into independent routing groups (GShard's G dim) — capacity
-    becomes per-group, dispatch memory drops by the group factor."""
+    becomes per-group, dispatch memory drops by the group factor.
+    `dispatch` picks the engine (module docstring); both route
+    identically."""
+    if dispatch not in DISPATCH_MODES:
+        raise ValueError(f"dispatch must be one of {DISPATCH_MODES}, "
+                         f"got {dispatch!r}")
     T, H = x.shape
     E = params["w_in"].shape[0]
     g = _resolve_groups(groups, T)
     tg = T // g
     capacity = max(1, int(capacity_factor * top_k * tg / E))
     xg = x.reshape(g, tg, H)
-    dispatch, combine, aux = _route_groups(params["gate"], xg, capacity,
-                                           top_k, rng, jitter_eps)
 
-    expert_in = jnp.einsum("gtec,gth->egch", dispatch, xg)   # [E, g, C, H]
-    expert_out = jax.vmap(_expert_ffn)(
-        params["w_in"], params["b_in"], params["w_out"], params["b_out"],
-        expert_in.reshape(E, g * capacity, H))              # [E, g*C, H]
-    y = jnp.einsum("gtec,egch->gth", combine,
-                   expert_out.reshape(E, g, capacity, H))
-    return y.reshape(T, H), aux
+    if dispatch == "einsum":
+        dispatch_t, combine, aux = _route_groups(
+            params["gate"], xg, capacity, top_k, rng, jitter_eps,
+            renorm_kept_choices=renorm_kept_choices)
+        expert_in = jnp.einsum("gtec,gth->egch", dispatch_t, xg)
+        expert_out = jax.vmap(_expert_ffn)(
+            params["w_in"], params["b_in"], params["w_out"],
+            params["b_out"],
+            expert_in.reshape(E, g * capacity, H))          # [E, g*C, H]
+        y = jnp.einsum("gtec,egch->gth", combine,
+                       expert_out.reshape(E, g, capacity, H))
+        return y.reshape(T, H), aux
+
+    probs = _jittered_probs(params["gate"], xg, rng, jitter_eps)
+    route = _sort_route(probs, capacity, top_k, renorm_kept_choices)
+    span, bm, bn = _gmm_geometry(capacity, H, params["w_in"].shape[-1],
+                                 x.dtype, gmm_block_m, gmm_block_n,
+                                 gmm_backend)
+    buf, sizes = _fill_buffer(x, route, capacity, span)
+    lut = tuple(np.repeat(np.arange(E), g))
+    out_buf = _sort_ffn(params, buf, sizes, span, lut, E, g * span,
+                        bm, bn, gmm_backend)
+    return _sort_combine(out_buf, route, span, T, x.dtype), route.aux
+
+
+def _a2a(t, axis_name):
+    return jax.lax.all_to_all(t, axis_name, 0, 0, tiled=False)
+
+
+def _overlap_chunks(requested, e_local):
+    """Largest divisor of the local expert count ≤ the requested chunk
+    count (1 = no pipelining)."""
+    n = max(1, min(int(requested), e_local))
+    while e_local % n:
+        n -= 1
+    return n
 
 
 def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25,
-                            top_k=1, rng=None, jitter_eps=0.0, groups=1):
+                            top_k=1, rng=None, jitter_eps=0.0, groups=1,
+                            dispatch="einsum", renorm_kept_choices=False,
+                            a2a_overlap_chunks=1, gmm_block_m=None,
+                            gmm_block_n=None, gmm_backend=None):
     """Inside shard_map: x is this rank's token shard [T_local, H];
     params carry this rank's experts ({"w_in" [E/ep, H, I], ...}) with
     the gate replicated. all_to_all exchanges expert-major token blocks
     so each rank runs only its own experts; a second all_to_all returns
     the outputs. Matches `moe_ffn_dense` run per-shard exactly (with the
-    same `groups`: capacity is per local routing group)."""
+    same `groups`: capacity is per local routing group).
+
+    With `dispatch="sort"` and `a2a_overlap_chunks > 1` the exchange is
+    chunked along the local-expert axis and software-pipelined: the
+    all_to_all for chunk i+1 is issued before the expert FFN of chunk i,
+    so XLA's scheduler can hide the ICI transfer under the grouped
+    matmul. Results are bit-identical to the unchunked exchange (pure
+    reordering of independent slices)."""
+    if dispatch not in DISPATCH_MODES:
+        raise ValueError(f"dispatch must be one of {DISPATCH_MODES}, "
+                         f"got {dispatch!r}")
     T, H = x.shape
     e_local = params["w_in"].shape[0]
     E = e_local * ep
@@ -205,31 +467,76 @@ def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25,
         # every rank's tokens identical noise (1/ep of the exploration)
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
     xg = x.reshape(g, tg, H)
-    dispatch, combine, aux = _route_groups(params["gate"], xg, capacity,
-                                           top_k, rng, jitter_eps)
 
-    # [g, Tg, E, C] → [E, g·C, H] expert-major buffers, then exchange:
-    # split E = ep × e_local; all_to_all gives [ep, e_local, g·C, H]
-    # where dim 0 is the source rank.
-    expert_in = jnp.einsum("gtec,gth->egch", dispatch, xg)
-    expert_in = expert_in.reshape(ep, e_local, g * capacity, H)
-    expert_in = jax.lax.all_to_all(expert_in, axis_name, 0, 0,
-                                   tiled=False)          # [ep, eL, g·C, H]
+    if dispatch == "einsum":
+        dispatch_t, combine, aux = _route_groups(
+            params["gate"], xg, capacity, top_k, rng, jitter_eps,
+            renorm_kept_choices=renorm_kept_choices)
 
-    flat_in = jnp.moveaxis(expert_in, 0, 1).reshape(
-        e_local, ep * g * capacity, H)
-    expert_out = jax.vmap(_expert_ffn)(
-        params["w_in"], params["b_in"], params["w_out"], params["b_out"],
-        flat_in)                                         # [eL, ep·g·C, H]
-    expert_out = jnp.moveaxis(
-        expert_out.reshape(e_local, ep, g * capacity, H), 1, 0)
+        # [g, Tg, E, C] → [E, g·C, H] expert-major buffers, then exchange:
+        # split E = ep × e_local; all_to_all gives [ep, e_local, g·C, H]
+        # where dim 0 is the source rank.
+        expert_in = jnp.einsum("gtec,gth->egch", dispatch_t, xg)
+        expert_in = expert_in.reshape(ep, e_local, g * capacity, H)
+        expert_in = _a2a(expert_in, axis_name)           # [ep, eL, g·C, H]
 
-    expert_out = jax.lax.all_to_all(expert_out, axis_name, 0, 0,
-                                    tiled=False)         # [ep, eL, g·C, H]
-    expert_out = expert_out.reshape(E, g, capacity, H)
-    y = jnp.einsum("gtec,egch->gth", combine, expert_out)
-    # aux is per-shard; average over the expert(-data) axis
-    return y.reshape(T, H), jax.lax.pmean(aux, axis_name)
+        flat_in = jnp.moveaxis(expert_in, 0, 1).reshape(
+            e_local, ep * g * capacity, H)
+        expert_out = jax.vmap(_expert_ffn)(
+            params["w_in"], params["b_in"], params["w_out"],
+            params["b_out"], flat_in)                    # [eL, ep·g·C, H]
+        expert_out = jnp.moveaxis(
+            expert_out.reshape(e_local, ep, g * capacity, H), 1, 0)
+
+        expert_out = _a2a(expert_out, axis_name)         # [ep, eL, g·C, H]
+        expert_out = expert_out.reshape(E, g, capacity, H)
+        y = jnp.einsum("gtec,egch->gth", combine, expert_out)
+        # aux is per-shard; average over the expert(-data) axis
+        return y.reshape(T, H), jax.lax.pmean(aux, axis_name)
+
+    # ---- sort engine -----------------------------------------------------
+    probs = _jittered_probs(params["gate"], xg, rng, jitter_eps)
+    route = _sort_route(probs, capacity, top_k, renorm_kept_choices)
+    span, bm, bn = _gmm_geometry(capacity, H, params["w_in"].shape[-1],
+                                 x.dtype, gmm_block_m, gmm_block_n,
+                                 gmm_backend)
+    buf, sizes = _fill_buffer(x, route, capacity, span)  # [E·g·span, H]
+
+    n_ch = _overlap_chunks(a2a_overlap_chunks, e_local)
+    e_chunk = e_local // n_ch
+    send = buf.reshape(ep, e_local, g * span, H)
+    sz_send = sizes.reshape(ep, e_local, g)
+
+    def ffn_chunk(ci, rbuf, rsz):
+        # rbuf [ep, e_chunk, g·span, H] (dim 0 = source rank); the
+        # span layout makes the received sizes the RAGGED group sizes
+        # the kernel was built for — ep·g spans per local expert.
+        flat = jnp.moveaxis(rbuf, 0, 1).reshape(
+            e_chunk * ep * g * span, H)
+        fsz = jnp.moveaxis(rsz, 0, 1).reshape(e_chunk * ep * g)
+        lut = tuple(np.repeat(np.arange(e_chunk), ep * g))
+        sl = slice(ci * e_chunk, (ci + 1) * e_chunk)
+        pchunk = {k: params[k][sl]
+                  for k in ("w_in", "b_in", "w_out", "b_out")}
+        out = _sort_ffn(pchunk, flat, fsz, span, lut, e_chunk,
+                        ep * g * span, bm, bn, gmm_backend)
+        return jnp.moveaxis(out.reshape(e_chunk, ep, g * span, H), 1, 0)
+
+    chunk = lambda t, ci: t[:, ci * e_chunk:(ci + 1) * e_chunk]  # noqa: E731
+    # software pipeline: exchange chunk i+1 concurrently with FFN(i)
+    recv = [(_a2a(chunk(send, 0), axis_name),
+             _a2a(chunk(sz_send, 0), axis_name))]
+    outs = []
+    for ci in range(n_ch):
+        if ci + 1 < n_ch:
+            recv.append((_a2a(chunk(send, ci + 1), axis_name),
+                         _a2a(chunk(sz_send, ci + 1), axis_name)))
+        rbuf, rsz = recv[ci]
+        outs.append(_a2a(ffn_chunk(ci, rbuf, rsz), axis_name))
+    out_full = outs[0] if n_ch == 1 else jnp.concatenate(outs, axis=1)
+    out_buf = out_full.reshape(E * g * span, H)
+    y = _sort_combine(out_buf, route, span, T, x.dtype)
+    return y, jax.lax.pmean(route.aux, axis_name)
 
 
 class MoELayer:
@@ -239,7 +546,11 @@ class MoELayer:
     def __init__(self, hidden_size, intermediate_size, num_experts,
                  capacity_factor=1.25, mesh=None, axis_name="expert",
                  param_dtype=jnp.float32, top_k=1, jitter_eps=0.0,
-                 groups=1):
+                 groups=1, dispatch="einsum", renorm_kept_choices=False,
+                 a2a_overlap_chunks=1):
+        if dispatch not in DISPATCH_MODES:
+            raise ValueError(f"dispatch must be one of {DISPATCH_MODES}, "
+                             f"got {dispatch!r}")
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
         self.num_experts = num_experts
@@ -247,6 +558,9 @@ class MoELayer:
         self.top_k = top_k          # 1 = Switch, 2 = GShard default
         self.jitter_eps = jitter_eps
         self.groups = groups        # 0 = auto (per-call token count)
+        self.dispatch = dispatch
+        self.renorm_kept_choices = renorm_kept_choices
+        self.a2a_overlap_chunks = a2a_overlap_chunks
         self.axis_name = axis_name
         self.ep = int(mesh.shape[axis_name]) \
             if mesh is not None and axis_name in mesh.axis_names else 1
@@ -281,10 +595,12 @@ class MoELayer:
         flat = x.reshape(-1, self.hidden_size)
         kw = dict(capacity_factor=self.capacity_factor, top_k=self.top_k,
                   rng=rng, jitter_eps=self.jitter_eps if rng is not None
-                  else 0.0, groups=self.groups)
+                  else 0.0, groups=self.groups, dispatch=self.dispatch,
+                  renorm_kept_choices=self.renorm_kept_choices)
         if self.ep > 1:
             y, aux = moe_ffn_expert_parallel(
-                params, flat, self.axis_name, self.ep, **kw)
+                params, flat, self.axis_name, self.ep,
+                a2a_overlap_chunks=self.a2a_overlap_chunks, **kw)
         else:
             y, aux = moe_ffn_dense(params, flat, **kw)
         return y.reshape(*lead, self.hidden_size), aux

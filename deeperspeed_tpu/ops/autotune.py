@@ -216,6 +216,123 @@ def memory_feasible(fn, abstract_args, budget_bytes=None, safety=0.92,
     return need <= budget_bytes * safety, stats
 
 
+# ---------------------------------------------------------------------------
+# grouped expert matmul (ops/pallas/grouped_matmul.py — the sort-based
+# MoE dispatch engine's FFN kernel)
+# ---------------------------------------------------------------------------
+
+# (block_m, block_n) targets, fattest first. The kernel fits each to the
+# actual span/output dims; candidates differing only after fitting are
+# deduped before measurement.
+GMM_BLOCK_CANDIDATES = ((512, 512), (512, 256), (256, 512), (256, 256),
+                        (128, 256), (256, 128), (128, 128))
+
+# Conservative per-instance VMEM bound for the static screen: the fwd
+# working set is double-buffered x [bm, K] and w [K, bn] tiles plus the
+# output tile; the bwd dw kernel's is the same order with a [K, bn] fp32
+# accumulator block in place of the output tile.
+_GMM_VMEM_BUDGET = 10 << 20
+
+
+def gmm_vmem_bytes(block_m, block_n, k_dim, itemsize):
+    """Estimated VMEM working set of one grouped-matmul instance
+    (fwd/bwd superset): double-buffered input tiles + the fp32
+    accumulator/output block (max of the fwd [bm, bn] and dw [K, bn])."""
+    return (2 * (block_m * k_dim + k_dim * block_n) * itemsize
+            + max(block_m * block_n, k_dim * block_n) * 4)
+
+
+def _gmm_itemsize(dtype):
+    import jax.numpy as jnp
+    import numpy as np
+    return 2 if dtype == jnp.bfloat16 else np.dtype(dtype).itemsize
+
+
+def grouped_matmul_blocks(capacity, k_dim, n_dim, dtype, tuner=None):
+    """(block_m, block_n) for `grouped_matmul` at the given expert-FFN
+    geometry. The SAME block pair serves both FFN matmuls — (k_dim →
+    n_dim) and back (n_dim → k_dim) — so candidates are screened
+    against the VMEM model at BOTH contraction dims (an over-budget
+    geometry is a Mosaic allocation failure, not a slow rung); with
+    `DS_TPU_AUTOTUNE=1` the survivors are additionally memory-screened
+    via AOT `memory_analysis` and then measured fwd+bwd over the
+    composite two-matmul FFN on the live device
+    (measure-once-use-forever, like the flash blocks). Without opt-in
+    the first screened candidate wins — a deterministic static pick, no
+    probe launches at trace time."""
+    itemsize = _gmm_itemsize(dtype)
+    screened = [c for c in GMM_BLOCK_CANDIDATES
+                if max(gmm_vmem_bytes(c[0], c[1], k_dim, itemsize),
+                       gmm_vmem_bytes(c[0], c[1], n_dim, itemsize))
+                <= _GMM_VMEM_BUDGET]
+    if not screened:
+        screened = [GMM_BLOCK_CANDIDATES[-1]]
+    if not autotune_enabled():
+        return screened[0]
+
+    tuner = tuner or _global_tuner
+    key = ("gmm", int(capacity), int(k_dim), int(n_dim), str(dtype))
+    hit = tuner.cached(key)
+    if hit is not None:
+        return hit
+
+    import jax.numpy as jnp
+    from .pallas.grouped_matmul import _interpret, grouped_matmul, \
+        pick_span
+
+    if len(screened) == 1 or jax.process_count() > 1 or _interpret():
+        # multi-host: per-host wall-clock picks can disagree → different
+        # programs per host → deadlock at the first collective.
+        # interpret mode (no TPU): timing the Pallas interpreter ranks
+        # XLA-emulation cost, not kernel geometry — and compiling the
+        # chained fwd+bwd probe through the interpreter takes minutes
+        return tuner.store(key, screened[0])
+
+    n_groups = 8
+
+    def build(cand):
+        # probe the geometry EXACTLY as the MoE layer deploys it: the
+        # composite in->out FFN pair (the second matmul's contraction
+        # dim is n_dim — usually the 4x larger one), with pick_span's
+        # fitted row block (two candidates can collapse to one pair)
+        span, bm = pick_span(capacity, cand[0])
+        x = jnp.zeros((n_groups * span, k_dim), dtype)
+        w1 = jnp.zeros((n_groups, k_dim, n_dim), dtype)
+        w2 = jnp.zeros((n_groups, n_dim, k_dim), dtype)
+        sizes = jnp.full((n_groups,), min(int(capacity), span), jnp.int32)
+
+        def run(xv):
+            h = grouped_matmul(xv, w1, sizes, span, None, bm, cand[1],
+                               backend="pallas")
+            out = grouped_matmul(h, w2, sizes, span, None, bm, cand[1],
+                                 backend="pallas")
+            return jnp.sum(out.astype(jnp.float32))
+        return run, x, (bm, cand[1])
+
+    # AOT memory screen before spending a timed run on a candidate;
+    # dedupe candidates that fit to the same deployed geometry
+    survivors, seen = [], set()
+    for cand in screened:
+        run, x, fitted = build(cand)
+        if fitted in seen:
+            continue
+        fits, _ = memory_feasible(
+            jax.grad(run), (jax.ShapeDtypeStruct(x.shape, x.dtype),))
+        if fits:
+            seen.add(fitted)
+            survivors.append(cand)
+    if not survivors:
+        survivors = [screened[0]]
+    if len(survivors) == 1:
+        return tuner.store(key, survivors[0])
+
+    def measure(cand):
+        run, x, _ = build(cand)
+        return jax.grad(run)(x)
+
+    return tuner.pick(key, survivors, measure)
+
+
 def flash_blocks_for(shape, dtype, causal, tuner=None):
     """Dispatch-time flash block geometry, or None for the built-in
     default. Long sequences (≥ `flash_tune_min_seq()`, env-tunable) and

@@ -1,3 +1,5 @@
 from .block_sparse_attention import BlockSparseAttention, build_lut
 from .flash_attention import flash_attention, flash_attention_supported
+from .grouped_matmul import (grouped_matmul, grouped_matmul_supported,
+                             grouped_matmul_xla)
 from .optimizer import adam_flat_reference, fused_adam_flat

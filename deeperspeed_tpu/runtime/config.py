@@ -359,19 +359,7 @@ class DeepSpeedConfig:
         # Config-drivable MoE / sequence parallelism (the engine hands
         # these to the model family via `apply_ds_config`; no library
         # imports needed in user code).
-        moe = d.get("moe") or {}
-        self.moe_enabled = bool(moe.get("enabled",
-                                        moe.get("num_experts", 0)))
-        self.moe_params = {
-            "num_experts": int(moe.get("num_experts", 0)),
-            "top_k": int(moe.get("top_k", 1)),
-            "capacity_factor": float(moe.get("capacity_factor", 1.25)),
-            "jitter_eps": float(moe.get("jitter_eps", 0.0)),
-            "aux_loss_coef": float(moe.get("aux_loss_coef", 0.01)),
-            # 1 = global capacity (reference numerics); 0 opts in
-            # to auto-sized groups
-            "num_groups": int(moe.get("num_groups", 1)),
-        } if self.moe_enabled else False
+        self._parse_moe_block(d)
         sp = d.get("sequence_parallel") or {}
         self.sequence_parallel_enabled = bool(sp.get("enabled", False))
         self.sequence_parallel_params = {
@@ -396,6 +384,103 @@ class DeepSpeedConfig:
 
         self.vocabulary_size = d.get(c.VOCABULARY_SIZE,
                                      c.VOCABULARY_SIZE_DEFAULT)
+
+    def _parse_moe_block(self, d):
+        """Parse + validate the "moe" block with the same parse-time
+        strictness as the "checkpoint"/"training_health" blocks: a
+        mistyped key or out-of-range knob must fail at startup, not
+        silently train a dense (or mis-routed) model."""
+        moe = d.get(c.MOE) or {}
+        known = {c.MOE_ENABLED, c.MOE_NUM_EXPERTS, c.MOE_TOP_K,
+                 c.MOE_CAPACITY_FACTOR, c.MOE_JITTER_EPS,
+                 c.MOE_AUX_LOSS_COEF, c.MOE_NUM_GROUPS, c.MOE_DISPATCH,
+                 c.MOE_A2A_OVERLAP_CHUNKS, c.MOE_RENORM_KEPT_CHOICES}
+        unknown = sorted(set(moe) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown 'moe' key(s) {unknown}; valid keys: "
+                f"{sorted(known)}")
+
+        self.moe_enabled = bool(moe.get(c.MOE_ENABLED,
+                                        moe.get(c.MOE_NUM_EXPERTS, 0)))
+        if not self.moe_enabled:
+            self.moe_params = False
+            return
+
+        num_experts = as_int(moe.get(c.MOE_NUM_EXPERTS, 0),
+                             f"moe.{c.MOE_NUM_EXPERTS}")
+        if num_experts <= 0:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_NUM_EXPERTS} must be a positive int, got "
+                f"{moe.get(c.MOE_NUM_EXPERTS)!r}")
+        top_k = as_int(moe.get(c.MOE_TOP_K, c.MOE_TOP_K_DEFAULT),
+                       f"moe.{c.MOE_TOP_K}")
+        if top_k not in c.MOE_TOP_K_CHOICES:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_TOP_K} must be one of "
+                f"{list(c.MOE_TOP_K_CHOICES)} (1 = Switch, 2 = GShard), "
+                f"got {top_k}")
+        def as_float(key, default):
+            try:
+                return float(moe.get(key, default))
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"moe.{key} must be a number, got {moe.get(key)!r}")
+
+        capacity_factor = as_float(c.MOE_CAPACITY_FACTOR,
+                                   c.MOE_CAPACITY_FACTOR_DEFAULT)
+        if not capacity_factor > 0:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_CAPACITY_FACTOR} must be > 0, got "
+                f"{capacity_factor}")
+        jitter_eps = as_float(c.MOE_JITTER_EPS, c.MOE_JITTER_EPS_DEFAULT)
+        if jitter_eps < 0:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_JITTER_EPS} must be >= 0, got {jitter_eps}")
+        aux_loss_coef = as_float(c.MOE_AUX_LOSS_COEF,
+                                 c.MOE_AUX_LOSS_COEF_DEFAULT)
+        if aux_loss_coef < 0:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_AUX_LOSS_COEF} must be >= 0 (a negative "
+                f"coefficient actively unbalances experts), got "
+                f"{aux_loss_coef}")
+        num_groups = as_int(moe.get(c.MOE_NUM_GROUPS,
+                                    c.MOE_NUM_GROUPS_DEFAULT),
+                            f"moe.{c.MOE_NUM_GROUPS}")
+        if num_groups < 0:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_NUM_GROUPS} must be >= 0 (0 = auto), got "
+                f"{num_groups}")
+        dispatch = str(moe.get(c.MOE_DISPATCH, c.MOE_DISPATCH_DEFAULT))
+        if dispatch not in c.MOE_DISPATCH_MODES:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_DISPATCH} must be one of "
+                f"{list(c.MOE_DISPATCH_MODES)}, got {dispatch!r}")
+        a2a_chunks = as_int(moe.get(c.MOE_A2A_OVERLAP_CHUNKS,
+                                    c.MOE_A2A_OVERLAP_CHUNKS_DEFAULT),
+                            f"moe.{c.MOE_A2A_OVERLAP_CHUNKS}")
+        if a2a_chunks < 1:
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_A2A_OVERLAP_CHUNKS} must be >= 1, got "
+                f"{a2a_chunks}")
+        renorm = moe.get(c.MOE_RENORM_KEPT_CHOICES,
+                         c.MOE_RENORM_KEPT_CHOICES_DEFAULT)
+        if not isinstance(renorm, bool):
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_RENORM_KEPT_CHOICES} must be a boolean, "
+                f"got {renorm!r}")
+
+        self.moe_params = {
+            "num_experts": num_experts,
+            "top_k": top_k,
+            "capacity_factor": capacity_factor,
+            "jitter_eps": jitter_eps,
+            "aux_loss_coef": aux_loss_coef,
+            "num_groups": num_groups,
+            "dispatch": dispatch,
+            "a2a_overlap_chunks": a2a_chunks,
+            "renorm_kept_choices": renorm,
+        }
 
     def _parse_checkpoint_block(self, d):
         """Parse + validate the "checkpoint" block: tag validation

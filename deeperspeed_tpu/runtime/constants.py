@@ -229,6 +229,37 @@ FP16_MIN_SCALE_PATIENCE = "min_scale_patience"
 FP16_MIN_SCALE_PATIENCE_DEFAULT = 0
 
 # ---------------------------------------------------------------------------
+# MoE block (moe/layer.py, config-drivable via apply_ds_config)
+# ---------------------------------------------------------------------------
+MOE = "moe"
+MOE_ENABLED = "enabled"
+MOE_NUM_EXPERTS = "num_experts"
+MOE_TOP_K = "top_k"
+MOE_TOP_K_DEFAULT = 1
+MOE_TOP_K_CHOICES = (1, 2)
+MOE_CAPACITY_FACTOR = "capacity_factor"
+MOE_CAPACITY_FACTOR_DEFAULT = 1.25
+MOE_JITTER_EPS = "jitter_eps"
+MOE_JITTER_EPS_DEFAULT = 0.0
+MOE_AUX_LOSS_COEF = "aux_loss_coef"
+MOE_AUX_LOSS_COEF_DEFAULT = 0.01
+# 1 = global capacity (reference numerics); 0 opts in to auto-sized groups
+MOE_NUM_GROUPS = "num_groups"
+MOE_NUM_GROUPS_DEFAULT = 1
+# dispatch engine: "einsum" = GShard one-hot [T, E, C] einsum pair
+# (reference numerics); "sort" = argsort token permutation + Pallas
+# grouped matmul (the fast path)
+MOE_DISPATCH = "dispatch"
+MOE_DISPATCH_DEFAULT = "einsum"
+MOE_DISPATCH_MODES = ("einsum", "sort")
+# expert-parallel all_to_all/compute software pipeline depth (sort engine)
+MOE_A2A_OVERLAP_CHUNKS = "a2a_overlap_chunks"
+MOE_A2A_OVERLAP_CHUNKS_DEFAULT = 1
+# renormalize top-2 combine weights over capacity-surviving choices
+MOE_RENORM_KEPT_CHOICES = "renorm_kept_choices"
+MOE_RENORM_KEPT_CHOICES_DEFAULT = False
+
+# ---------------------------------------------------------------------------
 # Sparse attention block
 # ---------------------------------------------------------------------------
 SPARSE_ATTENTION = "sparse_attention"

@@ -353,3 +353,87 @@ def test_config_writer_roundtrip(tmp_path):
     data = r.load_config(p)
     assert data["train_batch_size"] == 8
     assert data["optimizer"]["type"] == "Adam"
+
+
+# --- moe block (parse-time validation, PR 5) ------------------------------
+
+def test_moe_block_defaults_and_knobs():
+    cfg = make_config({"train_batch_size": 1})
+    assert cfg.moe_params is False and not cfg.moe_enabled
+    cfg = make_config({"train_batch_size": 1,
+                       "moe": {"num_experts": 8}})
+    assert cfg.moe_params == {
+        "num_experts": 8, "top_k": 1, "capacity_factor": 1.25,
+        "jitter_eps": 0.0, "aux_loss_coef": 0.01, "num_groups": 1,
+        "dispatch": "einsum", "a2a_overlap_chunks": 1,
+        "renorm_kept_choices": False}
+    cfg = make_config({"train_batch_size": 1,
+                       "moe": {"num_experts": 16, "top_k": 2,
+                               "capacity_factor": 2.0,
+                               "jitter_eps": 0.01, "num_groups": 0,
+                               "dispatch": "sort",
+                               "a2a_overlap_chunks": 4,
+                               "renorm_kept_choices": True}})
+    assert cfg.moe_params["dispatch"] == "sort"
+    assert cfg.moe_params["a2a_overlap_chunks"] == 4
+    assert cfg.moe_params["renorm_kept_choices"] is True
+    assert cfg.moe_params["num_groups"] == 0          # 0 = auto
+    # enabled: false disables even with num_experts set
+    cfg = make_config({"train_batch_size": 1,
+                       "moe": {"enabled": False, "num_experts": 8}})
+    assert cfg.moe_params is False
+
+
+def test_moe_block_parse_time_validation():
+    # unknown keys raise and name the valid choices (same contract as
+    # the checkpoint/training_health blocks)
+    with pytest.raises(DeepSpeedConfigError, match="num_experts"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"n_experts": 8}})
+    # non-positive num_experts
+    with pytest.raises(DeepSpeedConfigError, match="num_experts"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"enabled": True, "num_experts": 0}})
+    with pytest.raises(DeepSpeedConfigError, match="num_experts"):
+        make_config({"train_batch_size": 1, "moe": {"num_experts": -4}})
+    # top_k outside {1, 2} names the choices
+    with pytest.raises(DeepSpeedConfigError, match="1, 2"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "top_k": 3}})
+    # non-positive capacity factor
+    with pytest.raises(DeepSpeedConfigError, match="capacity_factor"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "capacity_factor": 0.0}})
+    # dispatch mode names the engines
+    with pytest.raises(DeepSpeedConfigError, match="einsum"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "dispatch": "scatter"}})
+    with pytest.raises(DeepSpeedConfigError, match="a2a_overlap_chunks"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "a2a_overlap_chunks": 0}})
+    with pytest.raises(DeepSpeedConfigError, match="renorm_kept_choices"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8,
+                             "renorm_kept_choices": "yes"}})
+    with pytest.raises(DeepSpeedConfigError, match="jitter_eps"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "jitter_eps": -0.1}})
+    with pytest.raises(DeepSpeedConfigError, match="num_groups"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "num_groups": -1}})
+
+
+def test_moe_aux_loss_coef_validated():
+    with pytest.raises(DeepSpeedConfigError, match="aux_loss_coef"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "aux_loss_coef": "high"}})
+    with pytest.raises(DeepSpeedConfigError, match="aux_loss_coef"):
+        make_config({"train_batch_size": 1,
+                     "moe": {"num_experts": 8, "aux_loss_coef": -0.01}})
+
+
+def test_moe_float_keys_raise_config_error_on_non_numeric():
+    for key in ("capacity_factor", "jitter_eps"):
+        with pytest.raises(DeepSpeedConfigError, match=key):
+            make_config({"train_batch_size": 1,
+                         "moe": {"num_experts": 8, key: "big"}})

@@ -363,3 +363,36 @@ def test_grouped_expert_parallel_matches_grouped_dense(devices):
     y, _ = jax.jit(mapped)(params, x)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+# --- sort dispatch engine (PR 5) ------------------------------------------
+
+def test_moe_sort_dispatch_config_drivable_trajectory_parity(devices):
+    """`moe.dispatch = "sort"` via JSON config alone: the engine trains
+    through the sort engine and tracks the einsum engine's loss
+    trajectory step for step."""
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    def run(dispatch):
+        model = GPTNeoX(GPTNeoXConfig.tiny(), use_pallas=False)
+        engine, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=None,
+            config_params={
+                "train_batch_size": 16,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000,
+                "moe": {"num_experts": 4, "top_k": 2,
+                        "dispatch": dispatch},
+            }, rng=jax.random.PRNGKey(0))
+        assert model.config.moe_dispatch == dispatch
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, model.config.vocab_size, (1, 16, 32),
+                            np.int32)
+        return [float(engine.train_batch(batch=(toks, toks)))
+                for _ in range(6)]
+
+    base = run("einsum")
+    got = run("sort")
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=2e-4)
+    assert got[-1] < got[0]
