@@ -29,6 +29,8 @@ KEYS (default all):
              disk-heavy)
   - sentinel (training-health sentinel detection overhead + injected-
              fault recovery latency; opt-in via DS_BENCH_SENTINEL=1)
+  - telemetry (unified-telemetry scalars-on overhead + in-engine MFU
+             vs analytic MFU cross-check; opt-in via DS_BENCH_TELEMETRY=1)
 """
 
 import gc
@@ -44,24 +46,18 @@ import numpy as np
 
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800, "ckpt": 600,
-               "sentinel": 600, "moe": 800}  # moe walks both engines
+               "sentinel": 600, "telemetry": 600,
+               "moe": 800}  # moe walks both engines
 ROW_TIMEOUT_DEFAULT = 420
 
 
 def peak_flops_per_chip(device):
-    """bf16 peak TFLOPS by TPU generation (public spec sheet numbers)."""
-    kind = getattr(device, "device_kind", "") or str(device)
-    kind = kind.lower()
-    table = {
-        "v5 lite": 197e12, "v5e": 197e12,
-        "v5p": 459e12, "v5": 459e12,
-        "v4": 275e12,
-        "v6": 918e12, "v6e": 918e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12  # conservative default
+    """bf16 peak TFLOPS by TPU generation — the table lives in
+    `deeperspeed_tpu.profiling.hardware` (shared with the in-engine
+    telemetry MFU, so bench and live scalars can never disagree)."""
+    from deeperspeed_tpu.profiling.hardware import \
+        peak_flops_per_chip as _peak
+    return _peak(device)
 
 
 def force(tree):
@@ -647,10 +643,99 @@ def row_sentinel():
                    "sentinel")
 
 
+def row_telemetry():
+    """Unified-telemetry cost + MFU cross-check (NeoX-125M, ZeRO-2):
+    step time with the telemetry block off vs on (goodput + MFU + span
+    scalars enabled, trace capture OFF — the acceptance bar is <= 1%
+    overhead in that mode), plus the in-engine MFU scalar (per-variant
+    `cost_analysis` flops / measured step time / peak) against this
+    bench's analytic tokens/s MFU — the two methodologies must agree
+    within ~2%. Opt-in via DS_BENCH_TELEMETRY=1."""
+    import shutil
+    import tempfile
+
+    jax = _setup_jax()
+    n_chips = len(jax.devices())
+    peak = peak_flops_per_chip(jax.devices()[0])
+    cfg, model, params = _headline_setup(jax)
+    seq = 1024
+
+    def engine_with(batch, tmp, telemetry=None):
+        import deeperspeed_tpu
+        config = {
+            "train_batch_size": batch,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 10_000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "fp16": {"enabled": True, "type": "bfloat16"},
+            "zero_optimization": {"stage": 2},
+            # both engines log scalars: the row isolates the telemetry
+            # layer's cost, not the monitor's
+            "tensorboard": {"enabled": True, "output_path": tmp,
+                            "job_name": "bench"},
+        }
+        if telemetry is not None:
+            config["telemetry"] = telemetry
+        eng, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params=config)
+        return eng
+
+    def run(bs_per_chip):
+        def thunk():
+            batch = bs_per_chip * n_chips
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                                  dtype=np.int32)
+            stacked = (tokens, tokens)
+            steps = 8
+            tmp = tempfile.mkdtemp(prefix="ds_telemetry_bench_")
+            try:
+                eng = engine_with(batch, tmp)
+                dt_off, _ = timed_steps(eng, stacked, steps=steps,
+                                        warmup=3)
+                del eng
+                gc.collect()
+
+                tel_on = {"enabled": True, "goodput": True, "mfu": True,
+                          "spans": True}
+                eng = engine_with(batch, tmp, telemetry=tel_on)
+                dt_on, _ = timed_steps(eng, stacked, steps=steps,
+                                       warmup=3)
+                overhead = (dt_on - dt_off) / dt_off
+
+                tps = batch * seq * steps / dt_on / n_chips
+                mfu_analytic = tps * _flops_per_token(cfg, seq) / peak
+                flops = eng.telemetry.compiled_flops.get(1)
+                mfu_engine = (flops / (dt_on / steps) / peak
+                              if flops else None)
+                frac = eng.telemetry.goodput.fraction
+                del eng
+                gc.collect()
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            out = {
+                "telemetry_step_ms_off": round(dt_off / steps * 1e3, 2),
+                "telemetry_step_ms_on": round(dt_on / steps * 1e3, 2),
+                "telemetry_overhead_pct": round(overhead * 100, 2),
+                "telemetry_mfu_analytic": round(mfu_analytic, 4),
+                "telemetry_goodput_fraction": round(frac, 4),
+            }
+            if mfu_engine is not None:
+                out["telemetry_mfu_in_engine"] = round(mfu_engine, 4)
+                out["telemetry_mfu_ratio"] = round(
+                    mfu_engine / mfu_analytic, 4)
+            return out
+        return thunk
+
+    bs0 = int(os.environ.get("DS_BENCH_TELEMETRY_BS", "16"))
+    return _ladder([(f"bs{bs0}", run(bs0)), ("bs8", run(8))], {},
+                   "telemetry")
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
-           "sentinel": row_sentinel}
+           "sentinel": row_sentinel, "telemetry": row_telemetry}
 
 
 # ---------------------------------------------------------------------------
@@ -666,6 +751,8 @@ def rows_enabled():
         order.append("ckpt")
     if os.environ.get("DS_BENCH_SENTINEL", "0") not in ("0", "", "false"):
         order.append("sentinel")
+    if os.environ.get("DS_BENCH_TELEMETRY", "0") not in ("0", "", "false"):
+        order.append("telemetry")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -673,7 +760,7 @@ def rows_enabled():
     picked = {r.strip() for r in sel.split(",")}
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
-    for opt_in in ("ckpt", "sentinel"):
+    for opt_in in ("ckpt", "sentinel", "telemetry"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

@@ -143,8 +143,15 @@ class AsyncCheckpointManager:
                             save_latest=save_latest)
             return tag
 
+        from ..runtime.telemetry import NULL_TELEMETRY
+        telemetry = getattr(engine, "telemetry", NULL_TELEMETRY)
         t0 = time.perf_counter()
-        payloads = snapshot_checkpoint(engine, client_state)
+        # the snapshot is the only training-loop stall of an async save;
+        # spanning it puts the stall on the trace timeline AND lets the
+        # goodput meter see mid-step saves (the ckpt_stall bucket reads
+        # total_stall_s deltas per step window)
+        with telemetry.span("ckpt_snapshot"):
+            payloads = snapshot_checkpoint(engine, client_state)
         stall_s = time.perf_counter() - t0
         step = engine.global_steps
         self.total_stall_s += stall_s

@@ -1,0 +1,36 @@
+"""Per-device-kind hardware peaks (shared by bench.py and the in-engine
+telemetry layer, `runtime/telemetry.py`).
+
+One table, two consumers: `bench.py` computes offline MFU from measured
+tokens/s, and the telemetry layer turns `compiled.cost_analysis()` flops
+into a live `Train/Samples/mfu` scalar. Keeping the table here means the
+two can never disagree about what "peak" means for a chip.
+
+Import-light on purpose: no jax at module scope — callers hand in device
+objects (or kind strings), so config parsing never pays a backend init.
+"""
+
+# bf16 peak FLOPS by TPU generation (public spec sheet numbers). Matched
+# as substrings against the lowercased `device_kind`.
+PEAK_FLOPS_BY_KIND = {
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v4": 275e12,
+    "v6": 918e12, "v6e": 918e12,
+}
+
+# Conservative default when the kind is unknown (also what CPU test runs
+# resolve to — their MFU scalars are meaningless but well-defined).
+PEAK_FLOPS_DEFAULT = 197e12
+
+
+def peak_flops_per_chip(device):
+    """bf16 peak FLOPS for a jax device (or a device-kind string)."""
+    kind = getattr(device, "device_kind", None)
+    if kind is None:
+        kind = str(device)
+    kind = (kind or "").lower()
+    for key, val in PEAK_FLOPS_BY_KIND.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS_DEFAULT

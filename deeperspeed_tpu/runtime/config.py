@@ -375,6 +375,7 @@ class DeepSpeedConfig:
 
         self._parse_checkpoint_block(d)
         self._parse_training_health_block(d)
+        self._parse_telemetry_block(d)
 
         # Fork additions: gradient storage for debugging.
         self.store_gradients = bool(
@@ -663,6 +664,120 @@ class DeepSpeedConfig:
             "max_rollbacks": ints[c.TRAINING_HEALTH_MAX_ROLLBACKS],
             "hang_timeout_seconds": floats[c.TRAINING_HEALTH_HANG_TIMEOUT],
             "fault_injection": fault_spec,
+        }
+
+    def _parse_telemetry_block(self, d):
+        """Parse + validate the "telemetry" block (runtime/telemetry.py:
+        span tracing, goodput + MFU accounting, trigger-driven profiler
+        capture). Same parse-time strictness as the "checkpoint" /
+        "training_health" blocks: a mistyped capture window must fail at
+        startup, not silently never trace."""
+        tel = d.get(c.TELEMETRY) or {}
+        known = {c.TELEMETRY_ENABLED, c.TELEMETRY_GOODPUT, c.TELEMETRY_MFU,
+                 c.TELEMETRY_SPANS, c.TELEMETRY_TRACE_DIR,
+                 c.TELEMETRY_CAPTURE, c.TELEMETRY_MEMORY_WATERMARK_INTERVAL,
+                 c.TELEMETRY_CAPTURE_ON_ANOMALY,
+                 c.TELEMETRY_ANOMALY_CAPTURE_STEPS}
+        unknown = sorted(set(tel) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown 'telemetry' key(s) {unknown}; valid keys: "
+                f"{sorted(known)}")
+
+        bools = {}
+        for key, default in (
+                (c.TELEMETRY_ENABLED, c.TELEMETRY_ENABLED_DEFAULT),
+                (c.TELEMETRY_GOODPUT, c.TELEMETRY_GOODPUT_DEFAULT),
+                (c.TELEMETRY_MFU, c.TELEMETRY_MFU_DEFAULT),
+                (c.TELEMETRY_SPANS, c.TELEMETRY_SPANS_DEFAULT),
+                (c.TELEMETRY_CAPTURE_ON_ANOMALY,
+                 c.TELEMETRY_CAPTURE_ON_ANOMALY_DEFAULT)):
+            value = tel.get(key, default)
+            if not isinstance(value, bool):
+                raise DeepSpeedConfigError(
+                    f"telemetry.{key} must be a boolean, got {value!r}")
+            bools[key] = value
+
+        trace_dir = tel.get(c.TELEMETRY_TRACE_DIR,
+                            c.TELEMETRY_TRACE_DIR_DEFAULT)
+        if trace_dir is not None and not isinstance(trace_dir, str):
+            raise DeepSpeedConfigError(
+                f"telemetry.{c.TELEMETRY_TRACE_DIR} must be a string "
+                f"path, got {trace_dir!r}")
+
+        capture = tel.get(c.TELEMETRY_CAPTURE)
+        if capture is not None:
+            if not isinstance(capture, dict):
+                raise DeepSpeedConfigError(
+                    f"telemetry.{c.TELEMETRY_CAPTURE} must be an object "
+                    "{start_step, num_steps}, got "
+                    f"{type(capture).__name__}")
+            cap_known = {c.TELEMETRY_CAPTURE_START_STEP,
+                         c.TELEMETRY_CAPTURE_NUM_STEPS}
+            cap_unknown = sorted(set(capture) - cap_known)
+            if cap_unknown:
+                raise DeepSpeedConfigError(
+                    f"Unknown telemetry.{c.TELEMETRY_CAPTURE} key(s) "
+                    f"{cap_unknown}; valid keys: {sorted(cap_known)}")
+            if c.TELEMETRY_CAPTURE_START_STEP not in capture:
+                raise DeepSpeedConfigError(
+                    f"telemetry.{c.TELEMETRY_CAPTURE} requires "
+                    f"{c.TELEMETRY_CAPTURE_START_STEP}")
+            start = as_int(capture[c.TELEMETRY_CAPTURE_START_STEP],
+                           f"telemetry.capture."
+                           f"{c.TELEMETRY_CAPTURE_START_STEP}")
+            num = as_int(capture.get(c.TELEMETRY_CAPTURE_NUM_STEPS,
+                                     c.TELEMETRY_CAPTURE_NUM_STEPS_DEFAULT),
+                         f"telemetry.capture."
+                         f"{c.TELEMETRY_CAPTURE_NUM_STEPS}")
+            if start < 0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.capture.{c.TELEMETRY_CAPTURE_START_STEP} "
+                    f"must be >= 0, got {start}")
+            if num < 1:
+                raise DeepSpeedConfigError(
+                    f"telemetry.capture.{c.TELEMETRY_CAPTURE_NUM_STEPS} "
+                    f"must be >= 1, got {num}")
+            capture = {c.TELEMETRY_CAPTURE_START_STEP: start,
+                       c.TELEMETRY_CAPTURE_NUM_STEPS: num}
+
+        watermark = as_int(
+            tel.get(c.TELEMETRY_MEMORY_WATERMARK_INTERVAL,
+                    c.TELEMETRY_MEMORY_WATERMARK_INTERVAL_DEFAULT),
+            f"telemetry.{c.TELEMETRY_MEMORY_WATERMARK_INTERVAL}")
+        if watermark < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.{c.TELEMETRY_MEMORY_WATERMARK_INTERVAL} must "
+                f"be >= 0 (0 disables), got {watermark}")
+        anomaly_steps = as_int(
+            tel.get(c.TELEMETRY_ANOMALY_CAPTURE_STEPS,
+                    c.TELEMETRY_ANOMALY_CAPTURE_STEPS_DEFAULT),
+            f"telemetry.{c.TELEMETRY_ANOMALY_CAPTURE_STEPS}")
+        if anomaly_steps < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.{c.TELEMETRY_ANOMALY_CAPTURE_STEPS} must be "
+                f">= 1, got {anomaly_steps}")
+
+        needs_dir = capture is not None or \
+            bools[c.TELEMETRY_CAPTURE_ON_ANOMALY]
+        if bools[c.TELEMETRY_ENABLED] and needs_dir and trace_dir is None:
+            raise DeepSpeedConfigError(
+                f"telemetry.{c.TELEMETRY_TRACE_DIR} is required when "
+                f"'{c.TELEMETRY_CAPTURE}' or "
+                f"'{c.TELEMETRY_CAPTURE_ON_ANOMALY}' is set (captures "
+                "need somewhere to write)")
+
+        self.telemetry_enabled = bools[c.TELEMETRY_ENABLED]
+        self.telemetry_config = {
+            "enabled": bools[c.TELEMETRY_ENABLED],
+            "goodput": bools[c.TELEMETRY_GOODPUT],
+            "mfu": bools[c.TELEMETRY_MFU],
+            "spans": bools[c.TELEMETRY_SPANS],
+            "trace_dir": trace_dir,
+            "capture": capture,
+            "memory_watermark_interval_steps": watermark,
+            "capture_on_anomaly": bools[c.TELEMETRY_CAPTURE_ON_ANOMALY],
+            "anomaly_capture_steps": anomaly_steps,
         }
 
     # -- batch triad -------------------------------------------------------

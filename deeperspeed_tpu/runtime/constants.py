@@ -229,6 +229,32 @@ FP16_MIN_SCALE_PATIENCE = "min_scale_patience"
 FP16_MIN_SCALE_PATIENCE_DEFAULT = 0
 
 # ---------------------------------------------------------------------------
+# Telemetry block (runtime/telemetry.py: span tracing, goodput + MFU
+# accounting, trigger-driven profiler capture)
+# ---------------------------------------------------------------------------
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+TELEMETRY_GOODPUT = "goodput"
+TELEMETRY_GOODPUT_DEFAULT = True
+TELEMETRY_MFU = "mfu"
+TELEMETRY_MFU_DEFAULT = True
+TELEMETRY_SPANS = "spans"
+TELEMETRY_SPANS_DEFAULT = True
+TELEMETRY_TRACE_DIR = "trace_dir"
+TELEMETRY_TRACE_DIR_DEFAULT = None
+TELEMETRY_CAPTURE = "capture"             # {"start_step": N, "num_steps": M}
+TELEMETRY_CAPTURE_START_STEP = "start_step"
+TELEMETRY_CAPTURE_NUM_STEPS = "num_steps"
+TELEMETRY_CAPTURE_NUM_STEPS_DEFAULT = 1
+TELEMETRY_MEMORY_WATERMARK_INTERVAL = "memory_watermark_interval_steps"
+TELEMETRY_MEMORY_WATERMARK_INTERVAL_DEFAULT = 0
+TELEMETRY_CAPTURE_ON_ANOMALY = "capture_on_anomaly"
+TELEMETRY_CAPTURE_ON_ANOMALY_DEFAULT = False
+TELEMETRY_ANOMALY_CAPTURE_STEPS = "anomaly_capture_steps"
+TELEMETRY_ANOMALY_CAPTURE_STEPS_DEFAULT = 1
+
+# ---------------------------------------------------------------------------
 # MoE block (moe/layer.py, config-drivable via apply_ds_config)
 # ---------------------------------------------------------------------------
 MOE = "moe"

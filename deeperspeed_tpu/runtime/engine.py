@@ -230,6 +230,19 @@ class DeepSpeedEngine:
         self.checkpoint_manager = AsyncCheckpointManager(
             self, **self._config.checkpoint_config)
 
+        # Unified telemetry (runtime/telemetry.py; the "telemetry" config
+        # block): span tracing mirrored into jax.profiler annotations,
+        # goodput buckets, in-engine MFU from compiled cost analysis, and
+        # trigger-driven trace/memory capture. NULL_TELEMETRY (every hook
+        # a no-op) when the block is absent — the hot path is unchanged.
+        from .telemetry import build_telemetry
+        local = [d for d in self.mesh.devices.flat
+                 if getattr(d, "process_index", 0) == jax.process_index()]
+        self.telemetry = build_telemetry(
+            self._config.telemetry_config, monitor=self.monitor,
+            devices=local or jax.local_devices())
+        self._step_flops = {}   # compiled-variant key -> per-device flops
+
         # --- offload tier -------------------------------------------------
         zc = self._config.zero_config
         self.host_offload = (zc.offload_optimizer is not None)
@@ -2058,6 +2071,10 @@ class DeepSpeedEngine:
             detailed=fp_cfg.detailed)
 
     def _after_step(self, metrics):
+        """Post-step host bookkeeping. Returns the step's verdict — one
+        of "ok" / "warned" / "quarantined" / "rollback" / "overflow" —
+        which the telemetry layer uses to classify the step's wall time
+        into goodput buckets."""
         # Only fp16 loss-scaled runs can skip steps; for bf16/fp32 the
         # overflow flag is statically False — never touch the device value
         # (a host read per step stalls the async dispatch pipeline). The
@@ -2070,15 +2087,22 @@ class DeepSpeedEngine:
         verdict = "ok"
         if self.sentinel is not None:
             try:
-                verdict = self.sentinel.after_step(self, metrics, overflow)
+                # sentinel escalation is a bounded phase too: the flags
+                # read syncs the device, and warn/rollback work is host
+                # time a trace should attribute
+                with self.telemetry.span("sentinel"):
+                    verdict = self.sentinel.after_step(self, metrics,
+                                                       overflow)
             finally:
                 self.sentinel.watchdog_feed()
             if verdict == "rollback":
                 # state + host counters were restored from the committed
                 # checkpoint; the poisoned step contributes nothing to
                 # schedules or telemetry
-                return
+                return verdict
         if overflow:
+            if verdict == "ok":
+                verdict = "overflow"   # scale-search skip: wasted time
             self.skipped_steps += 1
             log_dist(f"OVERFLOW! Skipping step; loss scale now "
                      f"{float(self.state.scale.cur_scale)}", ranks=[0])
@@ -2100,6 +2124,7 @@ class DeepSpeedEngine:
                 taken=0 if verdict == "quarantined" else 1)
         if self.monitor is not None:
             self._record_step_metrics(metrics)
+        return verdict
 
     def _record_step_metrics(self, metrics, sample_count=None):
         """Queue one step's scalars on the monitor (values stay device
@@ -2121,6 +2146,17 @@ class DeepSpeedEngine:
             scalars["Train/Samples/step_time_ms"] = \
                 (now - self._last_step_stamp) * 1e3
         self._last_step_stamp = now
+        # wall_clock_breakdown timers land in the event stream too (the
+        # reference only ever printed them): Train/Timers/<name>_ms keyed
+        # by the same sample count as the loss scalars. elapsed(reset)
+        # drains each timer so the values are per-step, not cumulative.
+        if self.wall_clock_breakdown():
+            for name, timer in self.timers.timers.items():
+                if timer.started_:
+                    continue   # mid-phase (fwd/bwd path): read next step
+                ms = timer.elapsed(reset=True) * 1e3
+                if ms > 0:
+                    scalars[f"Train/Timers/{name}_ms"] = ms
         self.monitor.record(
             self.global_samples if sample_count is None else sample_count,
             scalars)
@@ -2169,11 +2205,17 @@ class DeepSpeedEngine:
         """
         if layers_to_hook is not None:
             self.set_layers_to_hook(layers_to_hook)
+        tel = self.telemetry
+        tel.on_step_start(self.global_steps)
         gas = self.gradient_accumulation_steps()
         if batch is None:
-            micro = [next(data_iter) for _ in range(gas)]
-            batch = jax.tree_util.tree_map(
-                lambda *xs: np.stack(xs), *micro)
+            # host input pipeline: the goodput data_wait bucket is fed by
+            # this span — a slow loader shows up as lost goodput, not as
+            # a mysteriously slow "step"
+            with tel.span("data_fetch"):
+                micro = [next(data_iter) for _ in range(gas)]
+                batch = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *micro)
         self._assert_comm_precision()
         self._warn_gns_not_fed("train_batch")
 
@@ -2208,6 +2250,7 @@ class DeepSpeedEngine:
             raise
 
     def _train_batch_execute(self, batch, gas, fault):
+        tel = self.telemetry
         if self.param_offload:
             # ZeRO-Infinity: params stream from host/NVMe segment by
             # segment — skip the whole-batch device upload and the
@@ -2215,8 +2258,9 @@ class DeepSpeedEngine:
             # this mode exists to keep out of HBM).
             self.tput_timer.start()
             metrics = self._streamed_train_batch(batch)
-            self._after_step(metrics)
+            verdict = self._after_step(metrics)
             self.tput_timer.stop()
+            tel.on_step_end(self, verdict=verdict)
             return metrics.loss
 
         self._maybe_profile_flops(batch)
@@ -2228,12 +2272,13 @@ class DeepSpeedEngine:
         # cost — batch upload over PCIe — is timed here.
         if self.wall_clock_breakdown():
             self.timers("comms").start()
-        sharded = self._shard_stacked_batch(batch)
-        if self.wall_clock_breakdown():
-            # device_put is async; wait for the upload so the timer
-            # measures the transfer, not the dispatch.
-            jax.block_until_ready(sharded)
-            self.timers("comms").stop()
+        with tel.span("h2d"):
+            sharded = self._shard_stacked_batch(batch)
+            if self.wall_clock_breakdown():
+                # device_put is async; wait for the upload so the timer
+                # measures the transfer, not the dispatch.
+                jax.block_until_ready(sharded)
+                self.timers("comms").stop()
 
         if self._layers_to_hook:
             first_micro = jax.tree_util.tree_map(lambda x: x[0], sharded)
@@ -2243,10 +2288,12 @@ class DeepSpeedEngine:
             key = ("grads", gas)
             if key not in self._compiled_train:
                 self._compiled_train[key] = self._build_grads_step(gas)
-            loss, grads = self._compiled_train[key](
-                self.state.params, sharded, self._next_rng(),
-                self.state.scale.cur_scale, self.state.global_steps)
-            metrics = self._host_apply_update(grads)
+            with tel.span("train_dispatch"):
+                loss, grads = self._compiled_train[key](
+                    self.state.params, sharded, self._next_rng(),
+                    self.state.scale.cur_scale, self.state.global_steps)
+            with tel.span("host_optimizer"):
+                metrics = self._host_apply_update(grads)
             metrics = metrics._replace(loss=loss)
         else:
             key = gas if fault is None else (gas, "fault")
@@ -2260,19 +2307,38 @@ class DeepSpeedEngine:
                 post = self.global_steps >= self.optimizer.freeze_step
                 self._onebit_post_phase = bool(post)
                 key = (gas, bool(post))
-            if key not in self._compiled_train:
-                self._compiled_train[key] = self._build_train_step(
-                    gas, with_fault=fault is not None)
             lr = self._current_lr()
-            if fault is not None:
-                self.state, metrics = self._compiled_train[key](
-                    self.state, sharded, self._next_rng(), lr, fault)
-            else:
-                self.state, metrics = self._compiled_train[key](
-                    self.state, sharded, self._next_rng(), lr)
+            rng = self._next_rng()
+            call_args = (self.state, sharded, rng, lr) if fault is None \
+                else (self.state, sharded, rng, lr, fault)
+            if key not in self._compiled_train:
+                step_fn = self._build_train_step(
+                    gas, with_fault=fault is not None)
+                if tel.wants_flops:
+                    # AOT: lower+compile against the concrete args (one
+                    # trace, one compile — the executable IS the step we
+                    # run) and harvest the per-device program flops from
+                    # cost_analysis for the live MFU scalars. If GSPMD
+                    # settles the donated state onto different shardings
+                    # (or a checkpoint restore re-places it), the call
+                    # degrades once to a fresh jit wrapper.
+                    from .telemetry import aot_compile_with_flops
+                    wf = fault is not None
+                    step_fn, flops = aot_compile_with_flops(
+                        step_fn, call_args,
+                        rebuild=lambda: self._build_train_step(
+                            gas, with_fault=wf))
+                    self._step_flops[key] = flops
+                    tel.register_compiled(key, flops)
+                self._compiled_train[key] = step_fn
+            with tel.span("train_dispatch"), \
+                    tel.step_annotation(self.global_steps):
+                self.state, metrics = self._compiled_train[key](*call_args)
         self.micro_steps += gas
-        self._after_step(metrics)
+        verdict = self._after_step(metrics)
         self.tput_timer.stop()
+        tel.on_step_end(self, verdict=verdict,
+                        flops=self._step_flops.get(key))
         return metrics.loss
 
     def train_steps(self, batches):
@@ -2311,6 +2377,7 @@ class DeepSpeedEngine:
                 f"batches must be [n_steps, accum={gas}, micro, ...], "
                 f"got leading {lead[:2]}")
         self._assert_comm_precision()
+        self.telemetry.on_step_start(self.global_steps)
         self.tput_timer.start()
         if self.sentinel is not None and \
                 ("window", gas, n_steps) in self._compiled_train:
@@ -2327,20 +2394,35 @@ class DeepSpeedEngine:
             raise
 
     def _train_steps_execute(self, batches, gas, n_steps):
+        tel = self.telemetry
         # data axis on dim 2: dims 0/1 are the step and grad-accum scans
-        sharded = self._shard_stacked_batch(batches, n_scan_dims=2)
+        with tel.span("h2d"):
+            sharded = self._shard_stacked_batch(batches, n_scan_dims=2)
         self._warn_gns_not_fed("train_steps")
         key = ("window", gas, n_steps)
-        if key not in self._compiled_train:
-            self._compiled_train[key] = self._build_train_window(gas,
-                                                                 n_steps)
         lr = self._current_lr()
         base_rng = jax.device_put(self._get_base_rng(),
                                   self._replicated_sharding)
         ms0 = jax.device_put(np.uint32(self.micro_steps),
                              self._replicated_sharding)
-        self.state, losses = self._compiled_train[key](
-            self.state, sharded, base_rng, ms0, lr)
+        call_args = (self.state, sharded, base_rng, ms0, lr)
+        if key not in self._compiled_train:
+            window_fn = self._build_train_window(gas, n_steps)
+            if tel.wants_flops:
+                # per-window program flops (n_steps fused steps); the
+                # MFU scalar divides by the window wall time, so the
+                # ratio is still per-chip utilization
+                from .telemetry import aot_compile_with_flops
+                window_fn, flops = aot_compile_with_flops(
+                    window_fn, call_args,
+                    rebuild=lambda: self._build_train_window(gas,
+                                                             n_steps))
+                self._step_flops[key] = flops
+                tel.register_compiled(key, flops)
+            self._compiled_train[key] = window_fn
+        with tel.span("train_dispatch"), \
+                tel.step_annotation(self.global_steps):
+            self.state, losses = self._compiled_train[key](*call_args)
         self.micro_steps += gas * n_steps
         if self.sentinel is not None:
             # the in-jit probe/quarantine protected every step of the
@@ -2371,6 +2453,11 @@ class DeepSpeedEngine:
                                     {"Train/Samples/train_loss": losses[i],
                                      "Train/Samples/lr": lr})
         self.tput_timer.stop()
+        # windows classify as one block: wholly productive unless every
+        # step was skipped (goodput cannot see intra-window skips — the
+        # per-step loop can)
+        tel.on_step_end(self, verdict="ok" if taken else "quarantined",
+                        flops=self._step_flops.get(key), steps=n_steps)
         return losses
 
     def _assert_comm_precision(self):

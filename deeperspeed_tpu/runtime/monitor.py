@@ -44,10 +44,22 @@ class _TSVWriter:
     def add_scalar(self, tag, value, global_step):
         self._f.write(f"{tag}\t{global_step}\t{value}\n")
 
-    def flush(self):
+    def flush(self, fsync=False):
+        # flush on the TB path's cadence (buffered rows alone would
+        # vanish on a crash, silently losing up to flush_interval steps
+        # of events); the fsync barrier is reserved for draining flushes
+        # and close — on a networked filesystem a per-interval fsync
+        # would stall the training loop for a durability guarantee the
+        # TB backend never provides
         self._f.flush()
+        if fsync:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
 
     def close(self):
+        self.flush(fsync=True)
         self._f.close()
 
 
@@ -62,6 +74,7 @@ class TensorBoardMonitor:
         self._pending = []          # (sample_count, {tag: device-or-float})
         self.flush_interval = max(1, int(flush_interval))
         self.writer = None
+        self._warned_closed = False
         if not self.enabled:
             return
         log_dir = os.path.join(output_path or os.getcwd(), job_name)
@@ -83,6 +96,16 @@ class TensorBoardMonitor:
         scalars (fetched lazily at flush — no dispatch stall)."""
         if not self.enabled:
             return
+        if self.writer is None:
+            # closed: dropping silently hides a lifecycle bug (events
+            # recorded after close used to queue forever, then crash the
+            # next flush). Warn once, drop loudly.
+            if not self._warned_closed:
+                self._warned_closed = True
+                logger.warning(
+                    "monitor: record() after close(); events are being "
+                    "dropped (fix the caller's monitor lifecycle)")
+            return
         self._pending.append((int(sample_count), dict(scalars)))
         if len(self._pending) >= self.flush_interval:
             # periodic flush: hand events to the writer thread but do NOT
@@ -102,7 +125,10 @@ class TensorBoardMonitor:
         self._pending.clear()
         if drain:
             self._drain_writer_queue()
-        self.writer.flush()
+        if isinstance(self.writer, _TSVWriter):
+            self.writer.flush(fsync=drain)
+        else:
+            self.writer.flush()
 
     def _drain_writer_queue(self):
         """tensorboardX queues events to a worker thread and its flush()

@@ -361,6 +361,9 @@ class TrainingHealthSentinel:
         engine = self._engine_ref()
         if engine is None:
             return
+        # memory snapshot now (host-side reads are thread-safe); a trace
+        # is armed for the next step in case the hang clears
+        self._telemetry_anomaly(engine, "watchdog_hang")
         manager = getattr(engine, "checkpoint_manager", None)
         if manager is not None and manager.save_on_preemption and \
                 manager.save_dir:
@@ -413,6 +416,7 @@ class TrainingHealthSentinel:
             del self.quarantined_windows[:-self.max_quarantine_records]
         self._warn(record, quarantined)
         self._record_monitor(engine)
+        self._telemetry_anomaly(engine, "+".join(record["kinds"]))
 
         if self.policy_rank >= POLICIES.index("rollback") and \
                 self.consecutive >= self.rollback_after and \
@@ -478,6 +482,15 @@ class TrainingHealthSentinel:
                      f"{record['kinds']} at {record} — {action}; "
                      f"{self.consecutive} consecutive", ranks=[0])
 
+    def _telemetry_anomaly(self, engine, kind):
+        """Hand the anomaly to the telemetry layer (runtime/telemetry):
+        with `capture_on_anomaly` it snapshots device memory now and
+        arms a profiler trace over the next step(s) — once per
+        consecutive-anomaly episode."""
+        telemetry = getattr(engine, "telemetry", None)
+        if telemetry is not None:
+            telemetry.on_anomaly(engine, kind)
+
     def _record_monitor(self, engine):
         monitor = getattr(engine, "monitor", None)
         if monitor is not None and hasattr(monitor, "record_health"):
@@ -499,9 +512,12 @@ class TrainingHealthSentinel:
         rewinding it with the checkpoint — replaying the quarantined
         batch would re-trigger the same anomaly on real data corruption."""
         manager = engine.checkpoint_manager
-        manager.wait()   # the newest commit must be durable before load
-        path, _ = engine.load_checkpoint(manager.save_dir,
-                                         load_dataloader_states=False)
+        from .telemetry import NULL_TELEMETRY
+        telemetry = getattr(engine, "telemetry", NULL_TELEMETRY)
+        with telemetry.span("rollback_restore"):
+            manager.wait()   # newest commit must be durable before load
+            path, _ = engine.load_checkpoint(manager.save_dir,
+                                             load_dataloader_states=False)
         if path is None:
             raise TrainingHealthError(
                 f"training health: rollback requested after {record} but "

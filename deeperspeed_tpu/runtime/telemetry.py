@@ -1,0 +1,630 @@
+"""Unified telemetry: step/phase tracing, goodput + MFU accounting, and
+trigger-driven profiler capture.
+
+The reference instruments training piecemeal (`wall_clock_breakdown`
+CUDA timers, a standalone flops profiler, tensorboard scalars); here the
+pieces fuse into one config-driven layer the engine consults every step:
+
+- **Span tracer** (`telemetry.span("data_fetch")`): host-side phase
+  timing around every boundary the engine already owns — data fetch,
+  host→device batch upload, train-step dispatch, checkpoint snapshot
+  stall, sentinel escalation, rollback restore. Each span also enters a
+  `jax.profiler.TraceAnnotation`, so a device trace captured over the
+  same steps carries the same phase names, and the host spans export as
+  Chrome-trace/Perfetto JSON per capture window.
+- **Goodput accounting**: cumulative wall time inside step windows is
+  classified into productive / data_wait / ckpt_stall / quarantined /
+  rollback buckets, emitted as `Train/Goodput/*` scalars plus a running
+  `Train/Goodput/fraction` (productive over everything).
+- **In-engine MFU**: the engine AOT-compiles its train step when MFU is
+  on, the per-variant flops are harvested ONCE from
+  `compiled.cost_analysis()` (`profiling.flops_profiler._cost_analysis`)
+  and every step emits `Train/Samples/mfu` and achieved-FLOPS/s against
+  the per-device-kind peak table (`profiling.hardware`).
+- **Trigger-driven capture**: the validated ``"telemetry"`` JSON block
+  arms programmatic `jax.profiler` trace windows
+  (``capture: {start_step, num_steps}``), periodic HBM
+  `memory_stats` watermark scalars, and an on-anomaly hook — the
+  sentinel's warn/quarantine/rollback path and the hang watchdog grab a
+  memory snapshot immediately and a trace of the following step(s)
+  automatically, at most once per anomaly episode.
+
+Zero-overhead path: when the block is absent the engine holds
+`NULL_TELEMETRY`, whose hooks are empty methods and whose `span()`
+returns a shared no-op context manager — the compiled programs and the
+host loop are unchanged.
+"""
+
+import json
+import os
+import threading
+import time
+import weakref
+
+from ..utils.logging import log_dist, logger
+
+# one process-wide flag: jax.profiler supports a single active trace;
+# overlapping windows (scheduled + anomaly) must coalesce, not crash
+_TRACE_LOCK = threading.Lock()
+_TRACE_ACTIVE = False
+
+
+def _release_orphaned_trace(wstate):
+    """weakref.finalize target: a Telemetry collected mid-capture-window
+    must stop the jax trace it started and release the process-wide
+    flag, or every later window in the process silently skips tracing
+    (and the profiler keeps buffering forever). Shares only the mutable
+    `wstate` dict with the owner — no reference cycle."""
+    global _TRACE_ACTIVE
+    if not wstate.get("started_jax"):
+        return
+    with _TRACE_LOCK:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 - interpreter may be tearing down
+            pass
+        _TRACE_ACTIVE = False
+        wstate["started_jax"] = False
+
+
+def _cost_analysis_flops(compiled):
+    """Per-device program flops from an AOT-compiled executable (None
+    when the backend reports no cost model)."""
+    from ..profiling.flops_profiler.profiler import _cost_analysis
+    flops = float(_cost_analysis(compiled).get("flops", 0.0))
+    return flops if flops > 0 else None
+
+
+class _AOTStep:
+    """AOT executable with a one-time jit fallback.
+
+    The executable is compiled against the FIRST call's input shardings
+    and layouts. GSPMD may settle the donated state onto different
+    output shardings (the jit path silently retraces once for exactly
+    this; `_build_train_window`'s docstring records the same effect for
+    layouts) — and a checkpoint restore re-places state the same way.
+    The AOT call then raises a sharding/layout mismatch BEFORE executing
+    (inputs intact), so we degrade to the plain jit wrapper, which
+    re-specializes per input just like the telemetry-off path. Total
+    compile count matches the jit path's own worst case (two)."""
+
+    def __init__(self, compiled, rebuild):
+        self._fn = compiled
+        self._rebuild = rebuild
+        self._fell_back = False
+
+    def __call__(self, *args):
+        if not self._fell_back:
+            try:
+                return self._fn(*args)
+            # ValueError: sharding/layout mismatch; TypeError: aval
+            # mismatch ("Argument types differ...") — both raised by the
+            # Compiled input checks BEFORE execution, so inputs (incl.
+            # donated buffers) are intact and the jit retry is safe.
+            # Anything raised mid-execution propagates.
+            except (ValueError, TypeError) as e:
+                logger.warning(
+                    "telemetry: inputs settled away from the first-call "
+                    f"AOT compile ({e}); this step variant "
+                    "re-specializes under jit from here on")
+                self._fell_back = True
+                self._fn = self._rebuild()
+        return self._fn(*args)
+
+
+def aot_compile_with_flops(jitted, args, rebuild=None):
+    """Lower+compile `jitted` against concrete `args` (one trace, one
+    compile — the AOT executable IS the step the engine runs, so
+    `cost_analysis` costs nothing extra). Returns (callable, flops);
+    falls back to the plain jit wrapper on any failure. `rebuild`
+    (() -> fresh jit wrapper) arms the one-time sharding-settle fallback
+    — see `_AOTStep`."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        flops = _cost_analysis_flops(compiled)
+    except Exception as e:  # noqa: BLE001 - telemetry must not kill training
+        logger.warning(f"telemetry: AOT flops harvest failed "
+                       f"({type(e).__name__}: {e}); MFU scalars disabled "
+                       f"for this step variant")
+        return jitted, None
+    if rebuild is not None:
+        return _AOTStep(compiled, rebuild), flops
+    return compiled, flops
+
+
+class _NullSpan:
+    """Shared no-op context manager (the zero-overhead span)."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: phase accumulation + optional capture-buffer entry
+    + a mirrored `jax.profiler.TraceAnnotation` so device timelines show
+    the same names."""
+    __slots__ = ("tel", "name", "t0", "ann")
+
+    def __init__(self, tel, name):
+        self.tel = tel
+        self.name = name
+        self.ann = None
+
+    def __enter__(self):
+        tel = self.tel
+        self.t0 = time.perf_counter()
+        tel._depth += 1
+        if tel.mirror_annotations:
+            import jax
+            self.ann = jax.profiler.TraceAnnotation(self.name)
+            self.ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        tel = self.tel
+        t1 = time.perf_counter()
+        tel._depth -= 1
+        if self.ann is not None:
+            self.ann.__exit__(*exc)
+        tel._on_span(self.name, self.t0, t1 - self.t0, tel._depth)
+        return False
+
+
+class SpanTracer:
+    """Host-side span recorder. Always accumulates per-step phase
+    durations (goodput inputs); buffers full (name, ts, dur, depth)
+    events only while a capture window is open, and exports them as a
+    Chrome-trace JSON (`{"traceEvents": [...]}`, "X" complete events,
+    microsecond timestamps) loadable in Perfetto/chrome://tracing."""
+
+    def __init__(self, mirror_annotations=True):
+        self.mirror_annotations = mirror_annotations
+        self._depth = 0
+        self._phase_acc = {}        # name -> seconds, this step window
+        self._buffer = []           # capture-window events
+        self.capturing = False
+
+    def span(self, name):
+        return _Span(self, name)
+
+    def _on_span(self, name, t0, dur, depth):
+        self._phase_acc[name] = self._phase_acc.get(name, 0.0) + dur
+        if self.capturing:
+            self._buffer.append((name, t0, dur, depth))
+
+    def drain_phases(self):
+        phases, self._phase_acc = self._phase_acc, {}
+        return phases
+
+    def start_capture(self):
+        self._buffer = []
+        self.capturing = True
+
+    def stop_capture(self):
+        self.capturing = False
+        events, self._buffer = self._buffer, []
+        return events
+
+    @staticmethod
+    def chrome_trace(events, pid=0):
+        """Chrome-trace dict for a list of (name, t0, dur, depth)."""
+        trace_events = [
+            {"name": name, "ph": "X", "pid": pid, "tid": depth,
+             "ts": t0 * 1e6, "dur": dur * 1e6,
+             "cat": "deeperspeed_tpu"}
+            for name, t0, dur, depth in events]
+        return {"traceEvents": trace_events,
+                "displayTimeUnit": "ms"}
+
+    @classmethod
+    def export_chrome_trace(cls, events, path, pid=0):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(cls.chrome_trace(events, pid=pid), f)
+        return path
+
+
+# goodput bucket names, in emission order
+GOODPUT_BUCKETS = ("productive", "data_wait", "ckpt_stall", "quarantined",
+                   "rollback")
+
+
+class GoodputMeter:
+    """Cumulative wall-time classifier over step windows.
+
+    Every `account()` call covers one step window of `dt` seconds and
+    splits it: data-fetch span time is always charged to `data_wait`;
+    checkpoint snapshot stall (the delta of the async manager's
+    cumulative stall inside this window) to `ckpt_stall`; the rest goes
+    to `productive` for taken steps, `quarantined` for in-jit skipped
+    updates (sentinel quarantine or fp16 overflow — either way the step
+    burned time without advancing), and `rollback` for windows that
+    ended in a checkpoint restore."""
+
+    def __init__(self):
+        self.buckets = {name: 0.0 for name in GOODPUT_BUCKETS}
+
+    def account(self, dt, verdict, data_wait=0.0, ckpt_stall=0.0):
+        data_wait = min(max(data_wait, 0.0), dt)
+        ckpt_stall = min(max(ckpt_stall, 0.0), dt - data_wait)
+        rest = dt - data_wait - ckpt_stall
+        self.buckets["data_wait"] += data_wait
+        self.buckets["ckpt_stall"] += ckpt_stall
+        if verdict == "rollback":
+            self.buckets["rollback"] += rest
+        elif verdict in ("quarantined", "overflow"):
+            self.buckets["quarantined"] += rest
+        else:
+            self.buckets["productive"] += rest
+
+    @property
+    def total(self):
+        return sum(self.buckets.values())
+
+    @property
+    def fraction(self):
+        total = self.total
+        return self.buckets["productive"] / total if total > 0 else 1.0
+
+    def scalars(self):
+        out = {f"Train/Goodput/{name}_s": secs
+               for name, secs in self.buckets.items()}
+        out["Train/Goodput/fraction"] = self.fraction
+        return out
+
+
+class _NullTelemetry:
+    """The absent-config telemetry object: every hook is a no-op and
+    `span()` hands back one shared do-nothing context manager."""
+
+    enabled = False
+    wants_flops = False
+    spans_enabled = False
+
+    def span(self, name):  # noqa: ARG002
+        return _NULL_SPAN
+
+    def step_annotation(self, step):  # noqa: ARG002
+        return _NULL_SPAN
+
+    def on_step_start(self, step):  # noqa: ARG002
+        pass
+
+    def on_step_end(self, engine, verdict="ok", flops=None, steps=1):
+        pass
+
+    def on_anomaly(self, engine, kind, step=None):
+        pass
+
+    def register_compiled(self, key, flops):
+        pass
+
+    def close(self):
+        pass
+
+
+NULL_TELEMETRY = _NullTelemetry()
+
+
+class Telemetry:
+    """Config-driven engine telemetry (the ``"telemetry"`` JSON block).
+
+    Constructed by the engine AFTER the monitor; emits scalars through
+    `monitor.record` keyed by the engine's global sample count, so
+    goodput/MFU/memory series line up with the loss series."""
+
+    enabled = True
+
+    def __init__(self, monitor=None, devices=None, goodput=True, mfu=True,
+                 spans=True, trace_dir=None, capture=None,
+                 memory_watermark_interval_steps=0,
+                 capture_on_anomaly=False, anomaly_capture_steps=1):
+        self.monitor = monitor
+        self.devices = list(devices or [])
+        self.goodput_enabled = bool(goodput)
+        self.mfu_enabled = bool(mfu)
+        self.spans_enabled = bool(spans)
+        self.trace_dir = trace_dir
+        self.capture_start_step = None
+        self.capture_num_steps = 0
+        if capture:
+            self.capture_start_step = int(capture["start_step"])
+            self.capture_num_steps = int(capture["num_steps"])
+        self.memory_watermark_interval = int(memory_watermark_interval_steps)
+        self.capture_on_anomaly = bool(capture_on_anomaly)
+        self.anomaly_capture_steps = int(anomaly_capture_steps)
+
+        self.tracer = SpanTracer(mirror_annotations=self.spans_enabled)
+        self.goodput = GoodputMeter()
+        self.compiled_flops = {}    # step-variant key -> per-device flops
+
+        self._step_t0 = None
+        self._steps_seen = 0
+        self._last_ckpt_stall = None
+        self._peak_flops = None
+
+        # capture-window state. `started_jax` lives in a dict shared
+        # with a weakref.finalize below: a Telemetry collected mid-window
+        # (bench ladders delete failed engines and retry) must still stop
+        # the jax trace and release the process-wide flag — the atexit
+        # hook alone no-ops once the object is gone.
+        self._window_open = False
+        self._window_tag = None
+        self._window_steps_left = 0
+        self._wstate = {"started_jax": False}
+        self._finalizer = weakref.finalize(self, _release_orphaned_trace,
+                                           self._wstate)
+        self._scheduled_done = False
+        self._armed = []            # pending (tag, num_steps) requests
+
+        # anomaly episode state
+        self._anomaly_episode = False
+        self.anomaly_captures = 0
+        self.exported_traces = []   # chrome-trace JSON paths written
+
+        # flush an open capture window at interpreter exit: a run that
+        # ends (or dies) mid-window must still stop the jax trace and
+        # export the collected spans — and release the process-wide
+        # active-trace flag for any later engine. Weakly held, like the
+        # monitor's and checkpoint manager's hooks.
+        from .utils import register_weak_atexit
+        self._atexit = register_weak_atexit(self, "close")
+
+    # ------------------------------------------------------------------
+    # spans
+    # ------------------------------------------------------------------
+
+    def span(self, name):
+        # goodput keeps phase timing alive even with spans off: the
+        # data_wait / ckpt-stall buckets are fed by these spans, and
+        # `spans: false` must not silently blind the meter. What
+        # spans: false DOES turn off: the jax.profiler annotation
+        # mirroring (tracer.mirror_annotations) and span capture/export
+        # (_open_window skips start_capture).
+        if not (self.spans_enabled or self.goodput_enabled):
+            return _NULL_SPAN
+        return self.tracer.span(name)
+
+    def step_annotation(self, step):
+        """`jax.profiler.StepTraceAnnotation` around the train-step
+        dispatch: device timelines group kernels by train step."""
+        if not self.spans_enabled:
+            return _NULL_SPAN
+        import jax
+        return jax.profiler.StepTraceAnnotation("train",
+                                                step_num=int(step))
+
+    # ------------------------------------------------------------------
+    # MFU
+    # ------------------------------------------------------------------
+
+    @property
+    def wants_flops(self):
+        return self.mfu_enabled
+
+    def register_compiled(self, key, flops):
+        """Record a step variant's per-device program flops (harvested
+        once from `compiled.cost_analysis()` at compile time)."""
+        if flops:
+            self.compiled_flops[key] = float(flops)
+            log_dist(f"telemetry: step variant {key} costs "
+                     f"{flops / 1e9:.2f} GFLOPs/device per call",
+                     ranks=[0])
+
+    def _peak(self):
+        if self._peak_flops is None:
+            from ..profiling.hardware import peak_flops_per_chip
+            dev = self.devices[0] if self.devices else None
+            self._peak_flops = peak_flops_per_chip(dev)
+        return self._peak_flops
+
+    # ------------------------------------------------------------------
+    # step hooks
+    # ------------------------------------------------------------------
+
+    def on_step_start(self, step):
+        self._step_t0 = time.perf_counter()
+        # scheduled window: arm once when the step counter reaches it
+        if (self.capture_start_step is not None
+                and not self._scheduled_done
+                and step >= self.capture_start_step):
+            self._scheduled_done = True
+            self._armed.append((f"step{step}", self.capture_num_steps))
+        if self._armed and not self._window_open:
+            tag, n_steps = self._armed.pop(0)
+            self._open_window(tag, n_steps)
+
+    def on_step_end(self, engine, verdict="ok", flops=None, steps=1):
+        """Close one step window: goodput accounting, MFU/memory
+        scalars, capture-window bookkeeping. `steps` > 1 for fused
+        `train_steps` windows (one call covers n optimizer steps)."""
+        t1 = time.perf_counter()
+        dt = (t1 - self._step_t0) if self._step_t0 is not None else 0.0
+        self._step_t0 = None
+        self._steps_seen += steps
+        phases = self.tracer.drain_phases()
+
+        scalars = {}
+        if self.goodput_enabled:
+            manager = getattr(engine, "checkpoint_manager", None)
+            stall = getattr(manager, "total_stall_s", 0.0)
+            if self._last_ckpt_stall is None:
+                self._last_ckpt_stall = stall
+            ckpt_delta = max(stall - self._last_ckpt_stall, 0.0)
+            self._last_ckpt_stall = stall
+            self.goodput.account(dt, verdict,
+                                 data_wait=phases.get("data_fetch", 0.0),
+                                 ckpt_stall=ckpt_delta)
+            scalars.update(self.goodput.scalars())
+
+        if self.mfu_enabled and flops and dt > 0:
+            achieved = flops / dt          # per-device FLOPS/s
+            scalars["Train/Samples/achieved_tflops"] = achieved / 1e12
+            scalars["Train/Samples/mfu"] = achieved / self._peak()
+
+        if (self.memory_watermark_interval > 0
+                and self._steps_seen % self.memory_watermark_interval < steps):
+            scalars.update(self._memory_scalars())
+
+        if scalars and self.monitor is not None:
+            self.monitor.record(getattr(engine, "global_samples", 0),
+                                scalars)
+
+        if verdict == "ok":
+            self._anomaly_episode = False
+
+        if self._window_open:
+            self._window_steps_left -= steps
+            if self._window_steps_left <= 0:
+                self._close_window()
+
+    # ------------------------------------------------------------------
+    # anomaly hook (sentinel escalation path + hang watchdog)
+    # ------------------------------------------------------------------
+
+    def on_anomaly(self, engine, kind, step=None):
+        """Called by the sentinel when a step is flagged (and by the
+        hang watchdog on expiry): snapshot device memory NOW and arm a
+        trace window over the next step(s). Fires at most once per
+        anomaly episode — a run of consecutive anomalous steps produces
+        one capture, and the episode re-arms after the next healthy
+        step."""
+        if not self.capture_on_anomaly or self._anomaly_episode:
+            return
+        self._anomaly_episode = True
+        self.anomaly_captures += 1
+        step = step if step is not None else \
+            getattr(engine, "global_steps", 0)
+        tag = f"anomaly_{kind}_step{step}"
+        self.write_memory_snapshot(tag)
+        # trace the FOLLOWING step(s): the flagged step already ran
+        self._armed.append((tag, self.anomaly_capture_steps))
+        log_dist(f"telemetry: anomaly ({kind}) at step {step} — memory "
+                 f"snapshot written, trace armed for the next "
+                 f"{self.anomaly_capture_steps} step(s)", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # capture windows
+    # ------------------------------------------------------------------
+
+    def _open_window(self, tag, n_steps):
+        global _TRACE_ACTIVE
+        self._window_open = True
+        self._window_tag = tag
+        self._window_steps_left = max(int(n_steps), 1)
+        if self.spans_enabled:
+            # spans: false turns span capture/export off entirely — the
+            # window still drives the jax profiler trace below
+            self.tracer.start_capture()
+        self._wstate["started_jax"] = False
+        if self.trace_dir:
+            with _TRACE_LOCK:
+                if not _TRACE_ACTIVE:
+                    try:
+                        import jax
+                        jax.profiler.start_trace(self.trace_dir)
+                        _TRACE_ACTIVE = True
+                        self._wstate["started_jax"] = True
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            f"telemetry: jax profiler trace failed to "
+                            f"start ({e}); host spans still captured")
+
+    def _close_window(self):
+        global _TRACE_ACTIVE
+        events = self.tracer.stop_capture()
+        tag = self._window_tag
+        self._window_open = False
+        self._window_tag = None
+        if self._wstate["started_jax"]:
+            with _TRACE_LOCK:
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(f"telemetry: stop_trace failed ({e})")
+                _TRACE_ACTIVE = False
+                self._wstate["started_jax"] = False
+        if self.trace_dir and events:
+            try:
+                import jax
+                pid = jax.process_index()
+            except Exception:  # noqa: BLE001
+                pid = 0
+            path = os.path.join(self.trace_dir, f"spans_{tag}.json")
+            self.exported_traces.append(
+                SpanTracer.export_chrome_trace(events, path, pid=pid))
+            log_dist(f"telemetry: capture window '{tag}' closed — "
+                     f"{len(events)} host spans -> {path}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # memory
+    # ------------------------------------------------------------------
+
+    def _memory_scalars(self):
+        """HBM watermark scalars from the first local device (watermarks
+        are per-chip and SPMD keeps chips symmetric)."""
+        stats = self._device_memory_stats()
+        first = next(iter(stats.values()), None) or {}
+        out = {}
+        if "bytes_in_use" in first:
+            out["Train/Memory/hbm_bytes_in_use"] = first["bytes_in_use"]
+        if "peak_bytes_in_use" in first:
+            out["Train/Memory/hbm_peak_bytes"] = \
+                first["peak_bytes_in_use"]
+        return out
+
+    def _device_memory_stats(self):
+        out = {}
+        for dev in self.devices:
+            try:
+                out[str(dev)] = dev.memory_stats() or {}
+            except Exception:  # noqa: BLE001 - backends without stats
+                out[str(dev)] = {}
+        return out
+
+    def write_memory_snapshot(self, tag):
+        """Per-device `memory_stats` JSON under the trace dir (the
+        anomaly hook's 'what was HBM doing' artifact). Thread-safe: the
+        hang watchdog calls this from its own thread."""
+        if not self.trace_dir:
+            return None
+        path = os.path.join(self.trace_dir, f"memory_{tag}.json")
+        os.makedirs(self.trace_dir, exist_ok=True)
+        payload = {"tag": tag, "time": time.time(),
+                   "devices": self._device_memory_stats()}
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+        return path
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+
+    def close(self):
+        """Flush an open capture window (export what was collected) and
+        detach the atexit hook. Idempotent."""
+        if self._window_open:
+            self._close_window()
+        try:
+            import atexit
+            atexit.unregister(self._atexit)
+        except Exception:  # pragma: no cover
+            pass
+
+
+def build_telemetry(config_dict, monitor=None, devices=None):
+    """Telemetry (or NULL_TELEMETRY) from a parsed telemetry config
+    dict (`DeepSpeedConfig.telemetry_config`)."""
+    if not config_dict or not config_dict.get("enabled"):
+        return NULL_TELEMETRY
+    kwargs = {k: v for k, v in config_dict.items() if k != "enabled"}
+    return Telemetry(monitor=monitor, devices=devices, **kwargs)

@@ -28,25 +28,28 @@ class SynchronizedWallClockTimer:
     """Named timer group with device-synchronized start/stop."""
 
     class Timer:
+        # time.monotonic, not time.time: an NTP slew or wall-clock jump
+        # mid-span corrupts elapsed (negative or hours-long "steps" have
+        # been observed on preemptible fleets); monotonic can't go back.
         def __init__(self, name):
             self.name_ = name
             self.elapsed_ = 0.0
             self.started_ = False
-            self.start_time = time.time()
+            self.start_time = time.monotonic()
 
         def start(self):
             assert not self.started_, f"{self.name_} timer already started"
             _device_barrier()
-            self.start_time = time.time()
+            self.start_time = time.monotonic()
             self.started_ = True
 
         def stop(self, reset=False):
             assert self.started_, f"{self.name_} timer not started"
             _device_barrier()
             if reset:
-                self.elapsed_ = time.time() - self.start_time
+                self.elapsed_ = time.monotonic() - self.start_time
             else:
-                self.elapsed_ += time.time() - self.start_time
+                self.elapsed_ += time.monotonic() - self.start_time
             self.started_ = False
 
         def reset(self):
@@ -129,7 +132,7 @@ class ThroughputTimer:
         self.started = True
         if self.global_step_count >= self.start_step:
             _device_barrier()
-            self.start_time = time.time()
+            self.start_time = time.monotonic()
 
     def stop(self, report_speed=True):
         if not self.started:
@@ -139,14 +142,17 @@ class ThroughputTimer:
         self.global_step_count += 1
         if self.start_time > 0:
             _device_barrier()
-            self.end_time = time.time()
+            self.end_time = time.monotonic()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             if report_speed and \
                     self.global_step_count % self.steps_per_output == 0:
-                self.logging(
-                    f"{self.global_step_count}/{self.micro_step_count}, "
-                    f"SamplesPerSec={self.avg_samples_per_sec():.2f}")
+                avg = self.avg_samples_per_sec()
+                if avg > 0:   # still in warmup: nothing meaningful yet
+                    self.logging(
+                        f"{self.global_step_count}/"
+                        f"{self.micro_step_count}, "
+                        f"SamplesPerSec={avg:.2f}")
                 if self.monitor_memory:
                     vm = psutil.virtual_memory()
                     self.logging(f"virtual memory used: "
@@ -154,12 +160,16 @@ class ThroughputTimer:
                                  f"percent: {vm.percent}%")
 
     def avg_samples_per_sec(self):
-        if self.global_step_count > self.start_step:
+        # 0.0 (not -inf) before warmup completes: callers feed this into
+        # logs and monitor scalars, and a -inf both reads as garbage and
+        # poisons downstream aggregation.
+        if self.global_step_count > self.start_step and \
+                self.total_elapsed_time > 0:
             samples = self.batch_size * self.num_workers
             total_step_offset = self.global_step_count - self.start_step
             avg_time_per_step = self.total_elapsed_time / total_step_offset
             return samples / avg_time_per_step
-        return float("-inf")
+        return 0.0
 
 
 @contextlib.contextmanager
