@@ -31,6 +31,9 @@ KEYS (default all):
              fault recovery latency; opt-in via DS_BENCH_SENTINEL=1)
   - telemetry (unified-telemetry scalars-on overhead + in-engine MFU
              vs analytic MFU cross-check; opt-in via DS_BENCH_TELEMETRY=1)
+  - packed   (packed ragged-batch row: fixed-seed lognormal doc mixture
+             packed into 16k rows, segment-aware kernels vs the same
+             shapes without segments; opt-in via DS_BENCH_PACKED=1)
 """
 
 import gc
@@ -45,9 +48,9 @@ import time
 import numpy as np
 
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
-ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 800, "ckpt": 600,
-               "sentinel": 600, "telemetry": 600,
-               "moe": 800}  # moe walks both engines
+ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
+               "sentinel": 600, "telemetry": 600, "packed": 800,
+               "moe": 800}  # moe/longseq walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -120,19 +123,22 @@ def _ladder(rungs, out, name):
 # rows (each runs in its own subprocess)
 # ---------------------------------------------------------------------------
 
-def _neox_engine(model, params, batch, zero_cfg):
+def _neox_engine(model, params, batch, zero_cfg, extra_cfg=None):
     import deeperspeed_tpu
+    config_params = {
+        "train_batch_size": batch,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10_000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "fp16": {"enabled": True, "type": "bfloat16"},
+        "zero_optimization": zero_cfg,
+    }
+    if extra_cfg:
+        config_params.update(extra_cfg)
     eng, *_ = deeperspeed_tpu.initialize(
         model=model,
         model_parameters=params,
-        config_params={
-            "train_batch_size": batch,
-            "gradient_accumulation_steps": 1,
-            "steps_per_print": 10_000,
-            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-            "fp16": {"enabled": True, "type": "bfloat16"},
-            "zero_optimization": zero_cfg,
-        })
+        config_params=config_params)
     return eng
 
 
@@ -389,13 +395,30 @@ def row_gpt2xl():
     return _ladder(ladder, out, "gpt2_xl_1p5b")
 
 
+def _flash_block_extra(tag):
+    """Record the flash dispatch geometry the LAST trace actually chose
+    (fwd and bwd blocks + grid variant) so a bench round documents WHICH
+    kernel configuration produced its numbers — `_LAST_BLOCKS` is
+    written at trace time by `ops/pallas/flash_attention._fwd/_bwd`."""
+    from deeperspeed_tpu.ops.pallas.flash_attention import _LAST_BLOCKS
+    out = {}
+    fwd, bwd = _LAST_BLOCKS.get("fwd"), _LAST_BLOCKS.get("dkv")
+    if fwd:
+        out[f"{tag}_fwd_blocks"] = f"{fwd[0]}x{fwd[1]}"
+        out[f"{tag}_fwd_grid"] = _LAST_BLOCKS.get("fwd_variant", "?")
+    if bwd:
+        out[f"{tag}_bwd_blocks"] = f"{bwd[0]}x{bwd[1]}"
+        out[f"{tag}_bwd_grid"] = _LAST_BLOCKS.get("bwd_variant", "?")
+    return out
+
+
 def row_longseq():
     jax = _setup_jax()
     n_chips = len(jax.devices())
     peak = peak_flops_per_chip(jax.devices()[0])
     from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
 
-    def run(seq, bs_per_chip):
+    def run(seq, bs_per_chip, engine="dense"):
         def thunk():
             lcfg = GPTNeoXConfig(vocab_size=8192, hidden_size=768,
                                  num_layers=12, num_heads=12,
@@ -403,7 +426,18 @@ def row_longseq():
             lmodel = GPTNeoX(lcfg, use_pallas=True, remat_blocks=True)
             lparams = lmodel.init_params(jax.random.PRNGKey(5))
             lbs = bs_per_chip * n_chips
-            eng = _neox_engine(lmodel, lparams, lbs, {"stage": 2})
+            extra_cfg = None
+            if engine == "sparse":
+                # local+global fixed pattern à la the reference's
+                # SparseSelfAttention: 2k-token local window + one
+                # global block per window, causal. Density ~10% at 16k,
+                # ~5% at 32k — well under the sparse-kernel crossover.
+                extra_cfg = {"sparse_attention": {
+                    "mode": "fixed", "block": 128,
+                    "num_local_blocks": 16, "num_global_blocks": 1,
+                    "attention": "unidirectional"}}
+            eng = _neox_engine(lmodel, lparams, lbs, {"stage": 2},
+                               extra_cfg=extra_cfg)
             r = np.random.default_rng(6)
             ltok = r.integers(0, lcfg.vocab_size, (1, lbs, seq), np.int32)
             dt, _ = timed_steps(eng, (ltok, ltok), steps=3, warmup=2)
@@ -412,16 +446,32 @@ def row_longseq():
             lftok = 6 * ln + 12 * lcfg.num_layers * lcfg.hidden_size * \
                 seq // 2   # causal: half the score tiles are dead
             tag = f"longseq_{seq // 1024}k"
-            return {f"{tag}_tokens_per_sec_chip": round(tps, 1),
-                    f"{tag}_mfu": round(tps * lftok / peak, 4),
-                    f"{tag}_remat_policy": "full",
-                    f"{tag}_batch_per_chip": bs_per_chip}
+            if engine == "sparse":
+                # dense-equivalent MFU: tokens/s × DENSE flops/token —
+                # the comparable "how much dense work would this pace
+                # amount to" scalar (the sparse kernels burn fewer)
+                return {f"{tag}_sparse_tokens_per_sec_chip": round(tps, 1),
+                        f"{tag}_sparse_mfu_dense_equiv":
+                            round(tps * lftok / peak, 4),
+                        f"{tag}_sparse_pattern": "fixed_l16g1"}
+            out = {f"{tag}_tokens_per_sec_chip": round(tps, 1),
+                   f"{tag}_mfu": round(tps * lftok / peak, 4),
+                   f"{tag}_remat_policy": "full",
+                   f"{tag}_batch_per_chip": bs_per_chip}
+            out.update(_flash_block_extra(tag))
+            return out
         return thunk
 
     lbs = int(os.environ.get("DS_BENCH_LONG_BS", "2"))
+    want_sparse = os.environ.get("DS_BENCH_LONG_SPARSE", "1") not in (
+        "0", "", "false")
     out = _ladder([(f"bs{lbs}", run(16384, lbs))] +
                   ([("bs1", run(16384, 1))] if lbs > 1 else []),
                   {}, "longseq_16k")
+    if "longseq_16k_mfu" in out and want_sparse:
+        # block-sparse engine comparison rung at the same shape
+        out = _ladder([(f"sparse_bs{lbs}", run(16384, lbs, "sparse"))],
+                      out, "longseq_16k_sparse")
     if "longseq_16k_mfu" in out and \
             os.environ.get("DS_BENCH_32K", "1") not in ("0", "false"):
         # stretch row: 32k tokens (the reference claims ~10× longer
@@ -430,6 +480,91 @@ def row_longseq():
         out = _ladder([(f"bs{lbs}", run(32768, lbs))] +
                       ([("bs1", run(32768, 1))] if lbs > 1 else []),
                       out, "longseq_32k")
+        if "longseq_32k_mfu" in out and want_sparse:
+            out = _ladder(
+                [(f"sparse_bs{lbs}", run(32768, lbs, "sparse"))],
+                out, "longseq_32k_sparse")
+    return out
+
+
+def row_packed():
+    """Packed ragged-batch row (opt-in via DS_BENCH_PACKED=1): a fixed-
+    seed lognormal document mixture (`runtime.packing.
+    synthetic_doc_mixture` — the shape of web corpora) greedily packed
+    into 16k rows, trained with segment-aware flash kernels. The same
+    packed tokens run WITHOUT segment ids as the control: identical
+    shapes and flop ceiling, so the delta isolates the block-level
+    cross-document skip. Effective (non-pad, non-cross-doc) tokens/s
+    quantify what the padded-baseline loader would have wasted."""
+    jax = _setup_jax()
+    n_chips = len(jax.devices())
+    peak = peak_flops_per_chip(jax.devices()[0])
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+    from deeperspeed_tpu.runtime.packing import (
+        count_effective_targets, pack_documents, synthetic_doc_mixture)
+
+    seq = int(os.environ.get("DS_BENCH_PACKED_SEQ", str(16384)))
+
+    def run(bs_per_chip, with_segments):
+        def thunk():
+            lcfg = GPTNeoXConfig(vocab_size=8192, hidden_size=768,
+                                 num_layers=12, num_heads=12,
+                                 max_seq_len=seq)
+            lmodel = GPTNeoX(lcfg, use_pallas=True, remat_blocks=True)
+            lparams = lmodel.init_params(jax.random.PRNGKey(5))
+            lbs = bs_per_chip * n_chips
+            extra_cfg = {"packing": {"enabled": True}} if with_segments \
+                else None
+            eng = _neox_engine(lmodel, lparams, lbs, {"stage": 2},
+                               extra_cfg=extra_cfg)
+            # fixed seed => identical mixture every round (per topology):
+            # mean-2048 lognormal with a heavy tail, sized to fill lbs
+            # rows of seq tokens with 75% margin (greedy packing leaves
+            # partial tail rows; the guard below still backstops)
+            mean_len = 2048.0
+            n_docs = max(64, int(lbs * seq / mean_len * 1.75))
+            docs = synthetic_doc_mixture(7, n_docs, lcfg.vocab_size,
+                                         mean_len=mean_len, sigma=1.2,
+                                         max_len=seq)
+            tok, seg = pack_documents(docs, seq)
+            if tok.shape[0] < lbs:
+                raise RuntimeError(
+                    f"mixture packed into {tok.shape[0]} rows < batch "
+                    f"{lbs}; raise the doc count")
+            tok, seg = tok[:lbs][None], seg[:lbs][None]  # [1, lbs, S]
+            batch = (tok, tok, seg) if with_segments else (tok, tok)
+            dt, _ = timed_steps(eng, batch, steps=3, warmup=2)
+            tps = lbs * seq * 3 / dt / n_chips
+            ln = lcfg.num_params()
+            lftok = 6 * ln + 12 * lcfg.num_layers * lcfg.hidden_size * \
+                seq // 2
+            key = "packed_seg" if with_segments else "packed_noseg"
+            out = {f"{key}_tokens_per_sec_chip": round(tps, 1),
+                   f"{key}_mfu": round(tps * lftok / peak, 4)}
+            if with_segments:
+                eff = count_effective_targets(seg)
+                total = int(np.prod(seg.shape[:-1])) * (seg.shape[-1] - 1)
+                out["packed_occupancy"] = round(float((seg != 0).mean()), 4)
+                out["packed_effective_token_fraction"] = round(
+                    eff / total, 4)
+                out["packed_effective_tokens_per_sec_chip"] = round(
+                    tps * eff / total, 1)
+                out.update(_flash_block_extra("packed"))
+            return out
+        return thunk
+
+    bs0 = int(os.environ.get("DS_BENCH_PACKED_BS", "2"))
+    out = _ladder([(f"bs{bs0}", run(bs0, True))] +
+                  ([("bs1", run(1, True))] if bs0 > 1 else []),
+                  {}, "packed")
+    if "packed_seg_mfu" in out:
+        bs_ran = int(out.get("packed_config", f"bs{bs0}")[2:] or bs0)
+        out = _ladder([(f"bs{bs_ran}", run(bs_ran, False))], out,
+                      "packed_ctl")
+        if "packed_noseg_tokens_per_sec_chip" in out:
+            out["packed_seg_speedup"] = round(
+                out["packed_seg_tokens_per_sec_chip"] /
+                out["packed_noseg_tokens_per_sec_chip"], 3)
     return out
 
 
@@ -735,7 +870,8 @@ def row_telemetry():
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
-           "sentinel": row_sentinel, "telemetry": row_telemetry}
+           "sentinel": row_sentinel, "telemetry": row_telemetry,
+           "packed": row_packed}
 
 
 # ---------------------------------------------------------------------------
@@ -753,6 +889,8 @@ def rows_enabled():
         order.append("sentinel")
     if os.environ.get("DS_BENCH_TELEMETRY", "0") not in ("0", "", "false"):
         order.append("telemetry")
+    if os.environ.get("DS_BENCH_PACKED", "0") not in ("0", "", "false"):
+        order.append("packed")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -760,7 +898,7 @@ def rows_enabled():
     picked = {r.strip() for r in sel.split(",")}
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
-    for opt_in in ("ckpt", "sentinel", "telemetry"):
+    for opt_in in ("ckpt", "sentinel", "telemetry", "packed"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

@@ -127,6 +127,16 @@ class BertModel:
         from .gpt_neox import (apply_activation_checkpointing_config,
                                reject_unsupported_ds_blocks)
         reject_unsupported_ds_blocks(ds_config, "BERT")
+        if getattr(ds_config, "packing_params", None):
+            # the BERT loss paths consume MLM/classification batches, not
+            # the LM (tokens, labels, segment_ids) triples the packing
+            # block promises — accepting the block would silently train
+            # without intra-document masking. The encoder IS
+            # segment-capable: pass segment_ids to encode() directly.
+            raise NotImplementedError(
+                "the packing config block targets the LM families "
+                "(GPT-NeoX / GPT-2); for packed encoder runs pass "
+                "segment_ids to BertModel.encode() directly")
         apply_activation_checkpointing_config(self, ds_config, mesh)
 
     # -- params -----------------------------------------------------------
@@ -158,12 +168,20 @@ class BertModel:
 
     # -- forward ----------------------------------------------------------
 
-    def embed(self, params, input_ids, token_type_ids=None):
+    def embed(self, params, input_ids, token_type_ids=None,
+              segment_ids=None):
         cfg = self.config
         e = params["embeddings"]
         S = input_ids.shape[1]
         x = e["word"][input_ids]
-        x = x + e["position"][None, :S, :]
+        if segment_ids is None:
+            x = x + e["position"][None, :S, :]
+        else:
+            # packed ragged batches: gather the learned position table at
+            # each token's INTRA-document position so a packed document
+            # sees the same position rows as the same document alone
+            from ..runtime.packing import segment_relative_positions
+            x = x + e["position"][segment_relative_positions(segment_ids)]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = x + e["token_type"][token_type_ids]
@@ -172,17 +190,23 @@ class BertModel:
 
     def encode(self, params, input_ids, token_type_ids=None,
                attention_mask=None, rng=None, deterministic=True,
-               collect_hidden=False):
+               collect_hidden=False, segment_ids=None):
         """Run embeddings + encoder; with `collect_hidden` also return
         the per-layer outputs (the activation-capture path shares this
         exact forward).
+
+        `segment_ids` [B, S] int32 (packed ragged batches, 0 = pad —
+        `runtime.packing`): every layer's attention becomes
+        intra-document (bidirectional within a document) and the
+        position embedding is gathered at intra-document positions.
 
         With remat knobs set (and no hidden collection) the encoder runs
         as `number_checkpoints` checkpoint spans — each span recomputes
         its layers in backward under the named policy; explicit dropout
         keys replay identically by construction."""
         from .gpt_neox import resolve_remat
-        x = self.embed(params, input_ids, token_type_ids)
+        x = self.embed(params, input_ids, token_type_ids,
+                       segment_ids=segment_ids)
         hidden = [x] if collect_hidden else None
         L = self.config.num_layers
         rngs = (list(jax.random.split(rng, L))
@@ -191,11 +215,12 @@ class BertModel:
             else resolve_remat(False, self.remat_policy,
                                self.number_checkpoints)
         if do_remat:
-            def seg_fn(x, seg_params, seg_rngs, mask):
+            def seg_fn(x, seg_params, seg_rngs, mask, seg_ids):
                 for lp, r in zip(seg_params, seg_rngs):
                     x = self.layer.apply(lp, x, attention_mask=mask,
                                          rng=r,
-                                         deterministic=deterministic)
+                                         deterministic=deterministic,
+                                         segment_ids=seg_ids)
                 return x
 
             from .gpt_neox import segment_sizes
@@ -205,12 +230,13 @@ class BertModel:
             idx = 0
             for size in sizes:
                 x = ck(edge(x), params["layers"][idx:idx + size],
-                       rngs[idx:idx + size], attention_mask)
+                       rngs[idx:idx + size], attention_mask, segment_ids)
                 idx += size
             return x
         for lp, r in zip(params["layers"], rngs):
             x = self.layer.apply(lp, x, attention_mask=attention_mask,
-                                 rng=r, deterministic=deterministic)
+                                 rng=r, deterministic=deterministic,
+                                 segment_ids=segment_ids)
             if collect_hidden:
                 hidden.append(x)
         if collect_hidden:

@@ -35,6 +35,10 @@ class GPT2Config:
     # consumed by the shared NeoX block body: sequential residuals
     # (rotary is structurally absent — order comes from wpe)
     use_parallel_residual: bool = False
+    # packed ragged batches (runtime/packing.py): loss_fn requires
+    # (tokens, labels, segment_ids) and attention/wpe/loss become
+    # segment-aware (config-drivable via the JSON `packing` block)
+    use_segment_ids: bool = False
 
     @property
     def head_dim(self):
@@ -107,7 +111,7 @@ def init_params(cfg, rng):
     }
 
 
-def block_forward(cfg, params, x, use_pallas=True):
+def block_forward(cfg, params, x, use_pallas=True, segment_ids=None):
     """Pre-LN GPT-2 block with sequential residuals — the shared NeoX
     block body (`gpt_neox._block_core`, one implementation for dense/TP/
     decode) with `use_parallel_residual=False` and a zero rotary dim."""
@@ -116,13 +120,13 @@ def block_forward(cfg, params, x, use_pallas=True):
     cos_sin = (jnp.zeros((s, 0), jnp.float32),
                jnp.zeros((s, 0), jnp.float32), 0)
     return _block_core(cfg, params, x, cos_sin, use_pallas, mp=1,
-                       reduce_fn=lambda t: t)
+                       reduce_fn=lambda t: t, segment_ids=segment_ids)
 
 
 def forward_hidden(cfg, params, tokens, use_pallas=True,
                    remat_blocks=False, scan_blocks=False,
                    remat_policy=None, number_checkpoints=None,
-                   boundary_fn=None):
+                   boundary_fn=None, segment_ids=None):
     """tokens [B, S] → final-norm hidden [B, S, H].
 
     `scan_blocks` runs the (identically-shaped) blocks as ONE
@@ -131,15 +135,26 @@ def forward_hidden(cfg, params, tokens, use_pallas=True,
     O(1) in depth instead of O(L). Remat knobs (`remat_policy`,
     `number_checkpoints`, `boundary_fn`) follow `gpt_neox.forward_hidden`
     — same resolution (`gpt_neox.resolve_remat`), same segmented-scan
-    checkpointing (`gpt_neox.segmented_scan_blocks`)."""
+    checkpointing (`gpt_neox.segmented_scan_blocks`).
+
+    `segment_ids` [B, S] (packed ragged batches, 0 = pad): attention
+    becomes intra-document, and the learned position table is gathered
+    at each token's intra-document position (a packed document sees the
+    same wpe rows as the same document padded alone)."""
     from .gpt_neox import (resolve_remat, scan_stacked_blocks,
                            segmented_scan_blocks)
     S = tokens.shape[1]
-    x = params["embed"]["wte"][tokens] + \
-        params["embed"]["wpe"][:S][None]
+    if segment_ids is None:
+        wpe = params["embed"]["wpe"][:S][None]
+    else:
+        from ..runtime.packing import segment_relative_positions
+        wpe = params["embed"]["wpe"][
+            segment_relative_positions(segment_ids)]       # [B, S, H]
+    x = params["embed"]["wte"][tokens] + wpe
     do_remat, policy, n_ckpt = resolve_remat(remat_blocks, remat_policy,
                                              number_checkpoints)
-    block_fn = partial(block_forward, cfg, use_pallas=use_pallas)
+    block_fn = partial(block_forward, cfg, use_pallas=use_pallas,
+                       segment_ids=segment_ids)
     if n_ckpt is not None and len(params["blocks"]) > 1:
         x = segmented_scan_blocks(lambda bp, x: block_fn(bp, x), x,
                                   params["blocks"], n_ckpt, policy=policy,
@@ -198,12 +213,16 @@ class GPT2:
         self._ckpt_boundary_fn = None
 
     def apply_ds_config(self, ds_config, mesh=None):
-        """Wire the JSON `activation_checkpointing` block into the remat
-        knobs; moe/sequence_parallel stay loud failures (shared helpers
-        with the NeoX family)."""
+        """Wire the JSON `activation_checkpointing` / `packing` blocks
+        into the model; moe/sequence_parallel stay loud failures (shared
+        helpers with the NeoX family)."""
+        import dataclasses
         from .gpt_neox import (apply_activation_checkpointing_config,
                                reject_unsupported_ds_blocks)
         reject_unsupported_ds_blocks(ds_config, "GPT2")
+        if getattr(ds_config, "packing_params", None):
+            self.config = dataclasses.replace(self.config,
+                                              use_segment_ids=True)
         apply_activation_checkpointing_config(self, ds_config, mesh)
 
     def init_params(self, rng):
@@ -224,13 +243,22 @@ class GPT2:
                        number_checkpoints=self.number_checkpoints)
 
     def loss_fn(self, params, batch, rng=None):
-        tokens, labels = batch if isinstance(batch, (tuple, list)) \
-            else (batch, batch)
+        from .gpt_neox import split_lm_batch
+        tokens, labels, seg = split_lm_batch(batch)
+        if self.config.use_segment_ids and seg is None:
+            raise ValueError(
+                "packing is enabled (use_segment_ids) but the batch has "
+                "no segment_ids: feed (tokens, labels, segment_ids) "
+                "triples (runtime.packing.PackedDataset emits them)")
+        if seg is not None:
+            from ..runtime.packing import mask_cross_document_labels
+            labels = mask_cross_document_labels(labels, seg)
         hidden = forward_hidden(self.config, params, tokens,
                                 use_pallas=self.use_pallas,
                                 remat_blocks=self.remat_blocks,
                                 scan_blocks=self.scan_blocks,
                                 remat_policy=self.remat_policy,
                                 number_checkpoints=self.number_checkpoints,
-                                boundary_fn=self._ckpt_boundary_fn)
+                                boundary_fn=self._ckpt_boundary_fn,
+                                segment_ids=seg)
         return fused_lm_head_loss(hidden, params["embed"]["wte"], labels)

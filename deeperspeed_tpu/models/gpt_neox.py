@@ -69,6 +69,17 @@ class GPTNeoXConfig:
     moe_a2a_overlap_chunks: int = 1
     # renormalize top-2 combine weights over capacity-surviving choices
     moe_renorm_kept_choices: bool = False
+    # packed ragged batches (runtime/packing.py): loss_fn REQUIRES
+    # (tokens, labels, segment_ids) and attention/rotary/loss all become
+    # segment-aware. Config-drivable via the JSON `packing` block. A
+    # 3-tuple batch activates the same path without the flag; the flag
+    # makes a missing segment_ids a loud error instead of silent
+    # cross-document attention.
+    use_segment_ids: bool = False
+    # long-context attention engine: "dense" (flash, default) or
+    # "sparse" (SparseSelfAttention over the JSON `sparse_attention`
+    # block's pattern — local+global / strided per the reference)
+    attention_engine: str = "dense"
 
     @property
     def head_dim(self):
@@ -240,23 +251,76 @@ def _rotate_half(x):
 
 
 def apply_rotary(q, k, cos, sin, rot_dim):
-    """Rotary embedding on the first rot_dim dims of q/k [B, S, H, D]."""
+    """Rotary embedding on the first rot_dim dims of q/k [B, S, H, D].
+
+    cos/sin are [S, rot] (shared position stream) or [B, S, rot]
+    (per-batch positions — packed batches gather the cache at each
+    token's INTRA-document position, so a packed document sees the same
+    rotary stream as the same document padded alone)."""
     q_rot, q_pass = q[..., :rot_dim], q[..., rot_dim:]
     k_rot, k_pass = k[..., :rot_dim], k[..., rot_dim:]
-    cos = cos[None, :, None, :].astype(q.dtype)
-    sin = sin[None, :, None, :].astype(q.dtype)
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    cos = cos.astype(q.dtype)
+    sin = sin.astype(q.dtype)
     q_rot = q_rot * cos + _rotate_half(q_rot) * sin
     k_rot = k_rot * cos + _rotate_half(k_rot) * sin
     return (jnp.concatenate([q_rot, q_pass], axis=-1),
             jnp.concatenate([k_rot, k_pass], axis=-1))
 
 
-def causal_attention(q, k, v, use_pallas=True):
+def _parse_env_blocks(env_name, shape):
+    """'bq,bk' env override → (bq, bk) validated against `shape`, or
+    None when unset (shared by DS_FLASH_BLOCKS / DS_FLASH_BWD_BLOCKS)."""
+    from ..ops.pallas.flash_attention import flash_attention_supported
+    env_blocks = os.environ.get(env_name)
+    if not env_blocks:
+        return None
+    try:
+        bq, bk = (int(x) for x in env_blocks.split(","))
+    except ValueError as e:
+        raise ValueError(
+            f"{env_name} must be 'bq,bk' ints, got {env_blocks!r}") from e
+    if not flash_attention_supported(shape, bq, bk):
+        raise ValueError(
+            f"{env_name}={env_blocks} does not fit seq {shape[1]} "
+            f"(needs a 128-multiple block dividing the sequence)")
+    return bq, bk
+
+
+def _flash_dispatch(shape, dtype):
+    """Resolve (fwd_blocks, bwd_blocks) for a causal flash call:
+    env overrides first (perf A/B), then the measured autotune picks —
+    always at long sequences, opt-in (DS_TPU_AUTOTUNE=1) below. Either
+    may be None (= static default fwd / reuse-fwd bwd)."""
+    from ..ops.autotune import flash_blocks_for, flash_bwd_blocks_for
+    fwd = _parse_env_blocks("DS_FLASH_BLOCKS", shape)
+    if fwd is None:
+        fwd = flash_blocks_for(shape, dtype, True)
+    bwd = _parse_env_blocks("DS_FLASH_BWD_BLOCKS", shape)
+    if bwd is None:
+        bwd = flash_bwd_blocks_for(shape, dtype, True, fwd_blocks=fwd)
+    return fwd, bwd
+
+
+def causal_attention(q, k, v, use_pallas=True, segment_ids=None):
     """Causal MHA core on [B, S, H, D]; fp32 softmax accumulation.
 
     Uses the Pallas flash-attention kernel on TPU when shapes allow;
     XLA-fused fallback otherwise (the fallback still fuses well — softmax
-    and the PV matmul land on the MXU).
+    and the PV matmul land on the MXU). Block geometry: DS_FLASH_BLOCKS /
+    DS_FLASH_BWD_BLOCKS env overrides, else the autotuner's measured
+    picks (forward and backward dispatched INDEPENDENTLY — the bwd
+    dkv/dq working set is larger, so its winner is usually narrower).
+
+    `segment_ids` [B, S] int32 (packed ragged batches, 0 = pad) makes
+    attention intra-document: the segmented kernels skip fully-cross-
+    document blocks and mask the stragglers; the XLA fallback ANDs the
+    segment-equality mask into the causal mask.
 
     Every path tags its output with the `attn_residuals` remat name (the
     flash custom_vjp additionally tags its saved out/LSE residuals), so
@@ -266,48 +330,26 @@ def causal_attention(q, k, v, use_pallas=True):
         tag_attn_residual
     if use_pallas:
         try:
-            from ..ops.pallas.flash_attention import flash_attention_supported
-            from ..ops.pallas.flash_attention import flash_attention
+            from ..ops.pallas.flash_attention import (
+                BLOCK_K, BLOCK_Q, flash_attention,
+                flash_attention_segmented, flash_attention_supported)
             if flash_attention_supported(q.shape):
-                from ..ops.autotune import flash_blocks_for
-                env_blocks = os.environ.get("DS_FLASH_BLOCKS")
-                if env_blocks:
-                    # explicit geometry override (perf A/B): "bq,bk" —
-                    # e.g. 512,512 trades online-softmax overhead for
-                    # per-instance VMEM headroom (the compacted grid
-                    # already skips causal dead blocks at any geometry)
-                    try:
-                        bq, bk = (int(x) for x in env_blocks.split(","))
-                    except ValueError as e:
-                        raise ValueError(
-                            f"DS_FLASH_BLOCKS must be 'bq,bk' ints, got "
-                            f"{env_blocks!r}") from e
-                    if not flash_attention_supported(q.shape, bq, bk):
-                        raise ValueError(
-                            f"DS_FLASH_BLOCKS={env_blocks} does not fit "
-                            f"seq {q.shape[1]} (needs a 128-multiple "
-                            f"block dividing the sequence)")
-                    return flash_attention(q, k, v, causal=True,
-                                           sm_scale=None, block_q=bq,
-                                           block_k=bk)
-                # measure-once block pick (reference gemm_test.h
-                # contract), cached per shape/device: always for long
-                # sequences, opt-in (DS_TPU_AUTOTUNE=1) below that
-                blocks = flash_blocks_for(q.shape, q.dtype, True)
-                if blocks is not None:
-                    return flash_attention(q, k, v, causal=True,
-                                           sm_scale=None,
-                                           block_q=blocks[0],
-                                           block_k=blocks[1])
-                return flash_attention(q, k, v, causal=True)
+                fwd, bwd = _flash_dispatch(q.shape, q.dtype)
+                bq, bk = fwd if fwd is not None else (BLOCK_Q, BLOCK_K)
+                if segment_ids is not None:
+                    return flash_attention_segmented(
+                        q, k, v, segment_ids, True, None, bq, bk, bwd)
+                return flash_attention(q, k, v, True, None, bq, bk, bwd)
         except ImportError:
             pass
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
-    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    logits = jnp.where(mask[None, None, :, :], logits, -1e30)
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, :, :]
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, :, None] == segment_ids[:, None, :])
+    logits = jnp.where(mask[:, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return tag_attn_residual(jnp.einsum("bhqk,bkhd->bqhd", probs, v))
 
@@ -371,21 +413,28 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
 
 
 def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
-                return_kv=False, rng=None, attn_fn=None):
+                return_kv=False, rng=None, attn_fn=None,
+                segment_ids=None):
     """Shared block body: `mp == 1` with identity `reduce_fn` is the
     dense block; TP callers pass pre-sliced params (column/row parallel)
     and a psum reduce; the KV-cached decode step reuses the same
     `_block_qkv`/`_block_post_attn` pieces — one implementation, so the
     paths cannot drift. Biases of row-parallel matmuls are added after
-    the reduce (algebraically identical in the dense case)."""
+    the reduce (algebraically identical in the dense case).
+
+    `segment_ids` [B, S] (packed ragged batches) makes attention
+    intra-document on every path: the default flash/XLA core and any
+    segment-capable `attn_fn` (the SP ring accepts the kwarg)."""
     B, S, h = x.shape
     cos, sin, rot_dim = cos_sin
     q, k, v = _block_qkv(cfg, params, x, cos, sin, rot_dim,
                          cfg.num_heads // mp)
     if attn_fn is not None:
-        attn = attn_fn(q, k, v)
+        attn = attn_fn(q, k, v) if segment_ids is None else \
+            attn_fn(q, k, v, segment_ids=segment_ids)
     else:
-        attn = causal_attention(q, k, v, use_pallas=use_pallas)
+        attn = causal_attention(q, k, v, use_pallas=use_pallas,
+                                segment_ids=segment_ids)
     out = _block_post_attn(cfg, params, x, attn.reshape(B, S, h // mp),
                            reduce_fn, rng=rng)
     if return_kv:
@@ -394,12 +443,14 @@ def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
 
 
 def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
-                  use_pallas=True, rng=None, attn_fn=None):
+                  use_pallas=True, rng=None, attn_fn=None,
+                  segment_ids=None):
     """One GPT-NeoX block with parallel residual:
     x + attn(ln1(x)) + ffn(ln2(x)). With `cfg.moe_num_experts` the FFN
     is the MoE layer and the return is (out, aux_loss)."""
     return _block_core(cfg, params, x, cos_sin, use_pallas, mp=1,
-                       reduce_fn=lambda t: t, rng=rng, attn_fn=attn_fn)
+                       reduce_fn=lambda t: t, rng=rng, attn_fn=attn_fn,
+                       segment_ids=segment_ids)
 
 
 def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
@@ -524,11 +575,18 @@ def resolve_remat(remat_blocks, remat_policy, number_checkpoints):
 def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
                    collect_hidden=False, rng=None, attn_fn=None,
                    scan_blocks=False, remat_policy=None,
-                   number_checkpoints=None, boundary_fn=None):
+                   number_checkpoints=None, boundary_fn=None,
+                   segment_ids=None):
     """tokens [B, S] int32 → final-norm hidden states [B, S, H]; with
     `collect_hidden` also returns [embed, block outputs..., final norm]
     (the activation-capture path shares this exact forward). With MoE
     enabled, returns (out, aux_loss_total[, hidden]).
+
+    `segment_ids` [B, S] int32 (packed ragged batches, 0 = pad — see
+    `runtime.packing`): attention becomes intra-document on every block,
+    and the rotary cache is gathered at each token's INTRA-document
+    position, so a packed document sees the identical position stream as
+    the same document padded alone.
 
     `scan_blocks` compiles the (identically-shaped) blocks as ONE
     `lax.scan` body — XLA compile time O(1) in depth (the GPT-NeoX-20B
@@ -547,23 +605,31 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
                                              number_checkpoints)
     x = params["embed"]["wte"][tokens]
     cos, sin, rot_dim = _rotary_cache(cfg, tokens.shape[1])
+    if segment_ids is not None and rot_dim:
+        # gather the rotary cache at intra-document positions: [B, S, rot]
+        from ..runtime.packing import segment_relative_positions
+        pos = segment_relative_positions(segment_ids)
+        cos, sin = cos[pos], sin[pos]
     hidden = [x] if collect_hidden else None
 
     plain_block = lambda bp, x, r: block_forward(       # noqa: E731
         cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
-        rng=r, attn_fn=attn_fn)
+        rng=r, attn_fn=attn_fn, segment_ids=segment_ids)
     if do_remat and n_ckpt is None:
         # rot_dim must stay a STATIC python int: routed through
         # jax.checkpoint's traced args it becomes an int32 tracer and
         # the rotary slice bound blows up; close over it instead
+        # (segment_ids rides as an explicit traced arg so per-block remat
+        # replays see the same operand, not a stale closure constant)
         ck = jax.checkpoint(
-            lambda bp, x, cos, sin, r: block_forward(
+            lambda bp, x, cos, sin, seg, r: block_forward(
                 cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
-                rng=r, attn_fn=attn_fn), policy=policy)
+                rng=r, attn_fn=attn_fn, segment_ids=seg), policy=policy)
         # boundary_fn on every block input: per-block remat saves each
         # block's carry, so partition_activations constrains them all
         edge = boundary_fn if boundary_fn is not None else (lambda c: c)
-        block_fn = lambda bp, x, r: ck(bp, edge(x), cos, sin, r)  # noqa: E731,E501
+        block_fn = lambda bp, x, r: ck(bp, edge(x), cos, sin,  # noqa: E731
+                                       segment_ids, r)
     else:
         block_fn = plain_block
     aux_total = jnp.asarray(0.0, jnp.float32)
@@ -715,15 +781,21 @@ def make_partition_boundary(mesh, model_axis=MODEL_AXIS):
 
 
 def reject_unsupported_ds_blocks(ds_config, family):
-    """Families without MoE / sequence-parallel support must fail LOUDLY
-    when a config enables them — the engine calls `apply_ds_config`
-    expecting the blocks to be consumed, and accepting the call would
-    silently train a dense/non-SP model. Shared by GPT-2 and BERT."""
+    """Families without MoE / sequence-parallel / block-sparse support
+    must fail LOUDLY when a config enables them — the engine calls
+    `apply_ds_config` expecting the blocks to be consumed, and accepting
+    the call would silently train a dense/non-SP model. Shared by GPT-2
+    and BERT."""
     if getattr(ds_config, "moe_params", None) or \
             getattr(ds_config, "sequence_parallel_params", None):
         raise NotImplementedError(
             f"{family} does not implement the moe/sequence_parallel "
             "config blocks; use models.gpt_neox.GPTNeoX")
+    if getattr(ds_config, "sparse_attention", None):
+        raise NotImplementedError(
+            f"{family} does not implement the sparse_attention config "
+            "block (the run would silently train with dense attention); "
+            "the block-sparse engine lives on models.gpt_neox.GPTNeoX")
 
 
 def apply_activation_checkpointing_config(model, ds_config, mesh=None):
@@ -762,6 +834,74 @@ def apply_activation_checkpointing_config(model, ds_config, mesh=None):
         model._ckpt_boundary_fn = make_partition_boundary(mesh)
 
 
+def make_sparse_attention(cfg, sparse_params=None):
+    """Build the config-selectable block-sparse long-context attention
+    engine (`cfg.attention_engine == "sparse"`): a `SparseSelfAttention`
+    over the JSON `sparse_attention` block's pattern (local+global
+    `fixed`/`variable` layouts à la the reference's SparseSelfAttention),
+    used as the transformer's attention core.
+
+    A causal LM needs a unidirectional pattern — `attention` defaults to
+    "unidirectional" here (the reference's block default is
+    bidirectional, which would leak future tokens into the LM loss), and
+    an explicitly bidirectional pattern (incl. the structurally
+    bidirectional bigbird/bslongformer modes) is rejected loudly.
+
+    The kernels under it autotune: `SparseSelfAttention` consults
+    `ops.autotune.sparse_block_params` for the (group_q, fanout) grid
+    geometry at the live call shape under DS_TPU_AUTOTUNE, and its auto
+    dispatch hands dense-ish layouts to the masked dense-flash kernel.
+
+    Returns `attn_fn(q, k, v)` for `forward_hidden(attn_fn=...)`."""
+    d = dict(sparse_params or {})
+    d.setdefault("mode", "fixed")
+    d.setdefault("block", 128)
+    d["num_heads"] = cfg.num_heads
+    if d.get("attention") is None:
+        # the JSON parse leaves an unset `attention` as None so this
+        # path can tell "unset" from "asked for bidirectional" — only
+        # the latter should be a hard error on a causal LM
+        d["attention"] = "unidirectional"
+    from ..ops.sparse_attention import SparseSelfAttention
+    from ..ops.sparse_attention.sparsity_config import \
+        sparsity_config_from_dict
+    sc = sparsity_config_from_dict(d)
+    # Default the probe to "bidirectional": a config class that does not
+    # store an `attention` attribute (e.g. DenseSparsityConfig) cannot
+    # express directionality, and the kernel side (get_layout) treats a
+    # missing attribute as bidirectional — accepting it here would
+    # silently leak future tokens.
+    if getattr(sc, "attention", "bidirectional") != "unidirectional":
+        raise ValueError(
+            f"attention_engine 'sparse' on a causal LM needs a "
+            f"unidirectional sparsity pattern; mode {d['mode']!r} with "
+            f"attention={getattr(sc, 'attention', None)!r} attends "
+            f"bidirectionally (future-token leak). Use mode 'fixed' or "
+            f"'variable' with attention='unidirectional'")
+    sp = SparseSelfAttention(sc, max_seq_length=cfg.max_seq_len)
+
+    def attn_fn(q, k, v, segment_ids=None):
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "the block-sparse attention engine is not segment-aware; "
+                "packed batches need attention_engine='dense'")
+        return sp(q, k, v)
+
+    return attn_fn
+
+
+def split_lm_batch(batch):
+    """(tokens, labels, segment_ids) from an engine batch: bare array,
+    (tokens, labels) pair, or packed (tokens, labels, segment_ids)
+    triple. Shared by the GPT-NeoX and GPT-2 loss paths."""
+    if isinstance(batch, (tuple, list)):
+        if len(batch) == 3:
+            return batch[0], batch[1], batch[2]
+        tokens, labels = batch
+        return tokens, labels, None
+    return batch, batch, None
+
+
 class GPTNeoX:
     """Engine-protocol wrapper: loss_fn / init_params / param_specs."""
 
@@ -775,7 +915,24 @@ class GPTNeoX:
         self.remat_policy = remat_policy
         self.number_checkpoints = number_checkpoints
         self._ckpt_boundary_fn = None  # partition_activations constraint
-        self._attn_fn = None   # set by apply_ds_config (sequence parallel)
+        # set by apply_ds_config (sequence parallel / sparse engine)
+        self._attn_fn = None
+        self._sparse_params = None
+        if self.config.attention_engine not in ("dense", "sparse"):
+            raise ValueError(
+                f"attention_engine must be 'dense' or 'sparse', got "
+                f"{self.config.attention_engine!r}")
+
+    def _attention_fn(self):
+        """The attention core `forward_hidden` should use: the SP/sparse
+        attn_fn when configured, with a lazily-built sparse engine for
+        `attention_engine='sparse'` set directly on the config (no JSON
+        block)."""
+        if self._attn_fn is None and \
+                self.config.attention_engine == "sparse":
+            self._attn_fn = make_sparse_attention(self.config,
+                                                  self._sparse_params)
+        return self._attn_fn
 
     def apply_ds_config(self, ds_config, mesh=None):
         """Wire the JSON `moe` / `sequence_parallel` /
@@ -817,6 +974,27 @@ class GPTNeoX:
                     f"{sp['axis']!r}")
             self._attn_fn = SequenceParallel(mesh, axis=sp["axis"],
                                              mode=sp["mode"])
+        packing = getattr(ds_config, "packing_params", None)
+        if packing:
+            self.config = dataclasses.replace(self.config,
+                                              use_segment_ids=True)
+        sparse = getattr(ds_config, "sparse_attention", None)
+        if sparse:
+            if packing:
+                # also rejected at config parse; kept here for direct
+                # apply_ds_config callers
+                raise ValueError(
+                    "packing + sparse_attention is unsupported: the "
+                    "sparse kernels are not segment-aware")
+            if sp:
+                raise NotImplementedError(
+                    "sparse_attention + sequence_parallel is unsupported "
+                    "(the sparse engine runs full-sequence layouts)")
+            self.config = dataclasses.replace(self.config,
+                                              attention_engine="sparse")
+            self._sparse_params = dict(sparse)
+            self._attn_fn = make_sparse_attention(self.config,
+                                                  self._sparse_params)
         apply_activation_checkpointing_config(self, ds_config, mesh)
 
     def init_params(self, rng):
@@ -854,18 +1032,28 @@ class GPTNeoX:
                        number_checkpoints=self.number_checkpoints)
 
     def loss_fn(self, params, batch, rng=None):
-        if isinstance(batch, (tuple, list)):
-            tokens, labels = batch
-        else:
-            tokens = labels = batch
+        tokens, labels, seg = split_lm_batch(batch)
+        if self.config.use_segment_ids and seg is None:
+            raise ValueError(
+                "packing is enabled (use_segment_ids) but the batch has "
+                "no segment_ids: feed (tokens, labels, segment_ids) "
+                "triples (runtime.packing.PackedDataset emits them)")
+        if seg is not None:
+            # cross-document and pad targets carry no signal: their
+            # predictor is a different document's token (or padding) —
+            # ignore_index them so packing changes the loss ONLY via
+            # removed cross-document attention
+            from ..runtime.packing import mask_cross_document_labels
+            labels = mask_cross_document_labels(labels, seg)
         hidden = forward_hidden(self.config, params, tokens,
                                 use_pallas=self.use_pallas,
                                 remat_blocks=self.remat_blocks,
-                                rng=rng, attn_fn=self._attn_fn,
+                                rng=rng, attn_fn=self._attention_fn(),
                                 scan_blocks=self.scan_blocks,
                                 remat_policy=self.remat_policy,
                                 number_checkpoints=self.number_checkpoints,
-                                boundary_fn=self._ckpt_boundary_fn)
+                                boundary_fn=self._ckpt_boundary_fn,
+                                segment_ids=seg)
         aux = None
         if self.config.moe_num_experts:
             hidden, aux = hidden
@@ -894,6 +1082,13 @@ class GPTNeoX:
         from ..runtime.zero.param_offload import StreamPlan
 
         cfg = self.config
+        if cfg.use_segment_ids:
+            # the streamed per-segment block forward below does not
+            # thread segment_ids; silently ignoring them would attend
+            # across documents
+            raise NotImplementedError(
+                "packing (use_segment_ids) is not supported on the "
+                "ZeRO-Infinity param-offload stream path yet")
         use_pallas = self.use_pallas
 
         def tok_lab(batch):
@@ -942,10 +1137,12 @@ class GPTNeoX:
         (fork: `engine.py:222-254` forward hooks); shares
         `forward_hidden` so the capture can never drift from the real
         forward."""
-        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        tokens, _, seg = split_lm_batch(batch)
         res = forward_hidden(self.config, params, tokens,
                              use_pallas=self.use_pallas,
-                             collect_hidden=True, attn_fn=self._attn_fn)
+                             collect_hidden=True,
+                             attn_fn=self._attention_fn(),
+                             segment_ids=seg)
         return res[-1]
 
 

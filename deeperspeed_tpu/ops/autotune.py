@@ -333,6 +333,131 @@ def grouped_matmul_blocks(capacity, k_dim, n_dim, dtype, tuner=None):
     return tuner.pick(key, survivors, measure)
 
 
+def flash_bwd_blocks_for(shape, dtype, causal, fwd_blocks=None,
+                         tuner=None):
+    """Dispatch-time block geometry for the flash BACKWARD (dkv/dq)
+    kernels, or None for "reuse the forward geometry".
+
+    The backward working set per instance is ~2.5× the forward's (q/k/v
+    PLUS do tiles, lse/delta rows, fp32 dk/dv/dq accumulators), so the
+    measured-best backward blocks at ≥8k sequences are usually narrower
+    than the forward winner — PR 1 tuned only the shared geometry, which
+    pinned backward to whatever forward preferred. Gating matches
+    `flash_blocks_for`: long sequences always measure, DS_TPU_AUTOTUNE=1
+    measures everywhere, an explicit DS_TPU_AUTOTUNE=0 is the kill
+    switch. The probe times ONLY the vjp application (residuals are
+    computed once per candidate outside the timed region via jax.vjp),
+    so the pick ranks pure backward cost."""
+    env = os.environ.get(_TUNE_ENV)
+    if env is not None and env in ("0", "", "false", "False"):
+        return None
+    b, s, h, d = shape
+    if not (autotune_enabled() or s >= flash_tune_min_seq()):
+        return None
+
+    from .pallas.flash_attention import (_fit_block, _interpret,
+                                         flash_attention,
+                                         flash_attention_supported)
+    import numpy as np
+    import jax.numpy as jnp
+
+    tuner = tuner or _global_tuner
+    key = ("flash_bwd", tuple(shape), str(dtype), bool(causal))
+    hit = tuner.cached(key)
+    if hit is not None:
+        return hit
+
+    candidates = []
+    for c in FLASH_BLOCK_CANDIDATES:
+        fit = (_fit_block(c[0], s), _fit_block(c[1], s))
+        if 0 in fit or not flash_attention_supported(shape, *c):
+            continue
+        if fit not in candidates:
+            candidates.append(fit)
+    if not candidates:
+        raise ValueError(f"no flash block candidates fit shape {shape}")
+    if len(candidates) == 1 or jax.process_count() > 1 or _interpret():
+        # multi-host: divergent picks lower different programs per host;
+        # interpret mode: timing the interpreter ranks emulation cost
+        return tuner.store(key, candidates[0])
+    itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
+    if b * s * h * d * itemsize * 8 > _MAX_TUNE_BYTES:
+        from ..utils.logging import logger
+        logger.info(
+            f"flash bwd autotune: shape {tuple(shape)} exceeds the probe "
+            f"memory cap; reusing forward blocks")
+        return tuner.store(key, tuple(fwd_blocks)
+                           if fwd_blocks is not None else candidates[0])
+
+    fbq, fbk = fwd_blocks if fwd_blocks is not None else candidates[0]
+    zeros = jnp.zeros(shape, dtype)
+
+    bwd_cache = {}
+
+    def measure(cand):
+        # vjp ONCE per candidate (fwd geometry held FIXED at fbq/fbk so
+        # only the backward differs), memoized so the fwd execution +
+        # trace land in the tuner's first warmup call and the timed
+        # iterations apply only the bwd closure
+        f_bwd = bwd_cache.get(cand)
+        if f_bwd is None:
+            _, f_bwd = jax.vjp(
+                lambda q, k, v: flash_attention(q, k, v, causal, None,
+                                                fbq, fbk, tuple(cand)),
+                zeros, zeros, zeros)
+            bwd_cache[cand] = f_bwd
+        return f_bwd(zeros)
+
+    return tuner.pick(key, candidates, measure)
+
+
+# block-sparse attention (group_q, fanout) candidates, fattest first:
+# bigger groups amortize per-instance fixed cost when adjacent layout
+# rows share columns (windowed/global patterns); bigger fanout fetches
+# more scattered K blocks per grid step. Random-ish patterns (BigBird)
+# prefer smaller groups — the row union drags dead rows otherwise.
+SPARSE_GF_CANDIDATES = ((4, 4), (8, 4), (4, 8), (2, 8), (8, 8), (2, 4),
+                        (2, 2), (1, 4))
+
+
+def sparse_block_params(layout, shape, dtype, causal, sm_scale=None,
+                        tuner=None):
+    """(group_q, fanout) for `BlockSparseAttention` at a given layout and
+    call shape. Static default (4, 4) unless DS_TPU_AUTOTUNE=1, in which
+    case the candidates are measured fwd+bwd on the live device with the
+    ACTUAL layout (pattern structure decides the winner: the row-union
+    LUT tightness differs wildly between windowed and random patterns).
+    Cached per (layout geometry, density, shape, device kind)."""
+    default = SPARSE_GF_CANDIDATES[0]
+    if not autotune_enabled():
+        return default
+    from .pallas.block_sparse_attention import BlockSparseAttention
+    from .pallas.flash_attention import _interpret
+    import numpy as np
+    import jax.numpy as jnp
+
+    tuner = tuner or _global_tuner
+    lay = np.asarray(layout)
+    key = ("sparse_gf", lay.shape, round(float((lay != 0).mean()), 3),
+           tuple(shape), str(dtype), bool(causal))
+    hit = tuner.cached(key)
+    if hit is not None:
+        return hit
+    if jax.process_count() > 1 or _interpret():
+        return tuner.store(key, default)
+
+    zeros = jnp.zeros(shape, dtype)
+
+    def measure(cand):
+        attn = BlockSparseAttention(lay, block=128, causal=causal,
+                                    sm_scale=sm_scale, group=cand[0],
+                                    fanout=cand[1])
+        return jax.grad(lambda q: jnp.sum(
+            attn(q, zeros, zeros).astype(jnp.float32)))(zeros)
+
+    return tuner.pick(key, SPARSE_GF_CANDIDATES, measure)
+
+
 def flash_blocks_for(shape, dtype, causal, tuner=None):
     """Dispatch-time flash block geometry, or None for the built-in
     default. Long sequences (≥ `flash_tune_min_seq()`, env-tunable) and
@@ -396,8 +521,11 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
         return tuner.store(key, candidates[0])
     # Multi-host SPMD: per-host wall-clock picks can disagree, lowering
     # DIFFERENT programs per host → deadlock at the first collective.
-    # Take the deterministic default instead of measuring.
-    if jax.process_count() > 1:
+    # Interpret mode (CPU): measuring would rank Pallas-interpreter
+    # emulation cost — and a 16k probe takes MINUTES per candidate
+    # there. Take the deterministic default instead of measuring.
+    from .pallas.flash_attention import _interpret
+    if jax.process_count() > 1 or _interpret():
         return tuner.store(key, candidates[0])
     # x8: the fwd+bwd probe's live set is q/k/v/out + saved residuals +
     # the cotangent and dq/dk/dv inside _bwd — about twice the old

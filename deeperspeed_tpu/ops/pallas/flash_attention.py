@@ -126,6 +126,12 @@ def causal_grid_size(s, block_q=BLOCK_Q, block_k=BLOCK_K):
 # assert on this instead of re-deriving lowering internals.
 _LAST_GRIDS = {}
 
+# Ditto for dispatched block geometry: {"fwd"/"dkv"/"dq": (bq, bk)} plus
+# {"fwd_variant"/"bwd_variant": "single"/"trapezoid"/"dense"} of the most
+# recent call — the bench longseq rows record these in `extra` so a round
+# documents WHICH geometry produced its numbers.
+_LAST_BLOCKS = {}
+
 
 def _index_adapter(compact, kv_major=False):
     """BlockSpec index maps are written once, in dense (bh, i, j) form;
@@ -471,12 +477,14 @@ def _fwd_single(qb, kb, vb, causal, sm_scale, s, d, interpret, kbias=None,
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
-                use_mask=False, use_bias=False, dropout_rate=0.0,
-                compact=False):
+                use_seg=False, use_mask=False, use_bias=False,
+                dropout_rate=0.0, compact=False):
     it = iter(refs)
     if compact:
         qmap_ref, kmap_ref = next(it), next(it)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    sq_ref = next(it) if use_seg else None
+    sk_ref = next(it) if use_seg else None
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
     seed_ref = next(it) if dropout_rate > 0.0 else None
@@ -506,6 +514,14 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
     run = True
     if causal and not compact:
         run = ki * block_k <= qi * block_q + (block_q - 1)
+    seg_eq = None
+    if use_seg:
+        # [BQ, 1] vs [1, BK] segment-id equality: the elementwise mask
+        # AND the block-level skip — a tile whose q and k blocks share
+        # no document runs NO matmul/softmax work (the compare itself is
+        # O(BQ·BK) VPU next to the O(BQ·BK·D) MXU work it gates)
+        seg_eq = sq_ref[0].reshape(-1, 1) == sk_ref[0]
+        run = jnp.logical_and(run, jnp.any(seg_eq))
 
     @pl.when(run)
     def _compute():
@@ -518,6 +534,8 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
             preferred_element_type=jnp.float32) * sm_scale    # [BQ, BK]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if seg_eq is not None:
+            s = jnp.where(seg_eq, s, NEG_INF)
         if m_ref is not None:
             s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
         if b_ref is not None:
@@ -529,7 +547,7 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                       # [BQ, 1]
         p = jnp.exp(s - m_new)                                # [BQ, BK]
-        if m_ref is not None or b_ref is not None:
+        if seg_eq is not None or m_ref is not None or b_ref is not None:
             # rows with EVERY entry masked would otherwise see
             # exp(s - max) == 1 uniformly; zero masked entries so l==0
             # flags the dead row (poisoned-lse convention)
@@ -594,7 +612,7 @@ def _tag_residuals(out, lse):
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
-         layout=None, kbias=None, dropout_rate=0.0, seed=None):
+         layout=None, kbias=None, dropout_rate=0.0, seed=None, seg=None):
     b, s, h, d = q.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
 
@@ -605,9 +623,11 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     n_q, n_k = s // block_q, s // block_k
 
-    if n_q == 1 and n_k == 1 and layout is None:
+    if n_q == 1 and n_k == 1 and layout is None and seg is None:
         # whole sequence in one block: the online-softmax machinery is
         # pure overhead — run the specialized straight-softmax kernel
+        _LAST_BLOCKS["fwd"] = (s, s)
+        _LAST_BLOCKS["fwd_variant"] = "single"
         out, lse = _fwd_single(qb, kb, vb, causal, sm_scale, s, d,
                                _interpret(), kbias=kbias, h=h,
                                dropout_rate=dropout_rate, seed=seed)
@@ -616,9 +636,12 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         return out4, (qb, kb, vb, out, lse.reshape(b * h, s))
 
     compact = causal   # causal ⇒ trapezoidal schedule (no dead launches)
+    _LAST_BLOCKS["fwd"] = (block_q, block_k)
+    _LAST_BLOCKS["fwd_variant"] = "trapezoid" if compact else "dense"
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, n_k=n_k,
+                               use_seg=seg is not None,
                                use_mask=layout is not None,
                                use_bias=kbias is not None,
                                dropout_rate=dropout_rate,
@@ -647,6 +670,15 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
                      ix(lambda bh, qi, ki: (bh, 0, qi))),
     ]
     inputs = [qb, kb, vb]
+    if seg is not None:
+        # per-token segment ids [B, 1, S]: one q-row slice and one k-row
+        # slice per tile (same batch-indexed layout as the kbias row)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_q), ix(lambda bh, qi, ki, h=h: (bh // h, 0, qi))))
+        inputs.append(seg)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), ix(lambda bh, qi, ki, h=h: (bh // h, 0, ki))))
+        inputs.append(seg)
     if layout is not None:
         in_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN,
                                    ix))
@@ -894,13 +926,15 @@ def _bwd_single(qb, kb, vb, do, lse, delta, causal, sm_scale, s, d,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, n_q=None,
-                    use_mask=False, use_bias=False, dropout_rate=0.0,
-                    compact=False):
+                    use_seg=False, use_mask=False, use_bias=False,
+                    dropout_rate=0.0, compact=False):
     it = iter(refs)
     if compact:
         qmap_ref, kmap_ref = next(it), next(it)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    sq_ref = next(it) if use_seg else None
+    sk_ref = next(it) if use_seg else None
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
     seed_ref = next(it) if dropout_rate > 0.0 else None
@@ -926,6 +960,12 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, n_q=None,
     run = True
     if causal and not compact:
         run = ki * block_k <= qi * block_q + (block_q - 1)
+    seg_eq = None
+    if use_seg:
+        # block-level document skip, mirroring the forward kernel: a
+        # fully-cross-segment tile contributes zero to dk/dv
+        seg_eq = sq_ref[0].reshape(-1, 1) == sk_ref[0]
+        run = jnp.logical_and(run, jnp.any(seg_eq))
 
     @pl.when(run)
     def _compute():
@@ -936,6 +976,8 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, n_q=None,
             preferred_element_type=jnp.float32) * sm_scale   # [BQ, BK]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if seg_eq is not None:
+            s = jnp.where(seg_eq, s, NEG_INF)
         if m_ref is not None:
             s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
         if b_ref is not None:
@@ -974,13 +1016,15 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, n_q=None,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
-                   use_mask=False, use_bias=False, dropout_rate=0.0,
-                   compact=False):
+                   use_seg=False, use_mask=False, use_bias=False,
+                   dropout_rate=0.0, compact=False):
     it = iter(refs)
     if compact:
         qmap_ref, kmap_ref = next(it), next(it)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     do_ref, lse_ref, delta_ref = next(it), next(it), next(it)
+    sq_ref = next(it) if use_seg else None
+    sk_ref = next(it) if use_seg else None
     m_ref = next(it) if use_mask else None
     b_ref = next(it) if use_bias else None
     seed_ref = next(it) if dropout_rate > 0.0 else None
@@ -1002,6 +1046,10 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
     run = True
     if causal and not compact:
         run = ki * block_k <= qi * block_q + (block_q - 1)
+    seg_eq = None
+    if use_seg:
+        seg_eq = sq_ref[0].reshape(-1, 1) == sk_ref[0]
+        run = jnp.logical_and(run, jnp.any(seg_eq))
 
     @pl.when(run)
     def _compute():
@@ -1012,6 +1060,8 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if seg_eq is not None:
+            s = jnp.where(seg_eq, s, NEG_INF)
         if m_ref is not None:
             s = _apply_layout_mask(s, m_ref, qi, ki, block_q, block_k)
         if b_ref is not None:
@@ -1037,7 +1087,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, n_k=None,
 
 
 def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
-         kbias=None, dropout_rate=0.0, seed=None):
+         kbias=None, dropout_rate=0.0, seed=None, seg=None):
     qb, kb, vb, out, lse = res
     bh, s, d = qb.shape
     block_q, block_k = _fit_block(block_q, s), _fit_block(block_k, s)
@@ -1054,10 +1104,13 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
                     axis=-1).reshape(bh, 1, s)                # [BH, 1, S]
 
     n_q, n_k = s // block_q, s // block_k
+    use_seg = seg is not None
     use_mask = layout is not None
     use_bias = kbias is not None
 
-    if n_q == 1 and n_k == 1 and not use_mask:
+    if n_q == 1 and n_k == 1 and not use_mask and not use_seg:
+        _LAST_BLOCKS["dkv"] = _LAST_BLOCKS["dq"] = (s, s)
+        _LAST_BLOCKS["bwd_variant"] = "single"
         dq, dk, dv = _bwd_single(qb, kb, vb, do, lse, delta, causal,
                                  sm_scale, s, d, _interpret(),
                                  kbias=kbias, h=h,
@@ -1069,9 +1122,12 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
         return from_bh1(dq), from_bh1(dk), from_bh1(dv)
 
     compact = causal   # mirror the forward's trapezoidal schedule
+    _LAST_BLOCKS["dkv"] = _LAST_BLOCKS["dq"] = (block_q, block_k)
+    _LAST_BLOCKS["bwd_variant"] = "trapezoid" if compact else "dense"
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, n_q=n_q,
+                                   use_seg=use_seg,
                                    use_mask=use_mask,
                                    use_bias=use_bias,
                                    dropout_rate=dropout_rate,
@@ -1109,6 +1165,15 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
                      ixc(lambda bh, ki, qi: (bh, ki, 0))),
     ]
     dkv_inputs = [qb, kb, vb, do, lse, delta]
+    if use_seg:
+        dkv_specs.append(pl.BlockSpec(
+            (1, 1, block_q),
+            ixc(lambda bh, ki, qi, h=h: (bh // h, 0, qi))))
+        dkv_inputs.append(seg)
+        dkv_specs.append(pl.BlockSpec(
+            (1, 1, block_k),
+            ixc(lambda bh, ki, qi, h=h: (bh // h, 0, ki))))
+        dkv_inputs.append(seg)
     if use_mask:
         dkv_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN,
                                     ixc))
@@ -1137,6 +1202,7 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
                                   block_k=block_k, n_k=n_k,
+                                  use_seg=use_seg,
                                   use_mask=use_mask,
                                   use_bias=use_bias,
                                   dropout_rate=dropout_rate,
@@ -1169,6 +1235,15 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
     dq_out_spec = pl.BlockSpec(
         (1, block_q, d), ix(lambda bh, qi, ki: (bh, qi, 0)))
     dq_inputs = [qb, kb, vb, do, lse, delta]
+    if use_seg:
+        dq_specs.append(pl.BlockSpec(
+            (1, 1, block_q),
+            ix(lambda bh, qi, ki, h=h: (bh // h, 0, qi))))
+        dq_inputs.append(seg)
+        dq_specs.append(pl.BlockSpec(
+            (1, 1, block_k),
+            ix(lambda bh, qi, ki, h=h: (bh // h, 0, ki))))
+        dq_inputs.append(seg)
     if use_mask:
         dq_specs.append(_mask_spec(h, s // MASK_GRAIN, s // MASK_GRAIN,
                                    ix))
@@ -1193,26 +1268,86 @@ def _bwd(causal, sm_scale_arg, block_q, block_k, res, g, layout=None,
     return from_bh(dq), from_bh(dk), from_bh(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=True, sm_scale=None, block_q=BLOCK_Q,
-                    block_k=BLOCK_K):
-    """Tiled online-softmax attention on [B, S, H, D]."""
+                    block_k=BLOCK_K, bwd_blocks=None):
+    """Tiled online-softmax attention on [B, S, H, D].
+
+    `bwd_blocks` (optional `(bwd_block_q, bwd_block_k)` tuple) gives the
+    dkv/dq backward kernels their OWN block geometry: the backward
+    working set is larger (q/k/v/do tiles plus lse/delta rows and fp32
+    accumulators), so at ≥8k sequences the measured-best backward blocks
+    are usually narrower than the forward's. None = reuse the forward
+    geometry (the pre-tuning behaviour). The saved residuals (out, lse)
+    are block-independent, so fwd/bwd geometry can differ freely."""
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     out, _ = _fwd(q, k, v, causal, scale, block_q, block_k)
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, bwd_blocks):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     out, res = _fwd(q, k, v, causal, scale, block_q, block_k)
     return out, res
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    return _bwd(causal, sm_scale, block_q, block_k, res, g)
+def _flash_bwd(causal, sm_scale, block_q, block_k, bwd_blocks, res, g):
+    bbq, bbk = bwd_blocks if bwd_blocks is not None else (block_q, block_k)
+    return _bwd(causal, sm_scale, bbq, bbk, res, g)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention_segmented(q, k, v, segment_ids, causal=True,
+                              sm_scale=None, block_q=BLOCK_Q,
+                              block_k=BLOCK_K, bwd_blocks=None):
+    """Flash attention over PACKED ragged batches: tokens attend only
+    within their own document (`segment_ids` [B, S] int32, 0 = pad —
+    see `runtime.packing`), composed with the causal mask.
+
+    Masking is block-granular first, element-granular second: each tile
+    compares its q-block and k-block segment-id slices and SKIPS the
+    whole tile (no matmul, no softmax — the same `pl.when` gate as the
+    dense grid's causal gating) when no id is shared; surviving tiles
+    mask the stray cross-document elements to -inf. The fwd, dkv and dq
+    kernels all carry the gate, so packed batches spend MXU time only on
+    intra-document attention. Fully-masked rows follow the layout-mask
+    kernels' poisoned-lse convention (zero output, zero grads).
+
+    segment_ids is data, not a parameter: its cotangent is float0
+    (int inputs cannot carry gradients). `bwd_blocks` as in
+    `flash_attention`.
+    """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    seg3 = segment_ids.astype(jnp.int32).reshape(
+        segment_ids.shape[0], 1, -1)
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, seg=seg3)
+    return out
+
+
+def _flash_seg_fwd(q, k, v, segment_ids, causal, sm_scale, block_q,
+                   block_k, bwd_blocks):
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    seg3 = segment_ids.astype(jnp.int32).reshape(
+        segment_ids.shape[0], 1, -1)
+    out, res = _fwd(q, k, v, causal, scale, block_q, block_k, seg=seg3)
+    return out, (res, segment_ids)
+
+
+def _flash_seg_bwd(causal, sm_scale, block_q, block_k, bwd_blocks,
+                   res_seg, g):
+    import numpy as np
+    res, segment_ids = res_seg
+    seg3 = segment_ids.astype(jnp.int32).reshape(
+        segment_ids.shape[0], 1, -1)
+    bbq, bbk = bwd_blocks if bwd_blocks is not None else (block_q, block_k)
+    dq, dk, dv = _bwd(causal, sm_scale, bbq, bbk, res, g, seg=seg3)
+    return dq, dk, dv, np.zeros(segment_ids.shape, jax.dtypes.float0)
+
+
+flash_attention_segmented.defvjp(_flash_seg_fwd, _flash_seg_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))

@@ -206,7 +206,8 @@ class DeepSpeedTransformerLayer:
 
     # -- forward -----------------------------------------------------------
 
-    def _attention(self, params, x, attention_mask, rng, deterministic):
+    def _attention(self, params, x, attention_mask, rng, deterministic,
+                   segment_ids=None):
         cfg = self.config
         b, s, h = x.shape
         heads = cfg.heads
@@ -247,7 +248,41 @@ class DeepSpeedTransformerLayer:
         # biases fall back to the materialized path.
         attn_drop_active = (not deterministic and
                             cfg.attn_dropout_ratio > 0 and rng is not None)
-        if (additive_mask is None or kbias is not None) and \
+        if segment_ids is not None:
+            # packed ragged batches (bidirectional): intra-document
+            # attention via the segmented flash kernel when the shape
+            # and option set allow (no per-key bias, no in-kernel
+            # dropout — those kernels carry no segment gate), else the
+            # materialized pairwise-mask path below
+            if (additive_mask is None and not attn_drop_active and
+                    s >= _flash_min_seq() and
+                    flash_attention_supported((b, s, heads, hd))):
+                from ..autotune import (flash_blocks_for,
+                                        flash_bwd_blocks_for)
+                from ..pallas.flash_attention import (
+                    BLOCK_K, BLOCK_Q, flash_attention_segmented)
+                # same tuned geometry + min-seq gating as the dense
+                # branch below: the static square default was the
+                # measured long-context MFU cliff, and packed encoder
+                # batches hit the identical kernels
+                shape = (b, s, heads, hd)
+                blocks = flash_blocks_for(shape, q.dtype, False)
+                bq, bk = blocks if blocks is not None \
+                    else (BLOCK_Q, BLOCK_K)
+                bwd = flash_bwd_blocks_for(shape, q.dtype, False,
+                                           fwd_blocks=blocks)
+                ctx = flash_attention_segmented(q, k, v, segment_ids,
+                                                False, None, bq, bk, bwd)
+                ctx = ctx.reshape(b, s, h)
+                return ctx @ params["attn_ow"].astype(x.dtype) + \
+                    params["attn_ob"].astype(x.dtype)
+            seg_pen = jnp.where(
+                segment_ids[:, None, :, None] ==
+                segment_ids[:, None, None, :], 0.0, -1e30)  # [B,1,S,S]
+            additive_mask = seg_pen if additive_mask is None else \
+                additive_mask + seg_pen
+        if segment_ids is None and \
+                (additive_mask is None or kbias is not None) and \
                 s >= _flash_min_seq() and \
                 flash_attention_supported((b, s, heads, hd)):
             # measured block geometry for long sequences (and opt-in
@@ -290,7 +325,7 @@ class DeepSpeedTransformerLayer:
             params["output_b"].astype(x.dtype)
 
     def apply(self, params, x, attention_mask=None, rng=None,
-              deterministic=None):
+              deterministic=None, segment_ids=None):
         cfg = self.config
         if deterministic is None:
             deterministic = not cfg.training
@@ -303,11 +338,13 @@ class DeepSpeedTransformerLayer:
                 normed = _layer_norm(x, params["attn_nw"],
                                      params["attn_nb"], eps)
                 attn = self._attention(params, normed, attention_mask,
-                                       rngs[0], deterministic)
+                                       rngs[0], deterministic,
+                                       segment_ids=segment_ids)
                 return x + _dropout(attn, cfg.hidden_dropout_ratio, rngs[1],
                                     deterministic)
             attn = self._attention(params, x, attention_mask, rngs[0],
-                                   deterministic)
+                                   deterministic,
+                                   segment_ids=segment_ids)
             attn = _dropout(attn, cfg.hidden_dropout_ratio, rngs[1],
                             deterministic)
             return _layer_norm(x + attn, params["attn_nw"],

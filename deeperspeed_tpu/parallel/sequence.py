@@ -37,7 +37,7 @@ NEG_INF = -1e30
 
 
 def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
-                   axis_size=None):
+                   axis_size=None, segment_ids=None):
     """Ring attention inside shard_map: inputs are the local sequence
     shard [B, S/n, H, D]; returns the local output shard.
 
@@ -46,6 +46,12 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
     flash-style (m, l, acc) online softmax; `ppermute` then forwards k/v
     to the next neighbor. Unrolled over the (static) axis size so XLA
     overlaps each hop with the previous step's matmuls.
+
+    `segment_ids` (local shard [B, S/n] int32, 0 = pad — see
+    `runtime.packing`) makes attention intra-document: the k-side ids
+    ride the same ring as k/v and each fold ANDs the segment-equality
+    mask into the causal keep. A packed document split across ranks
+    still attends to all of itself — the ring walks every kv chunk.
     """
     n = axis_size
     if not isinstance(n, int):
@@ -61,6 +67,7 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
     acc = jnp.zeros((b, s_local, h, d), jnp.float32)
 
     k_cur, v_cur = k, v
+    seg_cur = segment_ids
     for step in range(n):
         src = (my_idx - step) % n
         logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
@@ -71,11 +78,16 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
             rows = jnp.arange(s_local)[:, None] + my_idx * s_local
             cols = jnp.arange(s_local)[None, :] + src * s_local
             keep = rows >= cols
+        if segment_ids is not None:
+            seg_eq = segment_ids[:, :, None] == seg_cur[:, None, :]
+            keep = seg_eq if keep is None else keep[None] & seg_eq
         m_run, l_run, acc = _osm_fold(m_run, l_run, acc, logits, v_cur,
                                       keep)
         if step < n - 1:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            if seg_cur is not None:
+                seg_cur = jax.lax.ppermute(seg_cur, axis_name, perm)
 
     l_safe = jnp.maximum(l_run, 1e-30)
     out = acc / l_safe.transpose(0, 2, 1)[..., None]
@@ -85,9 +97,11 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
 def _osm_fold(m, l, acc, logits, v, mask=None):
     """One online-softmax fold: merge a [B, H, R, C] logits tile (keys'
     values v [B, C, H, D]) into the running (m [B, H, R], l, acc
-    [B, R, H, D]) statistics."""
+    [B, R, H, D]) statistics. `mask` is [R, C] (shared across batch) or
+    [B, R, C] (segment masks differ per row)."""
     if mask is not None:
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        mask = mask[None, None] if mask.ndim == 2 else mask[:, None]
+        logits = jnp.where(mask, logits, NEG_INF)
     m_c = jnp.max(logits, axis=-1)
     m_new = jnp.maximum(m, m_c)
     p = jnp.exp(logits - m_new[..., None])
@@ -111,7 +125,7 @@ def zigzag_chunk_order(n):
 
 
 def ring_attention_balanced(q, k, v, axis_name, sm_scale=None,
-                            axis_size=None):
+                            axis_size=None, segment_ids=None):
     """Causal ring attention over ZIGZAG shards inside shard_map: the
     local [B, S/n, H, D] shard holds global chunks (r, 2n-1-r) (see
     `zigzag_chunk_order`; `SequenceParallel` applies the permutation).
@@ -130,6 +144,14 @@ def ring_attention_balanced(q, k, v, axis_name, sm_scale=None,
     Step 0 (own kv) folds the dense 2c×2c tile under the static zigzag
     diagonal mask [[tril, 0], [1, tril]]. Total per-step flops are
     rank-independent — the property the contiguous causal ring lacks.
+
+    `segment_ids` (local ZIGZAG shard [B, S/n], 0 = pad) makes attention
+    intra-document: ids ride the ring alongside k/v and every fold —
+    the step-0 diagonal tile and both off-diagonal tiles — ANDs the
+    segment-equality mask into its keep mask. The zigzag permutation
+    does not break segment semantics (ids are compared by VALUE, not
+    position), so a document straddling the head/tail chunk split still
+    attends to all of itself.
     """
     n = axis_size
     if not isinstance(n, int):
@@ -157,23 +179,38 @@ def ring_attention_balanced(q, k, v, axis_name, sm_scale=None,
     ], axis=0)
     logits0 = jnp.einsum("bqhd,bkhd->bhqk", q32, k.astype(jnp.float32),
                          preferred_element_type=jnp.float32) * scale
+    mask0 = mask0[None]
+    if segment_ids is not None:
+        mask0 = mask0 & (segment_ids[:, :, None] ==
+                         segment_ids[:, None, :])
     m_run, l_run, acc = _osm_fold(m_run, l_run, acc, logits0, v, mask0)
 
     k_cur, v_cur = k, v
+    seg_cur = segment_ids
+    seg_head_q = seg_tail_q = None
+    if segment_ids is not None:
+        seg_head_q, seg_tail_q = segment_ids[:, :c], segment_ids[:, c:]
     q_head, q_tail = q32[:, :c], q32[:, c:]
     for step in range(1, n):
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        if seg_cur is not None:
+            seg_cur = jax.lax.ppermute(seg_cur, axis_name, perm)
         k32 = k_cur.astype(jnp.float32)
         k_head, k_tail = k32[:, :c], k32[:, c:]
         v_head, v_tail = v_cur[:, :c], v_cur[:, c:]
 
         # tile A: tail rows × kv head chunk — always fully alive
+        # causally (segments may still mask elements within it)
         m_t, l_t = m_run[:, :, c:], l_run[:, :, c:]
         acc_t = acc[:, c:]
         logits_a = jnp.einsum("bqhd,bkhd->bhqk", q_tail, k_head,
                               preferred_element_type=jnp.float32) * scale
-        m_t, l_t, acc_t = _osm_fold(m_t, l_t, acc_t, logits_a, v_head)
+        mask_a = None
+        if seg_cur is not None:
+            mask_a = seg_tail_q[:, :, None] == seg_cur[:, None, :c]
+        m_t, l_t, acc_t = _osm_fold(m_t, l_t, acc_t, logits_a, v_head,
+                                    mask_a)
 
         # tile B: kv source rank src = (my - step) mod n precedes this
         # rank (src < my ⇔ step ≤ my) → head rows × kv head chunk;
@@ -189,8 +226,13 @@ def ring_attention_balanced(q, k, v, axis_name, sm_scale=None,
         acc_sel = jnp.where(to_head, acc_h, acc_t)
         logits_b = jnp.einsum("bqhd,bkhd->bhqk", q_b, k_b,
                               preferred_element_type=jnp.float32) * scale
+        mask_b = None
+        if seg_cur is not None:
+            seg_qb = jnp.where(to_head, seg_head_q, seg_tail_q)
+            seg_kb = jnp.where(to_head, seg_cur[:, :c], seg_cur[:, c:])
+            mask_b = seg_qb[:, :, None] == seg_kb[:, None, :]
         m_sel, l_sel, acc_sel = _osm_fold(m_sel, l_sel, acc_sel,
-                                          logits_b, v_b)
+                                          logits_b, v_b, mask_b)
         m_h = jnp.where(to_head, m_sel, m_h)
         l_h = jnp.where(to_head, l_sel, l_h)
         acc_h = jnp.where(to_head, acc_sel, acc_h)
@@ -208,10 +250,16 @@ def ring_attention_balanced(q, k, v, axis_name, sm_scale=None,
 
 
 def ulysses_attention(q, k, v, axis_name, attn_fn=None, causal=True,
-                      axis_size=None):
+                      axis_size=None, segment_ids=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism inside
     shard_map: swap sharding seq→heads, run full-sequence attention on
-    1/n of the heads, swap back. Requires num_heads % n == 0."""
+    1/n of the heads, swap back. Requires num_heads % n == 0.
+
+    `segment_ids` (local shard [B, S/n], 0 = pad): after the swap each
+    rank holds the FULL sequence for its head slice, so the ids are
+    all-gathered along the axis and handed to the attention core
+    (`attn_fn` must accept a `segment_ids` kwarg — the default
+    `causal_attention` and the segmented flash kernels do)."""
     n = axis_size
     if not isinstance(n, int):
         raise ValueError("ulysses_attention needs a static axis_size")
@@ -235,7 +283,12 @@ def ulysses_attention(q, k, v, axis_name, attn_fn=None, causal=True,
             else None
     if attn_fn is None:
         raise ValueError("non-causal ulysses needs an explicit attn_fn")
-    out = attn_fn(qh, kh, vh)
+    if segment_ids is not None:
+        seg_full = jax.lax.all_gather(segment_ids, axis_name, axis=1,
+                                      tiled=True)          # [B, S]
+        out = attn_fn(qh, kh, vh, segment_ids=seg_full)
+    else:
+        out = attn_fn(qh, kh, vh)
     return heads_to_seq(out)
 
 
@@ -283,11 +336,11 @@ class SequenceParallel:
                 f"2*axis_size={2 * self.axis_size}")
         return fits if self.balance is None else bool(self.balance)
 
-    def __call__(self, q, k, v):
+    def __call__(self, q, k, v, segment_ids=None):
         spec = P(None, self.axis, None, None)
         if self.mode == "ring":
             if self._use_balance(q.shape[1]):
-                return self._balanced_ring(q, k, v, spec)
+                return self._balanced_ring(q, k, v, spec, segment_ids)
             fn = partial(ring_attention, axis_name=self.axis,
                          causal=self.causal, axis_size=self.axis_size)
         elif self.mode == "ulysses":
@@ -295,15 +348,26 @@ class SequenceParallel:
                          causal=self.causal, axis_size=self.axis_size)
         else:
             raise ValueError(f"unknown mode {self.mode!r}")
-        mapped = shard_map(lambda q, k, v: fn(q, k, v), mesh=self.mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec)
-        return mapped(q, k, v)
+        if segment_ids is None:
+            mapped = shard_map(lambda q, k, v: fn(q, k, v),
+                               mesh=self.mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec)
+            return mapped(q, k, v)
+        seg_spec = P(None, self.axis)
+        mapped = shard_map(
+            lambda q, k, v, seg: fn(q, k, v, segment_ids=seg),
+            mesh=self.mesh, in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec)
+        return mapped(q, k, v, segment_ids.astype(jnp.int32))
 
-    def _balanced_ring(self, q, k, v, spec):
+    def _balanced_ring(self, q, k, v, spec, segment_ids=None):
         """Permute the sequence into the zigzag chunk order, run the
         balanced ring, and invert the permutation on the output (the
         gather pair is O(S·H·D) data movement, amortized over the
-        O(S²/n·H·D) attention it balances)."""
+        O(S²/n·H·D) attention it balances). Segment ids ride the same
+        permutation — they are compared by value, so reordering is
+        transparent to the intra-document masking."""
         import numpy as np
         n = self.axis_size
         c = q.shape[1] // (2 * n)
@@ -312,7 +376,20 @@ class SequenceParallel:
         inv = np.argsort(perm)
         fn = partial(ring_attention_balanced, axis_name=self.axis,
                      axis_size=n)
-        mapped = shard_map(lambda q, k, v: fn(q, k, v), mesh=self.mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec)
-        out = mapped(*(jnp.take(t, perm, axis=1) for t in (q, k, v)))
+        if segment_ids is None:
+            mapped = shard_map(lambda q, k, v: fn(q, k, v),
+                               mesh=self.mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec)
+            out = mapped(*(jnp.take(t, perm, axis=1)
+                           for t in (q, k, v)))
+            return jnp.take(out, inv, axis=1)
+        seg_spec = P(None, self.axis)
+        mapped = shard_map(
+            lambda q, k, v, seg: fn(q, k, v, segment_ids=seg),
+            mesh=self.mesh, in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec)
+        out = mapped(*(jnp.take(t, perm, axis=1) for t in (q, k, v)),
+                     jnp.take(segment_ids.astype(jnp.int32), perm,
+                              axis=1))
         return jnp.take(out, inv, axis=1)

@@ -65,9 +65,13 @@ def _parse_sparse_attention(param_dict):
             c.SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(
                 sparsity, c.SPARSE_NUM_GLOBAL_BLOCKS,
                 c.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+            # unset stays None: the consumer picks its default —
+            # the SparsityConfig constructors keep the reference's
+            # bidirectional, the causal-LM sparse engine (gpt_neox.
+            # make_sparse_attention) needs unidirectional and must be
+            # able to tell "user asked for bidirectional" apart
             c.SPARSE_ATTENTION_TYPE: get_scalar_param(
-                sparsity, c.SPARSE_ATTENTION_TYPE,
-                c.SPARSE_ATTENTION_TYPE_DEFAULT),
+                sparsity, c.SPARSE_ATTENTION_TYPE, None),
             c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
                 sparsity, c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
                 c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
@@ -89,9 +93,13 @@ def _parse_sparse_attention(param_dict):
             c.SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
                 sparsity, c.SPARSE_GLOBAL_BLOCK_END_INDICES,
                 c.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+            # unset stays None: the consumer picks its default —
+            # the SparsityConfig constructors keep the reference's
+            # bidirectional, the causal-LM sparse engine (gpt_neox.
+            # make_sparse_attention) needs unidirectional and must be
+            # able to tell "user asked for bidirectional" apart
             c.SPARSE_ATTENTION_TYPE: get_scalar_param(
-                sparsity, c.SPARSE_ATTENTION_TYPE,
-                c.SPARSE_ATTENTION_TYPE_DEFAULT),
+                sparsity, c.SPARSE_ATTENTION_TYPE, None),
             c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
                 sparsity, c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
                 c.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
@@ -376,6 +384,7 @@ class DeepSpeedConfig:
         self._parse_checkpoint_block(d)
         self._parse_training_health_block(d)
         self._parse_telemetry_block(d)
+        self._parse_packing_block(d)
 
         # Fork additions: gradient storage for debugging.
         self.store_gradients = bool(
@@ -778,6 +787,55 @@ class DeepSpeedConfig:
             "memory_watermark_interval_steps": watermark,
             "capture_on_anomaly": bools[c.TELEMETRY_CAPTURE_ON_ANOMALY],
             "anomaly_capture_steps": anomaly_steps,
+        }
+
+    def _parse_packing_block(self, d):
+        """Parse + validate the "packing" block (runtime/packing.py:
+        document-packed ragged batches with segment ids). Same parse-time
+        strictness as the "checkpoint"/"moe" blocks: a typo'd knob must
+        fail at startup, not silently train with cross-document
+        attention. The block makes the model families REQUIRE
+        (tokens, labels, segment_ids) batches — a missing segment_ids is
+        then a loud error instead of silent pad-token flops."""
+        pk = d.get(c.PACKING) or {}
+        known = {c.PACKING_ENABLED, c.PACKING_PAD_ID, c.PACKING_DROP_TAIL}
+        unknown = sorted(set(pk) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown 'packing' key(s) {unknown}; valid keys: "
+                f"{sorted(known)}")
+
+        enabled = pk.get(c.PACKING_ENABLED, c.PACKING_ENABLED_DEFAULT)
+        if not isinstance(enabled, bool):
+            raise DeepSpeedConfigError(
+                f"packing.{c.PACKING_ENABLED} must be a boolean, got "
+                f"{enabled!r}")
+        self.packing_enabled = enabled
+        if not enabled:
+            self.packing_params = False
+            return
+
+        pad_id = as_int(pk.get(c.PACKING_PAD_ID, c.PACKING_PAD_ID_DEFAULT),
+                        f"packing.{c.PACKING_PAD_ID}")
+        if pad_id < 0:
+            raise DeepSpeedConfigError(
+                f"packing.{c.PACKING_PAD_ID} must be >= 0, got {pad_id}")
+        drop_tail = pk.get(c.PACKING_DROP_TAIL,
+                           c.PACKING_DROP_TAIL_DEFAULT)
+        if not isinstance(drop_tail, bool):
+            raise DeepSpeedConfigError(
+                f"packing.{c.PACKING_DROP_TAIL} must be a boolean, got "
+                f"{drop_tail!r}")
+        if self.sparse_attention:
+            # the block-sparse kernels carry no segment gate: a packed
+            # batch through them would silently attend across documents
+            raise DeepSpeedConfigError(
+                "packing cannot be combined with sparse_attention: the "
+                "sparse kernels are not segment-aware (use the dense "
+                "segmented flash engine for packed batches)")
+        self.packing_params = {
+            "pad_id": pad_id,
+            "drop_tail": drop_tail,
         }
 
     # -- batch triad -------------------------------------------------------

@@ -320,3 +320,17 @@ SPARSE_GLOBAL_BLOCK_END_INDICES = "global_block_end_indices"
 SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT = None
 SPARSE_NUM_SLIDING_WINDOW_BLOCKS = "num_sliding_window_blocks"
 SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT = 3
+
+# ---------------------------------------------------------------------------
+# Packing block (document-packed ragged batches; runtime/packing.py)
+# ---------------------------------------------------------------------------
+PACKING = "packing"
+PACKING_ENABLED = "enabled"
+PACKING_ENABLED_DEFAULT = False
+# token id written on pad positions (segment id 0 marks them for the
+# kernels' masks and the effective-token accounting)
+PACKING_PAD_ID = "pad_id"
+PACKING_PAD_ID_DEFAULT = 0
+# drop rows under 50% occupancy (bench hygiene for tail rows)
+PACKING_DROP_TAIL = "drop_tail"
+PACKING_DROP_TAIL_DEFAULT = False

@@ -315,7 +315,17 @@ class DeepSpeedEngine:
         # from the JSON alone (VERDICT: user config, no library imports,
         # trains both axes)
         act_ckpt = self._config.activation_checkpointing_config
-        if self._config.moe_enabled or self._config.sequence_parallel_enabled:
+        model_blocks_active = (
+            self._config.moe_enabled
+            or self._config.sequence_parallel_enabled
+            # packing/sparse_attention likewise reconfigure the model
+            # itself (segment-aware loss; block-sparse attention core) —
+            # a model that cannot consume them must fail loudly, or the
+            # run silently trains with cross-document attention / dense
+            # kernels the config said to replace
+            or bool(getattr(self._config, "packing_params", None))
+            or bool(getattr(self._config, "sparse_attention", None)))
+        if model_blocks_active:
             from .pipe.module import PipelineModule
             if self._config.moe_enabled and \
                     isinstance(model, PipelineModule):
@@ -326,8 +336,9 @@ class DeepSpeedEngine:
                     "parallelism for MoE models)")
             if not hasattr(model, "apply_ds_config"):
                 raise DeepSpeedConfigError(
-                    "config enables moe/sequence_parallel but the model "
-                    "does not implement apply_ds_config(config, mesh) "
+                    "config enables moe/sequence_parallel/packing/"
+                    "sparse_attention but the model does not implement "
+                    "apply_ds_config(config, mesh) "
                     "(models.gpt_neox.GPTNeoX does)")
             model.apply_ds_config(self._config, self.mesh)
         elif act_ckpt.active and hasattr(model, "apply_ds_config"):
@@ -1841,6 +1852,30 @@ class DeepSpeedEngine:
     # data
     # ------------------------------------------------------------------
 
+    def pack_dataset(self, docs, seq_len=None):
+        """Pack a ragged document list into a `PackedDataset` using the
+        validated "packing" block's `pad_id`/`drop_tail` — the config is
+        the single source of truth for those knobs (a hand-built
+        `PackedDataset` with different values would desync pad detection
+        from the model's segment masking). `seq_len` defaults to the
+        model's `config.max_seq_len`. Feed the result to `deepspeed_io`
+        or iterate it directly into `train_batch`."""
+        params = getattr(self._config, "packing_params", None)
+        if not params:
+            raise DeepSpeedConfigError(
+                "pack_dataset requires the 'packing' config block with "
+                "\"enabled\": true")
+        if seq_len is None:
+            seq_len = getattr(getattr(self.module_obj, "config", None),
+                              "max_seq_len", None)
+            if seq_len is None:
+                raise DeepSpeedConfigError(
+                    "pack_dataset could not infer the packing window "
+                    "from model.config.max_seq_len; pass seq_len "
+                    "explicitly")
+        from .packing import PackedDataset
+        return PackedDataset(docs, seq_len, **params)
+
     def deepspeed_io(self, dataset, batch_size=None, route="train",
                      pin_memory=None, data_sampler=None, collate_fn=None,
                      num_local_io_workers=None):
@@ -2251,6 +2286,14 @@ class DeepSpeedEngine:
 
     def _train_batch_execute(self, batch, gas, fault):
         tel = self.telemetry
+        tokens = None
+        if tel.enabled:
+            # packed ragged batches: effective (non-pad, non-cross-doc)
+            # vs possible targets, counted host-side on the raw batch —
+            # telemetry reports effective-tokens/s and effective-MFU
+            # next to the raw scalars (None for unpacked batches)
+            from .packing import packed_batch_token_stats
+            tokens = packed_batch_token_stats(batch)
         if self.param_offload:
             # ZeRO-Infinity: params stream from host/NVMe segment by
             # segment — skip the whole-batch device upload and the
@@ -2260,7 +2303,7 @@ class DeepSpeedEngine:
             metrics = self._streamed_train_batch(batch)
             verdict = self._after_step(metrics)
             self.tput_timer.stop()
-            tel.on_step_end(self, verdict=verdict)
+            tel.on_step_end(self, verdict=verdict, tokens=tokens)
             return metrics.loss
 
         self._maybe_profile_flops(batch)
@@ -2338,7 +2381,7 @@ class DeepSpeedEngine:
         verdict = self._after_step(metrics)
         self.tput_timer.stop()
         tel.on_step_end(self, verdict=verdict,
-                        flops=self._step_flops.get(key))
+                        flops=self._step_flops.get(key), tokens=tokens)
         return metrics.loss
 
     def train_steps(self, batches):
@@ -2395,6 +2438,10 @@ class DeepSpeedEngine:
 
     def _train_steps_execute(self, batches, gas, n_steps):
         tel = self.telemetry
+        tokens = None
+        if tel.enabled:
+            from .packing import packed_batch_token_stats
+            tokens = packed_batch_token_stats(batches)
         # data axis on dim 2: dims 0/1 are the step and grad-accum scans
         with tel.span("h2d"):
             sharded = self._shard_stacked_batch(batches, n_scan_dims=2)
@@ -2457,7 +2504,8 @@ class DeepSpeedEngine:
         # step was skipped (goodput cannot see intra-window skips — the
         # per-step loop can)
         tel.on_step_end(self, verdict="ok" if taken else "quarantined",
-                        flops=self._step_flops.get(key), steps=n_steps)
+                        flops=self._step_flops.get(key), steps=n_steps,
+                        tokens=tokens)
         return losses
 
     def _assert_comm_precision(self):

@@ -298,7 +298,8 @@ class _NullTelemetry:
     def on_step_start(self, step):  # noqa: ARG002
         pass
 
-    def on_step_end(self, engine, verdict="ok", flops=None, steps=1):
+    def on_step_end(self, engine, verdict="ok", flops=None, steps=1,
+                    tokens=None):
         pass
 
     def on_anomaly(self, engine, kind, step=None):
@@ -350,6 +351,10 @@ class Telemetry:
         self._steps_seen = 0
         self._last_ckpt_stall = None
         self._peak_flops = None
+        # packed-batch effective-token accounting (runtime/packing.py):
+        # cumulative (non-pad, non-cross-document) vs possible targets
+        self._tokens_effective = 0
+        self._tokens_total = 0
 
         # capture-window state. `started_jax` lives in a dict shared
         # with a weakref.finalize below: a Telemetry collected mid-window
@@ -442,10 +447,18 @@ class Telemetry:
             tag, n_steps = self._armed.pop(0)
             self._open_window(tag, n_steps)
 
-    def on_step_end(self, engine, verdict="ok", flops=None, steps=1):
+    def on_step_end(self, engine, verdict="ok", flops=None, steps=1,
+                    tokens=None):
         """Close one step window: goodput accounting, MFU/memory
         scalars, capture-window bookkeeping. `steps` > 1 for fused
-        `train_steps` windows (one call covers n optimizer steps)."""
+        `train_steps` windows (one call covers n optimizer steps).
+
+        `tokens` = (effective, total) target counts for packed ragged
+        batches (`runtime.packing.packed_batch_token_stats`): raw
+        throughput/MFU count pad tokens and cross-document positions as
+        productive work, so packing wins would be invisible — these
+        emit effective-tokens/s and effective-MFU next to the raw
+        scalars, plus the running effective-token fraction."""
         t1 = time.perf_counter()
         dt = (t1 - self._step_t0) if self._step_t0 is not None else 0.0
         self._step_t0 = None
@@ -469,6 +482,22 @@ class Telemetry:
             achieved = flops / dt          # per-device FLOPS/s
             scalars["Train/Samples/achieved_tflops"] = achieved / 1e12
             scalars["Train/Samples/mfu"] = achieved / self._peak()
+
+        if tokens is not None and dt > 0:
+            eff, total = tokens
+            self._tokens_effective += int(eff)
+            self._tokens_total += int(total)
+            scalars["Train/Samples/tokens_per_sec"] = total / dt
+            scalars["Train/Samples/effective_tokens_per_sec"] = eff / dt
+            if self._tokens_total:
+                scalars["Train/Goodput/effective_token_fraction"] = (
+                    self._tokens_effective / self._tokens_total)
+            if self.mfu_enabled and flops and total:
+                # MFU counting only loss-bearing tokens as productive:
+                # the raw scalar times flops the kernels BURNED; this
+                # one credits only the fraction the loss consumed
+                scalars["Train/Samples/effective_mfu"] = (
+                    flops / dt / self._peak()) * (eff / total)
 
         if (self.memory_watermark_interval > 0
                 and self._steps_seen % self.memory_watermark_interval < steps):
