@@ -34,6 +34,10 @@ KEYS (default all):
   - packed   (packed ragged-batch row: fixed-seed lognormal doc mixture
              packed into 16k rows, segment-aware kernels vs the same
              shapes without segments; opt-in via DS_BENCH_PACKED=1)
+  - serve    (continuous-batching serving row: fixed-seed open-loop
+             request stream through the InferenceEngine's paged KV
+             cache; generated tokens/s/chip + p50/p99 per-token latency
+             + zero-recompile check; opt-in via DS_BENCH_SERVE=1)
 """
 
 import gc
@@ -50,7 +54,7 @@ import numpy as np
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
-               "moe": 800}  # moe/longseq walk both engines
+               "moe": 800, "serve": 800}  # moe/longseq walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -867,11 +871,118 @@ def row_telemetry():
                    "telemetry")
 
 
+def row_serve():
+    """Continuous-batching serving row (opt-in via DS_BENCH_SERVE=1): a
+    fixed-seed open-loop request stream (lognormal prompt lengths,
+    arrivals every other scheduler step regardless of progress) through
+    the InferenceEngine — NeoX-125M, greedy decode, paged KV cache,
+    single-bucket prefill/decode batch shapes so warmup compiles
+    exactly one program per prefill length plus one decode program.
+    Reports generated tokens/s/chip, p50/p99 inter-token latency, p50
+    time-to-first-token, and the compile-count delta over the measured
+    stream (the zero-recompile discipline: must be 0)."""
+    jax = _setup_jax()
+    cfg, model, params = _headline_setup(jax)
+
+    def run(n_req):
+        def thunk():
+            from deeperspeed_tpu.inference import InferenceEngine
+            max_batch = int(os.environ.get("DS_BENCH_SERVE_BATCH", "16"))
+            max_new = int(os.environ.get("DS_BENCH_SERVE_NEW", "64"))
+            conf = {"inference": {
+                "enabled": True, "page_size": 64,
+                "num_pages": int(os.environ.get("DS_BENCH_SERVE_PAGES",
+                                                "513")),
+                "max_batch_size": max_batch, "token_budget": 2048,
+                "prefill_batch_sizes": [4],
+                "decode_batch_sizes": [max_batch]}}
+            eng = InferenceEngine(model, config=conf, params=params)
+            rng = np.random.default_rng(0)
+            hi = min(768, eng.prefill_lengths[-1],
+                     eng.max_seq_len - max_new)
+            lens = np.clip(np.exp(rng.normal(5.0, 0.8, size=n_req)),
+                           8, hi).astype(int)
+            prompts = [list(rng.integers(1, cfg.vocab_size, size=int(n)))
+                       for n in lens]
+
+            # warm every prefill length bucket + the decode program so
+            # the measured stream starts fully compiled (b - 2 so the
+            # top bucket's prompt + 2 tokens still fits the window)
+            eng.generate([list(rng.integers(1, cfg.vocab_size, size=b - 2))
+                          for b in eng.prefill_lengths], max_new_tokens=2)
+            compiled_warm = eng.compile_count()
+            # measured-stream deltas only: the warmup pass's counters
+            # include per-bucket compile time in its prefill span
+            warm_stats = dict(eng.stats)
+
+            t_start = time.perf_counter()
+            submit_at, last, seen = {}, {}, {}
+            itl, ttft = [], []
+            submitted = 0
+            step = 0
+            while submitted < len(prompts) or eng.scheduler.has_work:
+                while submitted < len(prompts) and submitted * 2 <= step:
+                    rid = eng.submit(prompts[submitted],
+                                     max_new_tokens=max_new)
+                    submit_at[rid] = time.perf_counter()
+                    submitted += 1
+                if eng.scheduler.has_work:
+                    eng.step()
+                now = time.perf_counter()
+                for r in list(eng.scheduler.running) + \
+                        eng.scheduler.finished:
+                    rid = r.request_id
+                    if rid not in submit_at:
+                        continue                      # warmup requests
+                    k = len(r.generated)
+                    if k > seen.get(rid, 0):
+                        if rid in last:
+                            itl.append(now - last[rid])
+                        else:
+                            ttft.append(now - submit_at[rid])
+                        last[rid] = now
+                        seen[rid] = k
+                step += 1
+            dt = time.perf_counter() - t_start
+            stats = {k: v - warm_stats[k] for k, v in eng.stats.items()}
+            gen = sum(len(r.generated) for r in eng.scheduler.finished
+                      if r.request_id in submit_at)
+            def pct(vals, q):
+                # DS_BENCH_SERVE_NEW=1 yields no inter-token intervals
+                # (every request finishes at prefill) — report null,
+                # don't kill the row
+                if not vals:
+                    return None
+                return round(float(np.percentile(np.asarray(vals), q))
+                             * 1e3, 2)
+
+            return {
+                # serving runs on one chip unless a mesh is attached
+                "serve_tokens_per_s_chip": round(gen / dt, 1),
+                "serve_chips": 1,
+                "serve_p50_token_ms": pct(itl, 50),
+                "serve_p99_token_ms": pct(itl, 99),
+                "serve_ttft_p50_ms": pct(ttft, 50),
+                "serve_requests": n_req,
+                "serve_gen_tokens": gen,
+                "serve_steps": stats["steps"],
+                "serve_evictions": stats["evictions"],
+                "serve_prefill_s": round(stats["prefill_s"], 2),
+                "serve_decode_s": round(stats["decode_s"], 2),
+                "serve_compile_delta": eng.compile_count() - compiled_warm,
+            }
+        return thunk
+
+    n0 = int(os.environ.get("DS_BENCH_SERVE_REQUESTS", "64"))
+    return _ladder([(f"req{n0}", run(n0)), ("req16", run(16))], {},
+                   "serve")
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
            "sentinel": row_sentinel, "telemetry": row_telemetry,
-           "packed": row_packed}
+           "packed": row_packed, "serve": row_serve}
 
 
 # ---------------------------------------------------------------------------
@@ -891,6 +1002,8 @@ def rows_enabled():
         order.append("telemetry")
     if os.environ.get("DS_BENCH_PACKED", "0") not in ("0", "", "false"):
         order.append("packed")
+    if os.environ.get("DS_BENCH_SERVE", "0") not in ("0", "", "false"):
+        order.append("serve")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -898,7 +1011,7 @@ def rows_enabled():
     picked = {r.strip() for r in sel.split(",")}
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
-    for opt_in in ("ckpt", "sentinel", "telemetry", "packed"):
+    for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

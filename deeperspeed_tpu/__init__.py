@@ -16,6 +16,7 @@ from .parallel.topology import (PipeDataParallelTopology,
                                 PipeModelDataParallelTopology,
                                 ProcessTopology)
 from .runtime import zero  # noqa: F401
+from .inference import InferenceEngine
 from .runtime.config import DeepSpeedConfig
 from .runtime.engine import DeepSpeedEngine
 from .runtime.lr_schedules import add_tuning_arguments

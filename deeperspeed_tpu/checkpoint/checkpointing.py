@@ -558,9 +558,13 @@ def _load_host_offload_checkpoint(engine, shard):
     return params
 
 
-def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
-                    load_lr_scheduler_states=True,
-                    load_dataloader_states=True):
+def _resolve_committed_state(load_dir, tag):
+    """Shared candidate walk of the full-state and params-only loaders:
+    verify the requested tag's manifest and deserialize its model
+    states; when resuming from `latest` (tag=None), fall back to the
+    newest other COMMITTED checkpoint on corruption — a torn write of
+    the newest save costs at most one checkpoint interval, never the
+    job. Returns (tag, ckpt_dir, model_state) or (None, None, None)."""
     explicit_tag = tag is not None
     if tag is None:
         tag = mf.read_latest(load_dir)
@@ -568,12 +572,8 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
             logger.warning(f"No '{LATEST_FILE}' file at "
                            f"{os.path.join(load_dir, LATEST_FILE)}; "
                            "cannot resume")
-            return None, {}
+            return None, None, None
 
-    # Candidate order: the requested tag first; when resuming from
-    # `latest`, every other committed checkpoint (newest first) backs it
-    # up — a torn/corrupt write of the newest save must cost at most one
-    # checkpoint interval, not the job.
     candidates = [str(tag)]
     if not explicit_tag:
         candidates += [t for _, t in reversed(mf.committed_tags(load_dir))
@@ -612,13 +612,86 @@ def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
         if cand != str(tag):
             logger.warning(f"Resuming from fallback checkpoint {cand} "
                            f"instead of corrupt {tag}")
-        return _apply_checkpoint(engine, load_dir, cand, ckpt_dir,
-                                 model_state, load_optimizer_states,
-                                 load_lr_scheduler_states,
-                                 load_dataloader_states)
+        return cand, ckpt_dir, model_state
 
     logger.warning(f"No loadable checkpoint under {load_dir}")
-    return None, {}
+    return None, None, None
+
+
+# model-state keys that are training state, not caller payload: both
+# full and module-only loads exclude them from the returned client_state
+_TRAINING_STATE_KEYS = ("module", "optimizer", "lr_scheduler",
+                        "batch_size_scheduler", "dataloader",
+                        "gradient_noise_scale")
+
+
+def _client_state(model_state):
+    return {k: v for k, v in model_state.items()
+            if k not in _TRAINING_STATE_KEYS}
+
+
+def _module_state_view(model_state, load_dir, tag, like):
+    """Shared body of the params-only loaders: reject streamed-NVMe
+    saves (their params ARE the segment store — use a full load on an
+    offload_param engine) and return (natural_params, client_state)."""
+    if model_state.get("streamed_nvme"):
+        raise RuntimeError(
+            "module-only load is unsupported for streamed-NVMe "
+            "checkpoints: their params ARE the segment store (use a "
+            "full load on an offload_param engine)")
+    params = state_dict_to_tree(model_state["module"], like=like)
+    log_dist(f"Loaded module-only checkpoint {tag} from {load_dir}",
+             ranks=[0])
+    return params, _client_state(model_state)
+
+
+def load_checkpoint(engine, load_dir, tag=None, load_optimizer_states=True,
+                    load_lr_scheduler_states=True,
+                    load_dataloader_states=True, module_only=False):
+    cand, ckpt_dir, model_state = _resolve_committed_state(load_dir, tag)
+    if cand is None:
+        return None, {}
+    if module_only:
+        # params-only restore (serving restarts / weight-only warm
+        # starts): manifest CRC + fallback ran above exactly as for a
+        # full resume, but optimizer moments, schedulers, dataloader
+        # position, loss scale and counters are never deserialized or
+        # touched — the engine keeps its current training state
+        params_np, client_state = _module_state_view(
+            model_state, load_dir, cand, engine.params_natural_like())
+        params = engine.params_from_natural(params_np)
+        engine.state = engine.state._replace(params=params)
+        if getattr(engine, "keep_master", False) and \
+                engine.state.master is not None:
+            # fp32 masters were intentionally left alone: the next
+            # optimizer step recomputes params FROM them, discarding
+            # these weights — module_only is for eval/serving engines,
+            # not for continuing training
+            logger.warning(
+                "module_only load on an engine with fp32 masters: the "
+                "next train step overwrites params from the (stale) "
+                "masters — use module_only for evaluation/serving only")
+        return os.path.join(load_dir, cand), client_state
+    return _apply_checkpoint(engine, load_dir, cand, ckpt_dir,
+                             model_state, load_optimizer_states,
+                             load_lr_scheduler_states,
+                             load_dataloader_states)
+
+
+def load_module_checkpoint(load_dir, tag=None, like=None):
+    """Engine-free params-only load for the serving stack: the same
+    manifest verification + committed-tag fallback as `load_checkpoint`,
+    returning the NATURAL module pytree (host numpy leaves) without an
+    engine to hang state off. `like` supplies the expected tree
+    structure (paths are matched, so dtype/layout of the template do
+    not matter). Returns (path, params, client_state) or (None, None,
+    {})."""
+    cand, ckpt_dir, model_state = _resolve_committed_state(load_dir, tag)
+    if cand is None:
+        return None, None, {}
+    params, client_state = _module_state_view(model_state, load_dir, cand,
+                                              like)
+    return os.path.join(load_dir, cand), params, client_state
 
 
 def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
@@ -725,10 +798,7 @@ def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
         global_steps=jnp.asarray(engine.global_steps, jnp.int32),
         skipped_steps=jnp.asarray(engine.skipped_steps, jnp.int32))
 
-    client_state = {k: v for k, v in model_state.items()
-                    if k not in ("module", "optimizer", "lr_scheduler",
-                                 "batch_size_scheduler", "dataloader",
-                                 "gradient_noise_scale")}
+    client_state = _client_state(model_state)
     log_dist(f"Loaded checkpoint {tag} from {load_dir}", ranks=[0])
     return os.path.join(load_dir, str(tag)), client_state
 

@@ -1,0 +1,17 @@
+"""Serving subsystem: continuous batching, paged KV cache, and the
+Pallas paged decode-attention kernel (`docs/inference.md`).
+
+- `InferenceEngine` — the serving loop: bucketed prefill/decode split
+  at fixed compiled shapes, params-only checkpoint loading, telemetry.
+- `PagedKVCache` — the preallocated, mesh-sharded page pool + its
+  host-side allocator.
+- `ContinuousBatchingScheduler` / `Request` — per-step admission and
+  eviction under a token + page budget.
+"""
+
+from .engine import InferenceEngine
+from .kv_cache import PagedKVCache, pages_for_tokens
+from .scheduler import ContinuousBatchingScheduler, Request, StepPlan
+
+__all__ = ["InferenceEngine", "PagedKVCache", "pages_for_tokens",
+           "ContinuousBatchingScheduler", "Request", "StepPlan"]

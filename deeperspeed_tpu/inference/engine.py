@@ -1,0 +1,595 @@
+"""Serving engine: continuous batching over a paged KV cache.
+
+`InferenceEngine` is the serving-side sibling of the training
+`DeepSpeedEngine`: it wraps the same model families (GPT-NeoX / GPT-2 —
+their blocks share ONE implementation, `gpt_neox._block_qkv` /
+`_block_post_attn`, so the decode path cannot drift from training
+numerics), is driven by the same JSON config machinery (the validated
+``"inference"`` block, `runtime.config.parse_inference_block`), loads
+weights params-only through the manifest-verified checkpoint loader
+(`checkpoint.load_module_checkpoint` — CRC verification and the
+committed-tag fallback included, Adam moments never deserialized), and
+applies `module_inject.prepare_inference_params` so weights rest in the
+serving compute dtype.
+
+Execution model (docs/inference.md):
+
+- **Prefill/decode split.** New requests run one bucketed prefill
+  (whole prompt, causal attention, K/V written to their pages in
+  whole-page scatters); in-flight requests run one decode step each
+  (one token through the Pallas paged decode-attention kernel,
+  `ops/pallas/decode_attention.py`).
+- **Fixed compiled shapes.** Prefill compiles per (batch bucket, length
+  bucket), decode per batch bucket — the scheduler
+  (`inference.scheduler`) only ever emits those shapes, so after the
+  ladder warms up XLA never recompiles (`compile_count()` pins this in
+  tests and the `DS_BENCH_SERVE` row).
+- **State.** The page pools are donated through every compiled call and
+  rebound, so XLA updates them in place; everything else (params,
+  rotary cache) is read-only.
+
+Sampling is deterministic: temperature 0 (default) is argmax;
+temperature > 0 draws from `jax.random.categorical` under a fixed
+config seed folded with the step counter — the same request stream
+always produces the same tokens.
+"""
+
+import time
+import types
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..compat import shard_map
+from ..models import gpt2 as gpt2_mod
+from ..models import gpt_neox as neox
+from ..module_inject.replace_module import prepare_inference_params
+from ..ops.pallas.decode_attention import paged_decode_attention
+from ..parallel.mesh import MODEL_AXIS
+from ..runtime.config import DeepSpeedConfig, parse_inference_block
+from ..runtime.config_utils import (DeepSpeedConfigError, load_config_json)
+from ..runtime.precision import resolve_precision
+from .kv_cache import PagedKVCache, pages_for_tokens
+from .scheduler import ContinuousBatchingScheduler, Request
+
+
+def _pow2_ladder(lo, hi):
+    """lo, 2·lo, 4·lo, ... capped at hi (hi appended if not reached)."""
+    out, v = [], lo
+    while v < hi:
+        out.append(v)
+        v *= 2
+    out.append(hi)
+    return sorted(set(out))
+
+
+class _Family:
+    """The model-family seams the serving loop needs: token embedding,
+    position stream, LM head. Everything between (the block body) is
+    the shared `gpt_neox._block_qkv`/`_block_post_attn`."""
+
+    def __init__(self, model, max_seq_len):
+        self.cfg = model.config
+        if isinstance(model, neox.GPTNeoX):
+            self.kind = "gpt_neox"
+            self._cos, self._sin, self.rot_dim = neox._rotary_cache(
+                self.cfg, max_seq_len)
+        elif isinstance(model, gpt2_mod.GPT2):
+            self.kind = "gpt2"
+            self._cos = jnp.zeros((max_seq_len, 0), jnp.float32)
+            self._sin = jnp.zeros((max_seq_len, 0), jnp.float32)
+            self.rot_dim = 0
+        else:
+            raise DeepSpeedConfigError(
+                f"InferenceEngine serves the GPT-NeoX / GPT-2 families; "
+                f"got {type(model).__name__}")
+
+    def embed_prefill(self, params, tokens):
+        """tokens [B, S] → [B, S, H] at absolute positions 0..S-1."""
+        x = params["embed"]["wte"][tokens]
+        if self.kind == "gpt2":
+            x = x + params["embed"]["wpe"][:tokens.shape[1]][None]
+        return x
+
+    def embed_decode(self, params, tokens, positions):
+        """tokens [B] at absolute `positions` [B] → [B, 1, H]."""
+        x = params["embed"]["wte"][tokens][:, None, :]
+        if self.kind == "gpt2":
+            x = x + params["embed"]["wpe"][positions][:, None, :]
+        return x
+
+    def cos_sin_prefill(self, seqlen):
+        return (self._cos[:seqlen], self._sin[:seqlen], self.rot_dim)
+
+    def cos_sin_decode(self, positions):
+        """Per-batch rotary rows at `positions` [B] → ([B, 1, rot], ...)."""
+        return (self._cos[positions][:, None, :],
+                self._sin[positions][:, None, :], self.rot_dim)
+
+    def head(self, params, h):
+        """Final-norm hidden [B, H] → logits [B, V] (fp32)."""
+        if self.kind == "gpt2":
+            wte = params["embed"]["wte"]
+        else:
+            wte = params.get("embed_out", params["embed"])["wte"]
+        return jnp.einsum("bh,vh->bv", h, wte.astype(h.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+class InferenceEngine:
+    """Continuous-batching serving over the paged KV cache.
+
+    ``model`` is a `models.gpt_neox.GPTNeoX` or `models.gpt2.GPT2`
+    wrapper; ``config`` a dict / JSON path / `DeepSpeedConfig` holding
+    the validated ``"inference"`` block; ``params`` an optional natural
+    parameter pytree (else `load_checkpoint` or `model.init_params`).
+    """
+
+    def __init__(self, model, config=None, config_params=None, params=None,
+                 mesh=None, rng=None, monitor=None):
+        self.model = model
+        cfg = model.config
+        if getattr(cfg, "moe_num_experts", 0):
+            raise DeepSpeedConfigError(
+                "serving MoE models is not supported yet: the decode "
+                "block would silently drop the expert routing")
+        if getattr(cfg, "attention_engine", "dense") != "dense":
+            raise DeepSpeedConfigError(
+                "serving needs attention_engine='dense' (the block-"
+                "sparse engine has no decode variant)")
+        if getattr(model, "_attn_fn", None) is not None:
+            raise DeepSpeedConfigError(
+                "serving a sequence-parallel model is not supported "
+                "(decode is one token; there is no sequence to shard)")
+
+        # -- config --------------------------------------------------------
+        raw = config_params if config_params is not None else config
+        if isinstance(raw, DeepSpeedConfig):
+            self.inference_params = raw.inference_params
+            telemetry_config = raw.telemetry_config
+        else:
+            if raw is None:
+                raise DeepSpeedConfigError(
+                    "InferenceEngine requires a config with an "
+                    "'inference' block")
+            d = raw if isinstance(raw, dict) else load_config_json(raw)
+            self.inference_params = parse_inference_block(d)
+            # reuse the training parser's telemetry validation without
+            # dragging in the batch triad it also wants
+            ns = types.SimpleNamespace()
+            DeepSpeedConfig._parse_telemetry_block(ns, d)
+            telemetry_config = ns.telemetry_config
+        if not self.inference_params:
+            raise DeepSpeedConfigError(
+                "the 'inference' config block is required (with "
+                "\"enabled\": true) to build an InferenceEngine")
+        ip = self.inference_params
+
+        self.page_size = ip["page_size"]
+        self.max_seq_len = ip["max_seq_len"] or cfg.max_seq_len
+        if self.max_seq_len > cfg.max_seq_len:
+            raise DeepSpeedConfigError(
+                f"inference.max_seq_len {self.max_seq_len} exceeds the "
+                f"model's max_seq_len {cfg.max_seq_len}")
+        if self.max_seq_len % self.page_size:
+            raise DeepSpeedConfigError(
+                f"the serving window max_seq_len {self.max_seq_len} must "
+                f"be a multiple of page_size {self.page_size} (the paged "
+                f"re-prefill ladder cannot cover a misaligned tail); set "
+                f"inference.max_seq_len explicitly")
+        if ip["num_pages"] - 1 < pages_for_tokens(self.max_seq_len,
+                                                  self.page_size):
+            raise DeepSpeedConfigError(
+                f"inference.num_pages {ip['num_pages']} cannot hold even "
+                f"one max_seq_len sequence "
+                f"({pages_for_tokens(self.max_seq_len, self.page_size)} "
+                f"pages + the reserved trash page)")
+        self.max_batch_size = ip["max_batch_size"]
+        self.temperature = ip["temperature"]
+        self.seed = ip["seed"]
+        self._attn_backend = (None if ip["kernel"] == "auto"
+                              else ip["kernel"])
+
+        if ip["prefill_lengths"]:
+            bad = [b for b in ip["prefill_lengths"] if b > self.max_seq_len]
+            if bad:
+                raise DeepSpeedConfigError(
+                    f"inference.prefill_lengths {bad} exceed the serving "
+                    f"window max_seq_len {self.max_seq_len}")
+            self.prefill_lengths = ip["prefill_lengths"]
+        else:
+            self.prefill_lengths = _pow2_ladder(self.page_size,
+                                                self.max_seq_len)
+        self.prefill_batch_sizes = ip["prefill_batch_sizes"] or \
+            [b for b in (1, 2, 4) if b <= self.max_batch_size]
+        self.decode_batch_sizes = ip["decode_batch_sizes"] or \
+            _pow2_ladder(1, self.max_batch_size)
+
+        # -- mesh / params -------------------------------------------------
+        self.mesh = mesh
+        self.mp = 1
+        if mesh is not None and MODEL_AXIS in mesh.axis_names:
+            self.mp = int(mesh.shape[MODEL_AXIS])
+        if params is None:
+            params = model.init_params(
+                rng if rng is not None else jax.random.PRNGKey(0))
+        # compute dtype comes from a matmul WEIGHT: 1-D leaves (biases,
+        # norms) deliberately rest in fp32 (`prepare_inference_params`),
+        # so the first leaf would read fp32 off a bf16 model and
+        # silently double weight HBM
+        leaves = jax.tree_util.tree_leaves(params)
+        self.compute_dtype = next(
+            (leaf.dtype for leaf in leaves
+             if getattr(leaf, "ndim", 0) >= 2), leaves[0].dtype)
+        # kv_cache_dtype overrides the CACHE pools only (K/V are cast on
+        # write, attention runs at pool dtype) — it never re-casts the
+        # weights
+        kv_dtype = ip["kv_cache_dtype"]
+        self.kv_cache_dtype = (resolve_precision(kv_dtype) if kv_dtype
+                               else self.compute_dtype)
+        params = prepare_inference_params(params, self.compute_dtype)
+        self._set_params(params)
+
+        # -- cache / scheduler ---------------------------------------------
+        self.family = _Family(model, self.max_seq_len)
+        self.cache = PagedKVCache(
+            num_layers=cfg.num_layers, num_pages=ip["num_pages"],
+            num_heads=cfg.num_heads, page_size=self.page_size,
+            head_dim=cfg.head_dim, dtype=self.kv_cache_dtype, mesh=mesh)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.cache, max_seq_len=self.max_seq_len,
+            token_budget=ip["token_budget"],
+            max_batch_size=self.max_batch_size,
+            prefill_lengths=self.prefill_lengths,
+            prefill_batch_sizes=self.prefill_batch_sizes,
+            decode_batch_sizes=self.decode_batch_sizes)
+        self.n_pages_max = pages_for_tokens(self.max_seq_len,
+                                            self.page_size)
+
+        # -- telemetry (spans: schedule / prefill / decode; admission
+        #    wait is a per-request scalar — docs/inference.md) ------------
+        from ..runtime.telemetry import build_telemetry
+        self.monitor = monitor
+        self.telemetry = build_telemetry(telemetry_config, monitor=monitor,
+                                         devices=jax.local_devices())
+
+        self._compiled = {}
+        self._steps = 0
+        self.stats = {"steps": 0, "prefill_requests": 0,
+                      "prefill_tokens": 0, "decode_tokens": 0,
+                      "evictions": 0, "finished": 0,
+                      "schedule_s": 0.0, "prefill_s": 0.0,
+                      "decode_s": 0.0, "admission_wait_s": 0.0}
+
+    # ------------------------------------------------------------------
+    # weights
+    # ------------------------------------------------------------------
+
+    def _place_params(self, params):
+        if self.mp > 1:
+            specs = self.model.param_specs(params, self.mesh)
+            return jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    p, NamedSharding(self.mesh, s)), params, specs,
+                is_leaf=lambda x: isinstance(x, P))
+        return params
+
+    def _set_params(self, params):
+        """Place the params and pre-stack the block weights ONCE:
+        decode is weight-bandwidth bound, and stacking inside the
+        compiled step would materialize a full copy of the block
+        params every call (params are runtime jit inputs — XLA cannot
+        hoist the stack out)."""
+        self.params = self._place_params(params)
+        stacked = self._stacked_blocks(self.params)
+        if self.mp > 1:
+            specs = self.model.param_specs(self.params, self.mesh)
+            stacked = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, P(None, *s))),
+                stacked, specs["blocks"][0])
+        self.params_stacked = stacked
+
+    def load_checkpoint(self, load_dir, tag=None):
+        """Params-only restore through the manifest-verified loader:
+        CRC verification and the committed-tag fallback run exactly as
+        in training resume, but only the module tree is deserialized —
+        a serving restart never touches Adam moments."""
+        from ..checkpoint.checkpointing import load_module_checkpoint
+        path, natural, client_state = load_module_checkpoint(
+            load_dir, tag=tag, like=self.params)
+        if path is None:
+            return None, {}
+        params = prepare_inference_params(natural, self.compute_dtype)
+        # the compiled programs take params as runtime arguments, so the
+        # warmed bucket executables stay valid across a weight hot-swap
+        # (same avals = jit cache hit) — no recompile ladder to repay
+        self._set_params(params)
+        return path, client_state
+
+    # ------------------------------------------------------------------
+    # compiled programs (one per bucket — the no-recompile discipline)
+    # ------------------------------------------------------------------
+
+    def compile_count(self):
+        """Total compiled executables across all bucketed programs; the
+        zero-recompile tests/bench pin that this stops growing once the
+        bucket ladder has warmed up."""
+        total = 0
+        for fn in self._compiled.values():
+            total += (fn._cache_size() if hasattr(fn, "_cache_size")
+                      else 1)
+        return total
+
+    def _sample(self, logits, rng):
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / self.temperature, axis=-1).astype(jnp.int32)
+
+    def _attention(self, q, k_pages, v_pages, page_table, lengths):
+        """Paged decode attention, shard_mapped over the model axis when
+        the mesh shards heads (attention is head-independent, so each
+        shard runs the kernel on its local heads — no collective)."""
+        if self.mp > 1:
+            f = shard_map(
+                partial(paged_decode_attention, backend=self._attn_backend),
+                self.mesh,
+                in_specs=(P(None, MODEL_AXIS, None),
+                          P(None, MODEL_AXIS, None, None),
+                          P(None, MODEL_AXIS, None, None),
+                          P(None, None), P(None)),
+                out_specs=P(None, MODEL_AXIS, None),
+                check_vma=False)
+            return f(q, k_pages, v_pages, page_table, lengths)
+        return paged_decode_attention(q, k_pages, v_pages, page_table,
+                                      lengths, backend=self._attn_backend)
+
+    @staticmethod
+    def _stacked_blocks(params):
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *params["blocks"])
+
+    def _prefill_fn(self, batch, seqlen):
+        key = ("prefill", batch, seqlen)
+        if key in self._compiled:
+            return self._compiled[key]
+        cfg = self.model.config
+        fam = self.family
+        use_pallas = getattr(self.model, "use_pallas", True)
+        ps = self.page_size
+        n_pages_row = seqlen // ps
+        cos_sin = fam.cos_sin_prefill(seqlen)
+
+        def prefill(params, stacked, tokens, lengths, page_table, k_pool,
+                    v_pool, rng):
+            B, S = tokens.shape
+            pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+            # 1 = real token, 0 = pad: the segmented attention kernels
+            # (and the XLA fallback's segment mask) then give each row
+            # causal attention over its own tokens only
+            seg = (pos < lengths[:, None]).astype(jnp.int32)
+            x = fam.embed_prefill(params, tokens)
+
+            def body(carry, bp):
+                y, kv = neox._block_core(
+                    cfg, bp, carry, cos_sin, use_pallas, mp=1,
+                    reduce_fn=lambda t: t, return_kv=True,
+                    segment_ids=seg)
+                return y, kv
+
+            x, (ks, vs) = jax.lax.scan(body, x, stacked)
+
+            # whole-page scatter: [B, S, H, D] → B·S/ps page tiles at
+            # the page-table ids (pad rows hold table id 0 — the trash
+            # page — so duplicates only ever collide there)
+            flat_pt = page_table.reshape(-1)
+            H, D = cfg.num_heads, cfg.head_dim
+
+            def write(pool, new):
+                tiles = new.reshape(B, n_pages_row, ps, H, D)
+                tiles = jnp.moveaxis(tiles, 3, 2)
+                tiles = tiles.reshape(B * n_pages_row, H, ps, D)
+                return pool.at[flat_pt].set(tiles.astype(pool.dtype))
+
+            k_pool = jax.vmap(write)(k_pool, ks)
+            v_pool = jax.vmap(write)(v_pool, vs)
+
+            idx = jnp.clip(lengths - 1, 0, S - 1)
+            h_last = x[jnp.arange(B), idx][:, None, :]
+            h_last = neox.layer_norm(h_last, params["final_ln"]["scale"],
+                                     params["final_ln"]["bias"],
+                                     cfg.layernorm_eps)
+            logits = fam.head(params, h_last[:, 0])
+            return self._sample(logits, rng), k_pool, v_pool
+
+        fn = jax.jit(prefill, donate_argnums=(5, 6))
+        self._compiled[key] = fn
+        return fn
+
+    def _decode_fn(self, batch):
+        key = ("decode", batch)
+        if key in self._compiled:
+            return self._compiled[key]
+        cfg = self.model.config
+        fam = self.family
+        ps = self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+
+        def decode(params, stacked, tokens, lengths, page_table, k_pool,
+                   v_pool, rng):
+            B = tokens.shape[0]
+            # lengths INCLUDE the token decoded this step; 0 marks an
+            # inactive (padding) row whose page table is all trash
+            pos = jnp.maximum(lengths - 1, 0)
+            x = fam.embed_decode(params, tokens, pos)
+            cos, sin, rot_dim = fam.cos_sin_decode(pos)
+            page_idx = jnp.take_along_axis(
+                page_table, (pos // ps)[:, None], axis=1)[:, 0]
+            slot = pos % ps
+
+            def body(carry, xs):
+                bp, kp, vp = xs
+                q, k, v = neox._block_qkv(cfg, bp, carry, cos, sin,
+                                          rot_dim, H)
+                kp = kp.at[page_idx, :, slot].set(
+                    k[:, 0].astype(kp.dtype))
+                vp = vp.at[page_idx, :, slot].set(
+                    v[:, 0].astype(vp.dtype))
+                attn = self._attention(q[:, 0].astype(kp.dtype), kp, vp,
+                                       page_table, lengths)
+                attn = attn.astype(carry.dtype)
+                out = neox._block_post_attn(
+                    cfg, bp, carry, attn.reshape(B, 1, H * D),
+                    reduce_fn=lambda t: t)
+                return out, (kp, vp)
+
+            x, (k_pool, v_pool) = jax.lax.scan(
+                body, x, (stacked, k_pool, v_pool))
+            h = neox.layer_norm(x, params["final_ln"]["scale"],
+                                params["final_ln"]["bias"],
+                                cfg.layernorm_eps)
+            logits = fam.head(params, h[:, 0])
+            return self._sample(logits, rng), k_pool, v_pool
+
+        fn = jax.jit(decode, donate_argnums=(5, 6))
+        self._compiled[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # the serving loop
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens, eos_token_id=None,
+               request_id=None):
+        """Enqueue one request; returns its id."""
+        req = Request(prompt=[int(t) for t in prompt],
+                      max_new_tokens=int(max_new_tokens),
+                      eos_token_id=eos_token_id, request_id=request_id)
+        return self.scheduler.add_request(req, now=time.perf_counter())
+
+    def _next_rng(self):
+        self._steps += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                  self._steps)
+
+    def step(self):
+        """One scheduler step: admit + prefill new requests, decode one
+        token for every in-flight sequence. Returns a summary dict."""
+        now = time.perf_counter()
+        t0 = now
+        with self.telemetry.span("schedule"):
+            plan = self.scheduler.schedule(now=now)
+        self.stats["schedule_s"] += time.perf_counter() - t0
+        self.stats["evictions"] += len(plan.evicted)
+        for req in plan.prefills:
+            if req.admitted_at is not None and req.enqueued_at is not None:
+                self.stats["admission_wait_s"] += \
+                    req.admitted_at - req.enqueued_at
+
+        finished_before = len(self.scheduler.finished)
+
+        if plan.prefills:
+            t0 = time.perf_counter()
+            with self.telemetry.span("prefill"):
+                self._run_prefill(plan)
+            self.stats["prefill_s"] += time.perf_counter() - t0
+            self.stats["prefill_requests"] += len(plan.prefills)
+            # r.cached is the pre-sampling context length (complete_
+            # prefill pins it before appending the first token) — len(
+            # r.context) here would double-count that token once decode
+            # accounting starts
+            self.stats["prefill_tokens"] += \
+                sum(r.cached for r in plan.prefills)
+
+        if plan.decodes:
+            t0 = time.perf_counter()
+            with self.telemetry.span("decode"):
+                self._run_decode(plan)
+            self.stats["decode_s"] += time.perf_counter() - t0
+            self.stats["decode_tokens"] += len(plan.decodes)
+
+        finished = len(self.scheduler.finished) - finished_before
+        self.stats["finished"] += finished
+        self.stats["steps"] += 1
+        return {"prefilled": len(plan.prefills),
+                "decoded": len(plan.decodes),
+                "evicted": len(plan.evicted), "finished": finished}
+
+    def _run_prefill(self, plan):
+        B, S = plan.prefill_batch, plan.prefill_len
+        n_pages_row = S // self.page_size
+        tokens = np.zeros((B, S), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        page_table = np.zeros((B, n_pages_row), np.int32)
+        for i, req in enumerate(plan.prefills):
+            ctx = req.context
+            tokens[i, :len(ctx)] = ctx
+            lengths[i] = len(ctx)
+            page_table[i, :len(req.pages)] = req.pages
+        fn = self._prefill_fn(B, S)
+        nxt, self.cache.k, self.cache.v = fn(
+            self.params, self.params_stacked, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(page_table), self.cache.k,
+            self.cache.v, self._next_rng())
+        nxt = np.asarray(nxt)
+        for i, req in enumerate(plan.prefills):
+            self.scheduler.complete_prefill(req, int(nxt[i]))
+
+    def _run_decode(self, plan):
+        B = plan.decode_batch
+        tokens = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        page_table = np.zeros((B, self.n_pages_max), np.int32)
+        for i, req in enumerate(plan.decodes):
+            tokens[i] = req.generated[-1]
+            lengths[i] = req.cached + 1
+            page_table[i, :len(req.pages)] = req.pages
+        fn = self._decode_fn(B)
+        nxt, self.cache.k, self.cache.v = fn(
+            self.params, self.params_stacked, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(page_table), self.cache.k,
+            self.cache.v, self._next_rng())
+        nxt = np.asarray(nxt)
+        for i, req in enumerate(plan.decodes):
+            self.scheduler.complete_decode(req, int(nxt[i]))
+
+    def run(self, max_steps=None):
+        """Drive steps until the queue drains (or `max_steps`)."""
+        steps = 0
+        while self.scheduler.has_work:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    def generate(self, prompts, max_new_tokens, eos_token_id=None):
+        """Batch convenience: submit every prompt, drain the queue, and
+        return the generated token lists in submission order. Consumes
+        `scheduler.pop_finished()` (including any requests already
+        finished by earlier manual `step()` driving), so the finished
+        list cannot grow across repeated calls."""
+        ids = [self.submit(p, max_new_tokens, eos_token_id=eos_token_id)
+               for p in prompts]
+        done = {}
+        while self.scheduler.has_work:
+            self.step()
+            for r in self.scheduler.pop_finished():
+                done[r.request_id] = r
+        return [list(done[i].generated) for i in ids]
+
+    def serve_stats(self):
+        """Counters + phase seconds; also pushed to the monitor (as
+        ``Serve/*`` scalars keyed by total generated tokens) when one
+        was attached."""
+        out = dict(self.stats)
+        total = out["prefill_tokens"] + out["decode_tokens"]
+        if self.monitor is not None:
+            self.monitor.record(
+                total, {f"Serve/{k}": float(v) for k, v in out.items()})
+        return out

@@ -1,0 +1,113 @@
+"""Preallocated, mesh-sharded paged KV cache for the serving engine.
+
+Layout: one K and one V pool per model, ``[L, P, H, page_size, D]``
+(layers, pool pages, heads, slots per page, head dim) — head-major so a
+model-parallel mesh shards dim 2 (heads) over the ``model`` axis. One
+page id addresses the same page row in EVERY layer and every head
+shard, so the allocator is mesh- and layer-agnostic, and decode
+attention (head-independent) needs no collective.
+
+Page 0 is RESERVED as the trash page: the allocator never hands it out,
+schedulers pad dead page-table entries with it, and inactive batch rows
+write their (masked) K/V there. That turns "row is padding" into plain
+data flow — no dynamic shapes, no per-row programs.
+
+Allocation is host-side (scheduling is host-side anyway): a free list of
+page ids. The device arrays are functional jax values — the engine
+rebinds them after every compiled prefill/decode call (donated, so XLA
+updates in place).
+"""
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import jax.numpy as jnp
+
+from ..parallel.mesh import MODEL_AXIS
+
+
+def pages_for_tokens(n_tokens, page_size):
+    """Pages needed to hold n_tokens (ceil division)."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+class PagedKVCache:
+    """The pooled K/V store plus its free-list allocator.
+
+    ``num_pages`` includes the reserved trash page 0, so the usable pool
+    is ``num_pages - 1`` pages = ``(num_pages - 1) * page_size`` tokens
+    per layer.
+    """
+
+    def __init__(self, num_layers, num_pages, num_heads, page_size,
+                 head_dim, dtype=jnp.bfloat16, mesh=None):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved trash "
+                f"page), got {num_pages}")
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.num_heads = int(num_heads)
+        self.page_size = int(page_size)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        self.mesh = mesh
+        shape = (self.num_layers, self.num_pages, self.num_heads,
+                 self.page_size, self.head_dim)
+        self.sharding = None
+        if mesh is not None and MODEL_AXIS in mesh.axis_names and \
+                mesh.shape[MODEL_AXIS] > 1:
+            if self.num_heads % mesh.shape[MODEL_AXIS]:
+                raise ValueError(
+                    f"num_heads {self.num_heads} must divide over the "
+                    f"'{MODEL_AXIS}' mesh axis "
+                    f"({mesh.shape[MODEL_AXIS]} shards)")
+            self.sharding = NamedSharding(
+                mesh, P(None, None, MODEL_AXIS, None, None))
+        if self.sharding is not None:
+            import jax
+            self.k = jax.device_put(jnp.zeros(shape, dtype), self.sharding)
+            self.v = jax.device_put(jnp.zeros(shape, dtype), self.sharding)
+        else:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
+        # free list: every page except the trash page, low ids first so
+        # tests are deterministic
+        self._free = list(range(self.num_pages - 1, 0, -1))
+
+    # -- allocator (host-side) --------------------------------------------
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def tokens_capacity(self):
+        return self.num_free * self.page_size
+
+    def allocate(self, n):
+        """Pop n pages from the free list, or None when fewer remain
+        (all-or-nothing: a partial grab would deadlock admission)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            return None
+        if n == 0:
+            return []
+        pages, self._free = self._free[-n:][::-1], self._free[:-n]
+        return pages
+
+    def free(self, pages):
+        for p in pages:
+            p = int(p)
+            if p <= 0 or p >= self.num_pages:
+                raise ValueError(f"page {p} is not an allocatable id")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(int(p) for p in pages)
+
+    def bytes_per_token(self):
+        """K + V bytes of cache one token occupies across all layers."""
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.num_heads * self.head_dim * \
+            itemsize

@@ -1,0 +1,333 @@
+"""Continuous-batching scheduler for the serving engine.
+
+Per engine step the scheduler builds ONE `StepPlan`: a (possibly empty)
+prefill batch of newly admitted requests plus the decode batch of every
+in-flight sequence — at FIXED compiled shapes. Batch and length are
+bucketed (`prefill_lengths`, `prefill_batch_sizes`,
+`decode_batch_sizes`), so after the bucket ladder has warmed up, XLA
+never recompiles no matter how requests arrive (`InferenceEngine.
+compile_count` pins this in tests).
+
+Admission policy (in order, per step):
+
+1. Every running sequence decodes this step — decode is never starved
+   by prefill. A sequence crossing into a page it does not own yet gets
+   one page from the pool first; if the pool is empty, the YOUNGEST
+   running request is evicted (pages freed, request requeued at the
+   front of the waiting queue with its generated prefix intact as
+   prompt) until the allocation succeeds — oldest work finishes first,
+   and an evicted request re-prefills its whole context on readmission.
+2. Waiting requests admit FIFO while (a) the step's token budget holds
+   — a prefill costs its padded bucket length, a decode costs 1 token —
+   (b) a decode slot is free (`max_batch_size` bounds in-flight
+   sequences), (c) the prefill batch bucket has room, and (d) the pool
+   can hand the request all pages of its padded prompt bucket up front
+   (the whole-page prefill scatter writes every bucket page, and the
+   tail pages double as growth room — no per-token allocation until the
+   sequence outgrows its bucket). One prefill call runs ONE length
+   bucket: shorter queued prompts pad up into the batch's bucket, a
+   longer one closes the batch and leads the next step's.
+
+Token accounting uses PADDED bucket sizes, not raw prompt lengths: the
+budget is a compute bound, and compute is spent at compiled shapes.
+The budget must cover the largest user prefill bucket (validated at
+init — a smaller budget could never admit such a prompt); an evicted
+request whose regrown context buckets above the user ladder is exempt
+from the budget for the step's first prefill, so the queue can never
+wedge behind it.
+"""
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from .kv_cache import pages_for_tokens
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One generation request. `prompt` is a list/array of token ids."""
+    prompt: list
+    max_new_tokens: int
+    request_id: object = None
+    eos_token_id: int = None
+    # runtime state (owned by the scheduler/engine)
+    generated: list = field(default_factory=list)
+    pages: list = field(default_factory=list)
+    cached: int = 0          # tokens whose K/V sit in `pages`
+    state: str = WAITING
+    evictions: int = 0
+    enqueued_at: float = None
+    admitted_at: float = None
+
+    @property
+    def context(self):
+        """Prompt + generated so far (what an eviction re-prefills)."""
+        return list(self.prompt) + list(self.generated)
+
+    @property
+    def done(self):
+        if len(self.generated) >= self.max_new_tokens:
+            return True
+        return (self.eos_token_id is not None and self.generated and
+                self.generated[-1] == self.eos_token_id)
+
+
+@dataclass
+class StepPlan:
+    """One engine step at fixed compiled shapes."""
+    prefills: list            # requests entering this step
+    prefill_batch: int        # batch bucket (0 = no prefill this step)
+    prefill_len: int          # length bucket
+    decodes: list             # in-flight requests decoding this step
+    decode_batch: int         # batch bucket (0 = no decode this step)
+    evicted: list             # requests preempted while planning
+
+    @property
+    def empty(self):
+        return not self.prefills and not self.decodes
+
+
+def _bucket(value, buckets):
+    """Smallest bucket >= value; None when value exceeds the ladder."""
+    for b in buckets:
+        if value <= b:
+            return b
+    return None
+
+
+class ContinuousBatchingScheduler:
+    """Admission/eviction over a `PagedKVCache` pool under a per-step
+    token budget. Host-side and deterministic: the same request arrival
+    order always produces the same step plans (the serving bench's
+    fixed-seed open-loop stream relies on this)."""
+
+    def __init__(self, cache, max_seq_len, token_budget, max_batch_size,
+                 prefill_lengths, prefill_batch_sizes, decode_batch_sizes):
+        self.cache = cache
+        self.page_size = cache.page_size
+        self.max_seq_len = int(max_seq_len)
+        self.token_budget = int(token_budget)
+        self.max_batch_size = int(max_batch_size)
+        if self.max_seq_len % self.page_size:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} is not a multiple of "
+                f"page_size {self.page_size}: the page-aligned re-prefill "
+                f"ladder could not cover a context in the misaligned "
+                f"tail, so an evicted request there could never readmit")
+        self.prefill_lengths = sorted(int(b) for b in prefill_lengths)
+        self.prefill_batch_sizes = sorted(int(b) for b in
+                                          prefill_batch_sizes)
+        self.decode_batch_sizes = sorted(int(b) for b in decode_batch_sizes)
+        for length in self.prefill_lengths:
+            if length % self.page_size:
+                raise ValueError(
+                    f"prefill length bucket {length} is not a multiple "
+                    f"of page_size {self.page_size} (the prefill scatter "
+                    f"writes whole pages)")
+        if self.token_budget < self.prefill_lengths[-1]:
+            raise ValueError(
+                f"token_budget {self.token_budget} is smaller than the "
+                f"largest prefill bucket {self.prefill_lengths[-1]}: a "
+                f"prompt in that bucket could never be admitted (the "
+                f"queue would livelock)")
+        # Re-prefill ladder: an evicted request's context (prompt +
+        # generated) can legitimately outgrow the user ladder while
+        # staying under max_seq_len, so extend it with doubled
+        # page-aligned buckets up to the (page-aligned, validated
+        # above) window. Readmission then always has a shape; the
+        # doubling keeps the lazily compiled program set logarithmic,
+        # and eviction-regrowth is the only path that ever warms these
+        # extra buckets.
+        top = self.max_seq_len
+        ladder = set(self.prefill_lengths)
+        length = self.prefill_lengths[-1]
+        while length < top:
+            length = min(length * 2, top)
+            ladder.add(length)
+        self._prefill_ladder = sorted(ladder)
+        self.waiting = deque()
+        self.running = []
+        self.finished = []
+        self._counter = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def add_request(self, request, now=None):
+        prompt_len = len(request.prompt)
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got "
+                f"{request.max_new_tokens} (prefill always samples the "
+                f"first token)")
+        if _bucket(prompt_len, self.prefill_lengths) is None:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the largest prefill "
+                f"bucket {self.prefill_lengths[-1]}")
+        if prompt_len + request.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new_tokens "
+                f"{request.max_new_tokens} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        if request.request_id is None:
+            request.request_id = self._counter
+        self._counter += 1
+        request.state = WAITING
+        request.enqueued_at = now
+        self.waiting.append(request)
+        return request.request_id
+
+    @property
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    def pop_finished(self):
+        """Drain completed requests (the caller owns them afterwards).
+        Long-lived serving loops must consume this (the engine's
+        `generate` does) — `finished` otherwise grows without bound."""
+        out, self.finished = self.finished, []
+        return out
+
+    # -- planning ----------------------------------------------------------
+
+    def _evict_youngest(self, now=None):
+        """Preempt the most recently admitted running request: free its
+        pages and requeue it (front of the queue, full context as the
+        new prompt). Returns the request, or None if nothing to evict."""
+        if not self.running:
+            return None
+        req = self.running.pop()        # admission appends → last = youngest
+        self.cache.free(req.pages)
+        req.pages = []
+        req.cached = 0
+        req.evictions += 1
+        req.state = WAITING
+        # admission wait restarts from the requeue, else readmission
+        # re-counts the first wait AND the time spent running
+        req.enqueued_at = now
+        self.waiting.appendleft(req)
+        return req
+
+    def _grow_running(self, evicted, now=None):
+        """Give every running sequence the page its next token needs;
+        evict youngest-first when the pool runs dry. A sequence can
+        never evict itself out of existence: with one running request
+        the pool math guarantees its page fits or the config was
+        rejected at engine init."""
+        for req in list(self.running):
+            if req not in self.running:           # evicted by an earlier turn
+                continue
+            pos = req.cached                      # slot the next token takes
+            page_idx = pos // self.page_size
+            while page_idx >= len(req.pages):
+                got = self.cache.allocate(1)
+                if got is not None:
+                    req.pages.extend(got)
+                    continue
+                victim = self._evict_youngest(now)
+                if victim is None:
+                    raise RuntimeError(
+                        "page pool exhausted with nothing left to evict "
+                        "— num_pages is too small for max_seq_len")
+                evicted.append(victim)
+                if victim is req:                 # req evicted itself
+                    break
+
+    def schedule(self, now=None):
+        """Build this step's `StepPlan` (see the module docstring for
+        the policy). Mutates scheduler state: admitted requests move to
+        `running` with pages allocated; evicted ones back to `waiting`."""
+        evicted = []
+        self._grow_running(evicted, now)
+        decodes = list(self.running)
+        budget = self.token_budget - len(decodes)
+
+        prefills = []
+        step_len = 0
+        max_prefill_batch = self.prefill_batch_sizes[-1]
+        while self.waiting and len(prefills) < max_prefill_batch and \
+                len(self.running) < self.max_batch_size:
+            req = self.waiting[0]
+            length = _bucket(len(req.context), self._prefill_ladder)
+            if length is None:
+                # unreachable: the ladder tops at the aligned window and
+                # running contexts stay below it (_maybe_finish) — kept
+                # as a loud invariant guard rather than a queue wedge
+                self.waiting.popleft()
+                req.state = FINISHED
+                self.finished.append(req)
+                raise RuntimeError(
+                    f"request {req.request_id} context "
+                    f"({len(req.context)} tokens) outgrew the prefill "
+                    f"bucket ladder after eviction; raise "
+                    f"prefill_lengths or num_pages")
+            # one length bucket per prefill call: shorter prompts pad up
+            # into the batch's bucket, a LONGER one waits for the next
+            # step (mixed buckets would force a recompile-sized shape)
+            if prefills and length > step_len:
+                break
+            row_len = step_len if prefills else length
+            if row_len > budget and (prefills or not req.evictions):
+                # the step's first prefill is budget-exempt for EVICTED
+                # requests: their regrown context can bucket above the
+                # user ladder (and the validated budget floor), and they
+                # requeue at the queue front — holding them to the
+                # budget would wedge the queue behind them forever
+                break
+            pages = self.cache.allocate(pages_for_tokens(row_len,
+                                                         self.page_size))
+            if pages is None:
+                break                      # pool full: wait for completions
+            budget -= row_len
+            step_len = row_len
+            self.waiting.popleft()
+            req.pages = pages
+            req.cached = 0
+            req.state = RUNNING
+            req.admitted_at = now
+            self.running.append(req)
+            prefills.append(req)
+
+        prefill_len = step_len if prefills else 0
+        prefill_batch = (_bucket(len(prefills), self.prefill_batch_sizes)
+                         if prefills else 0)
+        decode_batch = (_bucket(len(decodes), self.decode_batch_sizes)
+                        if decodes else 0)
+        if decodes and decode_batch is None:
+            raise RuntimeError(
+                f"{len(decodes)} in-flight sequences exceed the decode "
+                f"bucket ladder {self.decode_batch_sizes}")
+        return StepPlan(prefills=prefills, prefill_batch=prefill_batch or 0,
+                        prefill_len=prefill_len, decodes=decodes,
+                        decode_batch=decode_batch or 0, evicted=evicted)
+
+    # -- results -----------------------------------------------------------
+
+    def complete_prefill(self, request, first_token):
+        """Record a prefill's result: the prompt's K/V is cached and the
+        first generated token sampled."""
+        request.cached = len(request.context)
+        request.generated.append(int(first_token))
+        self._maybe_finish(request)
+
+    def complete_decode(self, request, token):
+        """Record a decode step: the previous token's K/V entered the
+        cache at slot `cached`, and `token` was sampled from it."""
+        request.cached += 1
+        request.generated.append(int(token))
+        self._maybe_finish(request)
+
+    def _maybe_finish(self, request):
+        total = len(request.prompt) + len(request.generated)
+        if request.done or total >= self.max_seq_len:
+            if request in self.running:
+                self.running.remove(request)
+            self.cache.free(request.pages)
+            request.pages = []
+            request.state = FINISHED
+            self.finished.append(request)

@@ -242,7 +242,9 @@ class GPT2:
                        remat_policy=self.remat_policy,
                        number_checkpoints=self.number_checkpoints)
 
-    def loss_fn(self, params, batch, rng=None):
+    def _lm_forward(self, params, batch, rng=None):
+        """Shared body of `loss_fn` / `loss_and_logits`: one block-stack
+        forward → (final-norm hidden, masked labels)."""
         from .gpt_neox import split_lm_batch
         tokens, labels, seg = split_lm_batch(batch)
         if self.config.use_segment_ids and seg is None:
@@ -261,4 +263,19 @@ class GPT2:
                                 number_checkpoints=self.number_checkpoints,
                                 boundary_fn=self._ckpt_boundary_fn,
                                 segment_ids=seg)
+        return hidden, labels
+
+    def loss_fn(self, params, batch, rng=None):
+        hidden, labels = self._lm_forward(params, batch, rng)
         return fused_lm_head_loss(hidden, params["embed"]["wte"], labels)
+
+    def loss_and_logits(self, params, batch, rng=None):
+        """(loss, [B, S, V] fp32 logits) from ONE forward — what
+        `eval_batch(return_logits=True)` compiles, instead of tracing
+        the block stack twice for loss and `apply` (tied LM head)."""
+        hidden, labels = self._lm_forward(params, batch, rng)
+        wte = params["embed"]["wte"]
+        logits = jnp.einsum("bsh,vh->bsv", hidden,
+                            wte.astype(hidden.dtype),
+                            preferred_element_type=jnp.float32)
+        return fused_lm_head_loss(hidden, wte, labels), logits

@@ -1031,7 +1031,9 @@ class GPTNeoX:
                        remat_policy=self.remat_policy,
                        number_checkpoints=self.number_checkpoints)
 
-    def loss_fn(self, params, batch, rng=None):
+    def _lm_forward(self, params, batch, rng=None):
+        """Shared body of `loss_fn` / `loss_and_logits`: one block-stack
+        forward → (final-norm hidden, masked labels, moe aux or None)."""
         tokens, labels, seg = split_lm_batch(batch)
         if self.config.use_segment_ids and seg is None:
             raise ValueError(
@@ -1057,12 +1059,30 @@ class GPTNeoX:
         aux = None
         if self.config.moe_num_experts:
             hidden, aux = hidden
+        return hidden, labels, aux
+
+    def _head_loss(self, params, hidden, labels, aux):
         out_embed = params.get("embed_out", params["embed"])["wte"]
         loss = fused_lm_head_loss(hidden, out_embed, labels)
         if aux is not None:
             loss = loss + self.config.moe_aux_loss_coef * \
                 aux / max(self.config.num_layers, 1)
         return loss
+
+    def loss_fn(self, params, batch, rng=None):
+        hidden, labels, aux = self._lm_forward(params, batch, rng)
+        return self._head_loss(params, hidden, labels, aux)
+
+    def loss_and_logits(self, params, batch, rng=None):
+        """(loss, [B, S, V] fp32 logits) from ONE forward — what
+        `eval_batch(return_logits=True)` compiles, instead of tracing
+        the block stack twice for loss and `apply`."""
+        hidden, labels, aux = self._lm_forward(params, batch, rng)
+        out_embed = params.get("embed_out", params["embed"])["wte"]
+        logits = jnp.einsum("bsh,vh->bsv", hidden,
+                            out_embed.astype(hidden.dtype),
+                            preferred_element_type=jnp.float32)
+        return self._head_loss(params, hidden, labels, aux), logits
 
     def generate(self, params, prompt, max_new_tokens, temperature=0.0,
                  rng=None):

@@ -1,0 +1,221 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+The serving engine (`deeperspeed_tpu.inference`) keeps each sequence's
+K/V history in fixed-size PAGES of a preallocated pool instead of one
+contiguous [B, S_max, H, D] buffer — admission never has to find a
+contiguous region, eviction frees exact pages, and memory scales with
+tokens actually resident rather than worst-case sequence length. Decode
+then needs the 1-query-row variant of the flash forward: for every
+in-flight sequence, one new query attends over all its cached tokens,
+reading K/V THROUGH the page table.
+
+Contract (shared by kernel and XLA fallback):
+
+- ``q`` [B, H, D]: one query row per sequence (the token being decoded).
+- ``k_pages``/``v_pages`` [P, H, page_size, D]: the pooled cache for ONE
+  layer, head-major so a model-parallel mesh shards dim 1 (heads) and
+  each shard runs this kernel on its local heads unchanged (attention is
+  head-independent).
+- ``page_table`` [B, NP] int32: page ids of sequence b's pages in
+  position order. Entries past the sequence's live pages are don't-care
+  (the scheduler pads with page 0 — the pool's reserved trash page);
+  their loads are masked and contribute nothing.
+- ``lengths`` [B] int32: tokens valid for attention — INCLUDING the one
+  being decoded (its K/V must already be written to its page). A length
+  of 0 marks an inactive (padding) batch row; its output is exact zero.
+
+Mechanics: grid (B, H, NP) with the page dimension innermost and
+``arbitrary`` (it carries the online-softmax accumulation); the page
+table and lengths ride as scalar prefetch
+(`pltpu.PrefetchScalarGridSpec`), so the K/V BlockSpec index maps
+resolve page-table indirection at DMA-issue time — the same LUT
+mechanism as the compacted causal grids in `flash_attention.py`. Pages
+at or past a sequence's length skip all compute (`pl.when`); the last
+grid step writes ``acc / l``. No backward exists: decode is inference.
+
+On non-TPU backends the kernel runs in interpreter mode (slow,
+test-only); `paged_decode_attention` defaults to the XLA fallback there,
+a gather + masked softmax with identical semantics.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...compat import CompilerParams
+from .flash_attention import LANES, NEG_INF, _interpret
+
+_DIMSEM = CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+# Test/bench observability: backend ("pallas"/"xla") of the most recent
+# paged_decode_attention call — the serving tests pin which path ran.
+_LAST_BACKEND = {}
+
+
+def paged_decode_supported(head_dim, page_size):
+    """Mosaic constraints for the real-TPU kernel: MXU-friendly head
+    dim, sublane-aligned page size. Interpret mode (CPU tests) has no
+    tiling rules."""
+    if _interpret():
+        return True
+    return head_dim in (64, 128, 256) and page_size % 8 == 0
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, sm_scale, page_size):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    length = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(p * page_size < length)
+    def _compute():
+        q = q_ref[0, 0].reshape(1, -1)                         # [1, D]
+        k = k_ref[0, 0]                                        # [ps, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale     # [1, ps]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + \
+            p * page_size
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                                  # [1, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        prob = jnp.exp(s - m_new)
+        # masked slots would see exp(NEG_INF - m) == 0 already, except
+        # when the whole page is masked and m_new == NEG_INF; zero them
+        # so l stays an exact count of live probability mass
+        prob = jnp.where(s <= NEG_INF * 0.5, 0.0, prob)
+        l_new = alpha * l_prev + jnp.sum(prob, axis=1, keepdims=True)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        pv = jax.lax.dot_general(
+            prob.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [1, D]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        # inactive rows (length 0) never accumulated: acc == 0 → out 0
+        o_ref[0, 0] = (acc_scr[:] / l_safe).reshape(-1).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(q, k_pages, v_pages, page_table, lengths,
+                                  sm_scale):
+    B, H, D = q.shape
+    page_size = k_pages.shape[2]
+    NP = page_table.shape[1]
+    kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                               page_size=page_size)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, NP),
+            in_specs=[
+                pl.BlockSpec((1, 1, D),
+                             lambda b, h, p, pt, ln: (b, h, 0)),
+                pl.BlockSpec((1, 1, page_size, D),
+                             lambda b, h, p, pt, ln: (pt[b, p], h, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, D),
+                             lambda b, h, p, pt, ln: (pt[b, p], h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, D),
+                                   lambda b, h, p, pt, ln: (b, h, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((1, LANES), jnp.float32),
+                pltpu.VMEM((1, LANES), jnp.float32),
+                pltpu.VMEM((1, D), jnp.float32),
+            ],
+        ),
+        compiler_params=_DIMSEM,
+        interpret=_interpret(),
+    )
+    return call(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+                q, k_pages, v_pages)
+
+
+def paged_decode_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                               sm_scale):
+    """Pure-XLA reference/fallback: gather the sequence's pages back
+    into a contiguous [B, H, S_max, D] view and run a masked softmax.
+    Identical semantics to the kernel, including exact-zero outputs for
+    inactive (length 0) rows."""
+    B, H, D = q.shape
+    page_size = k_pages.shape[2]
+    NP = page_table.shape[1]
+    k = jnp.moveaxis(k_pages[page_table], 2, 1).reshape(B, H, NP * page_size,
+                                                        D)
+    v = jnp.moveaxis(v_pages[page_table], 2, 1).reshape(B, H, NP * page_size,
+                                                        D)
+    s = jnp.einsum("bhd,bhsd->bhs", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    pos = jnp.arange(NP * page_size, dtype=jnp.int32)
+    s = jnp.where(pos[None, None, :] < lengths[:, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    prob = jnp.exp(s - m)
+    prob = jnp.where(s <= NEG_INF * 0.5, 0.0, prob)
+    l = jnp.sum(prob, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhs,bhsd->bhd", (prob / l_safe).astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           sm_scale=None, backend=None):
+    """One decode step of paged attention: ``out[b, h] = softmax(q[b, h]
+    · K[b]) · V[b]`` with K/V read through ``page_table[b]`` and masked
+    at ``lengths[b]``.
+
+    backend: None = auto (Pallas kernel on TPU when
+    `paged_decode_supported`, XLA fallback otherwise — CPU test runs
+    keep XLA speed unless a test opts into the interpreter); "pallas"
+    forces the kernel (interpret-mode off-TPU); "xla" forces the
+    fallback.
+    """
+    B, H, D = q.shape
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    P, Hk, page_size, Dk = k_pages.shape
+    if (Hk, Dk) != (H, D):
+        raise ValueError(f"cache heads/dim {(Hk, Dk)} != query {(H, D)}")
+    if page_table.ndim != 2 or page_table.shape[0] != B:
+        raise ValueError(f"page_table shape {page_table.shape} must be "
+                         f"[{B}, n_pages]")
+    if lengths.shape != (B,):
+        raise ValueError(f"lengths shape {lengths.shape} != ({B},)")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+
+    if backend is None:
+        on_tpu = not _interpret()
+        backend = ("pallas" if on_tpu and paged_decode_supported(D, page_size)
+                   else "xla")
+    _LAST_BACKEND["decode"] = backend
+    if backend == "xla":
+        return paged_decode_attention_xla(q, k_pages, v_pages, page_table,
+                                          lengths, sm_scale)
+    if backend != "pallas":
+        raise ValueError(f"unknown paged decode backend {backend!r}")
+    return paged_decode_attention_pallas(q, k_pages, v_pages, page_table,
+                                         lengths, sm_scale)

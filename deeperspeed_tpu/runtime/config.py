@@ -135,6 +135,142 @@ def _parse_sparse_attention(param_dict):
     return common
 
 
+def parse_inference_block(d):
+    """Parse + validate the "inference" block (the serving engine,
+    `deeperspeed_tpu/inference`). Module-level so `InferenceEngine` can
+    validate a raw config dict without the training-side batch triad;
+    `DeepSpeedConfig` delegates here. Same parse-time strictness as the
+    "checkpoint" block: a mistyped bucket ladder must fail at engine
+    init, not recompile (or OOM the page pool) under live traffic.
+
+    Returns the validated params dict, or False when absent/disabled."""
+    inf = d.get(c.INFERENCE) or {}
+    known = {c.INFERENCE_ENABLED, c.INFERENCE_PAGE_SIZE,
+             c.INFERENCE_NUM_PAGES, c.INFERENCE_MAX_SEQ_LEN,
+             c.INFERENCE_MAX_BATCH_SIZE, c.INFERENCE_TOKEN_BUDGET,
+             c.INFERENCE_PREFILL_LENGTHS, c.INFERENCE_PREFILL_BATCH_SIZES,
+             c.INFERENCE_DECODE_BATCH_SIZES, c.INFERENCE_TEMPERATURE,
+             c.INFERENCE_SEED, c.INFERENCE_KERNEL, c.INFERENCE_KV_DTYPE}
+    unknown = sorted(set(inf) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'inference' key(s) {unknown}; valid keys: "
+            f"{sorted(known)}")
+
+    enabled = inf.get(c.INFERENCE_ENABLED, c.INFERENCE_ENABLED_DEFAULT)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_ENABLED} must be a boolean, got "
+            f"{enabled!r}")
+    if not enabled:
+        return False
+
+    ints = {}
+    for key, default, lo in (
+            (c.INFERENCE_PAGE_SIZE, c.INFERENCE_PAGE_SIZE_DEFAULT, 8),
+            (c.INFERENCE_NUM_PAGES, c.INFERENCE_NUM_PAGES_DEFAULT, 2),
+            (c.INFERENCE_MAX_BATCH_SIZE,
+             c.INFERENCE_MAX_BATCH_SIZE_DEFAULT, 1),
+            (c.INFERENCE_TOKEN_BUDGET,
+             c.INFERENCE_TOKEN_BUDGET_DEFAULT, 1),
+            (c.INFERENCE_SEED, c.INFERENCE_SEED_DEFAULT, 0)):
+        value = as_int(inf.get(key, default), f"inference.{key}")
+        if value < lo:
+            raise DeepSpeedConfigError(
+                f"inference.{key} must be >= {lo}, got {value}")
+        ints[key] = value
+    if ints[c.INFERENCE_PAGE_SIZE] % 8:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_PAGE_SIZE} must be a multiple of 8 "
+            f"(TPU sublane tile), got {ints[c.INFERENCE_PAGE_SIZE]}")
+
+    max_seq_len = inf.get(c.INFERENCE_MAX_SEQ_LEN,
+                          c.INFERENCE_MAX_SEQ_LEN_DEFAULT)
+    if max_seq_len is not None:
+        max_seq_len = as_int(max_seq_len,
+                             f"inference.{c.INFERENCE_MAX_SEQ_LEN}")
+        if max_seq_len < 1:
+            raise DeepSpeedConfigError(
+                f"inference.{c.INFERENCE_MAX_SEQ_LEN} must be >= 1, got "
+                f"{max_seq_len}")
+
+    def bucket_list(key, minimum=1):
+        raw = inf.get(key)
+        if raw is None:
+            return None
+        if not isinstance(raw, (list, tuple)) or not raw:
+            raise DeepSpeedConfigError(
+                f"inference.{key} must be a non-empty list of ints, got "
+                f"{raw!r}")
+        vals = [as_int(v, f"inference.{key}") for v in raw]
+        if any(v < minimum for v in vals):
+            raise DeepSpeedConfigError(
+                f"inference.{key} entries must be >= {minimum}, got "
+                f"{vals}")
+        if sorted(vals) != vals or len(set(vals)) != len(vals):
+            raise DeepSpeedConfigError(
+                f"inference.{key} must be strictly increasing, got "
+                f"{vals}")
+        return vals
+
+    prefill_lengths = bucket_list(c.INFERENCE_PREFILL_LENGTHS)
+    if prefill_lengths is not None:
+        bad = [v for v in prefill_lengths
+               if v % ints[c.INFERENCE_PAGE_SIZE]]
+        if bad:
+            raise DeepSpeedConfigError(
+                f"inference.{c.INFERENCE_PREFILL_LENGTHS} entries must "
+                f"be multiples of page_size "
+                f"{ints[c.INFERENCE_PAGE_SIZE]} (the prefill scatter "
+                f"writes whole pages), got {bad}")
+    prefill_batch_sizes = bucket_list(c.INFERENCE_PREFILL_BATCH_SIZES)
+    decode_batch_sizes = bucket_list(c.INFERENCE_DECODE_BATCH_SIZES)
+    if decode_batch_sizes is not None and \
+            decode_batch_sizes[-1] < ints[c.INFERENCE_MAX_BATCH_SIZE]:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_DECODE_BATCH_SIZES} tops out at "
+            f"{decode_batch_sizes[-1]} but max_batch_size is "
+            f"{ints[c.INFERENCE_MAX_BATCH_SIZE]}: a full continuous "
+            f"batch would have no compiled shape")
+
+    temperature = inf.get(c.INFERENCE_TEMPERATURE,
+                          c.INFERENCE_TEMPERATURE_DEFAULT)
+    if not isinstance(temperature, (int, float)) or \
+            isinstance(temperature, bool) or temperature < 0:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_TEMPERATURE} must be a number >= 0 "
+            f"(0 = greedy), got {temperature!r}")
+
+    kernel = inf.get(c.INFERENCE_KERNEL, c.INFERENCE_KERNEL_DEFAULT)
+    if kernel not in c.INFERENCE_KERNEL_CHOICES:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_KERNEL} must be one of "
+            f"{list(c.INFERENCE_KERNEL_CHOICES)}, got {kernel!r}")
+
+    kv_dtype = inf.get(c.INFERENCE_KV_DTYPE, c.INFERENCE_KV_DTYPE_DEFAULT)
+    if kv_dtype is not None:
+        if not isinstance(kv_dtype, str):
+            raise DeepSpeedConfigError(
+                f"inference.{c.INFERENCE_KV_DTYPE} must be a dtype name "
+                f"string or null, got {kv_dtype!r}")
+        resolve_precision(kv_dtype)   # raises on unknown names
+
+    return {
+        "page_size": ints[c.INFERENCE_PAGE_SIZE],
+        "num_pages": ints[c.INFERENCE_NUM_PAGES],
+        "max_seq_len": max_seq_len,
+        "max_batch_size": ints[c.INFERENCE_MAX_BATCH_SIZE],
+        "token_budget": ints[c.INFERENCE_TOKEN_BUDGET],
+        "prefill_lengths": prefill_lengths,
+        "prefill_batch_sizes": prefill_batch_sizes,
+        "decode_batch_sizes": decode_batch_sizes,
+        "temperature": float(temperature),
+        "seed": ints[c.INFERENCE_SEED],
+        "kernel": kernel,
+        "kv_cache_dtype": kv_dtype,
+    }
+
+
 class DeepSpeedConfigWriter:
     """In-memory config builder that serializes to the JSON schema
     (reference `config.py:519`)."""
@@ -385,6 +521,11 @@ class DeepSpeedConfig:
         self._parse_training_health_block(d)
         self._parse_telemetry_block(d)
         self._parse_packing_block(d)
+
+        # Serving engine (deeperspeed_tpu/inference); module-level parse
+        # so InferenceEngine validates raw dicts identically.
+        self.inference_params = parse_inference_block(d)
+        self.inference_enabled = bool(self.inference_params)
 
         # Fork additions: gradient storage for debugging.
         self.store_gradients = bool(

@@ -334,3 +334,42 @@ PACKING_PAD_ID_DEFAULT = 0
 # drop rows under 50% occupancy (bench hygiene for tail rows)
 PACKING_DROP_TAIL = "drop_tail"
 PACKING_DROP_TAIL_DEFAULT = False
+
+# ---------------------------------------------------------------------------
+# Inference block (serving engine; deeperspeed_tpu/inference)
+# ---------------------------------------------------------------------------
+INFERENCE = "inference"
+INFERENCE_ENABLED = "enabled"
+INFERENCE_ENABLED_DEFAULT = False
+# KV-cache page geometry: slots per page (128 = one lane tile on TPU)
+# and pool pages per layer (page 0 is the reserved trash page)
+INFERENCE_PAGE_SIZE = "page_size"
+INFERENCE_PAGE_SIZE_DEFAULT = 128
+INFERENCE_NUM_PAGES = "num_pages"
+INFERENCE_NUM_PAGES_DEFAULT = 1024
+# serving window; None = the model's max_seq_len
+INFERENCE_MAX_SEQ_LEN = "max_seq_len"
+INFERENCE_MAX_SEQ_LEN_DEFAULT = None
+# in-flight decode sequences (the continuous batch)
+INFERENCE_MAX_BATCH_SIZE = "max_batch_size"
+INFERENCE_MAX_BATCH_SIZE_DEFAULT = 8
+# per-step admission budget: a prefill costs its padded bucket length,
+# a decode costs 1 (scheduler.py)
+INFERENCE_TOKEN_BUDGET = "token_budget"
+INFERENCE_TOKEN_BUDGET_DEFAULT = 4096
+# compiled-shape bucket ladders (None = derived defaults)
+INFERENCE_PREFILL_LENGTHS = "prefill_lengths"
+INFERENCE_PREFILL_BATCH_SIZES = "prefill_batch_sizes"
+INFERENCE_DECODE_BATCH_SIZES = "decode_batch_sizes"
+# sampling: 0.0 = greedy argmax (deterministic)
+INFERENCE_TEMPERATURE = "temperature"
+INFERENCE_TEMPERATURE_DEFAULT = 0.0
+INFERENCE_SEED = "seed"
+INFERENCE_SEED_DEFAULT = 0
+# decode-attention backend: auto (Pallas kernel on TPU, XLA elsewhere)
+INFERENCE_KERNEL = "kernel"
+INFERENCE_KERNEL_DEFAULT = "auto"
+INFERENCE_KERNEL_CHOICES = ("auto", "pallas", "xla")
+# KV-cache storage dtype: null = the params' compute dtype
+INFERENCE_KV_DTYPE = "kv_cache_dtype"
+INFERENCE_KV_DTYPE_DEFAULT = None

@@ -386,6 +386,8 @@ class DeepSpeedEngine:
         self._compiled_update = None
         self._compiled_train = {}
         self._compiled_eval = None
+        self._compiled_eval_logits = None
+        self._compiled_infer = None
         self._compiled_capture = None
         self._layers_to_hook = []
         self.hooked_activations = {}
@@ -1743,6 +1745,47 @@ class DeepSpeedEngine:
             return self.loss_fn(self._compute_view(params), batch, rng)
         return jax.jit(eval_fn)
 
+    def _module_apply(self):
+        """The model's raw forward (`apply(params, tokens) → logits`) —
+        required by the reference-fork `inference_batch` /
+        `eval_batch(return_logits=True)` additions. Engines wrapping a
+        bare ``loss_fn`` callable have no logits surface to expose."""
+        module = self.module_obj
+        if module is None or not hasattr(module, "apply"):
+            raise RuntimeError(
+                "inference_batch / eval_batch(return_logits=True) need "
+                "a model object exposing apply(params, tokens) -> "
+                "logits (models.gpt_neox.GPTNeoX / models.gpt2.GPT2 "
+                "do); this engine wraps a bare loss_fn")
+        return module.apply
+
+    def _build_eval_logits_fn(self):
+        module = self.module_obj
+        if module is not None and hasattr(module, "loss_and_logits"):
+            # single-forward path: the LM families expose
+            # loss_and_logits so the block stack runs ONCE (loss_fn +
+            # apply traced separately would double the forward flops —
+            # XLA does not CSE across the Pallas attention custom-calls)
+            def eval_fn(params, batch, rng):
+                return module.loss_and_logits(self._compute_view(params),
+                                              batch, rng)
+            return jax.jit(eval_fn)
+        apply = self._module_apply()
+
+        def eval_fn(params, batch, rng):
+            p = self._compute_view(params)
+            loss = self.loss_fn(p, batch, rng)
+            tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+            return loss, apply(p, tokens)
+        return jax.jit(eval_fn)
+
+    def _build_logits_fn(self):
+        apply = self._module_apply()
+
+        def logits_fn(params, tokens):
+            return apply(self._compute_view(params), tokens)
+        return jax.jit(logits_fn)
+
     # ------------------------------------------------------------------
     # ZeRO-Infinity param-offload streamed execution
     # (reference zero/stage3.py:916-935; design in zero/param_offload.py)
@@ -2515,15 +2558,43 @@ class DeepSpeedEngine:
         from .pipe import p2p
         p2p.configure(fp32_comm=self._fp32_comm)
 
-    def eval_batch(self, batch, rng=None):
+    def eval_batch(self, batch, rng=None, return_logits=False):
+        """Forward-only loss; with ``return_logits=True`` also the raw
+        [B, S, V] logits (reference-fork API parity — the pipeline
+        engine's `eval_batch(return_logits=)` for the GSPMD engine).
+        Logits retention changes peak memory, so the two modes compile
+        separately."""
         self._assert_comm_precision()
         batch = self._shard_batch(batch)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         if self.param_offload:
+            if return_logits:
+                raise NotImplementedError(
+                    "return_logits is unsupported on the streamed "
+                    "param-offload tier (its forward never materializes "
+                    "full logits)")
             return self._streamed_eval(batch, rng)
+        if return_logits:
+            if self._compiled_eval_logits is None:
+                self._compiled_eval_logits = self._build_eval_logits_fn()
+            return self._compiled_eval_logits(self.state.params, batch, rng)
         if self._compiled_eval is None:
             self._compiled_eval = self._build_eval_fn()
         return self._compiled_eval(self.state.params, batch, rng)
+
+    def inference_batch(self, data_iter=None, batch=None):
+        """Forward pass returning raw model outputs (reference-fork
+        addition, `pipe/engine.py:422`, here for the GSPMD engine):
+        ``batch`` (or ``next(data_iter)``) may be bare tokens or a
+        (tokens, labels[, segment_ids]) tuple — only tokens are read."""
+        self._assert_comm_precision()
+        if batch is None:
+            batch = next(data_iter)
+        batch = self._shard_batch(batch)
+        tokens = batch[0] if isinstance(batch, (tuple, list)) else batch
+        if self._compiled_infer is None:
+            self._compiled_infer = self._build_logits_fn()
+        return self._compiled_infer(self.state.params, tokens)
 
     def allreduce_gradients(self, bucket_size=MEMORY_OPT_ALLREDUCE_SIZE):
         """No-op hook for API parity: gradient reduction happens inside the
@@ -2607,14 +2678,21 @@ class DeepSpeedEngine:
                         load_module_strict=True,
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True,
-                        load_dataloader_states=True):
+                        load_dataloader_states=True,
+                        module_only=False):
+        """`module_only=True` restores ONLY the module params (serving
+        restarts / weight-only warm starts): manifest CRC verification
+        and the committed-tag fallback still run, but optimizer moments,
+        schedulers, dataloader position, loss-scale state and step
+        counters are neither deserialized nor touched."""
         from ..checkpoint.checkpointing import load_checkpoint as _load
         path, client_state = _load(
             self, load_dir, tag=tag,
             load_optimizer_states=load_optimizer_states,
             load_lr_scheduler_states=load_lr_scheduler_states,
-            load_dataloader_states=load_dataloader_states)
-        if path is not None:
+            load_dataloader_states=load_dataloader_states,
+            module_only=module_only)
+        if path is not None and not module_only:
             self.checkpoint_manager.on_checkpoint_loaded(self)
         return path, client_state
 

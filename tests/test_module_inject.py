@@ -1,0 +1,165 @@
+"""Fast-lane coverage for `module_inject/replace_module.py` on the JAX
+stack (it previously had none): weight extraction from a (torch-free)
+HF-style BertLayer into the fused `DeepSpeedTransformerLayer` must
+reproduce an unfused reference forward to tolerance — the transpose and
+QKV-concat conventions are exactly where injection silently corrupts a
+model — plus the serving-side `prepare_inference_params` surgery.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeperspeed_tpu.module_inject.replace_module import (
+    extract_bert_layer_params, prepare_inference_params,
+    replace_transformer_layer)
+
+HIDDEN, INTER, HEADS, SEQ, BATCH = 32, 64, 4, 16, 2
+
+
+def _linear(rng, n_in, n_out):
+    """torch.nn.Linear convention: weight [out, in], y = x @ W^T + b."""
+    return SimpleNamespace(
+        weight=rng.normal(size=(n_out, n_in)).astype(np.float32) * 0.1,
+        bias=rng.normal(size=(n_out,)).astype(np.float32) * 0.1)
+
+
+def _layer_norm_mod(rng, n):
+    return SimpleNamespace(
+        weight=(1.0 + 0.1 * rng.normal(size=(n,))).astype(np.float32),
+        bias=(0.1 * rng.normal(size=(n,))).astype(np.float32))
+
+
+def _fake_bert_layer(rng):
+    """Structure-compatible with HF BertLayer, numpy weights (the
+    extraction helper `_t` takes torch tensors OR arrays)."""
+    return SimpleNamespace(
+        attention=SimpleNamespace(
+            self=SimpleNamespace(query=_linear(rng, HIDDEN, HIDDEN),
+                                 key=_linear(rng, HIDDEN, HIDDEN),
+                                 value=_linear(rng, HIDDEN, HIDDEN)),
+            output=SimpleNamespace(dense=_linear(rng, HIDDEN, HIDDEN),
+                                   LayerNorm=_layer_norm_mod(rng, HIDDEN))),
+        intermediate=SimpleNamespace(dense=_linear(rng, HIDDEN, INTER)),
+        output=SimpleNamespace(dense=_linear(rng, INTER, HIDDEN),
+                               LayerNorm=_layer_norm_mod(rng, HIDDEN)))
+
+
+def _np_layer_norm(x, w, b, eps=1e-12):
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return (x - mean) / np.sqrt(var + eps) * w + b
+
+
+def _reference_bert_layer(layer, x, attention_mask=None):
+    """Unfused post-LN BERT layer forward straight off the torch-layout
+    weights (y = x @ W^T + b) — the oracle the injected fused layer
+    must match."""
+    def lin(mod, t):
+        return t @ np.asarray(mod.weight).T + np.asarray(mod.bias)
+
+    sa = layer.attention.self
+    q = lin(sa.query, x).reshape(BATCH, SEQ, HEADS, HIDDEN // HEADS)
+    k = lin(sa.key, x).reshape(BATCH, SEQ, HEADS, HIDDEN // HEADS)
+    v = lin(sa.value, x).reshape(BATCH, SEQ, HEADS, HIDDEN // HEADS)
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(HIDDEN // HEADS)
+    if attention_mask is not None:
+        s = s + np.where(attention_mask > 0, 0.0,
+                         -1e30)[:, None, None, :]
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ctx = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(BATCH, SEQ, HIDDEN)
+    attn = lin(layer.attention.output.dense, ctx)
+    x = _np_layer_norm(x + attn,
+                       np.asarray(layer.attention.output.LayerNorm.weight),
+                       np.asarray(layer.attention.output.LayerNorm.bias))
+    inter = lin(layer.intermediate.dense, x)
+    inter = np.asarray(jax.nn.gelu(jnp.asarray(inter),
+                                   approximate=False))
+    out = lin(layer.output.dense, inter)
+    return _np_layer_norm(x + out,
+                          np.asarray(layer.output.LayerNorm.weight),
+                          np.asarray(layer.output.LayerNorm.bias))
+
+
+def _bert_config(n_layers):
+    return SimpleNamespace(
+        hidden_size=HIDDEN, intermediate_size=INTER,
+        num_attention_heads=HEADS, attention_probs_dropout_prob=0.0,
+        hidden_dropout_prob=0.0, num_hidden_layers=n_layers,
+        initializer_range=0.02, layer_norm_eps=1e-12)
+
+
+class TestReplaceTransformerLayer:
+    def test_extracted_params_layout(self):
+        layer = _fake_bert_layer(np.random.default_rng(0))
+        p = extract_bert_layer_params(layer)
+        assert p["attn_qkvw"].shape == (HIDDEN, 3 * HIDDEN)
+        assert p["attn_qkvb"].shape == (3 * HIDDEN,)
+        # Q block of the fused qkv == query weight transposed
+        np.testing.assert_allclose(
+            np.asarray(p["attn_qkvw"][:, :HIDDEN]),
+            np.asarray(layer.attention.self.query.weight).T)
+        assert p["inter_w"].shape == (HIDDEN, INTER)
+        assert p["output_w"].shape == (INTER, HIDDEN)
+
+    @pytest.mark.parametrize("with_mask", [False, True])
+    def test_injected_layer_matches_unfused_forward(self, with_mask):
+        rng = np.random.default_rng(1)
+        layers_src = [_fake_bert_layer(rng) for _ in range(2)]
+        model = SimpleNamespace(
+            encoder=SimpleNamespace(layer=layers_src))
+        layers, params_list, encoder_fn = replace_transformer_layer(
+            None, model, micro_batch_size=BATCH,
+            bert_config=_bert_config(2), max_seq_length=SEQ,
+            preln=False, fp16=False, huggingface=True, training=False)
+        assert len(layers) == len(params_list) == 2
+
+        x = rng.normal(size=(BATCH, SEQ, HIDDEN)).astype(np.float32)
+        mask = None
+        if with_mask:
+            mask = np.ones((BATCH, SEQ), np.float32)
+            mask[0, SEQ // 2:] = 0.0
+        got = np.asarray(encoder_fn(params_list, x,
+                                    attention_mask=mask,
+                                    deterministic=True))
+        ref = x
+        for src in layers_src:
+            ref = _reference_bert_layer(src, ref, attention_mask=mask)
+        if with_mask:
+            # masked-out key columns produce don't-care rows at their
+            # own positions; compare attended positions only
+            got = got[:, :SEQ // 2]
+            ref = ref[:, :SEQ // 2]
+        np.testing.assert_allclose(got, ref, atol=2e-5)
+
+    def test_find_layers_failure_is_loud(self):
+        with pytest.raises(ValueError, match="encoder layer"):
+            replace_transformer_layer(
+                None, SimpleNamespace(), micro_batch_size=BATCH,
+                bert_config=_bert_config(1))
+
+
+class TestPrepareInferenceParams:
+    def test_casts_matmul_weights_only(self):
+        params = {"w": jnp.ones((4, 4), jnp.float32),
+                  "stack": jnp.ones((2, 4, 4), jnp.float32),
+                  "b": jnp.ones((4,), jnp.float64
+                                if jax.config.jax_enable_x64
+                                else jnp.float32),
+                  "ln": {"scale": jnp.ones((4,), jnp.bfloat16)}}
+        out = prepare_inference_params(params, jnp.bfloat16)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["stack"].dtype == jnp.bfloat16
+        assert out["b"].dtype == jnp.float32
+        assert out["ln"]["scale"].dtype == jnp.float32
+
+    def test_identity_for_fp32(self):
+        params = {"w": jnp.full((2, 2), 3.0)}
+        out = prepare_inference_params(params, jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(params["w"]))
