@@ -38,6 +38,10 @@ KEYS (default all):
              request stream through the InferenceEngine's paged KV
              cache; generated tokens/s/chip + p50/p99 per-token latency
              + zero-recompile check; opt-in via DS_BENCH_SERVE=1)
+  - elastic  (supervised-restart recovery: a hard mid-run kill under the
+             elasticity supervisor — kill -> resumed-step wall clock
+             (MTTR) and steps lost vs the committed checkpoint; opt-in
+             via DS_BENCH_ELASTIC=1)
 """
 
 import gc
@@ -54,7 +58,8 @@ import numpy as np
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
-               "moe": 800, "serve": 800}  # moe/longseq walk both engines
+               "moe": 800, "serve": 800,
+               "elastic": 600}  # moe/longseq walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -978,11 +983,129 @@ def row_serve():
                    "serve")
 
 
+_ELASTIC_WORKER = '''
+import json, os, sys, time
+workdir, target, crash = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+restart = int(os.environ.get("DS_ELASTIC_RESTART_COUNT", "0") or 0)
+import numpy as np
+import jax, jax.numpy as jnp
+import deeperspeed_tpu
+
+D = 64
+def loss_fn(params, batch, rng):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"])
+    return jnp.mean((h @ params["w2"] - y) ** 2)
+
+k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+params = {"w1": jax.random.normal(k1, (D, D)) * 0.1,
+          "w2": jax.random.normal(k2, (D, D)) * 0.1}
+ckpt = os.path.join(workdir, "ckpt")
+engine, *_ = deeperspeed_tpu.initialize(
+    model=loss_fn, model_parameters=params,
+    config_params={"train_batch_size": 8, "steps_per_print": 100000,
+                   "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                   "checkpoint": {"save_dir": ckpt, "async_save": False,
+                                  "save_interval_steps": 2}})
+resumed = None
+if os.path.exists(os.path.join(ckpt, "latest")):
+    path, _ = engine.load_checkpoint(ckpt)
+    assert path is not None
+    resumed = engine.global_steps
+events = open(os.path.join(workdir, "events.jsonl"), "a")
+while engine.global_steps < target:
+    s = engine.global_steps
+    r = np.random.default_rng(s)          # batch keyed by step: resume
+    x = r.normal(size=(1, 8, D)).astype(np.float32)   # replays the
+    y = r.normal(size=(1, 8, D)).astype(np.float32)   # exact stream
+    loss = engine.train_batch(batch=(x, y))
+    events.write(json.dumps({"restart": restart,
+                             "step": engine.global_steps,
+                             "t": time.time(), "resumed_from": resumed,
+                             "loss": float(loss)}) + "\\n")
+    events.flush()
+    if restart == 0 and crash and engine.global_steps == crash:
+        os._exit(3)                       # hard kill: no cleanup
+'''
+
+
+def row_elastic():
+    """Supervised-restart recovery (opt-in via DS_BENCH_ELASTIC=1): a
+    tiny training job under `elasticity.supervisor.Supervisor` is
+    hard-killed (os._exit — the single-host stand-in for a preempted
+    host) mid-run; the row reports the kill -> resumed-step wall clock
+    (MTTR: crash detection + backoff + process relaunch + jax bring-up
+    + checkpoint load + recompile) and the steps lost to the
+    uncommitted window (save interval 2 -> at most 1)."""
+    import shutil
+    import tempfile
+
+    from deeperspeed_tpu.elasticity import constants as ec
+    from deeperspeed_tpu.elasticity.supervisor import Supervisor
+
+    target = int(os.environ.get("DS_BENCH_ELASTIC_STEPS", "12"))
+    crash = int(os.environ.get("DS_BENCH_ELASTIC_CRASH_STEP", "7"))
+    workdir = tempfile.mkdtemp(prefix="ds_elastic_bench_")
+    try:
+        worker = os.path.join(workdir, "worker.py")
+        with open(worker, "w") as f:
+            f.write(_ELASTIC_WORKER)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = env.get("DS_BENCH_ELASTIC_PLATFORM",
+                                       env.get("JAX_PLATFORMS", ""))
+        # the worker runs from the temp dir: put this repo on its path,
+        # and scrub any leaked rendezvous vars (the child is single-host)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.abspath(__file__))] +
+            [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        for var in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "NODE_RANK",
+                    "MASTER_ADDR", "MASTER_PORT", "DS_SLOTS"):
+            env.pop(var, None)
+        sup = Supervisor(
+            [sys.executable, worker, workdir, str(target), str(crash)],
+            os.path.join(workdir, "state"), env=env, max_restarts=2,
+            backoff_base_s=float(os.environ.get(
+                "DS_BENCH_ELASTIC_BACKOFF", "0.5")),
+            backoff_max_s=4.0, backoff_jitter=0.0)
+        t0 = time.perf_counter()
+        stats = sup.run()
+        total_s = time.perf_counter() - t0
+        if stats["exit_code"] != 0 or stats["restarts"] != 1:
+            return {"elastic_error": f"unexpected run: {stats}"}
+
+        events = [json.loads(line) for line in
+                  open(os.path.join(workdir, "events.jsonl"))]
+        record = json.load(open(os.path.join(
+            workdir, "state", ec.SUPERVISOR_FILE)))
+        resumed = [e for e in events if e["restart"] == 1]
+        first_resumed = resumed[0]
+        recovery_s = first_resumed["t"] - record["crash_time"]
+        steps_lost = crash - int(first_resumed["resumed_from"])
+        # trajectory check: replayed steps match the first incarnation
+        first_by_step = {e["step"]: e["loss"] for e in events
+                         if e["restart"] == 0}
+        aligned = all(
+            abs(e["loss"] - first_by_step[e["step"]]) <= 1e-6
+            for e in resumed if e["step"] in first_by_step)
+        return {
+            "elastic_recovery_s": round(recovery_s, 2),
+            "elastic_steps_lost": steps_lost,
+            "elastic_backoff_s": round(stats["total_backoff_s"], 2),
+            "elastic_total_s": round(total_s, 2),
+            "elastic_crash_step": crash,
+            "elastic_resumed_from": int(first_resumed["resumed_from"]),
+            "elastic_trajectory_aligned": bool(aligned),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
            "sentinel": row_sentinel, "telemetry": row_telemetry,
-           "packed": row_packed, "serve": row_serve}
+           "packed": row_packed, "serve": row_serve,
+           "elastic": row_elastic}
 
 
 # ---------------------------------------------------------------------------
@@ -1004,6 +1127,8 @@ def rows_enabled():
         order.append("packed")
     if os.environ.get("DS_BENCH_SERVE", "0") not in ("0", "", "false"):
         order.append("serve")
+    if os.environ.get("DS_BENCH_ELASTIC", "0") not in ("0", "", "false"):
+        order.append("elastic")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -1011,7 +1136,8 @@ def rows_enabled():
     picked = {r.strip() for r in sel.split(",")}
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
-    for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve"):
+    for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
+                   "elastic"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

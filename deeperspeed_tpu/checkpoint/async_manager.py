@@ -105,6 +105,14 @@ class AsyncCheckpointManager:
             err, self._error = self._error, None
             self._error_logged = False
         if err is not None:
+            from ..elasticity.config import PeerFailureError
+            if isinstance(err, PeerFailureError):
+                # a commit-barrier timeout on a missing peer: the typed
+                # error (and its supervisor-recognized exit code 76)
+                # must survive the thread handoff — wrapping it in
+                # RuntimeError would demote restartable peer loss to a
+                # generic crash
+                raise err
             raise RuntimeError(
                 f"async checkpoint save failed: {err}") from err
 
@@ -204,6 +212,9 @@ class AsyncCheckpointManager:
             with self._lock:
                 err, self._error = self._error, None
             if err is not None:
+                from ..elasticity.config import PeerFailureError
+                if isinstance(err, PeerFailureError):
+                    raise err   # keep the typed exit-76 peer failure
                 raise RuntimeError(
                     f"checkpoint save failed: {err}") from err
             return tag

@@ -34,8 +34,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..elasticity.config import PeerFailureError, TopologyChangeError
 from ..runtime.fp16.loss_scaler import LossScaleState
-from ..utils.distributed import barrier
+from ..utils.distributed import BarrierTimeoutError, barrier
 from ..utils.logging import log_dist, logger
 from . import manifest as mf
 from .serialization import (load_obj, save_obj, shard_slice,
@@ -43,6 +44,28 @@ from .serialization import (load_obj, save_obj, shard_slice,
                             unshard_concat)
 
 LATEST_FILE = mf.LATEST_FILE
+
+
+def _commit_barrier(tag):
+    """Checkpoint-commit barrier, converted from "hang until deadline"
+    into "fail fast and hand off": a `BarrierTimeoutError` (typed, from
+    `utils.distributed.barrier`) is re-raised as a `PeerFailureError`
+    annotated with the peers the heartbeat monitor considers stale — the
+    supervisor then treats the exit as restartable peer loss, and the
+    log names the absent host instead of a bare DEADLINE_EXCEEDED."""
+    try:
+        barrier(tag)
+    except BarrierTimeoutError as e:
+        from ..elasticity.heartbeat import suspect_peers
+        suspects = suspect_peers()
+        who = (f"stale-heartbeat peer(s): {suspects}" if suspects else
+               "absent peer unknown (no heartbeat monitor is running)")
+        logger.error(f"checkpoint commit barrier '{tag}' timed out "
+                     f"after {e.elapsed_s:.1f}s — {who}")
+        raise PeerFailureError(
+            f"checkpoint commit barrier '{tag}' timed out after "
+            f"{e.elapsed_s:.1f}s; {who}",
+            peers=suspects, staleness_s=e.elapsed_s, cause=e) from e
 
 
 def _model_states_name(mp_rank):
@@ -179,13 +202,13 @@ def write_and_commit(payloads, save_dir, tag, step, save_latest=True):
             nbytes += entries[rel]["bytes"]
         mf.commit_staged(save_dir, staging, tag, step, files=entries)
     # every host's files are durable before anyone flips/reads latest;
-    # barrier() honors the init_distributed(timeout=...) deadline so a
-    # host dying mid-save fails the commit fast instead of hanging the
-    # surviving hosts forever (no-op single-process)
-    barrier("deeperspeed_ckpt_commit")
+    # the commit barrier fails fast (typed, absent peer recorded) so a
+    # host dying mid-save costs seconds, not a hang until the scheduler
+    # reaps the job (no-op single-process)
+    _commit_barrier("deeperspeed_ckpt_commit")
     if save_latest and jax.process_index() == 0:
         mf.write_latest(save_dir, tag)
-    barrier("deeperspeed_ckpt_latest")
+    _commit_barrier("deeperspeed_ckpt_latest")
     return nbytes
 
 
@@ -284,7 +307,7 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
         payload = _streamed_process_payload(engine, shard_dir)
         save_obj(payload, os.path.join(shard_dir, "streamed_states.pt"),
                  all_ranks=True)
-        barrier("deeperspeed_streamed_save")
+        _commit_barrier("deeperspeed_streamed_save")
         if pidx == 0:
             meta = {
                 "streamed_nvme": True,
@@ -310,10 +333,10 @@ def _save_streamed_nvme_checkpoint(engine, save_dir, ckpt_dir, tag,
         # all shard writers (and the meta write) are durable before the
         # pointer flips — `latest` can never name a checkpoint some host
         # never finished
-        barrier("deeperspeed_streamed_save2")
+        _commit_barrier("deeperspeed_streamed_save2")
         if save_latest and pidx == 0:
             mf.write_latest(save_dir, tag)
-        barrier("deeperspeed_streamed_latest")
+        _commit_barrier("deeperspeed_streamed_latest")
         log_dist(f"Saved streamed-NVMe checkpoint {tag} to {ckpt_dir} "
                  f"({n_proc} process shards)", ranks=[0])
         return True
@@ -706,6 +729,33 @@ def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
         return _load_streamed_nvme_checkpoint(engine, ckpt_dir,
                                               model_state)
 
+    # --- topology guard (elastic resume rules) ----------------------------
+    # dp world changes are ABSORBED: the zero-shard merge below re-slices
+    # the saved partitions with the current shardings, and host-side
+    # per-replica state reconciles under the new replica count. mp/model-
+    # axis changes are REJECTED loudly: model-parallel layouts differ
+    # structurally (packed rows, per-shard fusion), and a silent re-place
+    # would corrupt the weights.
+    saved_mp = model_state.get("mp_world_size")
+    if saved_mp is not None and int(saved_mp) != int(engine.mp_world_size):
+        raise TopologyChangeError(
+            f"checkpoint was saved at mp_world_size={saved_mp} but this "
+            f"engine runs mp_world_size={engine.mp_world_size}: model-"
+            f"axis topology changes cannot be elastically resumed — "
+            f"restore the original mesh, or re-shard the checkpoint "
+            f"offline")
+    saved_dp = model_state.get("dp_world_size")
+    dp_changed = (saved_dp is not None and
+                  int(saved_dp) != int(engine.dp_world_size))
+    if dp_changed:
+        log_dist(
+            f"elastic resume: dp world size changed {saved_dp} -> "
+            f"{engine.dp_world_size}; zero shards re-slice under the "
+            f"current mesh, the dataloader stream re-deals under the "
+            f"new replica count (epoch preserved, offset reset), and "
+            f"the global batch is now "
+            f"{engine.train_batch_size()} samples/step", ranks=[0])
+
     # --- params -----------------------------------------------------------
     params_np = state_dict_to_tree(model_state["module"],
                                    like=engine.params_natural_like())
@@ -770,14 +820,31 @@ def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
             dataloader.load_state_dict(model_state["dataloader"])
         except ValueError as e:
             # elastic restarts legitimately change batch size / replica
-            # count: position restore is then impossible — continue with
-            # a fresh stream rather than aborting a half-applied load
-            logger.warning(f"dataloader position not restored ({e}); "
-                           "resuming from the start of the epoch")
+            # count: an exact position restore is then impossible — the
+            # downgrade-to-warn path RECONCILES instead of aborting a
+            # half-applied load: epoch + seed (order-independent across
+            # topologies) are kept, the batch offset resets, and the
+            # stream re-deals under the current replica count
+            if hasattr(dataloader, "reconcile_state_dict"):
+                kept = dataloader.reconcile_state_dict(
+                    model_state["dataloader"])
+                logger.warning(
+                    f"dataloader position not restored exactly ({e}); "
+                    f"reconciled under the current topology instead: "
+                    f"{kept}")
+            else:
+                logger.warning(f"dataloader position not restored ({e});"
+                               " resuming from the start of the epoch")
     gns = getattr(engine, "gradient_noise_scale", None)
     if gns is not None and \
             model_state.get("gradient_noise_scale") is not None:
         gns.load_state_dict(model_state["gradient_noise_scale"])
+        if dp_changed:
+            # the mid-window buffer accumulates micro-grads from the OLD
+            # sample stream; under a re-dealt stream those partial sums
+            # would pair batches that never co-occurred — drop the
+            # window, keep the (topology-independent) EMA estimates
+            gns.reconcile_topology()
 
     engine.global_steps = model_state.get("global_steps", 0)
     engine.global_samples = model_state.get("global_samples", 0)

@@ -15,6 +15,49 @@ class ElasticityIncompatibleWorldSize(ElasticityError):
     """World size is not in the valid device-count list for this config."""
 
 
+class PeerFailureError(ElasticityError, SystemExit):
+    """A PEER host died or went silent (heartbeat staleness past
+    `fail_after_s`, or a commit barrier timed out on a missing host)
+    while THIS process is healthy.
+
+    Subclasses SystemExit so an UNCAUGHT raise ends the process with
+    `constants.EXIT_CODE_PEER_FAILURE` (also `.exit_code`/`.code` here)
+    rather than a generic traceback-and-1 — that exit code is how the
+    supervisor tells restartable peer loss from a local crash, and it
+    must hold without every training script adding a handler. It still
+    derives from `ElasticityError`, so `except Exception` /
+    `except ElasticityError` handlers see it as usual."""
+
+    def __init__(self, message, peers=None, staleness_s=None, cause=None):
+        self.peers = list(peers or [])
+        self.staleness_s = staleness_s
+        self.cause = cause
+        self.exit_code = ec.EXIT_CODE_PEER_FAILURE
+        super().__init__(message)
+        # SystemExit's interpreter-exit hook reads `.code`; our __init__
+        # chain set args=(message,), so pin the numeric code explicitly
+        self.code = self.exit_code
+
+
+class RestartBudgetExceededError(ElasticityError):
+    """The supervisor exhausted its restart budget: the job keeps dying
+    faster than the budget allows — stop burning the queue and page a
+    human."""
+
+
+class PoisonStepError(ElasticityError):
+    """The SAME training step crashed `poison_step_threshold` times in a
+    row: the failure is deterministic (bad batch, corrupt checkpoint,
+    code bug), so restarting would loop forever. Abort instead."""
+
+
+class TopologyChangeError(ElasticityError):
+    """A checkpoint was saved under a topology this engine cannot
+    elastically absorb (model-parallel/model-axis world changed): the
+    sharded layouts differ structurally, and re-slicing silently would
+    corrupt the weights. Re-shard offline or restore the old mesh."""
+
+
 class ElasticityConfig:
     """Parsed "elasticity" block.
 
@@ -67,3 +110,155 @@ class ElasticityConfig:
 
     def __repr__(self):
         return f"ElasticityConfig({self.__dict__})"
+
+
+# ---------------------------------------------------------------------------
+# Resilience sub-blocks: "elasticity": {"heartbeat": {...},
+# "supervisor": {...}} — validated at the checkpoint-block parse
+# strictness the repo standardizes on (unknown keys / bad types / bad
+# ranges raise at startup, not at the first failure hours later).
+# ---------------------------------------------------------------------------
+
+def _require_number(block, where, key, default, lo=None, lo_open=False):
+    value = block.get(key, default)
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        raise ElasticityConfigError(
+            f"{where}.{key} must be a number, got {value!r}")
+    value = float(value)
+    if lo is not None and (value <= lo if lo_open else value < lo):
+        op = ">" if lo_open else ">="
+        raise ElasticityConfigError(
+            f"{where}.{key} must be {op} {lo}, got {value}")
+    return value
+
+
+def _require_int(block, where, key, default, lo=0):
+    value = block.get(key, default)
+    if not isinstance(value, int) or isinstance(value, bool):
+        raise ElasticityConfigError(
+            f"{where}.{key} must be an int, got {value!r}")
+    if value < lo:
+        raise ElasticityConfigError(
+            f"{where}.{key} must be >= {lo}, got {value}")
+    return value
+
+
+def _require_bool(block, where, key, default):
+    value = block.get(key, default)
+    if not isinstance(value, bool):
+        raise ElasticityConfigError(
+            f"{where}.{key} must be a boolean, got {value!r}")
+    return value
+
+
+def parse_heartbeat_block(block):
+    """Validate "elasticity.heartbeat" -> params dict, or False when
+    absent/disabled."""
+    block = block or {}
+    where = f"{ec.ELASTICITY}.{ec.HEARTBEAT}"
+    known = {ec.HEARTBEAT_ENABLED, ec.HEARTBEAT_INTERVAL,
+             ec.HEARTBEAT_WARN_AFTER, ec.HEARTBEAT_FAIL_AFTER,
+             ec.HEARTBEAT_EMERGENCY_SAVE}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise ElasticityConfigError(
+            f"Unknown {where} key(s) {unknown}; valid keys: "
+            f"{sorted(known)}")
+    if not _require_bool(block, where, ec.HEARTBEAT_ENABLED,
+                         ec.HEARTBEAT_ENABLED_DEFAULT):
+        return False
+    interval = _require_number(block, where, ec.HEARTBEAT_INTERVAL,
+                               ec.HEARTBEAT_INTERVAL_DEFAULT,
+                               lo=0.0, lo_open=True)
+    warn_after = _require_number(block, where, ec.HEARTBEAT_WARN_AFTER,
+                                 ec.HEARTBEAT_WARN_AFTER_DEFAULT,
+                                 lo=0.0, lo_open=True)
+    fail_after = _require_number(block, where, ec.HEARTBEAT_FAIL_AFTER,
+                                 ec.HEARTBEAT_FAIL_AFTER_DEFAULT,
+                                 lo=0.0, lo_open=True)
+    if not interval < warn_after <= fail_after:
+        raise ElasticityConfigError(
+            f"{where} thresholds must satisfy "
+            f"{ec.HEARTBEAT_INTERVAL} < {ec.HEARTBEAT_WARN_AFTER} <= "
+            f"{ec.HEARTBEAT_FAIL_AFTER}, got {interval} / {warn_after} "
+            f"/ {fail_after} (a warn threshold at or below the publish "
+            "interval flags every healthy peer)")
+    return {
+        "interval_s": interval,
+        "warn_after_s": warn_after,
+        "fail_after_s": fail_after,
+        "emergency_checkpoint": _require_bool(
+            block, where, ec.HEARTBEAT_EMERGENCY_SAVE,
+            ec.HEARTBEAT_EMERGENCY_SAVE_DEFAULT),
+    }
+
+
+def parse_supervisor_block(block):
+    """Validate "elasticity.supervisor" -> params dict, or False when
+    absent/disabled."""
+    block = block or {}
+    where = f"{ec.ELASTICITY}.{ec.SUPERVISOR}"
+    known = {ec.SUPERVISOR_ENABLED, ec.SUPERVISOR_MAX_RESTARTS,
+             ec.SUPERVISOR_BACKOFF_BASE, ec.SUPERVISOR_BACKOFF_MAX,
+             ec.SUPERVISOR_BACKOFF_JITTER,
+             ec.SUPERVISOR_POISON_STEP_THRESHOLD}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise ElasticityConfigError(
+            f"Unknown {where} key(s) {unknown}; valid keys: "
+            f"{sorted(known)}")
+    if not _require_bool(block, where, ec.SUPERVISOR_ENABLED,
+                         ec.SUPERVISOR_ENABLED_DEFAULT):
+        return False
+    base = _require_number(block, where, ec.SUPERVISOR_BACKOFF_BASE,
+                           ec.SUPERVISOR_BACKOFF_BASE_DEFAULT,
+                           lo=0.0, lo_open=True)
+    cap = _require_number(block, where, ec.SUPERVISOR_BACKOFF_MAX,
+                          ec.SUPERVISOR_BACKOFF_MAX_DEFAULT,
+                          lo=0.0, lo_open=True)
+    if cap < base:
+        raise ElasticityConfigError(
+            f"{where}.{ec.SUPERVISOR_BACKOFF_MAX} ({cap}) must be >= "
+            f"{ec.SUPERVISOR_BACKOFF_BASE} ({base})")
+    jitter = _require_number(block, where, ec.SUPERVISOR_BACKOFF_JITTER,
+                             ec.SUPERVISOR_BACKOFF_JITTER_DEFAULT, lo=0.0)
+    if jitter > 1.0:
+        raise ElasticityConfigError(
+            f"{where}.{ec.SUPERVISOR_BACKOFF_JITTER} must be in [0, 1] "
+            f"(a fraction of the backoff), got {jitter}")
+    return {
+        "max_restarts": _require_int(
+            block, where, ec.SUPERVISOR_MAX_RESTARTS,
+            ec.SUPERVISOR_MAX_RESTARTS_DEFAULT, lo=0),
+        "backoff_base_s": base,
+        "backoff_max_s": cap,
+        "backoff_jitter": jitter,
+        "poison_step_threshold": _require_int(
+            block, where, ec.SUPERVISOR_POISON_STEP_THRESHOLD,
+            ec.SUPERVISOR_POISON_STEP_THRESHOLD_DEFAULT, lo=2),
+    }
+
+
+def parse_resilience_config(param_dict):
+    """Parse the resilience sub-blocks out of a full ds_config dict:
+    ``{"heartbeat": {...}|False, "supervisor": {...}|False}``. Unknown
+    TOP-LEVEL elasticity keys also reject here (the batch-solver keys
+    plus the two sub-blocks are the whole schema)."""
+    block = param_dict.get(ec.ELASTICITY) or {}
+    if not isinstance(block, dict):
+        raise ElasticityConfigError(
+            f"'{ec.ELASTICITY}' must be an object, got "
+            f"{type(block).__name__}")
+    known = {ec.ENABLED, ec.MAX_ACCEPTABLE_BATCH_SIZE, ec.MICRO_BATCHES,
+             ec.MIN_GPUS, ec.MAX_GPUS, ec.MIN_TIME, ec.VERSION,
+             ec.PREFER_LARGER_BATCH, ec.IGNORE_NON_ELASTIC_BATCH_INFO,
+             ec.HEARTBEAT, ec.SUPERVISOR}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise ElasticityConfigError(
+            f"Unknown '{ec.ELASTICITY}' key(s) {unknown}; valid keys: "
+            f"{sorted(known)}")
+    return {
+        "heartbeat": parse_heartbeat_block(block.get(ec.HEARTBEAT)),
+        "supervisor": parse_supervisor_block(block.get(ec.SUPERVISOR)),
+    }

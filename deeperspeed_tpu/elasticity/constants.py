@@ -32,3 +32,50 @@ LATEST_ELASTICITY_VERSION = 0.1
 MINIMUM_DEEPSPEED_VERSION = "0.3.8"
 
 DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+
+# ---------------------------------------------------------------------------
+# Resilience sub-blocks (fork addition): peer-health heartbeats and the
+# supervised-restart layer. They live INSIDE the "elasticity" JSON block
+# next to the batch-solver keys above but are independently gated — a
+# job can run heartbeats + supervised restarts without the elastic
+# batch arithmetic, and vice versa.
+# ---------------------------------------------------------------------------
+
+HEARTBEAT = "heartbeat"
+HEARTBEAT_ENABLED = "enabled"
+HEARTBEAT_ENABLED_DEFAULT = False
+HEARTBEAT_INTERVAL = "interval_s"
+HEARTBEAT_INTERVAL_DEFAULT = 5.0
+HEARTBEAT_WARN_AFTER = "warn_after_s"
+HEARTBEAT_WARN_AFTER_DEFAULT = 15.0
+HEARTBEAT_FAIL_AFTER = "fail_after_s"
+HEARTBEAT_FAIL_AFTER_DEFAULT = 60.0
+HEARTBEAT_EMERGENCY_SAVE = "emergency_checkpoint"
+HEARTBEAT_EMERGENCY_SAVE_DEFAULT = True
+
+SUPERVISOR = "supervisor"
+SUPERVISOR_ENABLED = "enabled"
+SUPERVISOR_ENABLED_DEFAULT = False
+SUPERVISOR_MAX_RESTARTS = "max_restarts"
+SUPERVISOR_MAX_RESTARTS_DEFAULT = 3
+SUPERVISOR_BACKOFF_BASE = "backoff_base_s"
+SUPERVISOR_BACKOFF_BASE_DEFAULT = 1.0
+SUPERVISOR_BACKOFF_MAX = "backoff_max_s"
+SUPERVISOR_BACKOFF_MAX_DEFAULT = 60.0
+SUPERVISOR_BACKOFF_JITTER = "backoff_jitter"
+SUPERVISOR_BACKOFF_JITTER_DEFAULT = 0.25
+SUPERVISOR_POISON_STEP_THRESHOLD = "poison_step_threshold"
+SUPERVISOR_POISON_STEP_THRESHOLD_DEFAULT = 3
+
+# Env vars exported by the supervisor into every (re)launched child.
+DS_ELASTIC_STATE_DIR = "DS_ELASTIC_STATE_DIR"
+DS_ELASTIC_RESTART_COUNT = "DS_ELASTIC_RESTART_COUNT"
+
+# Files inside the elastic state dir.
+PROGRESS_FILE = "progress.json"          # child: step heartbeat
+SUPERVISOR_FILE = "supervisor.json"      # supervisor: restart record
+
+# Exit code a training process uses for "a PEER died, I am healthy":
+# restartable by the supervisor, distinct from local crashes in logs
+# and MTTR accounting. 70-79 is free of shell/Python conventions.
+EXIT_CODE_PEER_FAILURE = 76

@@ -1,0 +1,300 @@
+"""Supervised restart with capped exponential backoff.
+
+The missing half of the resilience loop: peer-health detection
+(`heartbeat.py`) and emergency checkpoints (PR 3) get a wounded job OFF
+the accelerators cleanly, but nothing brought it BACK — the launcher
+simply exited with the child's return code. `Supervisor` closes the
+loop: it relaunches the training process on restartable failures with
+
+- **capped exponential backoff + jitter** — ``backoff_base_s * 2**k``
+  up to ``backoff_max_s``, each scaled by a uniform jitter so a fleet
+  of per-host supervisors does not stampede the coordinator;
+- **a restart budget** — ``max_restarts`` relaunches total, then a
+  typed `RestartBudgetExceededError`;
+- **a poison-step detector** — the child reports its training step via
+  a progress file (written by the engine at every step boundary when
+  ``DS_ELASTIC_STATE_DIR`` is exported, which the supervisor does); the
+  SAME step crashing ``poison_step_threshold`` times in a row means the
+  failure is deterministic and restarting would loop forever — a typed
+  `PoisonStepError` aborts instead.
+
+Restartability: exit code 0 is success; `EXIT_CODE_PEER_FAILURE` (a
+healthy process exiting because a PEER died) and any other nonzero code
+(crash, OOM-kill, preemption SIGKILL) are restartable — the budget and
+the poison detector bound the loop, so an honest crash-restart cycle
+is safe to attempt.
+
+MTTR accounting: before each relaunch the supervisor writes
+``supervisor.json`` (crash wall-time, exit code, restart count) into
+the state dir; the restarted engine reads it at init and emits
+``Train/Elastic/mttr_s`` / ``restart_count`` scalars, so recovery
+latency is measured end to end by the system itself.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+from ..utils.logging import logger
+from . import constants as ec
+from .config import PoisonStepError, RestartBudgetExceededError
+
+_SLEEP_CHUNK_S = 0.2   # stop_requested is honored mid-backoff
+
+
+def read_progress(state_dir):
+    """The child's last progress record ({"global_steps": N, ...}), or
+    None when it never got far enough to write one."""
+    path = os.path.join(state_dir, ec.PROGRESS_FILE)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_progress(state_dir, global_steps, committed_step=None):
+    """Atomic progress write (engine step boundary): the supervisor must
+    never read a torn record mid-crash."""
+    record = {"global_steps": int(global_steps), "time": time.time()}
+    if committed_step is not None:
+        record["committed_step"] = int(committed_step)
+    tmp = os.path.join(state_dir, ec.PROGRESS_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, os.path.join(state_dir, ec.PROGRESS_FILE))
+
+
+def read_restart_record(state_dir=None):
+    """The supervisor's pre-relaunch record for THIS incarnation (crash
+    time / exit code / restart count), or None on a first launch. The
+    engine calls this (state dir from `DS_ELASTIC_STATE_DIR`) to emit
+    the MTTR + restart-count telemetry scalars."""
+    state_dir = state_dir or os.environ.get(ec.DS_ELASTIC_STATE_DIR)
+    if not state_dir:
+        return None
+    try:
+        with open(os.path.join(state_dir, ec.SUPERVISOR_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class Supervisor:
+    """Run a training child under restart supervision.
+
+    ``argv`` is the child command line (the launcher passes the user
+    script + args); ``state_dir`` holds the progress/supervisor files
+    and is exported to the child as ``DS_ELASTIC_STATE_DIR`` along with
+    ``DS_ELASTIC_RESTART_COUNT``. ``popen_fn``/``sleep_fn``/``rng`` are
+    injection seams for deterministic tests."""
+
+    def __init__(self, argv, state_dir, env=None, max_restarts=3,
+                 backoff_base_s=1.0, backoff_max_s=60.0,
+                 backoff_jitter=0.25, poison_step_threshold=3,
+                 popen_fn=None, sleep_fn=None, rng=None):
+        self.argv = list(argv)
+        self.state_dir = state_dir
+        self.env = dict(os.environ if env is None else env)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.backoff_jitter = float(backoff_jitter)
+        self.poison_step_threshold = int(poison_step_threshold)
+        self._popen = popen_fn or (
+            lambda argv, env: subprocess.Popen(argv, env=env))
+        self._sleep = sleep_fn or time.sleep
+        self._rng = rng or random.Random()
+        self.stop_requested = False
+        self._child = None
+
+        self.restarts = 0
+        self.exit_codes = []
+        self.crash_steps = []
+        self._same_step_crashes = 0
+        self._last_crash_step = None
+        self.total_backoff_s = 0.0
+
+    # -- policy ------------------------------------------------------------
+
+    def backoff_s(self, attempt):
+        """Backoff before restart `attempt` (1-based): capped
+        exponential, scaled by a uniform jitter in
+        [1 - jitter, 1 + jitter]."""
+        base = min(self.backoff_base_s * (2.0 ** (attempt - 1)),
+                   self.backoff_max_s)
+        if self.backoff_jitter:
+            base *= 1.0 + self._rng.uniform(-self.backoff_jitter,
+                                            self.backoff_jitter)
+        return max(base, 0.0)
+
+    def _record_crash_step(self):
+        progress = read_progress(self.state_dir)
+        step = None if progress is None else progress.get("global_steps")
+        self.crash_steps.append(step)
+        if step is not None and step == self._last_crash_step:
+            self._same_step_crashes += 1
+        else:
+            self._same_step_crashes = 1
+        self._last_crash_step = step
+        return step
+
+    # -- the supervision loop ---------------------------------------------
+
+    def _spawn(self):
+        env = dict(self.env)
+        env[ec.DS_ELASTIC_STATE_DIR] = self.state_dir
+        env[ec.DS_ELASTIC_RESTART_COUNT] = str(self.restarts)
+        self._child = self._popen(self.argv, env)
+        return self._child
+
+    def terminate_child(self):
+        """Forward a shutdown (launcher SIGTERM/SIGINT) to the child and
+        stop restarting."""
+        self.stop_requested = True
+        child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.terminate()
+            except OSError:  # pragma: no cover
+                pass
+
+    def run(self):
+        """Supervise until the child exits 0 (returns stats), the budget
+        runs out (`RestartBudgetExceededError`), the same step keeps
+        crashing (`PoisonStepError`), or a stop is requested (returns
+        stats with the child's last exit code)."""
+        os.makedirs(self.state_dir, exist_ok=True)
+        # stale records from a PREVIOUS supervision session in a reused
+        # state dir would poison this one: an old progress.json mis-
+        # attributes startup crashes to its step (false poison-step
+        # aborts), an old supervisor.json feeds the restarted engine a
+        # bogus days-long MTTR. Records written DURING this session
+        # survive restarts — only the pre-session leftovers go.
+        for stale in (ec.PROGRESS_FILE, ec.SUPERVISOR_FILE):
+            try:
+                os.remove(os.path.join(self.state_dir, stale))
+            except OSError:
+                pass
+        while True:
+            child = self._spawn()
+            rc = child.wait()
+            self._child = None
+            if rc == 0:
+                return self.stats(exit_code=0)
+            self.exit_codes.append(rc)
+            if self.stop_requested:
+                logger.info(f"supervisor: stop requested; child exited "
+                            f"{rc}, not restarting")
+                return self.stats(exit_code=rc)
+
+            crash_step = self._record_crash_step()
+            kind = ("peer failure"
+                    if rc == ec.EXIT_CODE_PEER_FAILURE else "crash")
+            if self._same_step_crashes >= self.poison_step_threshold:
+                raise PoisonStepError(
+                    f"step {crash_step} crashed "
+                    f"{self._same_step_crashes} times in a row "
+                    f"(poison_step_threshold="
+                    f"{self.poison_step_threshold}); the failure is "
+                    f"deterministic — aborting instead of looping. "
+                    f"Exit codes: {self.exit_codes}")
+            if self.restarts >= self.max_restarts:
+                raise RestartBudgetExceededError(
+                    f"child exited {rc} ({kind}) and the restart budget "
+                    f"({self.max_restarts}) is exhausted; aborting. "
+                    f"Exit codes: {self.exit_codes}, crash steps: "
+                    f"{self.crash_steps}")
+
+            self.restarts += 1
+            backoff = self.backoff_s(self.restarts)
+            self.total_backoff_s += backoff
+            logger.warning(
+                f"supervisor: child exited {rc} ({kind}) at step "
+                f"{crash_step}; restart {self.restarts}/"
+                f"{self.max_restarts} in {backoff:.1f}s")
+            self._write_restart_record(rc, crash_step, backoff)
+            self._interruptible_sleep(backoff)
+            if self.stop_requested:
+                return self.stats(exit_code=rc)
+
+    def _interruptible_sleep(self, seconds):
+        deadline = time.monotonic() + seconds
+        while not self.stop_requested:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            self._sleep(min(remaining, _SLEEP_CHUNK_S))
+
+    def _write_restart_record(self, exit_code, crash_step, backoff):
+        record = {
+            "crash_time": time.time(),
+            "exit_code": int(exit_code),
+            "crash_step": crash_step,
+            "restart_count": self.restarts,
+            "backoff_s": backoff,
+        }
+        tmp = os.path.join(self.state_dir, ec.SUPERVISOR_FILE + ".tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp,
+                       os.path.join(self.state_dir, ec.SUPERVISOR_FILE))
+        except OSError as e:  # pragma: no cover - state dir vanished
+            logger.warning(f"supervisor: could not write restart "
+                           f"record: {e}")
+
+    def stats(self, exit_code=0):
+        return {
+            "exit_code": exit_code,
+            "restarts": self.restarts,
+            "exit_codes": list(self.exit_codes),
+            "crash_steps": list(self.crash_steps),
+            "total_backoff_s": self.total_backoff_s,
+        }
+
+
+def supervised_exit_code(exc):
+    """Map a training-loop exception to the conventional process exit
+    code (`PeerFailureError` carries its own; everything else is 1)."""
+    return getattr(exc, "exit_code", 1)
+
+
+def main(argv=None):  # pragma: no cover - thin CLI shim
+    """``python -m deeperspeed_tpu.elasticity.supervisor [opts] --
+    <child argv>`` — the standalone form of what `launcher/launch.py
+    --elastic` does inline."""
+    import argparse
+    parser = argparse.ArgumentParser(description="DeeperSpeed-TPU "
+                                     "elastic restart supervisor")
+    parser.add_argument("--state_dir", required=True)
+    parser.add_argument("--max_restarts", type=int,
+                        default=ec.SUPERVISOR_MAX_RESTARTS_DEFAULT)
+    parser.add_argument("--backoff_base_s", type=float,
+                        default=ec.SUPERVISOR_BACKOFF_BASE_DEFAULT)
+    parser.add_argument("--backoff_max_s", type=float,
+                        default=ec.SUPERVISOR_BACKOFF_MAX_DEFAULT)
+    parser.add_argument("--backoff_jitter", type=float,
+                        default=ec.SUPERVISOR_BACKOFF_JITTER_DEFAULT)
+    parser.add_argument("--poison_step_threshold", type=int,
+                        default=ec.SUPERVISOR_POISON_STEP_THRESHOLD_DEFAULT)
+    parser.add_argument("child", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    child = [a for a in args.child if a != "--"]
+    if not child:
+        parser.error("no child command given")
+    supervisor = Supervisor(
+        child, args.state_dir, max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base_s,
+        backoff_max_s=args.backoff_max_s,
+        backoff_jitter=args.backoff_jitter,
+        poison_step_threshold=args.poison_step_threshold)
+    stats = supervisor.run()
+    sys.exit(stats["exit_code"])
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

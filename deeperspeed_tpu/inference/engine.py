@@ -265,6 +265,13 @@ class InferenceEngine:
                       "schedule_s": 0.0, "prefill_s": 0.0,
                       "decode_s": 0.0, "admission_wait_s": 0.0}
 
+        # graceful drain (SIGTERM): flag-only handler, acted on at the
+        # next serving-loop iteration — the PR 3 signal discipline
+        self.drain_deadline_s = ip["drain_deadline_s"]
+        self._drain_requested = False
+        self._drain_signum = None
+        self._prev_handlers = {}
+
     # ------------------------------------------------------------------
     # weights
     # ------------------------------------------------------------------
@@ -558,10 +565,105 @@ class InferenceEngine:
         for i, req in enumerate(plan.decodes):
             self.scheduler.complete_decode(req, int(nxt[i]))
 
+    # ------------------------------------------------------------------
+    # graceful drain (SIGTERM from the pod scheduler)
+    # ------------------------------------------------------------------
+    #
+    # Serving must NOT inherit the training engine's emergency-save
+    # handler semantics: there is no state worth checkpointing mid-
+    # decode, and dying mid-step wastes every in-flight sequence. The
+    # right shutdown is: stop admitting, finish what's running (bounded
+    # by `inference.drain_deadline_s`), flush the Serve/* telemetry,
+    # exit 0 so the orchestrator sees a clean termination.
+
+    def install_drain_handler(self):
+        """Register SIGTERM/SIGINT to REQUEST a drain (flag only — the
+        same async-signal-safe discipline as the training preemption
+        handler); `run()` performs the actual drain at its next loop
+        iteration. Weakly bound: the signal registry must not pin the
+        engine (and its page pools) for the process lifetime."""
+        import signal as _signal
+        import threading
+        import weakref
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        engine_ref = weakref.ref(self)
+
+        def handler(signum, frame):  # noqa: ARG001
+            engine = engine_ref()
+            if engine is not None:
+                engine._drain_requested = True
+                engine._drain_signum = signum
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = _signal.signal(sig, handler)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        return self
+
+    def restore_signal_handlers(self):
+        import signal as _signal
+        for sig, handler in self._prev_handlers.items():
+            try:
+                _signal.signal(sig, handler)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev_handlers = {}
+
+    def request_drain(self):
+        """Programmatic equivalent of the SIGTERM handler."""
+        self._drain_requested = True
+
+    def drain(self, deadline_s=None):
+        """Stop admissions, finish in-flight sequences for at most
+        `deadline_s` (config `inference.drain_deadline_s` by default),
+        then flush Serve/* telemetry. Returns a summary dict; fresh
+        queued requests are left unserved (`unserved` counts them) for
+        the replacement instance."""
+        deadline_s = (self.drain_deadline_s if deadline_s is None
+                      else float(deadline_s))
+        self.scheduler.stop_admissions()
+        t0 = time.perf_counter()
+        deadline_hit = False
+        while self.scheduler.has_inflight_work:
+            if time.perf_counter() - t0 > deadline_s:
+                deadline_hit = True
+                break
+            self.step()
+        summary = {
+            "drained_s": time.perf_counter() - t0,
+            "deadline_hit": deadline_hit,
+            "inflight_abandoned": (len(self.scheduler.running) +
+                                   sum(1 for r in self.scheduler.waiting
+                                       if r.evictions)),
+            "unserved": sum(1 for r in self.scheduler.waiting
+                            if not r.evictions),
+        }
+        self.serve_stats()          # pushes Serve/* scalars
+        if self.monitor is not None:
+            self.monitor.close()    # drain the buffered scalar queue
+        self.telemetry.close()
+        self.restore_signal_handlers()
+        from ..utils.logging import logger
+        logger.info(f"inference drain complete: {summary}")
+        return summary
+
     def run(self, max_steps=None):
-        """Drive steps until the queue drains (or `max_steps`)."""
+        """Drive steps until the queue drains (or `max_steps`). A
+        pending drain request (SIGTERM via `install_drain_handler`, or
+        `request_drain()`) switches to the graceful-drain path and exits
+        the process with code 0 once in-flight work is finished — also
+        on an IDLE server (nothing in flight ⇒ the drain is just the
+        telemetry flush + exit; the SIGTERM contract must not depend on
+        traffic being present)."""
         steps = 0
-        while self.scheduler.has_work:
+        while True:
+            if self._drain_requested:
+                self.drain()
+                raise SystemExit(0)
+            if not self.scheduler.has_work:
+                break
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:

@@ -153,6 +153,7 @@ class ContinuousBatchingScheduler:
         self.running = []
         self.finished = []
         self._counter = 0
+        self.draining = False
 
     # -- intake ------------------------------------------------------------
 
@@ -185,6 +186,24 @@ class ContinuousBatchingScheduler:
     @property
     def has_work(self):
         return bool(self.waiting or self.running)
+
+    # -- graceful drain ----------------------------------------------------
+
+    def stop_admissions(self):
+        """Graceful-drain mode (SIGTERM): `schedule()` stops admitting
+        FRESH requests from the queue. Eviction-regrowth re-prefills
+        (evicted in-flight sequences, whose K/V must be rebuilt to
+        finish) still admit — they count as in-flight work."""
+        self.draining = True
+
+    @property
+    def has_inflight_work(self):
+        """Work a graceful drain should still finish: running sequences
+        plus evicted ones awaiting re-prefill (their generation is
+        partial). Fresh queued requests do NOT count — a draining server
+        leaves them for the replacement instance."""
+        return bool(self.running or
+                    any(r.evictions for r in self.waiting))
 
     def pop_finished(self):
         """Drain completed requests (the caller owns them afterwards).
@@ -253,6 +272,11 @@ class ContinuousBatchingScheduler:
         while self.waiting and len(prefills) < max_prefill_batch and \
                 len(self.running) < self.max_batch_size:
             req = self.waiting[0]
+            if self.draining and not req.evictions:
+                # drain: fresh requests stay queued (the front of the
+                # queue is fresh ⇒ everything behind it is too — evicted
+                # requests requeue at the FRONT)
+                break
             length = _bucket(len(req.context), self._prefill_ladder)
             if length is None:
                 # unreachable: the ladder tops at the aligned window and

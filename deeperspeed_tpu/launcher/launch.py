@@ -6,6 +6,13 @@ every local chip, so this spawns ONE subprocess per node (rank ==
 node_rank) and exports the jax.distributed rendezvous env; ``DS_SLOTS``
 carries the chip count for the hostfile's slots= entry. Signal handling
 matches the reference: SIGINT/SIGTERM kill the child process group.
+
+Elastic mode (``--elastic``): the child runs under an
+`elasticity.supervisor.Supervisor` — restartable failures (peer death,
+preemption, crash) relaunch it with capped exponential backoff inside a
+restart budget, and the poison-step detector aborts a deterministic
+crash loop. The supervisor's state dir (progress + restart records) is
+exported to the child as ``DS_ELASTIC_STATE_DIR``.
 """
 
 import argparse
@@ -14,8 +21,124 @@ import signal
 import subprocess
 import sys
 
+from ..elasticity import constants as ec
+from ..elasticity.supervisor import Supervisor
 from ..utils.logging import logger
 from .runner import decode_world_info
+
+
+def add_elastic_args(parser):
+    """The supervised-restart CLI surface, shared by the `deepspeed`
+    front-end (`runner.py`, which forwards them here) and this per-node
+    launcher. Numeric defaults are None so resolution can tell "flag
+    given" from "flag omitted": explicit CLI > the config's
+    `elasticity.supervisor` block > built-in defaults."""
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise the training process: restart "
+                        "restartable failures with backoff + budget "
+                        "(also enabled by elasticity.supervisor.enabled "
+                        "in the ds config)")
+    parser.add_argument("--elastic_state_dir", type=str, default=None,
+                        help="dir for progress/restart records (exported "
+                        "to the child as DS_ELASTIC_STATE_DIR; default "
+                        ".ds_elastic)")
+    parser.add_argument("--elastic_max_restarts", type=int, default=None)
+    parser.add_argument("--elastic_backoff_base_s", type=float,
+                        default=None)
+    parser.add_argument("--elastic_backoff_max_s", type=float,
+                        default=None)
+    parser.add_argument("--elastic_backoff_jitter", type=float,
+                        default=None)
+    parser.add_argument("--elastic_poison_step_threshold", type=int,
+                        default=None)
+
+
+_ELASTIC_FLAGS = ("elastic_state_dir", "elastic_max_restarts",
+                  "elastic_backoff_base_s", "elastic_backoff_max_s",
+                  "elastic_backoff_jitter",
+                  "elastic_poison_step_threshold")
+
+
+def elastic_argv(args):
+    """Re-serialize the elastic flags for forwarding to launch.py
+    (only the ones actually given — omitted flags stay resolvable from
+    the config block on the receiving side)."""
+    if not getattr(args, "elastic", False):
+        return []
+    out = ["--elastic"]
+    for flag in _ELASTIC_FLAGS:
+        value = getattr(args, flag, None)
+        if value is not None:
+            out += [f"--{flag}", str(value)]
+    return out
+
+
+def _find_ds_config(user_args):
+    """The ds-config JSON path from the user script's own args (the
+    launcher forwards them verbatim, so the `elasticity.supervisor`
+    policy block can be honored without a second config mechanism)."""
+    for i, arg in enumerate(user_args):
+        if arg in ("--deepspeed_config", "--deepspeed-config"):
+            if i + 1 < len(user_args):
+                return user_args[i + 1]
+        for prefix in ("--deepspeed_config=", "--deepspeed-config="):
+            if arg.startswith(prefix):
+                return arg[len(prefix):]
+    return None
+
+
+def resolve_supervisor_params(args):
+    """(enabled, params) for the restart supervisor: explicit CLI flags
+    override the ds config's `elasticity.supervisor` block, which
+    overrides built-in defaults. Supervision is on when `--elastic` was
+    given OR the block says `enabled: true`. A malformed block raises
+    here (parse-time strictness — same error the engine would raise,
+    but before any process is spawned)."""
+    import json
+
+    block = False
+    config_path = _find_ds_config(args.user_args)
+    if config_path:
+        try:
+            with open(config_path) as f:
+                config = json.load(f)
+        except (OSError, ValueError) as e:
+            # unreadable config: the CHILD will fail with the real
+            # error; don't duplicate it here
+            logger.warning(f"could not read {config_path} for the "
+                           f"elasticity.supervisor block ({e})")
+            config = {}
+        from ..elasticity.config import parse_supervisor_block
+        block = parse_supervisor_block(
+            (config.get(ec.ELASTICITY) or {}).get(ec.SUPERVISOR))
+    enabled = bool(getattr(args, "elastic", False)) or bool(block)
+
+    def pick(cli_value, key, default):
+        if cli_value is not None:
+            return cli_value
+        if block and key in block:
+            return block[key]
+        return default
+
+    params = {
+        "state_dir": pick(args.elastic_state_dir, None, ".ds_elastic"),
+        "max_restarts": pick(args.elastic_max_restarts,
+                             "max_restarts",
+                             ec.SUPERVISOR_MAX_RESTARTS_DEFAULT),
+        "backoff_base_s": pick(args.elastic_backoff_base_s,
+                               "backoff_base_s",
+                               ec.SUPERVISOR_BACKOFF_BASE_DEFAULT),
+        "backoff_max_s": pick(args.elastic_backoff_max_s,
+                              "backoff_max_s",
+                              ec.SUPERVISOR_BACKOFF_MAX_DEFAULT),
+        "backoff_jitter": pick(args.elastic_backoff_jitter,
+                               "backoff_jitter",
+                               ec.SUPERVISOR_BACKOFF_JITTER_DEFAULT),
+        "poison_step_threshold": pick(
+            args.elastic_poison_step_threshold, "poison_step_threshold",
+            ec.SUPERVISOR_POISON_STEP_THRESHOLD_DEFAULT),
+    }
+    return enabled, params
 
 
 def parse_args(args=None):
@@ -26,6 +149,7 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--world_info", type=str, default="None",
                         help="base64-encoded {hostname: slots} dict")
+    add_elastic_args(parser)
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -54,6 +178,12 @@ def main(args=None):
         env["DS_SLOTS"] = str(slots)
 
     cmd = [sys.executable, "-u", args.user_script] + args.user_args
+
+    elastic_enabled, sup_params = resolve_supervisor_params(args)
+    if elastic_enabled:
+        return _run_supervised(sup_params, cmd, env, node_rank,
+                               world_size)
+
     logger.info(f"launching: {' '.join(cmd)} (rank {node_rank}/"
                 f"{world_size})")
     process = subprocess.Popen(cmd, env=env)
@@ -73,6 +203,48 @@ def main(args=None):
     process.wait()
     if process.returncode != 0:
         sys.exit(process.returncode)
+
+
+def _run_supervised(sup_params, cmd, env, node_rank, world_size):
+    """Elastic path: the child runs under the restart supervisor; a
+    launcher-level SIGTERM/SIGINT stops the restart loop AND the child
+    (a real shutdown must not be "restarted")."""
+    state_dir = os.path.join(sup_params["state_dir"], f"rank{node_rank}")
+    supervisor = Supervisor(
+        cmd, state_dir, env=env,
+        max_restarts=sup_params["max_restarts"],
+        backoff_base_s=sup_params["backoff_base_s"],
+        backoff_max_s=sup_params["backoff_max_s"],
+        backoff_jitter=sup_params["backoff_jitter"],
+        poison_step_threshold=sup_params["poison_step_threshold"])
+
+    def sig_handler(signum, frame):
+        logger.info(f"Received signal {signum}: stopping supervised "
+                    "child and the restart loop")
+        supervisor.terminate_child()
+
+    prev_handlers = {
+        sig: signal.signal(sig, sig_handler)
+        for sig in (signal.SIGINT, signal.SIGTERM)}
+
+    logger.info(f"launching under supervision: {' '.join(cmd)} "
+                f"(rank {node_rank}/{world_size}, "
+                f"budget {sup_params['max_restarts']} restarts, "
+                f"state {state_dir})")
+    try:
+        stats = supervisor.run()
+    finally:
+        # restore on the way out: an embedding caller (tests, a driver
+        # script) must not inherit a handler bound to a dead supervisor
+        for sig, handler in prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+    if stats["restarts"]:
+        logger.info(f"supervisor stats: {stats}")
+    if stats["exit_code"] != 0:
+        sys.exit(stats["exit_code"])
 
 
 if __name__ == "__main__":

@@ -61,6 +61,7 @@ class PDSHRunner(MultiNodeRunner):
         for key, val in self.exports.items():
             exports += f"export {key}={val}; "
 
+        from .launch import elastic_argv
         from .runner import encode_world_info
         world_info = encode_world_info(dict(active_resources))
         deepspeed_launch = [
@@ -72,6 +73,9 @@ class PDSHRunner(MultiNodeRunner):
             f"--master_addr={environment['MASTER_ADDR']}",
             f"--master_port={environment['MASTER_PORT']}",
         ]
+        # per-node supervised restarts (--elastic and friends) ride the
+        # same pass-through as the rendezvous flags
+        deepspeed_launch += elastic_argv(self.args)
         return pdsh_cmd + deepspeed_launch + [self.user_script] + \
             self.user_arguments
 

@@ -59,6 +59,12 @@ def parse_args(args=None):
     parser.add_argument("--detect_nvlink_pairs", action="store_true",
                         help="Accepted for CLI compat; no-op on TPU "
                         "(ICI topology is fixed)")
+    # Supervised-restart flags (forwarded to the per-node launcher):
+    # --elastic wraps each node's training process in the
+    # elasticity.supervisor restart loop (backoff + budget + poison-step
+    # detection).
+    from .launch import add_elastic_args
+    add_elastic_args(parser)
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -178,13 +184,13 @@ def main(args=None):
 
     if not resource_pool:
         # Single node: exec the per-node launcher in-process.
-        from .launch import main as launch_main
+        from .launch import elastic_argv, main as launch_main
         world_info = {"localhost": args.num_gpus if args.num_gpus > 0
                       else None}
         encoded = encode_world_info(world_info)
         argv = ["--world_info", encoded,
-                "--master_port", str(args.master_port),
-                args.user_script] + args.user_args
+                "--master_port", str(args.master_port)] + \
+            elastic_argv(args) + [args.user_script] + args.user_args
         return launch_main(argv)
 
     active_resources = parse_inclusion_exclusion(resource_pool,
@@ -209,6 +215,20 @@ def main(args=None):
         raise NotImplementedError(
             f"Unknown launcher {args.launcher}; valid: "
             f"{sorted(runners)}")
+    from .launch import resolve_supervisor_params
+    elastic_enabled, _ = resolve_supervisor_params(args)
+    if elastic_enabled and args.launcher.lower() != "pdsh":
+        # the MPI/Slurm/MosaicML backends exec the training script
+        # directly (no per-node launch.py to wrap in the supervisor);
+        # silently launching WITHOUT restart supervision would be
+        # discovered only at the first unrecovered preemption
+        raise NotImplementedError(
+            f"--elastic supervised restarts are only forwarded by the "
+            f"pdsh backend; launcher '{args.launcher}' execs the "
+            f"training script directly. Wrap each node's command "
+            f"explicitly instead: python -m "
+            f"deeperspeed_tpu.elasticity.supervisor --state_dir DIR "
+            f"-- <training cmd>")
     runner = runners[args.launcher.lower()](args, active_resources)
     if not runner.backend_exists():
         raise RuntimeError(

@@ -150,7 +150,8 @@ def parse_inference_block(d):
              c.INFERENCE_MAX_BATCH_SIZE, c.INFERENCE_TOKEN_BUDGET,
              c.INFERENCE_PREFILL_LENGTHS, c.INFERENCE_PREFILL_BATCH_SIZES,
              c.INFERENCE_DECODE_BATCH_SIZES, c.INFERENCE_TEMPERATURE,
-             c.INFERENCE_SEED, c.INFERENCE_KERNEL, c.INFERENCE_KV_DTYPE}
+             c.INFERENCE_SEED, c.INFERENCE_KERNEL, c.INFERENCE_KV_DTYPE,
+             c.INFERENCE_DRAIN_DEADLINE}
     unknown = sorted(set(inf) - known)
     if unknown:
         raise DeepSpeedConfigError(
@@ -255,6 +256,15 @@ def parse_inference_block(d):
                 f"string or null, got {kv_dtype!r}")
         resolve_precision(kv_dtype)   # raises on unknown names
 
+    drain_deadline = inf.get(c.INFERENCE_DRAIN_DEADLINE,
+                             c.INFERENCE_DRAIN_DEADLINE_DEFAULT)
+    if not isinstance(drain_deadline, (int, float)) or \
+            isinstance(drain_deadline, bool) or drain_deadline < 0:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_DRAIN_DEADLINE} must be a number "
+            f">= 0 (seconds; 0 = stop immediately after the current "
+            f"step), got {drain_deadline!r}")
+
     return {
         "page_size": ints[c.INFERENCE_PAGE_SIZE],
         "num_pages": ints[c.INFERENCE_NUM_PAGES],
@@ -268,6 +278,7 @@ def parse_inference_block(d):
         "seed": ints[c.INFERENCE_SEED],
         "kernel": kernel,
         "kv_cache_dtype": kv_dtype,
+        "drain_deadline_s": float(drain_deadline),
     }
 
 
@@ -521,6 +532,16 @@ class DeepSpeedConfig:
         self._parse_training_health_block(d)
         self._parse_telemetry_block(d)
         self._parse_packing_block(d)
+
+        # Elastic resilience sub-blocks ("elasticity": {"heartbeat",
+        # "supervisor"}) — validated at the same parse-time strictness
+        # as the blocks above (elasticity/config.py), independent of the
+        # batch-solver `enabled` flag: a job can run peer heartbeats and
+        # supervised restarts without elastic batch arithmetic. The
+        # supervisor block itself is consumed by the LAUNCHER; parsing
+        # it here means a typo'd restart budget still fails at startup.
+        from ..elasticity import parse_resilience_config
+        self.elasticity_resilience = parse_resilience_config(d)
 
         # Serving engine (deeperspeed_tpu/inference); module-level parse
         # so InferenceEngine validates raw dicts identically.

@@ -373,3 +373,8 @@ INFERENCE_KERNEL_CHOICES = ("auto", "pallas", "xla")
 # KV-cache storage dtype: null = the params' compute dtype
 INFERENCE_KV_DTYPE = "kv_cache_dtype"
 INFERENCE_KV_DTYPE_DEFAULT = None
+
+# Graceful drain (SIGTERM): stop admissions, finish in-flight sequences
+# for at most this many seconds, flush Serve/* telemetry, exit 0.
+INFERENCE_DRAIN_DEADLINE = "drain_deadline_s"
+INFERENCE_DRAIN_DEADLINE_DEFAULT = 30.0

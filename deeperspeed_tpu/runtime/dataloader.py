@@ -156,6 +156,22 @@ class DeepSpeedDataLoader:
         self._batches_yielded = self._resume_offset
         self.len = self._num_batches()
 
+    def reconcile_state_dict(self, sd):
+        """Elastic-resume fallback when `load_state_dict` rejects the
+        exact position (replica count / batch size / shuffle topology
+        changed): restore only the ORDER-INDEPENDENT stream identity —
+        epoch and shuffle seed — and reset the batch offset, so the
+        restarted job continues with the same per-epoch sample order the
+        run was configured for, re-dealt under the current topology. At
+        most one partial epoch is replayed; nothing is skipped silently.
+        Returns the fields kept (for the caller's warning)."""
+        self.epoch = int(sd.get("epoch", self.epoch))
+        self.seed = sd.get("seed", self.seed)
+        self._resume_offset = 0
+        self._batches_yielded = 0
+        self.len = self._num_batches()
+        return {"epoch": self.epoch, "seed": self.seed, "offset": 0}
+
     def __iter__(self):
         if self.tput_timer:
             self.tput_timer.start()

@@ -309,6 +309,55 @@ class DeepSpeedEngine:
                 min_scale=args.get("min_loss_scale", 1),
                 patience=self._config.min_scale_patience)
 
+        # --- elastic resilience (elasticity/heartbeat + supervisor) -------
+        # Peer-health heartbeats: a daemon thread publishes/observes
+        # coordination-service heartbeats; a dead PEER surfaces at the
+        # next step boundary as emergency-checkpoint + PeerFailureError
+        # (exit code the supervisor treats as restartable). When this
+        # process runs UNDER a supervisor (DS_ELASTIC_STATE_DIR set), the
+        # engine also writes a per-step progress file (poison-step
+        # detection) and emits MTTR/restart-count scalars.
+        import weakref as _weakref
+        from ..elasticity import constants as _ec
+        self.peer_monitor = None
+        self._peer_emergency_save = False
+        self._elastic_state_dir = os.environ.get(_ec.DS_ELASTIC_STATE_DIR)
+        self._elastic_restart_count = int(
+            os.environ.get(_ec.DS_ELASTIC_RESTART_COUNT, "0") or 0)
+        self._elastic_restart_record = None
+        self._elastic_scalars_emitted = False
+        if self._elastic_state_dir and self._elastic_restart_count:
+            # restart_count == 0 means no crash happened THIS supervision
+            # session — a leftover supervisor.json must not fake an MTTR
+            from ..elasticity.supervisor import read_restart_record
+            self._elastic_restart_record = read_restart_record(
+                self._elastic_state_dir)
+        hb_params = self._config.elasticity_resilience["heartbeat"]
+        if hb_params:
+            from ..elasticity.heartbeat import build_peer_monitor
+            engine_ref = _weakref.ref(self)
+
+            def _published_step():
+                engine = engine_ref()
+                return -1 if engine is None else engine.global_steps
+
+            self.peer_monitor = build_peer_monitor(
+                hb_params, step_fn=_published_step)
+            self._peer_emergency_save = hb_params["emergency_checkpoint"]
+            if self._fault_injector is not None:
+                # simulated peers named in the fault plan heartbeat
+                # healthily (via the monitor's own loop) until their
+                # peer_death/slow_peer fault fires
+                for name in self._fault_injector.simulated_peers:
+                    self.peer_monitor.ensure_simulated_peer(name)
+            self.peer_monitor.start()
+        elif self._fault_injector is not None and \
+                self._fault_injector.simulated_peers:
+            raise DeepSpeedConfigError(
+                "fault_injection peer_death/slow_peer faults act on the "
+                "peer-health monitor; enable the "
+                "elasticity.heartbeat block to use them")
+
         # --- config-drivable model features (moe / sequence parallel /
         # activation checkpointing): applied BEFORE param init so the
         # model builds expert weights / SP attention / remat-policy spans
@@ -2224,6 +2273,11 @@ class DeepSpeedEngine:
             scalars["Train/Samples/step_time_ms"] = \
                 (now - self._last_step_stamp) * 1e3
         self._last_step_stamp = now
+        if self.peer_monitor is not None:
+            # worst peer-heartbeat staleness: a rising series is a peer
+            # going quiet BEFORE the fail threshold declares it dead
+            scalars["Train/Elastic/heartbeat_staleness_s"] = \
+                self.peer_monitor.max_staleness()
         # wall_clock_breakdown timers land in the event stream too (the
         # reference only ever printed them): Train/Timers/<name>_ms keyed
         # by the same sample count as the loss scalars. elapsed(reset)
@@ -2258,6 +2312,78 @@ class DeepSpeedEngine:
         # step boundary: drain completed-save telemetry, honor preemption
         # requests, fire the auto-save interval (no-ops when unconfigured)
         self.checkpoint_manager.on_step_boundary(self)
+        # elastic resilience: progress file for the supervisor's
+        # poison-step detector, MTTR/restart scalars, and the peer-death
+        # escalation (emergency save + typed PeerFailureError)
+        self._elastic_step_boundary()
+
+    def _elastic_step_boundary(self):
+        if self._elastic_state_dir:
+            from ..elasticity.supervisor import write_progress
+            try:
+                write_progress(self._elastic_state_dir, self.global_steps)
+            except OSError as e:  # pragma: no cover - state dir vanished
+                logger.warning(f"elastic progress write failed: {e}")
+        if not self._elastic_scalars_emitted and self.monitor is not None \
+                and (self._elastic_restart_count or
+                     self.peer_monitor is not None):
+            # once, at the FIRST completed step of this incarnation: the
+            # crash-to-resumed-step wall clock IS the measured MTTR
+            self._elastic_scalars_emitted = True
+            import time as _time
+            scalars = {"Train/Elastic/restart_count":
+                       float(self._elastic_restart_count)}
+            record = self._elastic_restart_record
+            if record and record.get("crash_time"):
+                scalars["Train/Elastic/mttr_s"] = \
+                    _time.time() - float(record["crash_time"])
+            self.monitor.record(self.global_samples, scalars)
+        if self.peer_monitor is not None and self.peer_monitor.has_failure:
+            self._escalate_peer_failure()
+
+    def _escalate_peer_failure(self):
+        """A peer was declared dead (heartbeat staleness past
+        fail_after_s): emergency-checkpoint if configured, then exit the
+        training loop with the typed PeerFailureError whose exit code
+        the supervisor recognizes as restartable. Mirrors the preemption
+        flow — detection happened on the monitor thread, the action runs
+        here on the main thread at a step boundary where device state is
+        consistent."""
+        monitor = self.peer_monitor
+        peers = sorted(monitor.failed)
+        log_dist(f"PEER FAILURE: peer(s) {peers} declared dead; "
+                 f"saving emergency checkpoint and exiting for a "
+                 f"supervised restart", ranks=[0])
+        telemetry = getattr(self, "telemetry", None)
+        if telemetry is not None:
+            telemetry.on_anomaly(self, "peer_failure")
+        manager = self.checkpoint_manager
+        if self._peer_emergency_save and manager.save_dir:
+            try:
+                manager.save_sync(manager.save_dir)
+            except BaseException as e:
+                # a failed save must not mask the peer failure: the
+                # supervisor restarts from the previous committed
+                # checkpoint instead
+                logger.error(f"emergency checkpoint before peer-failure "
+                             f"exit failed: {e}")
+        monitor.stop()
+        monitor.raise_if_failed()
+
+    def _apply_host_fault(self, fault):
+        """Apply one elastic host-side injected fault (see
+        runtime/fault_injection.py): peer faults act on the peer-health
+        monitor's simulated peers; barrier_timeout arms the next
+        `utils.distributed.barrier` call to raise its typed error."""
+        kind = fault["kind"]
+        if kind == "barrier_timeout":
+            from ..utils.distributed import inject_barrier_timeout
+            inject_barrier_timeout(times=1)
+        elif kind == "peer_death":
+            self.peer_monitor.inject_peer_death(fault["peer"])
+        elif kind == "slow_peer":
+            self.peer_monitor.inject_slow_peer(fault["peer"],
+                                               fault["seconds"])
 
     def _step_program_ready(self, gas, fault):
         """Is the program the coming step will run already compiled?
@@ -2301,6 +2427,12 @@ class DeepSpeedEngine:
         stall_s = 0.0
         if self._fault_injector is not None:
             mode, factor, stall_s = self._fault_injector.plan_next_step()
+            # elastic host faults (peer_death / slow_peer /
+            # barrier_timeout) fire before the step dispatch: the
+            # simulated peer goes silent NOW, and the staleness clock
+            # runs while training continues — exactly the real timeline
+            for host_fault in self._fault_injector.take_host_faults():
+                self._apply_host_fault(host_fault)
             fault = (jax.device_put(np.int32(mode),
                                     self._replicated_sharding),
                      jax.device_put(np.float32(factor),

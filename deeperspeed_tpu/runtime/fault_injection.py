@@ -19,16 +19,27 @@ bookkeeping.
 
 Fault schema (all faults validated at parse time)::
 
-    {"kind": "nan_grads" | "loss_spike" | "stall",
+    {"kind": "nan_grads" | "loss_spike" | "stall"
+             | "peer_death" | "slow_peer" | "barrier_timeout",
      "step": N,          # 0-based optimizer-step serial in this process
      "times": 1,         # fires on steps [step, step+times)
      "factor": 1e3,      # loss_spike only: loss multiplier
-     "seconds": 1.0}     # stall only: host-side sleep length
+     "seconds": 1.0,     # stall: sleep length; slow_peer: heartbeat gap
+     "peer": "sim0"}     # peer_death/slow_peer: simulated peer name
 
 ``step`` counts train_batch invocations in THIS process (a monotonic
 serial, never rewound by rollback) — so a replayed window after a
 rollback does not re-trigger a one-shot fault, which is exactly the
 "transient corruption" scenario the recovery tests need.
+
+The elastic kinds are HOST faults (no device-step variant): the engine
+pops them via `take_host_faults()` right after `plan_next_step()`.
+``peer_death`` / ``slow_peer`` act on a SIMULATED peer registered with
+the peer-health monitor (`elasticity/heartbeat.py`) — on one host they
+reproduce exactly what a dead/wedged remote host looks like to the
+observer; ``barrier_timeout`` arms `utils.distributed.barrier` to raise
+a typed `BarrierTimeoutError` on its next rendezvous (e.g. the next
+checkpoint commit), driving the fail-fast-and-hand-off path.
 """
 
 import json
@@ -38,7 +49,10 @@ import jax.numpy as jnp
 
 from .config_utils import DeepSpeedConfigError
 
-FAULT_KINDS = ("nan_grads", "loss_spike", "stall")
+FAULT_KINDS = ("nan_grads", "loss_spike", "stall",
+               "peer_death", "slow_peer", "barrier_timeout")
+HOST_FAULT_KINDS = ("peer_death", "slow_peer", "barrier_timeout")
+DEFAULT_SIM_PEER = "sim_peer_0"
 
 # device-side injection modes (the (mode, factor) scalar pair)
 MODE_NONE = 0
@@ -66,7 +80,7 @@ def validate_fault_spec(spec, where="training_health.fault_injection"):
         raise DeepSpeedConfigError(
             f"{where}.faults must be a list, got "
             f"{type(faults).__name__}")
-    known = {"kind", "step", "times", "factor", "seconds"}
+    known = {"kind", "step", "times", "factor", "seconds", "peer"}
     out = []
     for i, fault in enumerate(faults):
         if not isinstance(fault, dict):
@@ -102,9 +116,18 @@ def validate_fault_spec(spec, where="training_health.fault_injection"):
                 raise DeepSpeedConfigError(
                     f"{where}.faults[{i}].{key} must be a number > 0, "
                     f"got {value!r}")
+        peer = fault.get("peer", DEFAULT_SIM_PEER)
+        if not isinstance(peer, str) or not peer:
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}].peer must be a non-empty string, "
+                f"got {peer!r}")
+        if "peer" in fault and kind not in ("peer_death", "slow_peer"):
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}].peer only applies to "
+                f"peer_death/slow_peer faults, not {kind!r}")
         out.append({"kind": kind, "step": step, "times": times,
                     "factor": float(factor), "seconds": float(seconds),
-                    "remaining": times})
+                    "peer": peer, "remaining": times})
     return out
 
 
@@ -120,6 +143,7 @@ class FaultInjector:
         self.faults = faults
         self.serial = 0       # monotonic step-attempt counter
         self.fired = []       # (serial, kind) audit trail
+        self._pending_host = []   # host faults fired by the last plan
 
     @classmethod
     def from_config_env(cls, config_spec=None, env=None):
@@ -146,6 +170,14 @@ class FaultInjector:
         return any(f["kind"] in ("nan_grads", "loss_spike")
                    for f in self.faults)
 
+    @property
+    def simulated_peers(self):
+        """Names of simulated peers the fault plan will act on — the
+        engine registers these with the peer-health monitor up front so
+        they heartbeat healthily until their fault fires."""
+        return sorted({f["peer"] for f in self.faults
+                       if f["kind"] in ("peer_death", "slow_peer")})
+
     def plan_next_step(self):
         serial = self.serial
         self.serial += 1
@@ -165,7 +197,16 @@ class FaultInjector:
                 factor = fault["factor"]
             elif fault["kind"] == "stall":
                 stall = max(stall, fault["seconds"])
+            elif fault["kind"] in HOST_FAULT_KINDS:
+                self._pending_host.append(dict(fault))
         return mode, factor, stall
+
+    def take_host_faults(self):
+        """Host-side faults fired by the most recent `plan_next_step`
+        (peer_death / slow_peer / barrier_timeout); the engine applies
+        them before dispatching the step. Drains the queue."""
+        out, self._pending_host = self._pending_host, []
+        return out
 
 
 def apply_fault(loss, grads, fault):

@@ -361,6 +361,27 @@ class TrainingHealthSentinel:
         engine = self._engine_ref()
         if engine is None:
             return
+        # local hang vs peer failure: a step wedged inside a collective
+        # because a PEER died looks identical from this host's stacks —
+        # the heartbeat monitor (elasticity/heartbeat.py) disambiguates.
+        # Stale peers -> name them (the supervisor/operator should look
+        # THERE); all peers healthy -> this really is a local hang.
+        peer_monitor = getattr(engine, "peer_monitor", None)
+        if peer_monitor is not None:
+            stale = [name for name, st in
+                     peer_monitor.peer_status().items()
+                     if st["status"] != "ok"]
+            if stale:
+                logger.error(
+                    f"hang watchdog: peer(s) {sorted(stale)} have stale "
+                    f"heartbeats — this step is most likely blocked on a "
+                    f"DEAD/SLOW PEER inside a collective, not hung "
+                    f"locally (peer-failure escalation will fire at "
+                    f"fail_after_s)")
+            else:
+                logger.error(
+                    "hang watchdog: all peer heartbeats are fresh — "
+                    "treating this as a LOCAL hang")
         # memory snapshot now (host-side reads are thread-safe); a trace
         # is armed for the next step in case the hang clears
         self._telemetry_anomaly(engine, "watchdog_hang")

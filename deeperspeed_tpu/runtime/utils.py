@@ -387,3 +387,21 @@ class GradientNoiseScale:
         self.noise_scale = sd["noise_scale"]
         self.n_updates = int(sd["n_updates"])
         self.skipped_nonfinite = int(sd.get("skipped_nonfinite", 0))
+
+    def reconcile_topology(self):
+        """Elastic resume under a changed replica count: the mid-window
+        micro-grad buffer was accumulated from the OLD sample stream —
+        pairing it with post-restart micro-batches would compare grads
+        that never co-occurred. Drop the partial window; the EMA
+        estimates (per-replica batch sizes, topology-independent)
+        survive."""
+        mid_window = self.n_updates % self.n_batches
+        if self.buffer or mid_window:
+            logger.info(
+                f"GradientNoiseScale: dropping a partial window "
+                f"({mid_window} of {self.n_batches} micro-grads) after "
+                "an elastic topology change; EMA estimates are kept")
+        self.buffer = []
+        # skip to the next window boundary so the next estimate averages
+        # exactly n_batches post-restart micro-grads
+        self.n_updates += (-self.n_updates) % self.n_batches

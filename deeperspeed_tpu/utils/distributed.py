@@ -8,6 +8,7 @@ via mpi4py when requested.
 """
 
 import os
+import time
 
 import jax
 
@@ -22,6 +23,51 @@ _initialized = False
 _collective_timeout = None
 _barrier_serials = {}
 _warned_no_client = False
+
+# Coordination-service barriers are ALWAYS deadline-bearing when the
+# client exists: with no timeout configured this default applies instead
+# of degrading to the unbounded device-collective fallback. A dead peer
+# then surfaces as a typed BarrierTimeoutError after this many seconds
+# — still far faster (and infinitely more diagnosable) than an infinite
+# sync_global_devices hang.
+DEFAULT_BARRIER_TIMEOUT_S = 900.0
+
+# fault-injection seam (runtime/fault_injection.py `barrier_timeout`
+# faults): {tag_or_None: remaining_fires}. None matches any tag.
+_forced_timeouts = {}
+
+
+class BarrierTimeoutError(RuntimeError):
+    """A host-coordination barrier blew its deadline: one or more peers
+    never arrived (dead, preempted, or wedged). Carries the barrier tag
+    and the elapsed wall time so the supervisor / logs can tell WHICH
+    rendezvous failed and how long the survivors waited."""
+
+    def __init__(self, tag, timeout_s, elapsed_s, cause=None):
+        self.tag = tag
+        self.timeout_s = float(timeout_s)
+        self.elapsed_s = float(elapsed_s)
+        super().__init__(
+            f"barrier '{tag}' timed out after {elapsed_s:.1f}s "
+            f"(deadline {timeout_s:.1f}s): a peer host never arrived"
+            + (f" — {cause}" if cause else ""))
+
+
+def inject_barrier_timeout(tag=None, times=1):
+    """Arm the next `times` barrier call(s) (optionally only those with
+    `tag`) to raise BarrierTimeoutError without waiting — the
+    single-host test seam for the `barrier_timeout` fault kind."""
+    _forced_timeouts[tag] = _forced_timeouts.get(tag, 0) + int(times)
+
+
+def _pop_forced_timeout(tag):
+    for key in (tag, None):
+        if _forced_timeouts.get(key, 0) > 0:
+            _forced_timeouts[key] -= 1
+            if not _forced_timeouts[key]:
+                del _forced_timeouts[key]
+            return True
+    return False
 
 
 def get_collective_timeout():
@@ -102,32 +148,64 @@ def _distributed_client():
 def barrier(tag, timeout=None):
     """Multihost host-level barrier with a fail-fast deadline.
 
-    With a timeout (argument, or the `init_distributed(timeout=...)`
-    default) the barrier runs on the coordination service
-    (`wait_at_barrier`), which raises DEADLINE_EXCEEDED when any host is
-    missing — a preempted/dead host costs seconds, not an infinite hang
-    inside a device collective. Without one (or on jax builds without the
-    client API) it degrades to `sync_global_devices`, the seed's
-    unbounded device-collective barrier. Single-process: no-op."""
-    if jax.process_count() <= 1:
+    Whenever a coordination client exists the barrier runs on the
+    coordination service (`wait_at_barrier`) under a deadline — the
+    explicit `timeout` argument, the `init_distributed(timeout=...)`
+    default, or `DEFAULT_BARRIER_TIMEOUT_S` as the floor — and a missing
+    host raises a typed `BarrierTimeoutError` (tag + elapsed) instead of
+    the raw gRPC DEADLINE_EXCEEDED: a preempted/dead peer costs seconds
+    and is diagnosable, not an infinite hang inside a device collective.
+
+    HAZARD: the `sync_global_devices` fallback (no client — single
+    controller, or jax builds without the client API) is a DEVICE
+    collective with NO deadline of any kind: a dead peer hangs every
+    surviving host until the cluster scheduler reaps the job. It is kept
+    only as a last resort; callers that need fail-fast semantics must
+    run under `jax.distributed.initialize` (the launcher's default).
+    Single-process: no-op (forced-timeout injection still fires, so the
+    fault-injection harness can drive the failure path on one host)."""
+    if jax.process_count() <= 1 and not _forced_timeouts:
         return
     timeout = _collective_timeout if timeout is None else timeout
-    if timeout:
-        client = _distributed_client()
-        if client is not None:
-            # wait_at_barrier ids must be unique per rendezvous; every
-            # host derives the same serial for the same call site order
-            serial = _barrier_serials.get(tag, 0)
-            _barrier_serials[tag] = serial + 1
+    if _pop_forced_timeout(tag):
+        raise BarrierTimeoutError(
+            tag, timeout or DEFAULT_BARRIER_TIMEOUT_S, 0.0,
+            cause="injected fault (barrier_timeout)")
+    if jax.process_count() <= 1:
+        return
+    client = _distributed_client()
+    if client is not None:
+        # the client path is ALWAYS deadline-bearing: an unbounded
+        # coordination wait would just reproduce the device-collective
+        # hang with extra steps
+        timeout = float(timeout) if timeout else DEFAULT_BARRIER_TIMEOUT_S
+        # wait_at_barrier ids must be unique per rendezvous; every
+        # host derives the same serial for the same call site order
+        serial = _barrier_serials.get(tag, 0)
+        _barrier_serials[tag] = serial + 1
+        t0 = time.monotonic()
+        try:
             client.wait_at_barrier(f"{tag}:{serial}",
-                                   int(float(timeout) * 1000))
-            return
+                                   int(timeout * 1000))
+        except Exception as e:
+            elapsed = time.monotonic() - t0
+            # DEADLINE_EXCEEDED from a missing peer; re-raise typed so
+            # callers (checkpoint commit, supervisor handoff) can tell a
+            # barrier timeout from a generic runtime error
+            if "DEADLINE" in str(e).upper() or elapsed >= timeout * 0.9:
+                raise BarrierTimeoutError(tag, timeout, elapsed,
+                                          cause=e) from e
+            raise
+        return
+    if timeout:
         global _warned_no_client
         if not _warned_no_client:  # pragma: no cover - env dependent
             _warned_no_client = True
             logger.warning("barrier timeout requested but no distributed "
                            "client is available; falling back to the "
-                           "unbounded device-collective barrier")
+                           "UNBOUNDED device-collective barrier (a dead "
+                           "peer will hang this job until the scheduler "
+                           "reaps it)")
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(tag)
 

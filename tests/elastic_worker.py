@@ -1,0 +1,77 @@
+"""Chaos-test child for the supervised-restart loop: trains SimpleModel
+with interval auto-checkpointing, crashes hard (`os._exit`, no cleanup
+— the closest single-host stand-in for a preempted/killed host) at a
+chosen step on its FIRST incarnation, and relies on the supervisor +
+full-state resume to finish the run. Each incarnation appends its
+per-step ``(global_step, loss)`` pairs to ``losses_<restart>.txt`` so
+the driving test can check the resumed trajectory is step-aligned with
+the committed checkpoint against an uninterrupted reference run.
+
+Usage: python elastic_worker.py <workdir> <target_steps> <crash_step>
+(crash_step 0 = never crash — the reference-run mode).
+"""
+
+import os
+import sys
+
+
+def main():
+    workdir, target_steps, crash_step = (sys.argv[1], int(sys.argv[2]),
+                                         int(sys.argv[3]))
+    restart = int(os.environ.get("DS_ELASTIC_RESTART_COUNT", "0") or 0)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np  # noqa: F401
+
+    import deeperspeed_tpu
+    from tests.simple_model import SimpleModel, random_dataset
+
+    hidden = 16
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    model = SimpleModel(hidden_dim=hidden)
+    dataset = random_dataset(256, hidden, seed=0)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        training_data=dataset,
+        config_params={
+            "train_batch_size": 8,
+            "steps_per_print": 1000,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "checkpoint": {"save_dir": ckpt_dir, "async_save": False,
+                           "save_interval_steps": 2},
+        })
+
+    resumed_from = None
+    if os.path.exists(os.path.join(ckpt_dir, "latest")):
+        path, _ = engine.load_checkpoint(ckpt_dir)
+        assert path is not None, "committed checkpoint must load"
+        resumed_from = engine.global_steps
+
+    log_path = os.path.join(workdir, f"losses_{restart}.txt")
+    with open(log_path, "a") as log:
+        if resumed_from is not None:
+            log.write(f"# resumed_from {resumed_from}\n")
+        stream = iter(engine.training_dataloader)
+        while engine.global_steps < target_steps:
+            try:
+                loss = engine.train_batch(data_iter=stream)
+            except StopIteration:
+                stream = iter(engine.training_dataloader)
+                continue
+            log.write(f"{engine.global_steps} {float(loss):.10e}\n")
+            log.flush()
+            if restart == 0 and crash_step and \
+                    engine.global_steps == crash_step:
+                os._exit(3)   # hard death: no atexit, no emergency save
+
+    with open(os.path.join(workdir, "done.json"), "w") as f:
+        import json
+        json.dump({"final_steps": engine.global_steps,
+                   "restart": restart}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,808 @@
+"""Elastic multi-host resilience (elasticity/heartbeat.py +
+elasticity/supervisor.py + the fail-fast barrier path): peer-health
+detection with staleness escalation, supervised restarts with
+backoff/budget/poison-step semantics, typed barrier timeouts, and the
+engine-level peer-failure escalation — all driven single-host through
+the fault-injection harness and injectable clocks/transports."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import deeperspeed_tpu
+from deeperspeed_tpu.checkpoint import manifest as mf
+from deeperspeed_tpu.elasticity import constants as ec
+from deeperspeed_tpu.elasticity.config import (ElasticityConfigError,
+                                               PeerFailureError,
+                                               PoisonStepError,
+                                               RestartBudgetExceededError,
+                                               parse_resilience_config)
+from deeperspeed_tpu.elasticity.heartbeat import (InMemoryTransport,
+                                                  PeerHealthMonitor,
+                                                  suspect_peers)
+from deeperspeed_tpu.elasticity.supervisor import (Supervisor,
+                                                   read_progress,
+                                                   write_progress)
+from deeperspeed_tpu.runtime import fault_injection as fi
+from deeperspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+from deeperspeed_tpu.utils import distributed as dist
+from tests.simple_model import SimpleModel, random_batches
+
+pytestmark = pytest.mark.elastic
+
+HIDDEN = 16
+
+
+def cfg(**overrides):
+    base = {
+        "train_batch_size": 8,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    base.update(overrides)
+    return base
+
+
+def make_engine(config, seed=0):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=config)
+    return engine
+
+
+class FakeMonitor:
+    def __init__(self):
+        self.records = []
+
+    def record(self, sample_count, scalars):
+        self.records.append((sample_count, dict(scalars)))
+
+    def scalar_series(self, key):
+        return [s[key] for _, s in self.records if key in s]
+
+
+# ---------------------------------------------------------------------------
+# config validation (checkpoint-block strictness)
+# ---------------------------------------------------------------------------
+
+def test_resilience_config_defaults_off():
+    out = parse_resilience_config({})
+    assert out == {"heartbeat": False, "supervisor": False}
+
+
+def test_resilience_config_parses_both_blocks():
+    out = parse_resilience_config({"elasticity": {
+        "heartbeat": {"enabled": True, "interval_s": 1.0,
+                      "warn_after_s": 3.0, "fail_after_s": 9.0},
+        "supervisor": {"enabled": True, "max_restarts": 5,
+                       "backoff_base_s": 0.5, "backoff_max_s": 8.0,
+                       "backoff_jitter": 0.1,
+                       "poison_step_threshold": 2}}})
+    assert out["heartbeat"]["fail_after_s"] == 9.0
+    assert out["heartbeat"]["emergency_checkpoint"] is True
+    assert out["supervisor"]["max_restarts"] == 5
+    assert out["supervisor"]["poison_step_threshold"] == 2
+
+
+@pytest.mark.parametrize("block,match", [
+    ({"heartbeat": {"enabled": True, "intervl_s": 1}}, "Unknown"),
+    ({"heartbeat": {"enabled": "yes"}}, "boolean"),
+    ({"heartbeat": {"enabled": True, "interval_s": 0}}, "interval_s"),
+    ({"heartbeat": {"enabled": True, "interval_s": 5.0,
+                    "warn_after_s": 4.0}}, "thresholds"),
+    ({"heartbeat": {"enabled": True, "warn_after_s": 20.0,
+                    "fail_after_s": 10.0}}, "thresholds"),
+    ({"supervisor": {"enabled": True, "max_restarts": -1}}, ">="),
+    ({"supervisor": {"enabled": True, "backoff_base_s": 4.0,
+                     "backoff_max_s": 2.0}}, "backoff_max_s"),
+    ({"supervisor": {"enabled": True, "backoff_jitter": 1.5}}, "jitter"),
+    ({"supervisor": {"enabled": True,
+                     "poison_step_threshold": 1}}, ">= 2"),
+    ({"supervisor": {"enabled": True, "budget": 3}}, "Unknown"),
+    ({"heartbeats": {}}, "Unknown"),
+])
+def test_resilience_config_rejects(block, match):
+    with pytest.raises(ElasticityConfigError, match=match):
+        parse_resilience_config({"elasticity": block})
+
+
+def test_resilience_block_reaches_ds_config():
+    eng = make_engine(cfg(elasticity={
+        "heartbeat": {"enabled": False}}))
+    assert eng._config.elasticity_resilience == {
+        "heartbeat": False, "supervisor": False}
+    assert eng.peer_monitor is None
+
+
+def test_fault_spec_accepts_elastic_kinds():
+    faults = fi.validate_fault_spec({"faults": [
+        {"kind": "peer_death", "step": 3, "peer": "simA"},
+        {"kind": "slow_peer", "step": 1, "seconds": 2.5},
+        {"kind": "barrier_timeout", "step": 0},
+    ]})
+    assert faults[0]["peer"] == "simA"
+    assert faults[1]["peer"] == fi.DEFAULT_SIM_PEER
+    injector = fi.FaultInjector(faults)
+    assert injector.simulated_peers == ["simA", fi.DEFAULT_SIM_PEER]
+    assert not injector.has_device_faults   # no extra compile variant
+
+
+def test_fault_spec_rejects_peer_on_wrong_kind():
+    with pytest.raises(DeepSpeedConfigError, match="peer"):
+        fi.validate_fault_spec({"faults": [
+            {"kind": "stall", "step": 0, "peer": "x"}]})
+    with pytest.raises(DeepSpeedConfigError, match="non-empty"):
+        fi.validate_fault_spec({"faults": [
+            {"kind": "peer_death", "step": 0, "peer": ""}]})
+
+
+def test_injector_host_fault_queue():
+    injector = fi.FaultInjector(fi.validate_fault_spec({"faults": [
+        {"kind": "barrier_timeout", "step": 1},
+        {"kind": "peer_death", "step": 1}]}))
+    injector.plan_next_step()
+    assert injector.take_host_faults() == []
+    injector.plan_next_step()
+    fired = injector.take_host_faults()
+    assert sorted(f["kind"] for f in fired) == ["barrier_timeout",
+                                               "peer_death"]
+    assert injector.take_host_faults() == []   # drained
+
+
+# ---------------------------------------------------------------------------
+# typed barrier timeout (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_barrier_timeout_error_is_typed():
+    dist.inject_barrier_timeout(tag="ckpt", times=1)
+    with pytest.raises(dist.BarrierTimeoutError) as ei:
+        dist.barrier("ckpt")
+    assert ei.value.tag == "ckpt"
+    assert ei.value.timeout_s > 0
+    assert "peer" in str(ei.value)
+    # one-shot: the next call is clean (single-process no-op)
+    dist.barrier("ckpt")
+
+
+def test_commit_barrier_converts_to_peer_failure(tmp_path):
+    """A commit barrier timing out must fail the save FAST with the
+    typed, supervisor-restartable PeerFailureError — not a raw gRPC
+    error, not a hang."""
+    engine = make_engine(cfg())
+    x = np.zeros((1, 8, HIDDEN), np.float32)
+    engine.train_batch(batch=(x, x))
+    dist.inject_barrier_timeout(times=1)
+    with pytest.raises(PeerFailureError) as ei:
+        engine.save_checkpoint(str(tmp_path))
+    assert ei.value.exit_code == ec.EXIT_CODE_PEER_FAILURE
+    assert "commit barrier" in str(ei.value)
+
+
+def test_barrier_timeout_fault_through_engine(tmp_path):
+    """The `barrier_timeout` injection kind arms the NEXT barrier: the
+    step itself completes, the following checkpoint commit fails
+    typed."""
+    engine = make_engine(cfg(training_health={
+        "fault_injection": {"faults": [
+            {"kind": "barrier_timeout", "step": 0}]}}))
+    x = np.zeros((1, 8, HIDDEN), np.float32)
+    engine.train_batch(batch=(x, x))      # fires the injection arm
+    with pytest.raises(PeerFailureError):
+        engine.save_checkpoint(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# peer-health monitor state machine (fake clock, no threads)
+# ---------------------------------------------------------------------------
+
+def _monitor(**kw):
+    defaults = dict(interval_s=1.0, warn_after_s=3.0, fail_after_s=6.0,
+                    transport=InMemoryTransport(), clock=lambda: 0.0)
+    defaults.update(kw)
+    return PeerHealthMonitor("0", **defaults)
+
+
+def test_monitor_ok_slow_dead_escalation():
+    mon = _monitor(peers=["1"])
+    mon.transport.publish("1", {"serial": 1, "step": 5})
+    status = mon.poll_once(now=0.0)
+    assert status["1"]["status"] == "ok"
+    # serial never advances: staleness grows through the thresholds
+    status = mon.poll_once(now=2.0)
+    assert status["1"]["status"] == "ok"
+    status = mon.poll_once(now=4.0)
+    assert status["1"]["status"] == "slow"
+    assert not mon.has_failure
+    status = mon.poll_once(now=7.0)
+    assert status["1"]["status"] == "dead"
+    assert mon.has_failure
+    with pytest.raises(PeerFailureError) as ei:
+        mon.raise_if_failed()
+    assert ei.value.peers == ["1"]
+    assert ei.value.exit_code == ec.EXIT_CODE_PEER_FAILURE
+    assert ei.value.staleness_s >= 6.0
+
+
+def test_monitor_slow_peer_recovers():
+    mon = _monitor(peers=["1"])
+    mon.transport.publish("1", {"serial": 1, "step": 0})
+    mon.poll_once(now=0.0)
+    assert mon.poll_once(now=4.0)["1"]["status"] == "slow"
+    mon.transport.publish("1", {"serial": 2, "step": 1})
+    assert mon.poll_once(now=4.5)["1"]["status"] == "ok"
+    assert not mon.has_failure
+    assert "1" in mon.warned            # the slow episode was logged
+
+
+def test_monitor_publishes_own_heartbeat_with_step():
+    steps = {"n": 7}
+    mon = _monitor(step_fn=lambda: steps["n"])
+    mon.poll_once(now=0.0)
+    beats = mon.transport.read_all()
+    assert beats["0"]["serial"] == 1
+    assert beats["0"]["step"] == 7
+    # within the publish interval: no re-publish
+    mon.poll_once(now=0.5)
+    assert mon.transport.read_all()["0"]["serial"] == 1
+    mon.poll_once(now=1.5)
+    assert mon.transport.read_all()["0"]["serial"] == 2
+
+
+def test_monitor_never_seen_peer_is_not_stale():
+    """A peer that has not published yet (still initializing) must not
+    be flagged immediately — the grace starts at the monitor's first
+    poll."""
+    mon = _monitor(peers=["1"])
+    status = mon.poll_once(now=100.0)
+    assert status["1"]["status"] == "ok"
+    assert status["1"]["staleness_s"] == 0.0
+    assert mon.max_staleness(now=100.0) == 0.0
+
+
+def test_monitor_never_published_peer_escalates_bounded():
+    """The first-beat grace is BOUNDED: a host that dies during
+    bring-up (never publishes at all) must escalate like any other —
+    an unbounded grace would leave it permanently 'ok' and misdiagnose
+    the resulting collective hang as local."""
+    mon = _monitor(peers=["1"])
+    mon.poll_once(now=0.0)
+    assert mon.poll_once(now=2.0)["1"]["status"] == "ok"
+    mon.poll_once(now=4.0)                      # > warn_after_s silent
+    assert "1" in mon.warned
+    assert not mon.has_failure
+    assert mon.poll_once(now=7.0)["1"]["status"] == "dead"
+    assert mon.has_failure
+    with pytest.raises(PeerFailureError):
+        mon.raise_if_failed()
+
+    # ...but a first beat arriving within the grace starts normal
+    # tracking (no false positive)
+    mon2 = _monitor(peers=["1"])
+    mon2.poll_once(now=0.0)
+    mon2.transport.publish("1", {"serial": 1, "step": 0})
+    assert mon2.poll_once(now=5.0)["1"]["status"] == "ok"
+    assert not mon2.has_failure
+
+
+def test_monitor_dead_is_sticky():
+    """A peer heartbeating again AFTER being declared dead must not
+    race away the escalation: the collective world is already torn."""
+    mon = _monitor(peers=["1"])
+    mon.transport.publish("1", {"serial": 1, "step": 0})
+    mon.poll_once(now=0.0)
+    assert mon.poll_once(now=7.0)["1"]["status"] == "dead"
+    mon.transport.publish("1", {"serial": 2, "step": 1})
+    assert mon.poll_once(now=7.5)["1"]["status"] == "dead"
+    assert mon.has_failure
+
+
+def test_monitor_simulated_peer_death_and_slow():
+    mon = _monitor()
+    mon.ensure_simulated_peer("simA")
+    mon.poll_once(now=0.0)
+    assert mon.poll_once(now=2.0)["simA"]["status"] == "ok"
+    mon.inject_peer_death("simA")
+    assert mon.poll_once(now=5.5)["simA"]["status"] == "slow"
+    assert mon.poll_once(now=9.0)["simA"]["status"] == "dead"
+    assert mon.has_failure
+
+    mon2 = _monitor()
+    mon2.ensure_simulated_peer("simB")
+    mon2.poll_once(now=0.0)
+    mon2.inject_slow_peer("simB", 4.0)   # warn_after < 4.0 < fail_after+
+    assert mon2.poll_once(now=3.5)["simB"]["status"] == "slow"
+    # the slow peer DOES publish at its degraded cadence: recovers
+    mon2.poll_once(now=4.1)
+    assert mon2.poll_once(now=4.2)["simB"]["status"] == "ok"
+    assert not mon2.has_failure
+
+
+def test_monitor_survives_transport_errors_and_escalates():
+    """A failing heartbeat transport (coordination service unreachable —
+    likely because its host died) must not kill detection silently: the
+    poll loop survives, and fail_after_s of CONTINUOUS failure declares
+    the coordination service itself a dead peer."""
+    class FailingTransport:
+        def publish(self, peer, payload):
+            raise RuntimeError("UNAVAILABLE: coordinator unreachable")
+
+        def read_all(self):
+            raise RuntimeError("UNAVAILABLE: coordinator unreachable")
+
+    from deeperspeed_tpu.elasticity.heartbeat import COORDINATOR
+    mon = _monitor(transport=FailingTransport())
+    mon.poll_once(now=0.0)                    # warn once, keep going
+    assert mon.transport_errors == 1
+    assert not mon.has_failure
+    mon.poll_once(now=3.0)
+    assert not mon.has_failure                # within fail_after_s
+    mon.poll_once(now=7.0)                    # > fail_after_s outage
+    assert mon.has_failure
+    assert COORDINATOR in mon.failed
+    with pytest.raises(PeerFailureError) as ei:
+        mon.raise_if_failed()
+    assert COORDINATOR in ei.value.peers
+
+    # a recovering transport clears the outage clock
+    mon2 = _monitor(transport=FailingTransport())
+    mon2.poll_once(now=0.0)
+    mon2.transport = InMemoryTransport()      # service came back
+    mon2.poll_once(now=3.0)
+    assert mon2._transport_fail_since is None
+    mon2.transport = FailingTransport()
+    mon2.poll_once(now=4.0)                   # new outage starts at 4.0
+    mon2.poll_once(now=9.0)                   # only 5s of THIS outage
+    assert not mon2.has_failure
+
+
+def test_async_manager_preserves_peer_failure_type(tmp_path):
+    """A commit-barrier timeout inside the writer thread must surface
+    from wait() as the typed PeerFailureError (exit 76), not a generic
+    'async checkpoint save failed' RuntimeError."""
+    engine = make_engine(cfg())
+    x = np.zeros((1, 8, HIDDEN), np.float32)
+    engine.train_batch(batch=(x, x))
+    dist.inject_barrier_timeout(times=1)
+    engine.save_checkpoint_async(str(tmp_path))
+    with pytest.raises(PeerFailureError) as ei:
+        engine.checkpoint_manager.wait()
+    assert ei.value.exit_code == ec.EXIT_CODE_PEER_FAILURE
+
+
+def test_suspect_peers_reads_active_monitor():
+    mon = _monitor(peers=["1"])
+    mon.transport.publish("1", {"serial": 1, "step": 0})
+    mon.poll_once(now=0.0)
+    mon.start()       # registers as the active monitor
+    try:
+        mon.poll_once(now=10.0)   # stale by fake clock
+        assert "1" in suspect_peers()
+    finally:
+        mon.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine escalation: fault-injected peer death -> emergency save ->
+# typed exit (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def _hb(interval=0.05, warn=0.1, fail=0.18):
+    return {"enabled": True, "interval_s": interval,
+            "warn_after_s": warn, "fail_after_s": fail}
+
+
+def test_engine_peer_death_escalates(tmp_path):
+    """Fault-injected peer death: the monitor flags staleness, the
+    engine's next step boundary saves an emergency checkpoint and
+    raises PeerFailureError with the supervisor's restartable exit
+    code; Train/Elastic scalars carry the staleness series."""
+    engine = make_engine(cfg(
+        elasticity={"heartbeat": _hb()},
+        checkpoint={"save_dir": str(tmp_path), "async_save": False},
+        training_health={"fault_injection": {"faults": [
+            {"kind": "peer_death", "step": 1, "peer": "simX"}]}}))
+    engine.monitor = FakeMonitor()
+    assert engine.peer_monitor is not None
+    it = random_batches(40, 8, HIDDEN, seed=0)
+    import time
+    with pytest.raises(PeerFailureError) as ei:
+        for _ in range(40):
+            engine.train_batch(data_iter=it)
+            time.sleep(0.02)
+    assert "simX" in ei.value.peers
+    assert ei.value.exit_code == ec.EXIT_CODE_PEER_FAILURE
+    # emergency checkpoint committed before the exit
+    tags = [t for _, t in mf.committed_tags(str(tmp_path))]
+    assert tags, "peer-failure escalation must leave a committed " \
+        "emergency checkpoint"
+    # staleness telemetry was recorded and eventually exceeded zero
+    series = engine.monitor.scalar_series(
+        "Train/Elastic/heartbeat_staleness_s")
+    assert series and max(series) > 0.0
+
+
+def test_engine_peer_faults_require_heartbeat():
+    with pytest.raises(DeepSpeedConfigError, match="heartbeat"):
+        make_engine(cfg(training_health={"fault_injection": {"faults": [
+            {"kind": "peer_death", "step": 0}]}}))
+
+
+def test_engine_restart_scalars(tmp_path, monkeypatch):
+    """A supervised restart surfaces MTTR + restart count as scalars at
+    the first completed step of the new incarnation."""
+    state_dir = tmp_path / "elastic"
+    state_dir.mkdir()
+    import time
+    crash_time = time.time() - 2.5
+    (state_dir / ec.SUPERVISOR_FILE).write_text(json.dumps({
+        "crash_time": crash_time, "exit_code": 76, "crash_step": 3,
+        "restart_count": 2, "backoff_s": 1.0}))
+    monkeypatch.setenv(ec.DS_ELASTIC_STATE_DIR, str(state_dir))
+    monkeypatch.setenv(ec.DS_ELASTIC_RESTART_COUNT, "2")
+    engine = make_engine(cfg())
+    engine.monitor = FakeMonitor()
+    x = np.zeros((1, 8, HIDDEN), np.float32)
+    engine.train_batch(batch=(x, x))
+    assert engine.monitor.scalar_series(
+        "Train/Elastic/restart_count") == [2.0]
+    (mttr,) = engine.monitor.scalar_series("Train/Elastic/mttr_s")
+    assert 2.5 <= mttr < 60.0
+    # progress file written for the supervisor's poison-step detector
+    progress = read_progress(str(state_dir))
+    assert progress["global_steps"] == engine.global_steps
+
+
+# ---------------------------------------------------------------------------
+# supervisor: backoff / budget / poison-step (typed aborts pinned)
+# ---------------------------------------------------------------------------
+
+class FakeChild:
+    def __init__(self, rc):
+        self.rc = rc
+
+    def poll(self):
+        return self.rc
+
+    def wait(self):
+        return self.rc
+
+    def terminate(self):
+        pass
+
+
+def scripted_popen(script):
+    """script: list of callables(env) -> exit code (may write progress
+    as a side effect)."""
+    calls = []
+
+    def popen(argv, env):
+        step = script[min(len(calls), len(script) - 1)]
+        calls.append(dict(env))
+        return FakeChild(step(env))
+    popen.calls = calls
+    return popen
+
+
+def make_supervisor(tmp_path, script, **kw):
+    defaults = dict(max_restarts=3, backoff_base_s=0.0,
+                    backoff_max_s=0.0, backoff_jitter=0.0,
+                    poison_step_threshold=3,
+                    popen_fn=scripted_popen(script),
+                    sleep_fn=lambda s: None)
+    defaults.update(kw)
+    return Supervisor(["train.py"], str(tmp_path / "state"), env={},
+                      **defaults)
+
+
+def test_supervisor_clean_exit_no_restart(tmp_path):
+    sup = make_supervisor(tmp_path, [lambda env: 0])
+    stats = sup.run()
+    assert stats == {"exit_code": 0, "restarts": 0, "exit_codes": [],
+                     "crash_steps": [], "total_backoff_s": 0.0}
+
+
+def test_peer_failure_error_exits_process_with_code():
+    """An UNCAUGHT PeerFailureError must end the process with the
+    supervisor-recognized exit code, without every training script
+    adding a handler: it subclasses SystemExit and carries the code."""
+    err = PeerFailureError("peer gone", peers=["1"])
+    assert isinstance(err, SystemExit)
+    assert isinstance(err, Exception)        # normal handlers still see it
+    assert err.code == ec.EXIT_CODE_PEER_FAILURE
+    assert err.exit_code == ec.EXIT_CODE_PEER_FAILURE
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from deeperspeed_tpu.elasticity import PeerFailureError; "
+         "raise PeerFailureError('peer gone')"],
+        capture_output=True,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep))})
+    assert proc.returncode == ec.EXIT_CODE_PEER_FAILURE
+
+
+def test_supervisor_clears_stale_session_records(tmp_path):
+    """Leftovers from a PREVIOUS supervision session in a reused state
+    dir must not poison this one: a stale progress.json would
+    mis-attribute startup crashes to its step, a stale supervisor.json
+    would feed the restarted engine a bogus MTTR."""
+    state = tmp_path / "state"
+    state.mkdir()
+    write_progress(str(state), 99)           # job A's last step
+    (state / ec.SUPERVISOR_FILE).write_text(json.dumps(
+        {"crash_time": 1.0, "exit_code": 1, "restart_count": 5}))
+
+    # job B's child crashes at STARTUP (never writes progress): the
+    # poison detector must see step None each time, not job A's 99
+    sup = make_supervisor(tmp_path, [lambda env: 1], max_restarts=2,
+                          poison_step_threshold=2)
+    with pytest.raises(RestartBudgetExceededError):
+        sup.run()
+    assert sup.crash_steps == [None, None, None]
+    assert not (state / ec.PROGRESS_FILE).exists()
+
+
+def test_supervisor_restarts_through_crashes(tmp_path):
+    state = tmp_path / "state"
+
+    def crash(step):
+        def run(env):
+            os.makedirs(state, exist_ok=True)
+            write_progress(str(state), step)
+            return ec.EXIT_CODE_PEER_FAILURE
+        return run
+
+    sup = make_supervisor(
+        tmp_path, [crash(3), crash(7), lambda env: 0])
+    stats = sup.run()
+    assert stats["exit_code"] == 0
+    assert stats["restarts"] == 2
+    assert stats["crash_steps"] == [3, 7]
+    # every relaunch exported the state dir + its restart ordinal
+    envs = sup._popen.calls
+    assert [e[ec.DS_ELASTIC_RESTART_COUNT] for e in envs] == \
+        ["0", "1", "2"]
+    assert all(e[ec.DS_ELASTIC_STATE_DIR] == str(state) for e in envs)
+    # the pre-relaunch restart record is what MTTR accounting reads
+    record = json.loads((state / ec.SUPERVISOR_FILE).read_text())
+    assert record["restart_count"] == 2
+    assert record["exit_code"] == ec.EXIT_CODE_PEER_FAILURE
+
+
+def test_supervisor_budget_exhaustion_typed(tmp_path):
+    state = tmp_path / "state"
+
+    def crash(env):
+        os.makedirs(state, exist_ok=True)
+        # different step each crash: NOT poison, purely budget
+        write_progress(str(state), len(sup.exit_codes))
+        return 1
+
+    sup = make_supervisor(tmp_path, [crash], max_restarts=2)
+    with pytest.raises(RestartBudgetExceededError, match="budget"):
+        sup.run()
+    assert sup.restarts == 2
+    assert sup.exit_codes == [1, 1, 1]
+
+
+def test_supervisor_poison_step_typed(tmp_path):
+    state = tmp_path / "state"
+
+    def crash_same_step(env):
+        os.makedirs(state, exist_ok=True)
+        write_progress(str(state), 11)
+        return 1
+
+    sup = make_supervisor(tmp_path, [crash_same_step], max_restarts=10,
+                          poison_step_threshold=3)
+    with pytest.raises(PoisonStepError, match="step 11"):
+        sup.run()
+    # two restarts happened, the third same-step crash aborted
+    assert sup.restarts == 2
+    assert sup.crash_steps == [11, 11, 11]
+
+
+def test_supervisor_poison_beats_budget_only_on_same_step(tmp_path):
+    """Alternating crash steps must NOT trip the poison detector."""
+    state = tmp_path / "state"
+    steps = iter([5, 9, 5, 9, 5])
+
+    def crash(env):
+        os.makedirs(state, exist_ok=True)
+        write_progress(str(state), next(steps))
+        return 1
+
+    sup = make_supervisor(tmp_path, [crash], max_restarts=4,
+                          poison_step_threshold=2)
+    with pytest.raises(RestartBudgetExceededError):
+        sup.run()
+
+
+def test_supervisor_backoff_capped_exponential_with_jitter(tmp_path):
+    sup = make_supervisor(tmp_path, [lambda env: 0],
+                          backoff_base_s=1.0, backoff_max_s=8.0,
+                          backoff_jitter=0.0)
+    assert [sup.backoff_s(k) for k in (1, 2, 3, 4, 5, 6)] == \
+        [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+    import random
+    sup2 = make_supervisor(tmp_path, [lambda env: 0],
+                           backoff_base_s=2.0, backoff_max_s=64.0,
+                           backoff_jitter=0.25,
+                           rng=random.Random(0))
+    vals = [sup2.backoff_s(2) for _ in range(50)]
+    assert all(4.0 * 0.75 <= v <= 4.0 * 1.25 for v in vals)
+    assert len(set(vals)) > 1              # jitter actually varies
+
+
+def test_supervisor_stop_requested_no_restart(tmp_path):
+    def crash(env):
+        sup.stop_requested = True          # SIGTERM arrived mid-run
+        return 1
+
+    sup = make_supervisor(tmp_path, [crash])
+    stats = sup.run()
+    assert stats["exit_code"] == 1
+    assert stats["restarts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# launcher integration: launch.py --elastic drives a real child process
+# (no jax in the child — the supervisor machinery is what's under test)
+# ---------------------------------------------------------------------------
+
+def _write_script(tmp_path, body):
+    script = tmp_path / "child.py"
+    script.write_text("import json, os, sys\n" + body)
+    return str(script)
+
+
+def test_launch_elastic_restarts_child(tmp_path):
+    """launch.py --elastic: a child that dies once (simulated peer
+    failure) is relaunched and succeeds; the launcher exits cleanly."""
+    from deeperspeed_tpu.launcher import launch
+    marker = tmp_path / "ran.txt"
+    script = _write_script(tmp_path, f"""
+state = os.environ["DS_ELASTIC_STATE_DIR"]
+count = int(os.environ["DS_ELASTIC_RESTART_COUNT"])
+with open({str(marker)!r}, "a") as f:
+    f.write(str(count) + "\\n")
+with open(os.path.join(state, "progress.json"), "w") as f:
+    json.dump({{"global_steps": 5 + count}}, f)
+sys.exit({ec.EXIT_CODE_PEER_FAILURE} if count == 0 else 0)
+""")
+    launch.main(["--elastic",
+                 "--elastic_state_dir", str(tmp_path / "es"),
+                 "--elastic_backoff_base_s", "0.01",
+                 "--elastic_backoff_max_s", "0.02",
+                 "--elastic_backoff_jitter", "0.0",
+                 script])
+    assert marker.read_text().splitlines() == ["0", "1"]
+
+
+def test_launch_elastic_poison_step_aborts(tmp_path):
+    from deeperspeed_tpu.launcher import launch
+    script = _write_script(tmp_path, """
+state = os.environ["DS_ELASTIC_STATE_DIR"]
+with open(os.path.join(state, "progress.json"), "w") as f:
+    json.dump({"global_steps": 4}, f)
+sys.exit(3)
+""")
+    with pytest.raises(PoisonStepError):
+        launch.main(["--elastic",
+                     "--elastic_state_dir", str(tmp_path / "es"),
+                     "--elastic_backoff_base_s", "0.01",
+                     "--elastic_backoff_max_s", "0.02",
+                     "--elastic_backoff_jitter", "0.0",
+                     "--elastic_poison_step_threshold", "2",
+                     "--elastic_max_restarts", "10",
+                     script])
+
+
+def test_runner_forwards_elastic_flags(tmp_path):
+    from deeperspeed_tpu.launcher.launch import elastic_argv
+    from deeperspeed_tpu.launcher.runner import parse_args
+    args = parse_args(["--elastic", "--elastic_max_restarts", "7",
+                       "train.py", "--foo"])
+    argv = elastic_argv(args)
+    assert "--elastic" in argv
+    assert argv[argv.index("--elastic_max_restarts") + 1] == "7"
+    # off by default: nothing forwarded
+    assert elastic_argv(parse_args(["train.py"])) == []
+
+
+def test_launch_supervisor_policy_from_config_block(tmp_path):
+    """The ds config's elasticity.supervisor block alone (no --elastic
+    flag) enables supervision and sets the policy; explicit CLI flags
+    override individual block values."""
+    from deeperspeed_tpu.launcher import launch
+    ds_config = tmp_path / "ds_config.json"
+    ds_config.write_text(json.dumps({"elasticity": {"supervisor": {
+        "enabled": True, "max_restarts": 9, "backoff_base_s": 0.01,
+        "backoff_max_s": 0.02, "backoff_jitter": 0.0}}}))
+
+    args = launch.parse_args([
+        str(tmp_path / "train.py"), "--deepspeed_config",
+        str(ds_config)])
+    enabled, params = launch.resolve_supervisor_params(args)
+    assert enabled and params["max_restarts"] == 9
+    assert params["backoff_base_s"] == 0.01
+    assert params["poison_step_threshold"] == \
+        ec.SUPERVISOR_POISON_STEP_THRESHOLD_DEFAULT   # block omits it
+
+    # explicit CLI flag wins over the block
+    args = launch.parse_args([
+        "--elastic_max_restarts", "2",
+        str(tmp_path / "train.py"), "--deepspeed_config",
+        str(ds_config)])
+    _, params = launch.resolve_supervisor_params(args)
+    assert params["max_restarts"] == 2
+
+    # end to end: config-enabled supervision restarts a dying child
+    marker = tmp_path / "ran.txt"
+    script = _write_script(tmp_path, f"""
+count = int(os.environ["DS_ELASTIC_RESTART_COUNT"])
+with open({str(marker)!r}, "a") as f:
+    f.write(str(count) + "\\n")
+sys.exit(0 if count else 1)
+""")
+    launch.main(["--elastic_state_dir", str(tmp_path / "es"),
+                 script, "--deepspeed_config", str(ds_config)])
+    assert marker.read_text().splitlines() == ["0", "1"]
+
+    # a malformed block fails at the launcher, before any spawn
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"elasticity": {"supervisor": {
+        "enabled": True, "budget": 1}}}))
+    with pytest.raises(ElasticityConfigError, match="Unknown"):
+        launch.resolve_supervisor_params(launch.parse_args(
+            [script, "--deepspeed_config", str(bad)]))
+
+
+def test_runner_rejects_elastic_on_unforwarding_backends(tmp_path):
+    """Backends that exec the training script directly (no per-node
+    launch.py) cannot forward --elastic: launching WITHOUT supervision
+    silently would be discovered at the first unrecovered preemption."""
+    from deeperspeed_tpu.launcher import runner as runner_mod
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=4\nworker-1 slots=4\n")
+    with pytest.raises(NotImplementedError, match="pdsh"):
+        runner_mod.main(["--hostfile", str(hostfile),
+                         "--launcher", "slurm", "--elastic",
+                         "train.py"])
+
+
+# ---------------------------------------------------------------------------
+# watchdog disambiguation: local hang vs peer failure
+# ---------------------------------------------------------------------------
+
+def test_watchdog_hang_names_stale_peers(monkeypatch):
+    engine = make_engine(cfg(
+        elasticity={"heartbeat": _hb(interval=60, warn=120, fail=240)},
+        training_health={"enabled": True, "policy": "warn",
+                         "hang_timeout_seconds": 9999}))
+    errors = []
+    from deeperspeed_tpu.runtime import sentinel as sentinel_mod
+    monkeypatch.setattr(sentinel_mod.logger, "error",
+                        lambda msg, *a, **k: errors.append(str(msg)))
+    try:
+        # freeze a stale view: simulated peer registered then killed,
+        # observed far in the future via a manual poll
+        engine.peer_monitor.stop()
+        engine.peer_monitor.ensure_simulated_peer("simZ")
+        engine.peer_monitor.poll_once(now=0.0)
+        engine.peer_monitor.inject_peer_death("simZ")
+        engine.peer_monitor._clock = lambda: 500.0
+        engine.peer_monitor.poll_once(now=500.0)
+        engine.sentinel._on_hang()
+        assert any("simZ" in msg and "PEER" in msg for msg in errors)
+    finally:
+        if engine.sentinel is not None and \
+                engine.sentinel.watchdog is not None:
+            engine.sentinel.watchdog.stop()

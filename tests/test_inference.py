@@ -833,3 +833,118 @@ class TestServingSoak:
         for rid, prompt in list(pending.items())[::7]:  # spot-check
             assert list(by_id[rid].generated) == _teacher_forced(
                 cfg, params, neox_forward, prompt, 6)
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (SIGTERM): stop admissions, finish in-flight, flush,
+# exit 0 — serving must NOT inherit the training emergency-save handler
+# ---------------------------------------------------------------------------
+
+@pytest.mark.elastic
+class TestGracefulDrain:
+    def test_config_key(self):
+        p = parse_inference_block({"inference": {"enabled": True}})
+        assert p["drain_deadline_s"] == 30.0
+        p = parse_inference_block({"inference": {
+            "enabled": True, "drain_deadline_s": 5}})
+        assert p["drain_deadline_s"] == 5.0
+        with pytest.raises(DeepSpeedConfigError, match="drain_deadline"):
+            parse_inference_block({"inference": {
+                "enabled": True, "drain_deadline_s": -1}})
+
+    def test_scheduler_stops_fresh_admissions_only(self):
+        _, s = _sched()
+        first = Request(prompt=list(range(1, 8)), max_new_tokens=4)
+        s.add_request(first)
+        plan = s.schedule()
+        assert plan.prefills == [first]          # admitted while open
+        s.add_request(Request(prompt=list(range(1, 8)),
+                              max_new_tokens=4))
+        s.stop_admissions()
+        plan = s.schedule()
+        assert plan.prefills == []               # fresh request held
+        assert plan.decodes == [first]           # in-flight continues
+        assert s.has_inflight_work
+        # an EVICTED request still re-admits during drain (its partial
+        # generation is in-flight work)
+        s._evict_youngest()
+        assert s.has_inflight_work
+        plan = s.schedule()
+        assert plan.prefills == [first]
+        # finish it: only the fresh request remains -> no inflight work
+        s.complete_prefill(first, 7)
+        for _ in range(3):
+            s.complete_decode(first, 7)
+        assert first.done and first not in s.running
+        assert not s.has_inflight_work
+        assert s.has_work                        # the held fresh request
+
+    def _drain_engine(self, **cfg_kw):
+        cfg = GPTNeoXConfig.tiny()
+        model = GPTNeoX(config=cfg, use_pallas=False)
+        params = model.init_params(jax.random.PRNGKey(4))
+        return InferenceEngine(model, config=_engine_config(**cfg_kw),
+                               params=params), cfg, params
+
+    def test_drain_finishes_inflight_and_holds_queue(self):
+        eng, cfg, params = self._drain_engine()
+        rng = np.random.default_rng(3)
+        p1 = list(rng.integers(1, cfg.vocab_size, size=6))
+        p2 = list(rng.integers(1, cfg.vocab_size, size=9))
+        r1 = eng.submit(p1, max_new_tokens=4)
+        eng.step()                                # p1 in flight
+        eng.submit(p2, max_new_tokens=4)          # fresh, queued
+        summary = eng.drain()
+        assert summary["deadline_hit"] is False
+        assert summary["inflight_abandoned"] == 0
+        assert summary["unserved"] == 1           # p2 left for successor
+        done = {r.request_id: r for r in eng.scheduler.pop_finished()}
+        assert list(done[r1].generated) == _teacher_forced(
+            cfg, params, neox_forward, p1, 4)
+        # drained engine flushed its signal handlers
+        assert eng._prev_handlers == {}
+
+    def test_drain_deadline_bounds_the_wait(self):
+        eng, cfg, _ = self._drain_engine(drain_deadline_s=0)
+        rng = np.random.default_rng(5)
+        eng.submit(list(rng.integers(1, cfg.vocab_size, size=6)),
+                   max_new_tokens=64)
+        eng.step()
+        summary = eng.drain(deadline_s=0.0)       # no time to finish
+        assert summary["deadline_hit"] is True
+        assert summary["inflight_abandoned"] == 1
+
+    def test_run_exits_zero_on_drain_request(self):
+        eng, cfg, _ = self._drain_engine()
+        rng = np.random.default_rng(6)
+        eng.submit(list(rng.integers(1, cfg.vocab_size, size=6)),
+                   max_new_tokens=3)
+        eng.step()
+        eng.request_drain()                       # SIGTERM equivalent
+        with pytest.raises(SystemExit) as ei:
+            eng.run()
+        assert ei.value.code == 0
+        assert not eng.scheduler.has_inflight_work
+
+    def test_run_honors_drain_on_idle_server(self):
+        """SIGTERM while IDLE must still flush-and-exit-0: the drain
+        contract cannot depend on traffic being present."""
+        eng, _, _ = self._drain_engine()
+        eng.request_drain()
+        with pytest.raises(SystemExit) as ei:
+            eng.run()
+        assert ei.value.code == 0
+
+    def test_sigterm_handler_is_flag_only(self):
+        import signal
+        eng, cfg, _ = self._drain_engine()
+        eng.install_drain_handler()
+        try:
+            assert eng._drain_requested is False
+            # deliver SIGTERM to ourselves: the handler must only set
+            # the flag (no save, no exit) — acted on by run()
+            signal.raise_signal(signal.SIGTERM)
+            assert eng._drain_requested is True
+            assert eng._drain_signum == signal.SIGTERM
+        finally:
+            eng.restore_signal_handlers()
