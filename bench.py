@@ -59,7 +59,7 @@ ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
                "moe": 800, "serve": 800,
-               "elastic": 600}  # moe/longseq walk both engines
+               "elastic": 600, "fleet": 600}  # moe/longseq walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -407,17 +407,19 @@ def row_gpt2xl():
 def _flash_block_extra(tag):
     """Record the flash dispatch geometry the LAST trace actually chose
     (fwd and bwd blocks + grid variant) so a bench round documents WHICH
-    kernel configuration produced its numbers — `_LAST_BLOCKS` is
-    written at trace time by `ops/pallas/flash_attention._fwd/_bwd`."""
-    from deeperspeed_tpu.ops.pallas.flash_attention import _LAST_BLOCKS
+    kernel configuration produced its numbers — read through the public
+    `ops.dispatch_report()` accessor (the same record the telemetry
+    capture exports and fleet trace metadata embed)."""
+    from deeperspeed_tpu.ops import dispatch_report
+    flash = dispatch_report()["flash"]
     out = {}
-    fwd, bwd = _LAST_BLOCKS.get("fwd"), _LAST_BLOCKS.get("dkv")
+    fwd, bwd = flash.get("fwd"), flash.get("dkv")
     if fwd:
         out[f"{tag}_fwd_blocks"] = f"{fwd[0]}x{fwd[1]}"
-        out[f"{tag}_fwd_grid"] = _LAST_BLOCKS.get("fwd_variant", "?")
+        out[f"{tag}_fwd_grid"] = flash.get("fwd_variant", "?")
     if bwd:
         out[f"{tag}_bwd_blocks"] = f"{bwd[0]}x{bwd[1]}"
-        out[f"{tag}_bwd_grid"] = _LAST_BLOCKS.get("bwd_variant", "?")
+        out[f"{tag}_bwd_grid"] = flash.get("bwd_variant", "?")
     return out
 
 
@@ -876,6 +878,130 @@ def row_telemetry():
                    "telemetry")
 
 
+def row_fleet():
+    """Fleet observability row (opt-in via DS_BENCH_FLEET=1, NeoX-125M,
+    ZeRO-2): (a) telemetry overhead with fleet scalars + the Prometheus
+    exporter ON (capture off) vs the telemetry block absent — the
+    acceptance bar is <= 1% step time; (b) straggler detection: an
+    injected `slow_peer` fault (the PR 9 fault kind) must be NAMED by
+    the collective-skew probe, recording the detection latency in steps
+    and the named-host correctness; (c) a live scrape of the Prometheus
+    endpoint counting the Train/* families served."""
+    import shutil
+    import tempfile
+    import urllib.request
+
+    jax = _setup_jax()
+    n_chips = len(jax.devices())
+    cfg, model, params = _headline_setup(jax)
+    seq = 1024
+
+    def engine_with(batch, tmp, fleet=False, fault_step=None):
+        import deeperspeed_tpu
+        config = {
+            "train_batch_size": batch,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 10_000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "fp16": {"enabled": True, "type": "bfloat16"},
+            "zero_optimization": {"stage": 2},
+            "tensorboard": {"enabled": True, "output_path": tmp,
+                            "job_name": "bench"},
+        }
+        if fleet:
+            config["telemetry"] = {
+                "enabled": True, "goodput": True, "mfu": False,
+                "spans": True,
+                "fleet": {"enabled": True, "window_steps": 4,
+                          "skew_interval_steps": 2,
+                          "skew_slow_threshold_ms": 100.0}}
+            config["monitor"] = {"export": {"prometheus_port": 0}}
+            config["elasticity"] = {"heartbeat": {
+                "enabled": True, "interval_s": 0.2,
+                "warn_after_s": 60.0, "fail_after_s": 600.0}}
+        if fault_step is not None:
+            config["training_health"] = {"fault_injection": {"faults": [
+                {"kind": "slow_peer", "step": fault_step,
+                 "seconds": 0.25}]}}
+        eng, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params=config)
+        return eng
+
+    def run(bs_per_chip):
+        def thunk():
+            batch = bs_per_chip * n_chips
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                                  dtype=np.int32)
+            stacked = (tokens, tokens)
+            steps = 8
+            tmp = tempfile.mkdtemp(prefix="ds_fleet_bench_")
+            try:
+                eng = engine_with(batch, tmp)
+                dt_off, _ = timed_steps(eng, stacked, steps=steps,
+                                        warmup=3)
+                del eng
+                gc.collect()
+
+                eng = engine_with(batch, tmp, fleet=True)
+                dt_on, _ = timed_steps(eng, stacked, steps=steps,
+                                       warmup=3)
+                overhead = (dt_on - dt_off) / dt_off
+                prom = eng.monitor.prometheus
+                eng.monitor.flush()
+                families = 0
+                if prom is not None:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{prom.port}/metrics",
+                        timeout=5).read().decode()
+                    families = sum(1 for line in body.splitlines()
+                                   if line.startswith("# TYPE ds_train_"))
+                if eng.peer_monitor is not None:
+                    eng.peer_monitor.stop()
+                eng.monitor.close()
+                del eng
+                gc.collect()
+
+                # straggler detection: slow_peer fires at step 3; the
+                # skew probe (every 2 steps) must NAME the simulated
+                # host — detection latency = steps from fire to naming
+                fault_step = 3
+                eng = engine_with(batch, tmp, fleet=True,
+                                  fault_step=fault_step)
+                from deeperspeed_tpu.runtime.fault_injection import \
+                    DEFAULT_SIM_PEER
+                detected_at = None
+                for i in range(10):
+                    eng.train_batch(batch=stacked)
+                    fleet = eng.telemetry.fleet
+                    if detected_at is None and fleet is not None and \
+                            fleet.last_slowest == DEFAULT_SIM_PEER:
+                        detected_at = i + 1
+                        break
+                named_ok = detected_at is not None
+                eng.peer_monitor.stop()
+                eng.monitor.close()
+                del eng
+                gc.collect()
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            out = {
+                "fleet_step_ms_off": round(dt_off / steps * 1e3, 2),
+                "fleet_step_ms_on": round(dt_on / steps * 1e3, 2),
+                "fleet_overhead_pct": round(overhead * 100, 2),
+                "fleet_prom_train_families": families,
+                "fleet_slow_peer_named": bool(named_ok),
+            }
+            if detected_at is not None:
+                out["fleet_detect_latency_steps"] = \
+                    detected_at - fault_step
+            return out
+        return thunk
+
+    bs0 = int(os.environ.get("DS_BENCH_FLEET_BS", "16"))
+    return _ladder([(f"bs{bs0}", run(bs0))], {}, "fleet")
+
+
 def row_serve():
     """Continuous-batching serving row (opt-in via DS_BENCH_SERVE=1): a
     fixed-seed open-loop request stream (lognormal prompt lengths,
@@ -1105,7 +1231,7 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
            "sentinel": row_sentinel, "telemetry": row_telemetry,
            "packed": row_packed, "serve": row_serve,
-           "elastic": row_elastic}
+           "elastic": row_elastic, "fleet": row_fleet}
 
 
 # ---------------------------------------------------------------------------
@@ -1129,6 +1255,8 @@ def rows_enabled():
         order.append("serve")
     if os.environ.get("DS_BENCH_ELASTIC", "0") not in ("0", "", "false"):
         order.append("elastic")
+    if os.environ.get("DS_BENCH_FLEET", "0") not in ("0", "", "false"):
+        order.append("fleet")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -1137,7 +1265,7 @@ def rows_enabled():
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
-                   "elastic"):
+                   "elastic", "fleet"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

@@ -194,6 +194,11 @@ class PeerHealthMonitor:
         self._simulated = {}
         self.failed = {}             # name -> staleness at death
         self.warned = set()
+        # quantitative per-host step skew from the fleet probe
+        # (runtime/fleet.py note_skew): whole-dict swaps, read lock-free
+        # from the poll thread so escalation logs can cite it
+        self._skew_behind_ms = {}
+        self._skew_steps = {}
         self.transport_errors = 0
         self._transport_fail_since = None
         self._first_poll = None      # first-beat grace starts here
@@ -236,6 +241,34 @@ class PeerHealthMonitor:
         # warn/fail thresholds is noticed promptly
         return max(min(self.interval_s / 2.0, 1.0), 0.05)
 
+    # -- fleet skew probe (runtime/fleet.py) -------------------------------
+
+    def note_skew(self, behind_ms_by_peer, behind_steps_by_peer):
+        """Record the fleet probe's quantitative verdict: per-host EMA
+        of step-time lateness behind the fleet median (ms) and the
+        consecutive steps each host has spent past the slow threshold.
+        Whole-dict swaps (atomic under the GIL) — the poll thread reads
+        without taking the monitor lock, so `skew_context` is safe from
+        inside `_observe`."""
+        self._skew_behind_ms = {str(k): float(v)
+                                for k, v in behind_ms_by_peer.items()}
+        self._skew_steps = {str(k): int(v)
+                            for k, v in behind_steps_by_peer.items()}
+
+    def skew_context(self, name):
+        """Human-readable skew citation for one peer ("host 3 is
+        180ms/step behind the median for 50 consecutive steps"), or
+        None when the probe has nothing quantitative on it — the slow
+        escalation and the hang watchdog's LOCAL-vs-peer verdict cite
+        this instead of a staleness guess."""
+        name = str(name)
+        behind = self._skew_behind_ms.get(name)
+        if behind is None or behind <= 0:
+            return None
+        steps = self._skew_steps.get(name, 0)
+        return (f"host {name} is {behind:.0f}ms/step behind the median "
+                f"for {steps} consecutive steps")
+
     # -- fault-injection hooks (single-host simulated peers) ---------------
 
     def ensure_simulated_peer(self, name):
@@ -265,6 +298,14 @@ class PeerHealthMonitor:
         sim.delay_s = float(delay_s)
         logger.warning(f"fault injection: simulated peer {name} slowed "
                        f"to one heartbeat per {delay_s:.1f}s")
+
+    def simulated_delays(self):
+        """{name: delay_s} of the LIVE simulated peers — the fleet skew
+        probe's single-host gather reads a `slow_peer` fault's delay as
+        that host's per-step arrival lateness."""
+        with self._lock:
+            return {name: sim.delay_s
+                    for name, sim in self._simulated.items() if sim.alive}
 
     # -- the observable core ----------------------------------------------
 
@@ -411,12 +452,18 @@ class PeerHealthMonitor:
                     if state["status"] == PEER_OK:
                         state["status"] = PEER_SLOW
                         self.warned.add(name)
+                        # cite the fleet probe's quantitative skew when
+                        # available: "slow" backed by measured ms/step,
+                        # not just a staleness guess
+                        skew = self.skew_context(name)
                         logger.warning(
                             f"peer health: peer {name} heartbeat stale "
                             f"for {staleness:.1f}s (> warn_after_s="
                             f"{self.warn_after_s:.1f}) — slow or "
                             f"wedged; escalating to dead at "
-                            f"{self.fail_after_s:.1f}s")
+                            f"{self.fail_after_s:.1f}s"
+                            + (f" [fleet skew probe: {skew}]" if skew
+                               else ""))
 
     # -- views -------------------------------------------------------------
 

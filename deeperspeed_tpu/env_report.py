@@ -37,6 +37,69 @@ def op_report():
     return rows
 
 
+def env_fingerprint():
+    """Machine-readable environment identity: versions, device kind,
+    process count/index, and topology. This is what `ds_report --json`
+    prints and what the fleet trace collector embeds in merged-capture
+    metadata (`runtime/fleet.py`) — WHICH jax/jaxlib/device produced a
+    trace matters when comparing lanes across hosts."""
+    import jax
+
+    import numpy as np
+
+    from .version import __version__
+
+    info = {
+        "deeperspeed_tpu": __version__,
+        "jax": jax.__version__,
+        "numpy": np.__version__,
+    }
+    try:
+        import jaxlib
+        info["jaxlib"] = jaxlib.__version__
+    except Exception:  # noqa: BLE001 - bundled builds
+        info["jaxlib"] = getattr(getattr(jax, "lib", None), "__version__",
+                                 None)
+    try:
+        devices = jax.devices()
+        info.update({
+            "backend": jax.default_backend(),
+            "device_count": len(devices),
+            "local_device_count": len(jax.local_devices()),
+            "device_kind": (getattr(devices[0], "device_kind", "unknown")
+                            if devices else "none"),
+            "process_count": jax.process_count(),
+            "process_index": jax.process_index(),
+            "topology": {
+                "platforms": sorted({getattr(d, "platform", "unknown")
+                                     for d in devices}),
+                "devices_per_process": (len(devices)
+                                        // max(jax.process_count(), 1)),
+            },
+        })
+    except RuntimeError as e:  # backend not initializable here
+        info["backend_error"] = str(e)
+    try:
+        import flax
+        info["flax"] = flax.__version__
+    except ImportError:
+        pass
+    return info
+
+
+def json_report():
+    """The full `ds_report --json` payload: environment fingerprint +
+    op/kernel availability matrix."""
+    from .ops.compat import ALL_OPS
+    ops = {}
+    for name, check in ALL_OPS.items():
+        try:
+            ops[name] = bool(check())
+        except Exception:  # noqa: BLE001 - probe failure = unavailable
+            ops[name] = False
+    return {"env": env_fingerprint(), "ops": ops}
+
+
 def debug_report():
     import jax
 
@@ -73,7 +136,15 @@ def debug_report():
     return rows
 
 
-def main():
+def main(argv=None):
+    import sys
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if "--json" in argv:
+        # machine-readable mode: env fingerprint + op matrix, nothing
+        # else on stdout (the fleet collector and CI parse this)
+        import json
+        print(json.dumps(json_report(), indent=2, default=str))
+        return
     op_report()
     debug_report()
 

@@ -54,7 +54,8 @@ from ..runtime.config import DeepSpeedConfig, parse_inference_block
 from ..runtime.config_utils import (DeepSpeedConfigError, load_config_json)
 from ..runtime.precision import resolve_precision
 from .kv_cache import PagedKVCache, pages_for_tokens
-from .scheduler import ContinuousBatchingScheduler, Request
+from .metrics import ServeRequestMetrics
+from .scheduler import FINISHED, ContinuousBatchingScheduler, Request
 
 
 def _pow2_ladder(lo, hi):
@@ -263,7 +264,13 @@ class InferenceEngine:
                       "prefill_tokens": 0, "decode_tokens": 0,
                       "evictions": 0, "finished": 0,
                       "schedule_s": 0.0, "prefill_s": 0.0,
-                      "decode_s": 0.0, "admission_wait_s": 0.0}
+                      "decode_s": 0.0, "admission_wait_s": 0.0,
+                      "queue_depth": 0.0, "page_pool_util": 0.0}
+        # request-level latency histograms (inference/metrics.py):
+        # admission-wait / TTFT / inter-token distributions, fanned out
+        # to the monitor's export backends (Prometheus histogram
+        # families) at observation time
+        self.request_metrics = ServeRequestMetrics(monitor=monitor)
 
         # graceful drain (SIGTERM): flag-only handler, acted on at the
         # next serving-loop iteration — the PR 3 signal discipline
@@ -495,8 +502,14 @@ class InferenceEngine:
         self.stats["evictions"] += len(plan.evicted)
         for req in plan.prefills:
             if req.admitted_at is not None and req.enqueued_at is not None:
-                self.stats["admission_wait_s"] += \
-                    req.admitted_at - req.enqueued_at
+                wait = req.admitted_at - req.enqueued_at
+                self.stats["admission_wait_s"] += wait
+                self.request_metrics.observe_admission_wait(wait)
+        # per-step gauges: scheduler backlog + KV page-pool occupancy —
+        # the two saturation signals an autoscaler watches
+        usable = max(self.cache.num_pages - 1, 1)
+        self.stats["queue_depth"] = float(len(self.scheduler.waiting))
+        self.stats["page_pool_util"] = 1.0 - self.cache.num_free / usable
 
         finished_before = len(self.scheduler.finished)
 
@@ -523,9 +536,36 @@ class InferenceEngine:
         finished = len(self.scheduler.finished) - finished_before
         self.stats["finished"] += finished
         self.stats["steps"] += 1
+        self._record_request_spans(plan)
+        if self.monitor is not None:
+            # per-step saturation series keyed by total generated tokens
+            # (the Serve/* convention); buffered — no per-step flush
+            total = self.stats["prefill_tokens"] + \
+                self.stats["decode_tokens"]
+            self.monitor.record(total, {
+                "Serve/queue_depth": self.stats["queue_depth"],
+                "Serve/page_pool_util": self.stats["page_pool_util"],
+                "Serve/running": float(len(self.scheduler.running))})
         return {"prefilled": len(plan.prefills),
                 "decoded": len(plan.decodes),
                 "evicted": len(plan.evicted), "finished": finished}
+
+    def _record_request_spans(self, plan):
+        """Per-request lifecycle records behind the telemetry capture
+        machinery: while a capture window is open, every request that
+        FINISHED this step lands in the span buffer as one event
+        covering submit → last token (exported in the Chrome trace next
+        to the schedule/prefill/decode spans). Zero cost outside a
+        window."""
+        tracer = getattr(self.telemetry, "tracer", None)
+        if tracer is None or not tracer.capturing:
+            return
+        now = time.perf_counter()
+        for req in plan.prefills + plan.decodes:
+            if req.state == FINISHED and req.submitted_at is not None:
+                tracer.record_event(
+                    f"request/{req.request_id}", req.submitted_at,
+                    (req.last_token_at or now) - req.submitted_at)
 
     def _run_prefill(self, plan):
         B, S = plan.prefill_batch, plan.prefill_len
@@ -544,8 +584,16 @@ class InferenceEngine:
             jnp.asarray(lengths), jnp.asarray(page_table), self.cache.k,
             self.cache.v, self._next_rng())
         nxt = np.asarray(nxt)
+        now = time.perf_counter()
         for i, req in enumerate(plan.prefills):
             self.scheduler.complete_prefill(req, int(nxt[i]))
+            # TTFT: once per request, from the ORIGINAL submit — an
+            # evicted request's re-prefill resamples a token it already
+            # delivered and must not re-count
+            if req.first_token_at is None and req.submitted_at is not None:
+                req.first_token_at = now
+                self.request_metrics.observe_ttft(now - req.submitted_at)
+            req.last_token_at = now
 
     def _run_decode(self, plan):
         B = plan.decode_batch
@@ -562,8 +610,13 @@ class InferenceEngine:
             jnp.asarray(lengths), jnp.asarray(page_table), self.cache.k,
             self.cache.v, self._next_rng())
         nxt = np.asarray(nxt)
+        now = time.perf_counter()
         for i, req in enumerate(plan.decodes):
             self.scheduler.complete_decode(req, int(nxt[i]))
+            if req.last_token_at is not None:
+                self.request_metrics.observe_inter_token(
+                    now - req.last_token_at)
+            req.last_token_at = now
 
     # ------------------------------------------------------------------
     # graceful drain (SIGTERM from the pod scheduler)
@@ -686,10 +739,13 @@ class InferenceEngine:
         return [list(done[i].generated) for i in ids]
 
     def serve_stats(self):
-        """Counters + phase seconds; also pushed to the monitor (as
+        """Counters + phase seconds + request-latency percentiles
+        (p50/p99 of admission wait / TTFT / inter-token, from the
+        fixed-bucket histograms); also pushed to the monitor (as
         ``Serve/*`` scalars keyed by total generated tokens) when one
         was attached."""
         out = dict(self.stats)
+        out.update(self.request_metrics.summary())
         total = out["prefill_tokens"] + out["decode_tokens"]
         if self.monitor is not None:
             self.monitor.record(

@@ -62,6 +62,12 @@ class Request:
     evictions: int = 0
     enqueued_at: float = None
     admitted_at: float = None
+    # request-level latency observability (inference/metrics.py):
+    # submitted_at survives evictions (TTFT measures from first submit,
+    # once); last_token_at feeds the inter-token histogram
+    submitted_at: float = None
+    first_token_at: float = None
+    last_token_at: float = None
 
     @property
     def context(self):
@@ -180,6 +186,8 @@ class ContinuousBatchingScheduler:
         self._counter += 1
         request.state = WAITING
         request.enqueued_at = now
+        if request.submitted_at is None:
+            request.submitted_at = now
         self.waiting.append(request)
         return request.request_id
 

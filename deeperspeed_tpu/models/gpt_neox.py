@@ -69,6 +69,9 @@ class GPTNeoXConfig:
     moe_a2a_overlap_chunks: int = 1
     # renormalize top-2 combine weights over capacity-surviving choices
     moe_renorm_kept_choices: bool = False
+    # Train/MoE routing observability (sort dispatch only): per-expert
+    # load + capacity-drop stats emitted host-side via async callback
+    moe_observability: bool = False
     # packed ragged batches (runtime/packing.py): loss_fn REQUIRES
     # (tokens, labels, segment_ids) and attention/rotary/loss all become
     # segment-aware. Config-drivable via the JSON `packing` block. A
@@ -394,7 +397,8 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
             groups=getattr(cfg, "moe_num_groups", 1),
             dispatch=getattr(cfg, "moe_dispatch", "einsum"),
             renorm_kept_choices=getattr(cfg, "moe_renorm_kept_choices",
-                                        False))
+                                        False),
+            observe=getattr(cfg, "moe_observability", False))
         moe_out = y.reshape(ln2.shape)
         if cfg.use_parallel_residual:
             return x + reduce_fn(attn_partial) + out_b + moe_out, aux
@@ -953,7 +957,8 @@ class GPTNeoX:
                 moe_dispatch=moe.get("dispatch", "einsum"),
                 moe_a2a_overlap_chunks=moe.get("a2a_overlap_chunks", 1),
                 moe_renorm_kept_choices=moe.get("renorm_kept_choices",
-                                                False))
+                                                False),
+                moe_observability=moe.get("observability", False))
             if self.config.moe_a2a_overlap_chunks > 1:
                 # the GSPMD model path lets XLA insert the expert
                 # exchange — explicit a2a chunking only exists on the

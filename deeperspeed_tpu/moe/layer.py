@@ -57,6 +57,7 @@ exactly (tested on the 8-device mesh), for either engine.
 """
 
 import functools
+import threading
 
 import numpy as np
 
@@ -69,6 +70,77 @@ import jax.numpy as jnp
 _AUTO_GROUP_TOKENS = 1024
 
 DISPATCH_MODES = ("einsum", "sort")
+
+
+class _RoutingStatsCollector:
+    """Host-side sink for the sort engine's in-jit routing statistics
+    (``moe.observability``): per-expert load fractions and the
+    capacity-drop fraction land here via `jax.debug.callback`
+    (unordered — the callback runs when the device values materialize,
+    so the hot path never syncs) and the engine drains them into
+    ``Train/MoE/*`` scalars at its step-record boundary.
+
+    Samples are AVERAGED across everything that accumulated since the
+    last drain: one entry per MoE layer per step, plus duplicates when
+    rematerialization re-runs a layer's forward in the backward pass —
+    duplicate values are identical, so the averages are unbiased."""
+
+    # un-drained cap: with no monitor attached nothing ever drains —
+    # keep the most recent window instead of growing forever
+    MAX_PENDING = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._load = []          # [E] load fraction per emission
+        self._drop = []          # capacity-drop fraction per emission
+
+    def _record(self, load, drop):
+        load = np.asarray(load, np.float64)
+        drop = float(np.asarray(drop))
+        with self._lock:
+            self._load.append(load)
+            self._drop.append(drop)
+            if len(self._load) > self.MAX_PENDING:
+                del self._load[:-self.MAX_PENDING]
+                del self._drop[:-self.MAX_PENDING]
+
+    def drain(self):
+        """Averaged scalars since the last drain, or None when nothing
+        was emitted (observability off, or the callbacks have not
+        materialized yet)."""
+        with self._lock:
+            load, self._load = self._load, []
+            drop, self._drop = self._drop, []
+        if not load:
+            return None
+        mean_load = np.mean(np.stack(load), axis=0)     # [E]
+        mean = float(mean_load.mean())
+        return {
+            "Train/MoE/expert_load_min": float(mean_load.min()),
+            "Train/MoE/expert_load_max": float(mean_load.max()),
+            # coefficient of variation: 0 = perfectly balanced; the
+            # single-number imbalance series worth alerting on
+            "Train/MoE/expert_load_cv":
+                float(mean_load.std() / max(mean, 1e-12)),
+            "Train/MoE/capacity_drop_fraction": float(np.mean(drop)),
+        }
+
+
+ROUTING_STATS = _RoutingStatsCollector()
+
+
+def _emit_routing_stats(route, capacity, E, g):
+    """Emit one routing observation from inside the compiled step (sort
+    engine only — `route.counts`/`route.pos` already hold the
+    position-in-expert bookkeeping, so the stats cost two reductions).
+    The virtual-expert counts fold back to real experts
+    (virtual id = expert·g + group)."""
+    kT = route.pos.shape[0]                      # routed copies (T·top_k)
+    counts_e = route.counts.reshape(E, g).sum(axis=1)
+    load = counts_e.astype(jnp.float32) / max(kT, 1)
+    kept = jnp.sum(jnp.minimum(route.counts, capacity))
+    drop = 1.0 - kept.astype(jnp.float32) / max(kT, 1)
+    jax.debug.callback(ROUTING_STATS._record, load, drop, ordered=False)
 
 
 @functools.lru_cache(maxsize=None)
@@ -379,7 +451,7 @@ def _gmm_geometry(capacity, k_dim, n_dim, dtype, block_m, block_n,
 def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
                   jitter_eps=0.0, groups=1, dispatch="einsum",
                   renorm_kept_choices=False, gmm_block_m=None,
-                  gmm_block_n=None, gmm_backend=None):
+                  gmm_block_n=None, gmm_backend=None, observe=False):
     """Reference semantics on one device. params: stacked expert weights
     {"w_in" [E, H, I], "b_in" [E, I], "w_out" [E, I, H], "b_out" [E, H],
     "gate" [H, E]}; x [T, H] → (y [T, H], aux_loss). `groups` splits the
@@ -390,6 +462,10 @@ def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
     if dispatch not in DISPATCH_MODES:
         raise ValueError(f"dispatch must be one of {DISPATCH_MODES}, "
                          f"got {dispatch!r}")
+    if observe and dispatch != "sort":
+        raise ValueError(
+            "observe=True requires dispatch='sort': the routing stats "
+            "come from the sort engine's position-in-expert bookkeeping")
     T, H = x.shape
     E = params["w_in"].shape[0]
     g = _resolve_groups(groups, T)
@@ -412,6 +488,8 @@ def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
 
     probs = _jittered_probs(params["gate"], xg, rng, jitter_eps)
     route = _sort_route(probs, capacity, top_k, renorm_kept_choices)
+    if observe:
+        _emit_routing_stats(route, capacity, E, g)
     span, bm, bn = _gmm_geometry(capacity, H, params["w_in"].shape[-1],
                                  x.dtype, gmm_block_m, gmm_block_n,
                                  gmm_backend)
@@ -439,7 +517,8 @@ def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25,
                             top_k=1, rng=None, jitter_eps=0.0, groups=1,
                             dispatch="einsum", renorm_kept_choices=False,
                             a2a_overlap_chunks=1, gmm_block_m=None,
-                            gmm_block_n=None, gmm_backend=None):
+                            gmm_block_n=None, gmm_backend=None,
+                            observe=False):
     """Inside shard_map: x is this rank's token shard [T_local, H];
     params carry this rank's experts ({"w_in" [E/ep, H, I], ...}) with
     the gate replicated. all_to_all exchanges expert-major token blocks
@@ -456,6 +535,10 @@ def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25,
     if dispatch not in DISPATCH_MODES:
         raise ValueError(f"dispatch must be one of {DISPATCH_MODES}, "
                          f"got {dispatch!r}")
+    if observe and dispatch != "sort":
+        raise ValueError(
+            "observe=True requires dispatch='sort': the routing stats "
+            "come from the sort engine's position-in-expert bookkeeping")
     T, H = x.shape
     e_local = params["w_in"].shape[0]
     E = e_local * ep
@@ -497,6 +580,11 @@ def moe_ffn_expert_parallel(params, x, axis_name, ep, capacity_factor=1.25,
     # ---- sort engine -----------------------------------------------------
     probs = _jittered_probs(params["gate"], xg, rng, jitter_eps)
     route = _sort_route(probs, capacity, top_k, renorm_kept_choices)
+    if observe:
+        # per-rank stats over this rank's token shard (each rank routes
+        # its own tokens to all E global experts); the host collector
+        # averages across ranks' emissions
+        _emit_routing_stats(route, capacity, E, g)
     span, bm, bn = _gmm_geometry(capacity, H, params["w_in"].shape[-1],
                                  x.dtype, gmm_block_m, gmm_block_n,
                                  gmm_backend)

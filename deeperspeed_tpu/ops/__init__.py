@@ -3,6 +3,27 @@ from .transformer import (DeepSpeedTransformerConfig,
                           DeepSpeedTransformerLayer)
 from .sparse_attention import SparseSelfAttention
 
+
+def dispatch_report():
+    """Last-dispatched kernel configuration, as one dict — the PUBLIC
+    accessor over the kernels' internal dispatch records
+    (`flash_attention._LAST_BLOCKS`, `decode_attention._LAST_BACKEND`).
+    The bench `extra` columns, the telemetry capture exports, and the
+    fleet trace metadata all consume this; WHICH block geometry / grid
+    variant / decode backend produced a number is as load-bearing as
+    the number itself.
+
+    Keys (present once the corresponding kernel has dispatched):
+    ``flash``: {"fwd": (bq, bk), "fwd_variant", "dkv", "dq",
+    "bwd_variant"}; ``decode_attention``: {"decode": backend}.
+    """
+    from .pallas.decode_attention import _LAST_BACKEND
+    from .pallas.flash_attention import _LAST_BLOCKS
+    return {"flash": dict(_LAST_BLOCKS),
+            "decode_attention": dict(_LAST_BACKEND)}
+
+
 __all__ = ["adam", "lamb", "op_builder", "pallas", "sparse_attention",
            "transformer", "DeepSpeedTransformerConfig",
-           "DeepSpeedTransformerLayer", "SparseSelfAttention"]
+           "DeepSpeedTransformerLayer", "SparseSelfAttention",
+           "dispatch_report"]

@@ -55,6 +55,20 @@ _DIMSEM = CompilerParams(
 # Test/bench observability: backend ("pallas"/"xla") of the most recent
 # paged_decode_attention call — the serving tests pin which path ran.
 _LAST_BACKEND = {}
+_DISPATCH_LOGGED = False
+
+
+def _log_first_dispatch():
+    """One structured log line at the first paged-decode dispatch (see
+    flash_attention._log_first_dispatch; `ops.dispatch_report()` is the
+    query interface)."""
+    global _DISPATCH_LOGGED
+    if _DISPATCH_LOGGED:
+        return
+    _DISPATCH_LOGGED = True
+    from ...utils.logging import logger
+    logger.info("ops.dispatch decode_attention first dispatch: "
+                f"backend={_LAST_BACKEND.get('decode')}")
 
 
 def paged_decode_supported(head_dim, page_size):
@@ -212,6 +226,7 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
         backend = ("pallas" if on_tpu and paged_decode_supported(D, page_size)
                    else "xla")
     _LAST_BACKEND["decode"] = backend
+    _log_first_dispatch()
     if backend == "xla":
         return paged_decode_attention_xla(q, k_pages, v_pages, page_table,
                                           lengths, sm_scale)

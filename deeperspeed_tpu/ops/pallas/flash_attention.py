@@ -131,6 +131,24 @@ _LAST_GRIDS = {}
 # recent call — the bench longseq rows record these in `extra` so a round
 # documents WHICH geometry produced its numbers.
 _LAST_BLOCKS = {}
+_DISPATCH_LOGGED = False
+
+
+def _log_first_dispatch():
+    """One structured log line at the FIRST flash dispatch of the
+    process: which block geometry / grid variant is live. Later
+    dispatches update `_LAST_BLOCKS` silently — `ops.dispatch_report()`
+    is the query interface; this line exists so every training log
+    records the kernel configuration without anyone asking."""
+    global _DISPATCH_LOGGED
+    if _DISPATCH_LOGGED:
+        return
+    _DISPATCH_LOGGED = True
+    import json
+
+    from ...utils.logging import logger
+    logger.info("ops.dispatch flash_attention first dispatch: "
+                + json.dumps(_LAST_BLOCKS, default=str))
 
 
 def _index_adapter(compact, kv_major=False):
@@ -628,6 +646,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
         # pure overhead — run the specialized straight-softmax kernel
         _LAST_BLOCKS["fwd"] = (s, s)
         _LAST_BLOCKS["fwd_variant"] = "single"
+        _log_first_dispatch()
         out, lse = _fwd_single(qb, kb, vb, causal, sm_scale, s, d,
                                _interpret(), kbias=kbias, h=h,
                                dropout_rate=dropout_rate, seed=seed)
@@ -638,6 +657,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     compact = causal   # causal ⇒ trapezoidal schedule (no dead launches)
     _LAST_BLOCKS["fwd"] = (block_q, block_k)
     _LAST_BLOCKS["fwd_variant"] = "trapezoid" if compact else "dense"
+    _log_first_dispatch()
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, n_k=n_k,

@@ -501,6 +501,7 @@ class DeepSpeedConfig:
             c.TENSORBOARD_OUTPUT_PATH, c.TENSORBOARD_OUTPUT_PATH_DEFAULT)
         self.tensorboard_job_name = tb.get(c.TENSORBOARD_JOB_NAME,
                                            c.TENSORBOARD_JOB_NAME_DEFAULT)
+        self._parse_monitor_block(d)
 
         self.sparse_attention = _parse_sparse_attention(d)
 
@@ -566,7 +567,8 @@ class DeepSpeedConfig:
         known = {c.MOE_ENABLED, c.MOE_NUM_EXPERTS, c.MOE_TOP_K,
                  c.MOE_CAPACITY_FACTOR, c.MOE_JITTER_EPS,
                  c.MOE_AUX_LOSS_COEF, c.MOE_NUM_GROUPS, c.MOE_DISPATCH,
-                 c.MOE_A2A_OVERLAP_CHUNKS, c.MOE_RENORM_KEPT_CHOICES}
+                 c.MOE_A2A_OVERLAP_CHUNKS, c.MOE_RENORM_KEPT_CHOICES,
+                 c.MOE_OBSERVABILITY}
         unknown = sorted(set(moe) - known)
         if unknown:
             raise DeepSpeedConfigError(
@@ -641,6 +643,18 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"moe.{c.MOE_RENORM_KEPT_CHOICES} must be a boolean, "
                 f"got {renorm!r}")
+        observability = moe.get(c.MOE_OBSERVABILITY,
+                                c.MOE_OBSERVABILITY_DEFAULT)
+        if not isinstance(observability, bool):
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_OBSERVABILITY} must be a boolean, got "
+                f"{observability!r}")
+        if observability and dispatch != "sort":
+            raise DeepSpeedConfigError(
+                f"moe.{c.MOE_OBSERVABILITY} requires moe.dispatch="
+                f"\"sort\": the expert-load / capacity-drop statistics "
+                f"come from the sort engine's position-in-expert "
+                f"bookkeeping (got dispatch={dispatch!r})")
 
         self.moe_params = {
             "num_experts": num_experts,
@@ -652,6 +666,7 @@ class DeepSpeedConfig:
             "dispatch": dispatch,
             "a2a_overlap_chunks": a2a_chunks,
             "renorm_kept_choices": renorm,
+            "observability": observability,
         }
 
     def _parse_checkpoint_block(self, d):
@@ -848,7 +863,7 @@ class DeepSpeedConfig:
                  c.TELEMETRY_SPANS, c.TELEMETRY_TRACE_DIR,
                  c.TELEMETRY_CAPTURE, c.TELEMETRY_MEMORY_WATERMARK_INTERVAL,
                  c.TELEMETRY_CAPTURE_ON_ANOMALY,
-                 c.TELEMETRY_ANOMALY_CAPTURE_STEPS}
+                 c.TELEMETRY_ANOMALY_CAPTURE_STEPS, c.TELEMETRY_FLEET}
         unknown = sorted(set(tel) - known)
         if unknown:
             raise DeepSpeedConfigError(
@@ -929,6 +944,10 @@ class DeepSpeedConfig:
                 f"telemetry.{c.TELEMETRY_ANOMALY_CAPTURE_STEPS} must be "
                 f">= 1, got {anomaly_steps}")
 
+        # module-level helper: the InferenceEngine reuses this parser
+        # with a bare namespace as `self`
+        fleet = _parse_telemetry_fleet(tel)
+
         needs_dir = capture is not None or \
             bools[c.TELEMETRY_CAPTURE_ON_ANOMALY]
         if bools[c.TELEMETRY_ENABLED] and needs_dir and trace_dir is None:
@@ -949,7 +968,87 @@ class DeepSpeedConfig:
             "memory_watermark_interval_steps": watermark,
             "capture_on_anomaly": bools[c.TELEMETRY_CAPTURE_ON_ANOMALY],
             "anomaly_capture_steps": anomaly_steps,
+            "fleet": fleet,
         }
+
+    def _parse_monitor_block(self, d):
+        """Parse + validate the ``monitor`` block (runtime/exporters.py:
+        the Prometheus endpoint, the JSONL event stream, and event-file
+        rotation). Same parse-time strictness as the telemetry block —
+        a mistyped port must fail at startup, not silently never serve
+        a scrape."""
+        mon = d.get(c.MONITOR) or {}
+        known = {c.MONITOR_EXPORT}
+        unknown = sorted(set(mon) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown 'monitor' key(s) {unknown}; valid keys: "
+                f"{sorted(known)}")
+        exp = mon.get(c.MONITOR_EXPORT) or {}
+        if not isinstance(exp, dict):
+            raise DeepSpeedConfigError(
+                f"monitor.{c.MONITOR_EXPORT} must be an object, got "
+                f"{type(exp).__name__}")
+        exp_known = {c.MONITOR_PROMETHEUS_PORT, c.MONITOR_PROMETHEUS_HOST,
+                     c.MONITOR_JSONL, c.MONITOR_ROTATE_MAX_MB,
+                     c.MONITOR_ROTATE_KEEP}
+        exp_unknown = sorted(set(exp) - exp_known)
+        if exp_unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown monitor.{c.MONITOR_EXPORT} key(s) "
+                f"{exp_unknown}; valid keys: {sorted(exp_known)}")
+        port = exp.get(c.MONITOR_PROMETHEUS_PORT,
+                       c.MONITOR_PROMETHEUS_PORT_DEFAULT)
+        if port is not None:
+            port = as_int(port,
+                          f"monitor.export.{c.MONITOR_PROMETHEUS_PORT}")
+            if not 0 <= port <= 65535:
+                raise DeepSpeedConfigError(
+                    f"monitor.export.{c.MONITOR_PROMETHEUS_PORT} must be "
+                    f"in [0, 65535] (0 = ephemeral), got {port}")
+        jsonl = exp.get(c.MONITOR_JSONL, c.MONITOR_JSONL_DEFAULT)
+        if not isinstance(jsonl, bool):
+            raise DeepSpeedConfigError(
+                f"monitor.export.{c.MONITOR_JSONL} must be a boolean, "
+                f"got {jsonl!r}")
+        try:
+            rotate_mb = float(exp.get(c.MONITOR_ROTATE_MAX_MB,
+                                      c.MONITOR_ROTATE_MAX_MB_DEFAULT))
+        except (TypeError, ValueError):
+            raise DeepSpeedConfigError(
+                f"monitor.export.{c.MONITOR_ROTATE_MAX_MB} must be a "
+                f"number (MB; 0 disables rotation), got "
+                f"{exp.get(c.MONITOR_ROTATE_MAX_MB)!r}")
+        if rotate_mb < 0:
+            raise DeepSpeedConfigError(
+                f"monitor.export.{c.MONITOR_ROTATE_MAX_MB} must be >= 0, "
+                f"got {rotate_mb}")
+        keep = as_int(exp.get(c.MONITOR_ROTATE_KEEP,
+                              c.MONITOR_ROTATE_KEEP_DEFAULT),
+                      f"monitor.export.{c.MONITOR_ROTATE_KEEP}")
+        if keep < 1:
+            raise DeepSpeedConfigError(
+                f"monitor.export.{c.MONITOR_ROTATE_KEEP} must be >= 1, "
+                f"got {keep}")
+        host = exp.get(c.MONITOR_PROMETHEUS_HOST,
+                       c.MONITOR_PROMETHEUS_HOST_DEFAULT)
+        if not isinstance(host, str) or not host:
+            raise DeepSpeedConfigError(
+                f"monitor.export.{c.MONITOR_PROMETHEUS_HOST} must be a "
+                f"non-empty bind address string (default 127.0.0.1; "
+                f"0.0.0.0 exposes the scrape off-box), got {host!r}")
+        self.monitor_export_config = {
+            "prometheus_port": port,
+            "prometheus_host": host,
+            "jsonl": jsonl,
+            "rotate_max_mb": rotate_mb,
+            "rotate_keep": keep,
+        }
+        # an armed export backend means the user wants the monitor even
+        # without a tensorboard block — the engine constructs it either
+        # way (the parser's contract: a configured exporter must serve,
+        # not silently depend on an unrelated block)
+        self.monitor_export_active = port is not None or jsonl
 
     def _parse_packing_block(self, d):
         """Parse + validate the "packing" block (runtime/packing.py:
@@ -1104,3 +1203,93 @@ def _default_dp_world_size():
         return jax.device_count()
     except Exception:
         return 1
+
+
+def _parse_telemetry_fleet(tel):
+    """Validate the ``telemetry.fleet`` sub-block (runtime/fleet.py:
+    cross-host aggregation windows, the collective-skew probe, and the
+    merged-capture event bound). Module-level (not a method): the
+    InferenceEngine drives `_parse_telemetry_block` with a bare
+    namespace as ``self``. Returns the params dict, or None when the
+    sub-block is absent/disabled."""
+    fl = tel.get(c.TELEMETRY_FLEET)
+    if fl is None:
+        return None
+    if not isinstance(fl, dict):
+        raise DeepSpeedConfigError(
+            f"telemetry.{c.TELEMETRY_FLEET} must be an object, got "
+            f"{type(fl).__name__}")
+    known = {c.TELEMETRY_FLEET_ENABLED,
+             c.TELEMETRY_FLEET_WINDOW_STEPS,
+             c.TELEMETRY_FLEET_SKEW_INTERVAL,
+             c.TELEMETRY_FLEET_SKEW_EMA_BETA,
+             c.TELEMETRY_FLEET_SKEW_THRESHOLD_MS,
+             c.TELEMETRY_FLEET_MAX_TRACE_EVENTS}
+    unknown = sorted(set(fl) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown telemetry.{c.TELEMETRY_FLEET} key(s) {unknown}; "
+            f"valid keys: {sorted(known)}")
+    enabled = fl.get(c.TELEMETRY_FLEET_ENABLED,
+                     c.TELEMETRY_FLEET_ENABLED_DEFAULT)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_ENABLED} must be a "
+            f"boolean, got {enabled!r}")
+    window = as_int(fl.get(c.TELEMETRY_FLEET_WINDOW_STEPS,
+                           c.TELEMETRY_FLEET_WINDOW_STEPS_DEFAULT),
+                    f"telemetry.fleet.{c.TELEMETRY_FLEET_WINDOW_STEPS}")
+    if window < 1:
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_WINDOW_STEPS} must be "
+            f">= 1, got {window}")
+    skew_interval = as_int(
+        fl.get(c.TELEMETRY_FLEET_SKEW_INTERVAL,
+               c.TELEMETRY_FLEET_SKEW_INTERVAL_DEFAULT),
+        f"telemetry.fleet.{c.TELEMETRY_FLEET_SKEW_INTERVAL}")
+    if skew_interval < 0:
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_SKEW_INTERVAL} must be "
+            f">= 0 (0 disables the probe), got {skew_interval}")
+    try:
+        beta = float(fl.get(c.TELEMETRY_FLEET_SKEW_EMA_BETA,
+                            c.TELEMETRY_FLEET_SKEW_EMA_BETA_DEFAULT))
+    except (TypeError, ValueError):
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_SKEW_EMA_BETA} must be "
+            f"a number, got {fl.get(c.TELEMETRY_FLEET_SKEW_EMA_BETA)!r}")
+    if not 0.0 <= beta < 1.0:
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_SKEW_EMA_BETA} must be "
+            f"in [0, 1), got {beta}")
+    try:
+        threshold = float(
+            fl.get(c.TELEMETRY_FLEET_SKEW_THRESHOLD_MS,
+                   c.TELEMETRY_FLEET_SKEW_THRESHOLD_MS_DEFAULT))
+    except (TypeError, ValueError):
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_SKEW_THRESHOLD_MS} "
+            f"must be a number, got "
+            f"{fl.get(c.TELEMETRY_FLEET_SKEW_THRESHOLD_MS)!r}")
+    if threshold < 0:
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_SKEW_THRESHOLD_MS} "
+            f"must be >= 0, got {threshold}")
+    max_events = as_int(
+        fl.get(c.TELEMETRY_FLEET_MAX_TRACE_EVENTS,
+               c.TELEMETRY_FLEET_MAX_TRACE_EVENTS_DEFAULT),
+        f"telemetry.fleet.{c.TELEMETRY_FLEET_MAX_TRACE_EVENTS}")
+    if max_events < 1:
+        raise DeepSpeedConfigError(
+            f"telemetry.fleet.{c.TELEMETRY_FLEET_MAX_TRACE_EVENTS} must "
+            f"be >= 1, got {max_events}")
+    if not enabled:
+        return None
+    return {
+        "enabled": True,
+        "window_steps": window,
+        "skew_interval_steps": skew_interval,
+        "skew_ema_beta": beta,
+        "skew_slow_threshold_ms": threshold,
+        "max_trace_events": max_events,
+    }

@@ -146,6 +146,24 @@ TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
 
 # ---------------------------------------------------------------------------
+# Monitor export (runtime/exporters.py): scrapeable metrics backends fed by
+# the monitor's single buffered drain — Prometheus HTTP endpoint +
+# structured JSONL, and the TSV fallback's size-based rotation.
+# ---------------------------------------------------------------------------
+MONITOR = "monitor"
+MONITOR_EXPORT = "export"
+MONITOR_PROMETHEUS_PORT = "prometheus_port"     # None = off, 0 = ephemeral
+MONITOR_PROMETHEUS_PORT_DEFAULT = None
+MONITOR_PROMETHEUS_HOST = "prometheus_host"     # 0.0.0.0 = off-box scrape
+MONITOR_PROMETHEUS_HOST_DEFAULT = "127.0.0.1"
+MONITOR_JSONL = "jsonl"
+MONITOR_JSONL_DEFAULT = False
+MONITOR_ROTATE_MAX_MB = "rotate_max_mb"         # 0 disables rotation
+MONITOR_ROTATE_MAX_MB_DEFAULT = 64
+MONITOR_ROTATE_KEEP = "rotate_keep"
+MONITOR_ROTATE_KEEP_DEFAULT = 5
+
+# ---------------------------------------------------------------------------
 # Progressive layer drop
 # ---------------------------------------------------------------------------
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
@@ -253,6 +271,21 @@ TELEMETRY_CAPTURE_ON_ANOMALY = "capture_on_anomaly"
 TELEMETRY_CAPTURE_ON_ANOMALY_DEFAULT = False
 TELEMETRY_ANOMALY_CAPTURE_STEPS = "anomaly_capture_steps"
 TELEMETRY_ANOMALY_CAPTURE_STEPS_DEFAULT = 1
+# Fleet observability sub-block (runtime/fleet.py): cross-host scalar
+# aggregation + merged Perfetto capture + collective-skew straggler probe.
+TELEMETRY_FLEET = "fleet"
+TELEMETRY_FLEET_ENABLED = "enabled"
+TELEMETRY_FLEET_ENABLED_DEFAULT = False
+TELEMETRY_FLEET_WINDOW_STEPS = "window_steps"
+TELEMETRY_FLEET_WINDOW_STEPS_DEFAULT = 50
+TELEMETRY_FLEET_SKEW_INTERVAL = "skew_interval_steps"   # 0 disables probe
+TELEMETRY_FLEET_SKEW_INTERVAL_DEFAULT = 10
+TELEMETRY_FLEET_SKEW_EMA_BETA = "skew_ema_beta"
+TELEMETRY_FLEET_SKEW_EMA_BETA_DEFAULT = 0.9
+TELEMETRY_FLEET_SKEW_THRESHOLD_MS = "skew_slow_threshold_ms"
+TELEMETRY_FLEET_SKEW_THRESHOLD_MS_DEFAULT = 50.0
+TELEMETRY_FLEET_MAX_TRACE_EVENTS = "max_trace_events"
+TELEMETRY_FLEET_MAX_TRACE_EVENTS_DEFAULT = 2000
 
 # ---------------------------------------------------------------------------
 # MoE block (moe/layer.py, config-drivable via apply_ds_config)
@@ -284,6 +317,10 @@ MOE_A2A_OVERLAP_CHUNKS_DEFAULT = 1
 # renormalize top-2 combine weights over capacity-surviving choices
 MOE_RENORM_KEPT_CHOICES = "renorm_kept_choices"
 MOE_RENORM_KEPT_CHOICES_DEFAULT = False
+# Routing observability (Train/MoE/expert_load_* + capacity-drop fraction
+# from the sort-dispatch path; requires dispatch="sort")
+MOE_OBSERVABILITY = "observability"
+MOE_OBSERVABILITY_DEFAULT = False
 
 # ---------------------------------------------------------------------------
 # Sparse attention block

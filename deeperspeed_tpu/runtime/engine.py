@@ -216,11 +216,17 @@ class DeepSpeedEngine:
         self.monitor = None
         self._last_step_stamp = None
         self._last_used_lr = None
-        if self._config.tensorboard_enabled:
+        # an armed monitor.export backend (Prometheus port / JSONL)
+        # constructs the monitor even without a tensorboard block — a
+        # validated exporter that silently never serves a scrape is the
+        # exact failure the parser rejects typos for
+        if self._config.tensorboard_enabled or \
+                self._config.monitor_export_active:
             from .monitor import TensorBoardMonitor
             self.monitor = TensorBoardMonitor(
                 output_path=self._config.tensorboard_output_path,
-                job_name=self._config.tensorboard_job_name)
+                job_name=self._config.tensorboard_job_name,
+                export=self._config.monitor_export_config)
 
         # Fault-tolerant async checkpointing (checkpoint/async_manager):
         # snapshot-then-commit saves in a background writer, auto-save
@@ -242,6 +248,13 @@ class DeepSpeedEngine:
             self._config.telemetry_config, monitor=self.monitor,
             devices=local or jax.local_devices())
         self._step_flops = {}   # compiled-variant key -> per-device flops
+
+        # MoE routing observability (moe.observability): the sort
+        # engine's in-jit stats land host-side via an async callback and
+        # are drained into Train/MoE/* scalars at each step record
+        moe_cfg = self._config.moe_params
+        self._moe_observe = bool(moe_cfg and
+                                 moe_cfg.get("observability"))
 
         # --- offload tier -------------------------------------------------
         zc = self._config.zero_config
@@ -351,6 +364,13 @@ class DeepSpeedEngine:
                 for name in self._fault_injector.simulated_peers:
                     self.peer_monitor.ensure_simulated_peer(name)
             self.peer_monitor.start()
+            # fleet skew probe (runtime/fleet.py): quantitative per-host
+            # lateness feeds the heartbeat monitor so slow-peer
+            # escalation cites measured ms/step — and the single-host
+            # simulated gather reads the monitor's slow_peer faults
+            fleet = getattr(self.telemetry, "fleet", None)
+            if fleet is not None:
+                fleet.bind_peer_monitor(self.peer_monitor)
         elif self._fault_injector is not None and \
                 self._fault_injector.simulated_peers:
             raise DeepSpeedConfigError(
@@ -2278,6 +2298,15 @@ class DeepSpeedEngine:
             # going quiet BEFORE the fail threshold declares it dead
             scalars["Train/Elastic/heartbeat_staleness_s"] = \
                 self.peer_monitor.max_staleness()
+        if self._moe_observe:
+            # expert-load / capacity-drop stats emitted by the sort
+            # dispatch via async callback; values may trail the step
+            # that produced them by one drain (the callback runs when
+            # the device values materialize — no dispatch stall)
+            from ..moe.layer import ROUTING_STATS
+            moe_stats = ROUTING_STATS.drain()
+            if moe_stats:
+                scalars.update(moe_stats)
         # wall_clock_breakdown timers land in the event stream too (the
         # reference only ever printed them): Train/Timers/<name>_ms keyed
         # by the same sample count as the loss scalars. elapsed(reset)

@@ -1,0 +1,473 @@
+"""Fleet observability: cross-host aggregation + collective-skew probe.
+
+PR 6's telemetry and PR 9's heartbeats are strictly per-host: every
+process writes its own span captures and ``Train/*`` scalars, and the
+heartbeat monitor's ``slow`` state is a qualitative staleness guess.
+This module gives the JOB a view:
+
+- **Cross-host aggregation** (`FleetAggregator`): every host accumulates
+  its per-step wall time / data-wait / checkpoint-stall locally and, at
+  the close of each ``window_steps`` window, publishes ONE bounded
+  summary through the same coordination-service KV transport the
+  heartbeats ride (`elasticity.heartbeat.CoordinationTransport`; the
+  in-memory transport single-host). Rank 0 collects the summaries and
+  emits job-level ``Train/Fleet/*`` scalars — min/median/max/skew of
+  step time, data wait, and checkpoint stall across hosts, with the
+  slowest host NAMED (scalar + log line).
+- **Collective-skew straggler diagnosis**: every K steps
+  (``skew_interval_steps``) the hosts run a cheap two-phase probe: a
+  rendezvous all-gather is entered at the step boundary, each host
+  measures how long IT waited for the others (waits are *durations*, so
+  no cross-host clock comparison — the heartbeat module's rule), and a
+  second all-gather of those waits yields per-host arrival lateness:
+  the straggler is the host everyone else waited for. The instantaneous
+  spread is emitted as ``Train/Fleet/step_skew_ms`` with a persistent
+  per-host EMA, and the quantitative verdict feeds
+  `PeerHealthMonitor.note_skew` — the heartbeat ``slow`` escalation and
+  the hang watchdog's LOCAL-vs-peer verdict can then cite "host 3 is
+  180ms/step behind the median for 50 consecutive steps" instead of a
+  staleness guess. Single-host (tests, the fault-injection harness) the
+  probe reads the heartbeat monitor's SIMULATED peers: a ``slow_peer``
+  fault's delay becomes that host's arrival lateness, so detection is
+  drivable on one box.
+- **Merged Perfetto export**: when a telemetry capture window closes,
+  each host ships its (bounded) span events + environment fingerprint
+  (`env_report.env_fingerprint`) + kernel dispatch report
+  (`ops.dispatch_report`) through the trace transport; rank 0 merges
+  them into ONE Chrome-trace JSON with one lane ("pid") per host —
+  process_name metadata names the lanes — loadable in Perfetto.
+
+Zero-overhead discipline: the aggregator exists only when the validated
+``telemetry.fleet`` block enables it; per-step cost is a few float
+appends, the skew probe's collective is amortized over K steps, and
+nothing here ever blocks on the KV store inside a step (publishes are
+small, reads happen only on rank 0 at window close).
+"""
+
+import json
+import os
+import statistics
+import time
+
+from ..utils.logging import log_dist, logger
+
+FLEET_SUMMARY_PREFIX = "ds_fleet/sum"
+FLEET_TRACE_PREFIX = "ds_fleet/trace"
+
+# the Train/Fleet cross-host families emitted at window close
+FLEET_WINDOW_METRICS = ("step_time_ms", "data_wait_ms", "ckpt_stall_ms")
+
+
+def _default_transports():
+    """(summary, trace) transports: coordination-service KV when a
+    multi-host client exists, process-local otherwise."""
+    import jax
+
+    from ..elasticity.heartbeat import (CoordinationTransport,
+                                        InMemoryTransport)
+    if jax.process_count() > 1:
+        from ..utils.distributed import _distributed_client
+        client = _distributed_client()
+        if client is not None:
+            return (CoordinationTransport(client,
+                                          prefix=FLEET_SUMMARY_PREFIX),
+                    CoordinationTransport(client,
+                                          prefix=FLEET_TRACE_PREFIX))
+        logger.warning(  # pragma: no cover - private-API drift
+            "fleet: no coordination client available; cross-host "
+            "aggregation degrades to process-local summaries")
+    return InMemoryTransport(), InMemoryTransport()
+
+
+class FleetAggregator:
+    """Per-host accumulator + rank-0 collector (module docstring).
+
+    ``params`` is the validated ``telemetry.fleet`` dict
+    (`DeepSpeedConfig._parse_telemetry_block`). Tests drive multiple
+    simulated hosts by constructing several aggregators with explicit
+    ``process_index`` over SHARED in-memory transports, and inject a
+    fake ``gather`` to script the skew probe."""
+
+    def __init__(self, params, process_index=None, process_count=None,
+                 summary_transport=None, trace_transport=None,
+                 gather=None, clock=time.perf_counter):
+        import jax
+        self.window_steps = int(params.get("window_steps", 50))
+        self.skew_interval = int(params.get("skew_interval_steps", 10))
+        self.ema_beta = float(params.get("skew_ema_beta", 0.9))
+        self.threshold_ms = float(params.get("skew_slow_threshold_ms",
+                                             50.0))
+        self.max_trace_events = int(params.get("max_trace_events", 2000))
+
+        self.process_index = (jax.process_index() if process_index is None
+                              else int(process_index))
+        self.process_count = (jax.process_count() if process_count is None
+                              else int(process_count))
+        self.host = str(self.process_index)
+        self.is_collector = self.process_index == 0
+        if summary_transport is None and trace_transport is None:
+            summary_transport, trace_transport = _default_transports()
+        self.summary_transport = summary_transport
+        self.trace_transport = trace_transport
+        self._gather = gather
+        self._clock = clock
+        self._peer_monitor = None
+
+        # window accumulators (reset at each close)
+        self._w_step_s = []
+        self._w_data_wait_s = 0.0
+        self._w_ckpt_stall_s = 0.0
+        self._steps = 0
+        self._last_probe_step = 0
+        self._last_window_step = 0
+        self._serial = 0
+        self._transport_errors = 0
+        self._warned_transport = False
+
+        # skew state: persistent per-host EMA of lateness-behind-median,
+        # and the consecutive-step count each host has spent past the
+        # threshold (what the escalation log cites)
+        self.skew_ema_ms = {}
+        self.behind_steps = {}
+        self.last_skew_ms = None
+        self.last_slowest = None
+        self._named = set()          # hosts already log-named this episode
+
+    # ------------------------------------------------------------------
+    # wiring
+    # ------------------------------------------------------------------
+
+    def bind_peer_monitor(self, monitor):
+        """Attach the heartbeat monitor: the skew probe feeds it
+        quantitative per-host verdicts (`note_skew`), and the simulated
+        single-host gather reads its `slow_peer` fault state."""
+        self._peer_monitor = monitor
+        return self
+
+    # ------------------------------------------------------------------
+    # per-step hook (called from Telemetry.on_step_end)
+    # ------------------------------------------------------------------
+
+    def on_step_end(self, dt_s, data_wait_s=0.0, ckpt_stall_s=0.0,
+                    steps=1):
+        """Accumulate one step window; returns the Train/Fleet scalars
+        due THIS step (skew at probe boundaries, cross-host stats at
+        window close on the collector; {} otherwise)."""
+        steps = max(int(steps), 1)
+        self._w_step_s.append(float(dt_s) / steps)
+        self._w_data_wait_s += float(data_wait_s)
+        self._w_ckpt_stall_s += float(ckpt_stall_s)
+        self._steps += steps
+        scalars = {}
+        if self.skew_interval > 0 and \
+                self._steps - self._last_probe_step >= self.skew_interval:
+            self._last_probe_step = self._steps
+            scalars.update(self.probe_skew())
+        if self.window_steps > 0 and \
+                self._steps - self._last_window_step >= self.window_steps:
+            self._last_window_step = self._steps
+            scalars.update(self._close_window())
+        return scalars
+
+    # ------------------------------------------------------------------
+    # collective-skew probe
+    # ------------------------------------------------------------------
+
+    def _gather_lateness_ms(self):
+        """{host: arrival lateness in ms} for this probe — 0 for the
+        host that reached the dispatch boundary last (everyone waited
+        for it ⇒ it waited least… inverted: lateness = how much LATER
+        than the earliest arrival). Three sources, in priority order:
+        an injected test gather, the real two-phase all-gather
+        (multi-host), or the heartbeat monitor's simulated peers."""
+        if self._gather is not None:
+            return dict(self._gather())
+        if self.process_count > 1:
+            return self._gather_real()
+        return self._gather_simulated()
+
+    def _gather_real(self):  # pragma: no cover - needs a real pod
+        import numpy as np
+
+        from jax.experimental import multihost_utils
+        # phase 1: rendezvous; each host measures how long it waited
+        # for the others. Waits are local DURATIONS — comparable across
+        # hosts without any clock synchronization.
+        t0 = self._clock()
+        multihost_utils.process_allgather(np.zeros((), np.float32))
+        wait_ms = (self._clock() - t0) * 1e3
+        # phase 2: exchange the waits; the host that waited longest
+        # arrived first, so lateness_i = max(waits) - wait_i
+        waits = np.asarray(
+            multihost_utils.process_allgather(np.float32(wait_ms)),
+            dtype=np.float64).reshape(-1)
+        lateness = waits.max() - waits
+        return {str(i): float(lateness[i]) for i in range(len(waits))}
+
+    def _gather_simulated(self):
+        """Single-host: derive lateness from the heartbeat monitor's
+        simulated peers — a `slow_peer` fault's delay IS that host's
+        per-step lateness, so the detect → name → escalate loop is
+        drivable (and testable) on one box."""
+        lateness = {self.host: 0.0}
+        monitor = self._peer_monitor
+        if monitor is not None:
+            delays = getattr(monitor, "simulated_delays", None)
+            if delays is not None:
+                for name, delay_s in delays().items():
+                    lateness[str(name)] = float(delay_s) * 1e3
+        return lateness
+
+    def probe_skew(self):
+        """One probe: gather per-host arrival lateness, update the
+        per-host EMAs and consecutive-behind counters, feed the
+        heartbeat monitor, and return the Train/Fleet skew scalars."""
+        try:
+            lateness = self._gather_lateness_ms()
+        except Exception as e:  # noqa: BLE001 - probe must not kill a step
+            self._note_transport_error("skew gather", e)
+            return {}
+        if not lateness:
+            return {}
+        values = list(lateness.values())
+        med = statistics.median(values)
+        skew = max(values) - min(values)
+        self.last_skew_ms = skew
+        slowest = max(lateness, key=lateness.get)
+        behind_now = {}
+        for host, late in lateness.items():
+            behind = late - med
+            ema = self.skew_ema_ms.get(host)
+            self.skew_ema_ms[host] = (behind if ema is None else
+                                      self.ema_beta * ema +
+                                      (1.0 - self.ema_beta) * behind)
+            if behind > self.threshold_ms:
+                self.behind_steps[host] = \
+                    self.behind_steps.get(host, 0) + self.skew_interval
+            else:
+                self.behind_steps[host] = 0
+                self._named.discard(host)
+            behind_now[host] = behind
+        self.last_slowest = slowest if skew > self.threshold_ms else None
+        if self.last_slowest and self.last_slowest not in self._named:
+            self._named.add(self.last_slowest)
+            logger.warning(
+                f"fleet skew probe: host {self.last_slowest} is "
+                f"{behind_now[self.last_slowest]:.0f}ms/step behind the "
+                f"median across {len(lateness)} host(s) "
+                f"(skew {skew:.0f}ms)")
+        monitor = self._peer_monitor
+        if monitor is not None and hasattr(monitor, "note_skew"):
+            monitor.note_skew(
+                {h: self.skew_ema_ms[h] for h in lateness},
+                dict(self.behind_steps))
+        scalars = {"Train/Fleet/step_skew_ms": skew,
+                   "Train/Fleet/step_skew_ema_ms":
+                       max(self.skew_ema_ms.values(), default=0.0)}
+        # the gauge is ALWAYS emitted (-1 = nobody past the threshold):
+        # a latest-value scrape backend would otherwise keep naming the
+        # last straggler forever after it recovered
+        if self.last_slowest is None:
+            scalars["Train/Fleet/slowest_host"] = -1.0
+        else:
+            try:
+                scalars["Train/Fleet/slowest_host"] = \
+                    float(int(self.last_slowest))
+            except (TypeError, ValueError):
+                pass   # non-numeric (simulated) host: leave unset
+        return scalars
+
+    # ------------------------------------------------------------------
+    # window summaries (cross-host scalar aggregation)
+    # ------------------------------------------------------------------
+
+    def _note_transport_error(self, what, exc):
+        self._transport_errors += 1
+        if not self._warned_transport:
+            self._warned_transport = True
+            logger.warning(
+                f"fleet: {what} failed ({type(exc).__name__}: {exc}); "
+                f"fleet scalars degrade to this host only (warned once)")
+
+    def _own_summary(self):
+        n = max(len(self._w_step_s), 1)
+        return {
+            "serial": self._serial,
+            "host": self.host,
+            "steps": len(self._w_step_s),
+            "step_time_ms": 1e3 * (sum(self._w_step_s) / n),
+            "data_wait_ms": 1e3 * self._w_data_wait_s / n,
+            "ckpt_stall_ms": 1e3 * self._w_ckpt_stall_s / n,
+        }
+
+    def _close_window(self):
+        """Publish this host's window summary; on the collector, read
+        every host's latest summary and emit the cross-host scalars."""
+        self._serial += 1
+        summary = self._own_summary()
+        self._w_step_s = []
+        self._w_data_wait_s = 0.0
+        self._w_ckpt_stall_s = 0.0
+        try:
+            self.summary_transport.publish(self.host, summary)
+        except Exception as e:  # noqa: BLE001
+            self._note_transport_error("summary publish", e)
+        if not self.is_collector:
+            return {}
+        try:
+            summaries = self.summary_transport.read_all()
+        except Exception as e:  # noqa: BLE001
+            self._note_transport_error("summary collect", e)
+            summaries = {}
+        summaries[self.host] = summary     # own window is always current
+        return self._fleet_scalars(summaries)
+
+    def _fleet_scalars(self, summaries):
+        hosts = sorted(summaries)
+        scalars = {"Train/Fleet/hosts": float(len(hosts))}
+        for metric in FLEET_WINDOW_METRICS:
+            values = [float(summaries[h].get(metric, 0.0)) for h in hosts]
+            scalars[f"Train/Fleet/{metric}_min"] = min(values)
+            scalars[f"Train/Fleet/{metric}_median"] = \
+                statistics.median(values)
+            scalars[f"Train/Fleet/{metric}_max"] = max(values)
+            scalars[f"Train/Fleet/{metric}_skew"] = \
+                max(values) - min(values)
+        step_times = {h: float(summaries[h].get("step_time_ms", 0.0))
+                      for h in hosts}
+        slowest = max(step_times, key=step_times.get)
+        try:
+            scalars["Train/Fleet/slowest_host_step_time"] = \
+                float(int(slowest))
+        except (TypeError, ValueError):
+            pass
+        if len(hosts) > 1 and \
+                scalars["Train/Fleet/step_time_ms_skew"] > 0:
+            log_dist(
+                f"fleet window: {len(hosts)} hosts, step time "
+                f"median {scalars['Train/Fleet/step_time_ms_median']:.1f}"
+                f"ms skew {scalars['Train/Fleet/step_time_ms_skew']:.1f}"
+                f"ms — slowest host {slowest} "
+                f"({step_times[slowest]:.1f}ms)", ranks=[0])
+        return scalars
+
+    # ------------------------------------------------------------------
+    # merged Perfetto trace (capture-window close)
+    # ------------------------------------------------------------------
+
+    def ship_capture(self, tag, events):
+        """Publish this host's capture-window span events (BOUNDED —
+        the coordination KV store is not a trace sink; past
+        ``max_trace_events`` the tail is dropped and counted) plus the
+        environment fingerprint and kernel dispatch report."""
+        dropped = max(len(events) - self.max_trace_events, 0)
+        events = list(events)[:self.max_trace_events]
+        base = min((t0 for _, t0, _, _ in events), default=0.0)
+        payload = {
+            "serial": self._serial,
+            "tag": str(tag),
+            # host-relative microsecond timestamps: perf_counter origins
+            # differ per host, so lanes align at their own window start
+            "events": [[name, (t0 - base) * 1e6, dur * 1e6, depth]
+                       for name, t0, dur, depth in events],
+            "dropped": dropped,
+            "env": _safe_env_fingerprint(),
+            "dispatch": _safe_dispatch_report(),
+        }
+        try:
+            self.trace_transport.publish(self.host, payload)
+        except Exception as e:  # noqa: BLE001
+            self._note_transport_error("trace publish", e)
+
+    # how long the collector waits for peers' capture payloads before
+    # merging what arrived: peers close the same scheduled window a few
+    # ms apart, so rank 0 must not read-and-merge instantly (it would
+    # silently drop every lane but its own on a real pod)
+    merge_timeout_s = 5.0
+
+    def merged_trace(self, tag, trace_dir, timeout_s=None):
+        """Rank-0 collector: merge every host's shipped capture for
+        ``tag`` into one Chrome-trace JSON — one lane (pid) per host,
+        process_name metadata naming the lanes, env + dispatch reports
+        embedded as trace metadata. Waits (bounded by
+        ``merge_timeout_s``) until all ``process_count`` hosts have
+        shipped the tag; an incomplete merge warns with the lane count.
+        Returns the path (None off-rank-0 or when nothing was
+        shipped)."""
+        if not self.is_collector:
+            return None
+        timeout_s = self.merge_timeout_s if timeout_s is None \
+            else float(timeout_s)
+        deadline = self._clock() + timeout_s
+        shipped = {}
+        while True:
+            try:
+                current = self.trace_transport.read_all()
+            except Exception as e:  # noqa: BLE001
+                self._note_transport_error("trace collect", e)
+                return None
+            shipped = {h: p for h, p in current.items()
+                       if p.get("tag") == str(tag)}
+            if len(shipped) >= self.process_count or \
+                    self._clock() >= deadline:
+                break
+            time.sleep(0.05)
+        if not shipped:
+            return None
+        if len(shipped) < self.process_count:
+            logger.warning(
+                f"fleet: merged capture '{tag}' has only "
+                f"{len(shipped)}/{self.process_count} host lane(s) — "
+                f"peers had not published within {timeout_s:.1f}s")
+        trace_events, hosts_meta = [], {}
+        for host in sorted(shipped):
+            payload = shipped[host]
+            try:
+                pid = int(host)
+            except (TypeError, ValueError):
+                pid = len(hosts_meta) + 1000
+            trace_events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"host{host}"}})
+            for name, ts_us, dur_us, depth in payload.get("events", []):
+                trace_events.append({
+                    "name": name, "ph": "X", "pid": pid, "tid": depth,
+                    "ts": ts_us, "dur": dur_us,
+                    "cat": "deeperspeed_tpu"})
+            hosts_meta[str(host)] = {
+                "env": payload.get("env"),
+                "dispatch": payload.get("dispatch"),
+                "dropped_events": payload.get("dropped", 0)}
+        trace = {"traceEvents": trace_events, "displayTimeUnit": "ms",
+                 "otherData": {"hosts": hosts_meta}}
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(trace_dir, f"fleet_spans_{tag}.json")
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        log_dist(f"fleet: merged capture '{tag}' — {len(hosts_meta)} "
+                 f"host lane(s) -> {path}", ranks=[0])
+        return path
+
+
+def _safe_env_fingerprint():
+    try:
+        from ..env_report import env_fingerprint
+        return env_fingerprint()
+    except Exception:  # noqa: BLE001 - metadata must not break a capture
+        return None
+
+
+def _safe_dispatch_report():
+    try:
+        from ..ops import dispatch_report
+        return dispatch_report()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def build_fleet(params, **kwargs):
+    """FleetAggregator (or None) from the validated ``telemetry.fleet``
+    params dict."""
+    if not params or not params.get("enabled"):
+        return None
+    return FleetAggregator(params, **kwargs)

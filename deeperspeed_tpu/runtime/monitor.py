@@ -11,7 +11,15 @@ training never blocks on the writer.
 
 Backends: tensorboardX when importable (real event files, same as the
 reference), else a TSV file with the same tag/value/sample rows — the data
-is never silently dropped.
+is never silently dropped. The TSV file is size-rotated
+(``monitor.export.rotate_max_mb`` / ``rotate_keep``): a long-lived serving
+process can no longer grow ``events.tsv`` without bound.
+
+Export backends (`runtime/exporters.py`, the ``monitor.export`` config
+block): a Prometheus text-format HTTP endpoint and a structured-JSONL
+stream. Both are fed inside the SAME buffered drain as the primary writer
+— each pending scalar is converted to a host float exactly once and handed
+to every sink; no backend keeps a second copy of the scalar queue.
 """
 
 import atexit
@@ -22,6 +30,7 @@ import numpy as np
 import jax
 
 from ..utils.logging import log_dist, logger
+from .exporters import RotatingFile, build_export_backends
 from .utils import register_weak_atexit
 
 try:
@@ -33,16 +42,16 @@ except Exception:  # pragma: no cover
 
 
 class _TSVWriter:
-    """Fallback event writer: one `events.tsv` of (tag, sample, value)."""
+    """Fallback event writer: size-rotated `events.tsv` of
+    (tag, sample, value) rows."""
 
-    def __init__(self, log_dir):
-        os.makedirs(log_dir, exist_ok=True)
-        self._f = open(os.path.join(log_dir, "events.tsv"), "a")
-        if self._f.tell() == 0:  # header only for a fresh file
-            self._f.write("tag\tsample\tvalue\n")
+    def __init__(self, log_dir, max_bytes=0, keep=5):
+        self._file = RotatingFile(os.path.join(log_dir, "events.tsv"),
+                                  max_bytes=max_bytes, keep=keep,
+                                  header="tag\tsample\tvalue\n")
 
     def add_scalar(self, tag, value, global_step):
-        self._f.write(f"{tag}\t{global_step}\t{value}\n")
+        self._file.write(f"{tag}\t{global_step}\t{value}\n")
 
     def flush(self, fsync=False):
         # flush on the TB path's cadence (buffered rows alone would
@@ -51,16 +60,10 @@ class _TSVWriter:
         # and close — on a networked filesystem a per-interval fsync
         # would stall the training loop for a durability guarantee the
         # TB backend never provides
-        self._f.flush()
-        if fsync:
-            try:
-                os.fsync(self._f.fileno())
-            except OSError:  # pragma: no cover - exotic filesystems
-                pass
+        self._file.flush(fsync=fsync)
 
     def close(self):
-        self.flush(fsync=True)
-        self._f.close()
+        self._file.close()
 
 
 class TensorBoardMonitor:
@@ -68,28 +71,45 @@ class TensorBoardMonitor:
     keyed by global sample count (reference `engine.py:1222-1275`)."""
 
     def __init__(self, output_path="", job_name="DeepSpeedJobName",
-                 flush_interval=10, rank=None):
+                 flush_interval=10, rank=None, export=None):
         rank = jax.process_index() if rank is None else rank
         self.enabled = rank == 0
         self._pending = []          # (sample_count, {tag: device-or-float})
         self.flush_interval = max(1, int(flush_interval))
         self.writer = None
         self._warned_closed = False
+        self._export_backends = []
         if not self.enabled:
             return
+        export = export or {}
         log_dir = os.path.join(output_path or os.getcwd(), job_name)
+        rotate_bytes = int(float(export.get("rotate_max_mb", 0))
+                           * 1024 * 1024)
         if _HAVE_TB:
             self.writer = _TBWriter(log_dir=log_dir)
         else:  # pragma: no cover
-            self.writer = _TSVWriter(log_dir)
+            self.writer = _TSVWriter(log_dir, max_bytes=rotate_bytes,
+                                     keep=export.get("rotate_keep", 5))
             logger.warning("tensorboardX unavailable; writing TSV events "
                            f"to {log_dir}/events.tsv")
+        self._export_backends = build_export_backends(export, log_dir)
         # drain buffered scalars on interpreter shutdown: up to
         # `flush_interval - 1` steps of events sit in `_pending` at any
         # time and would be silently lost on an unclosed exit (weakly
         # held — discarded monitors stay collectible)
         self._atexit = register_weak_atexit(self, "close")
         log_dist(f"Monitor: writing events to {log_dir}", ranks=[0])
+
+    @property
+    def prometheus(self):
+        """The PrometheusBackend when ``monitor.export.prometheus_port``
+        armed one (tests + the serving engine read its port), else
+        None."""
+        from .exporters import PrometheusBackend
+        for backend in self._export_backends:
+            if isinstance(backend, PrometheusBackend):
+                return backend
+        return None
 
     def record(self, sample_count, scalars):
         """Queue `{tag: value}` at `sample_count`; values may be device
@@ -112,23 +132,47 @@ class TensorBoardMonitor:
             # drain it — draining blocks the training loop on telemetry
             self.flush(drain=False)
 
+    def observe_histogram(self, tag, value, edges=None):
+        """Feed one histogram observation (serving latencies:
+        admission wait / TTFT / inter-token) to every export backend
+        that keeps distributions. Host floats, no buffering — the
+        values arrive already materialized from the serving loop."""
+        if not self.enabled or self.writer is None:
+            return
+        for backend in self._export_backends:
+            hook = getattr(backend, "observe_histogram", None)
+            if hook is not None:
+                if edges is not None:
+                    hook(tag, float(value), edges=edges)
+                else:
+                    hook(tag, float(value))
+
     def flush(self, drain=True):
         """Write pending scalars. `drain=True` (explicit/user flush) also
         waits for the writer thread so events are durable for readers;
         the periodic auto-flush passes drain=False to stay non-blocking."""
-        if not self.enabled or not self._pending:
+        if not self.enabled or self.writer is None:
             return
-        for sample_count, scalars in self._pending:
-            for tag, value in scalars.items():
-                self.writer.add_scalar(tag, float(np.asarray(value)),
-                                       sample_count)
-        self._pending.clear()
-        if drain:
-            self._drain_writer_queue()
-        if isinstance(self.writer, _TSVWriter):
-            self.writer.flush(fsync=drain)
-        else:
-            self.writer.flush()
+        if self._pending:
+            for sample_count, scalars in self._pending:
+                for tag, value in scalars.items():
+                    # ONE host conversion per scalar, shared by every
+                    # sink
+                    v = float(np.asarray(value))
+                    self.writer.add_scalar(tag, v, sample_count)
+                    for backend in self._export_backends:
+                        backend.observe_scalar(tag, v, sample_count)
+            self._pending.clear()
+            if drain:
+                self._drain_writer_queue()
+            if isinstance(self.writer, _TSVWriter):
+                self.writer.flush(fsync=drain)
+            else:
+                self.writer.flush()
+        # backends flush even with no pending scalars: the JSONL sink
+        # buffers histogram observations independently of the queue
+        for backend in self._export_backends:
+            backend.flush()
 
     def _drain_writer_queue(self):
         """tensorboardX queues events to a worker thread and its flush()
@@ -182,6 +226,13 @@ class TensorBoardMonitor:
             self.flush()
             self.writer.close()
             self.writer = None
+            for backend in self._export_backends:
+                try:
+                    backend.close()
+                except Exception as e:  # noqa: BLE001 - best-effort
+                    logger.warning(f"monitor: export backend close "
+                                   f"failed: {e}")
+            self._export_backends = []
             try:
                 atexit.unregister(self._atexit)
             except Exception:  # pragma: no cover

@@ -371,13 +371,23 @@ class TrainingHealthSentinel:
             stale = [name for name, st in
                      peer_monitor.peer_status().items()
                      if st["status"] != "ok"]
+            # cite the fleet skew probe's quantitative per-host verdict
+            # when one exists (runtime/fleet.py note_skew): the
+            # LOCAL-vs-peer call is then backed by measured ms/step
+            skew_fn = getattr(peer_monitor, "skew_context", None)
+            cites = []
+            if skew_fn is not None:
+                cites = [c for c in (skew_fn(n) for n in sorted(stale))
+                         if c]
             if stale:
                 logger.error(
                     f"hang watchdog: peer(s) {sorted(stale)} have stale "
                     f"heartbeats — this step is most likely blocked on a "
                     f"DEAD/SLOW PEER inside a collective, not hung "
                     f"locally (peer-failure escalation will fire at "
-                    f"fail_after_s)")
+                    f"fail_after_s)"
+                    + (f" [fleet skew probe: {'; '.join(cites)}]"
+                       if cites else ""))
             else:
                 logger.error(
                     "hang watchdog: all peer heartbeats are fresh — "

@@ -200,6 +200,15 @@ class SpanTracer:
         if self.capturing:
             self._buffer.append((name, t0, dur, depth))
 
+    def record_event(self, name, t0, dur, depth=0):
+        """Append one pre-timed event to an open capture window (the
+        serving engine's per-request lifecycle records ride this — they
+        are not live spans, the request's wall time was measured by the
+        scheduler). No-op outside a window."""
+        if self.capturing:
+            self._buffer.append((str(name), float(t0), float(dur),
+                                 int(depth)))
+
     def drain_phases(self):
         phases, self._phase_acc = self._phase_acc, {}
         return phases
@@ -214,21 +223,27 @@ class SpanTracer:
         return events
 
     @staticmethod
-    def chrome_trace(events, pid=0):
-        """Chrome-trace dict for a list of (name, t0, dur, depth)."""
+    def chrome_trace(events, pid=0, metadata=None):
+        """Chrome-trace dict for a list of (name, t0, dur, depth);
+        `metadata` (kernel dispatch report, env fingerprint) lands in
+        the trace's ``otherData``."""
         trace_events = [
             {"name": name, "ph": "X", "pid": pid, "tid": depth,
              "ts": t0 * 1e6, "dur": dur * 1e6,
              "cat": "deeperspeed_tpu"}
             for name, t0, dur, depth in events]
-        return {"traceEvents": trace_events,
-                "displayTimeUnit": "ms"}
+        trace = {"traceEvents": trace_events,
+                 "displayTimeUnit": "ms"}
+        if metadata:
+            trace["otherData"] = metadata
+        return trace
 
     @classmethod
-    def export_chrome_trace(cls, events, path, pid=0):
+    def export_chrome_trace(cls, events, path, pid=0, metadata=None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
-            json.dump(cls.chrome_trace(events, pid=pid), f)
+            json.dump(cls.chrome_trace(events, pid=pid,
+                                       metadata=metadata), f)
         return path
 
 
@@ -288,6 +303,7 @@ class _NullTelemetry:
     enabled = False
     wants_flops = False
     spans_enabled = False
+    fleet = None
 
     def span(self, name):  # noqa: ARG002
         return _NULL_SPAN
@@ -327,7 +343,8 @@ class Telemetry:
     def __init__(self, monitor=None, devices=None, goodput=True, mfu=True,
                  spans=True, trace_dir=None, capture=None,
                  memory_watermark_interval_steps=0,
-                 capture_on_anomaly=False, anomaly_capture_steps=1):
+                 capture_on_anomaly=False, anomaly_capture_steps=1,
+                 fleet=None):
         self.monitor = monitor
         self.devices = list(devices or [])
         self.goodput_enabled = bool(goodput)
@@ -346,6 +363,13 @@ class Telemetry:
         self.tracer = SpanTracer(mirror_annotations=self.spans_enabled)
         self.goodput = GoodputMeter()
         self.compiled_flops = {}    # step-variant key -> per-device flops
+
+        # fleet observability (runtime/fleet.py; the telemetry.fleet
+        # sub-block): cross-host scalar aggregation, merged Perfetto
+        # capture, collective-skew straggler probe. None when absent —
+        # the per-host path is unchanged.
+        from .fleet import build_fleet
+        self.fleet = build_fleet(fleet)
 
         self._step_t0 = None
         self._steps_seen = 0
@@ -394,7 +418,8 @@ class Telemetry:
         # spans: false DOES turn off: the jax.profiler annotation
         # mirroring (tracer.mirror_annotations) and span capture/export
         # (_open_window skips start_capture).
-        if not (self.spans_enabled or self.goodput_enabled):
+        if not (self.spans_enabled or self.goodput_enabled
+                or self.fleet is not None):
             return _NULL_SPAN
         return self.tracer.span(name)
 
@@ -466,17 +491,26 @@ class Telemetry:
         phases = self.tracer.drain_phases()
 
         scalars = {}
-        if self.goodput_enabled:
+        data_wait = phases.get("data_fetch", 0.0)
+        ckpt_delta = 0.0
+        if self.goodput_enabled or self.fleet is not None:
+            # checkpoint stall is shared by the goodput meter and the
+            # fleet window summaries: read it once per step
             manager = getattr(engine, "checkpoint_manager", None)
             stall = getattr(manager, "total_stall_s", 0.0)
             if self._last_ckpt_stall is None:
                 self._last_ckpt_stall = stall
             ckpt_delta = max(stall - self._last_ckpt_stall, 0.0)
             self._last_ckpt_stall = stall
+        if self.goodput_enabled:
             self.goodput.account(dt, verdict,
-                                 data_wait=phases.get("data_fetch", 0.0),
+                                 data_wait=data_wait,
                                  ckpt_stall=ckpt_delta)
             scalars.update(self.goodput.scalars())
+        if self.fleet is not None:
+            scalars.update(self.fleet.on_step_end(
+                dt, data_wait_s=data_wait, ckpt_stall_s=ckpt_delta,
+                steps=steps))
 
         if self.mfu_enabled and flops and dt > 0:
             achieved = flops / dt          # per-device FLOPS/s
@@ -589,10 +623,25 @@ class Telemetry:
             except Exception:  # noqa: BLE001
                 pid = 0
             path = os.path.join(self.trace_dir, f"spans_{tag}.json")
+            # the capture artifact carries the kernel dispatch report:
+            # WHICH flash/decode geometry produced these timings is as
+            # load-bearing as the timings themselves
+            from .fleet import _safe_dispatch_report
             self.exported_traces.append(
-                SpanTracer.export_chrome_trace(events, path, pid=pid))
+                SpanTracer.export_chrome_trace(
+                    events, path, pid=pid,
+                    metadata={"host": pid,
+                              "dispatch": _safe_dispatch_report()}))
             log_dist(f"telemetry: capture window '{tag}' closed — "
                      f"{len(events)} host spans -> {path}", ranks=[0])
+        if self.fleet is not None and self.trace_dir:
+            # cross-host merge: every host ships its (bounded) events;
+            # rank 0 collects one lane per host into a single Perfetto
+            # trace next to the per-host exports
+            self.fleet.ship_capture(tag, events)
+            merged = self.fleet.merged_trace(tag, self.trace_dir)
+            if merged:
+                self.exported_traces.append(merged)
 
     # ------------------------------------------------------------------
     # memory

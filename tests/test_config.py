@@ -366,7 +366,7 @@ def test_moe_block_defaults_and_knobs():
         "num_experts": 8, "top_k": 1, "capacity_factor": 1.25,
         "jitter_eps": 0.0, "aux_loss_coef": 0.01, "num_groups": 1,
         "dispatch": "einsum", "a2a_overlap_chunks": 1,
-        "renorm_kept_choices": False}
+        "renorm_kept_choices": False, "observability": False}
     cfg = make_config({"train_batch_size": 1,
                        "moe": {"num_experts": 16, "top_k": 2,
                                "capacity_factor": 2.0,

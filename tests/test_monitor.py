@@ -173,3 +173,93 @@ def test_train_steps_window_logs_losses(tmp_path, devices):
     scalars = _read_scalars(os.path.join(str(tmp_path), "unit"))
     assert [s for s, _ in scalars["Train/Samples/train_loss"]] == \
         [16, 32, 48]
+
+
+# ---------------------------------------------------------------------------
+# backend coverage (PR 10): TSV fallback + rotation, record_health keying,
+# post-close drop-with-one-warning
+# ---------------------------------------------------------------------------
+
+def _tsv_monitor(tmp_path, monkeypatch, job="tsv", export=None):
+    """Force the TSV fallback even when tensorboardX is importable."""
+    from deeperspeed_tpu.runtime import monitor as monitor_mod
+    monkeypatch.setattr(monitor_mod, "_HAVE_TB", False)
+    return TensorBoardMonitor(output_path=str(tmp_path), job_name=job,
+                              flush_interval=100, export=export)
+
+
+def test_tsv_fallback_when_tensorboard_absent(tmp_path, monkeypatch):
+    """With tensorboardX unimportable the monitor degrades to the TSV
+    writer — same (tag, sample, value) rows, nothing silently dropped."""
+    from deeperspeed_tpu.runtime.monitor import _TSVWriter
+    mon = _tsv_monitor(tmp_path, monkeypatch)
+    assert isinstance(mon.writer, _TSVWriter)
+    mon.record(16, {"Train/Samples/train_loss": 1.5})
+    mon.flush()
+    mon.close()
+    scalars = _read_scalars(os.path.join(str(tmp_path), "tsv"))
+    assert scalars["Train/Samples/train_loss"] == [(16, 1.5)]
+
+
+def test_tsv_rotation_bounds_event_file(tmp_path, monkeypatch):
+    """Long-lived serving: events.tsv rotates at rotate_max_mb and only
+    the last rotate_keep generations survive."""
+    mon = _tsv_monitor(tmp_path, monkeypatch, job="rot",
+                       export={"rotate_max_mb": 0.0005,  # ~500 bytes
+                               "rotate_keep": 2})
+    for i in range(200):
+        mon.record(i, {"Serve/queue_depth": float(i)})
+        mon.flush()
+    mon.close()
+    log_dir = os.path.join(str(tmp_path), "rot")
+    tsv = os.path.join(log_dir, "events.tsv")
+    assert os.path.isfile(tsv)
+    assert os.path.getsize(tsv) < 2048
+    assert os.path.isfile(tsv + ".1")
+    assert os.path.isfile(tsv + ".2")
+    assert not os.path.exists(tsv + ".3")   # keep=2 bounds the set
+    # every generation re-opens with the header row
+    with open(tsv + ".1") as f:
+        assert f.readline() == "tag\tsample\tvalue\n"
+
+
+def test_record_health_sample_count_keying(tmp_path, devices):
+    """Sentinel counters land under Train/Sentinel/* keyed by the SAME
+    sample count as the loss series (PR 4 contract)."""
+    mon = TensorBoardMonitor(output_path=str(tmp_path), job_name="hl",
+                             flush_interval=100)
+    mon.record_health(48, {"anomalies": 2, "rollbacks": 1})
+    mon.record_health(64, {"anomalies": 3, "rollbacks": 1})
+    mon.flush()
+    scalars = _read_scalars(os.path.join(str(tmp_path), "hl"))
+    assert scalars["Train/Sentinel/anomalies"] == [(48, 2.0), (64, 3.0)]
+    assert scalars["Train/Sentinel/rollbacks"] == [(48, 1.0), (64, 1.0)]
+    mon.close()
+
+
+def test_record_after_close_drops_with_one_warning(tmp_path, devices):
+    """Post-close records drop loudly: exactly one warning, no queueing
+    (the old behavior queued forever then crashed the next flush)."""
+    from deeperspeed_tpu.utils.logging import logger as ds_logger
+    mon = TensorBoardMonitor(output_path=str(tmp_path), job_name="pc",
+                             flush_interval=100)
+    mon.close()
+    records = []
+
+    class _Capture:
+        level = 0
+
+        def handle(self, record):
+            records.append(record)
+
+    handler = _Capture()
+    ds_logger.addHandler(handler)
+    try:
+        mon.record(8, {"Train/Samples/train_loss": 1.0})
+        mon.record(16, {"Train/Samples/train_loss": 2.0})
+    finally:
+        ds_logger.removeHandler(handler)
+    assert not mon._pending            # dropped, not queued
+    warns = [r for r in records if "after close" in r.getMessage()]
+    assert len(warns) == 1             # warned once, not per record
+    mon.flush()                        # no crash on a closed monitor
