@@ -42,6 +42,16 @@ KEYS (default all):
              elasticity supervisor — kill -> resumed-step wall clock
              (MTTR) and steps lost vs the committed checkpoint; opt-in
              via DS_BENCH_ELASTIC=1)
+  - pipe     (config-driven 1F1B pipeline rows: NeoX-125M over 2/4
+             stages x remaining-chips ZeRO-1 data parallel, classic and
+             comm-overlap wire schedules, analytic bubble fraction +
+             zero-recompile check; opt-in via DS_BENCH_PIPE=1)
+
+The zero3 row additionally measures `zero3_explicit` — the explicit
+shard_map collective schedule (layer-ahead bucketed all-gather prefetch,
+reduce-scatter at layer-backward boundaries) vs the GSPMD path, with
+prefetch depth / bucket MB / group size in extra
+(DS_BENCH_ZERO3_PREFETCH / _BUCKET_MB / _GROUP).
 """
 
 import gc
@@ -58,7 +68,7 @@ import numpy as np
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
-               "moe": 800, "serve": 800,
+               "moe": 800, "serve": 800, "zero3": 800, "pipe": 900,
                "elastic": 600, "fleet": 600}  # moe/longseq walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
@@ -165,29 +175,138 @@ def _flops_per_token(cfg, seq):
 
 
 def row_zero3():
+    """ZeRO-3 row: the GSPMD path (XLA schedules the param gathers) AND
+    the explicit shard_map schedule (zero_optimization.schedule.mode
+    "explicit": bucketed all-gathers prefetched DS_BENCH_ZERO3_PREFETCH
+    layers ahead, reduce-scatters at layer-backward boundaries) — the
+    head-to-head that closes the BENCH_r05 zero3-vs-ddp gap. Prefetch
+    depth / bucket MB / remat-group size ride in extra."""
     jax = _setup_jax()
     n_chips = len(jax.devices())
     peak = peak_flops_per_chip(jax.devices()[0])
     cfg, model, params = _headline_setup(jax)
-    seq = 1024
+    seq = min(int(os.environ.get("DS_BENCH_SEQ", "1024")),
+              cfg.max_seq_len)
+    prefetch = int(os.environ.get("DS_BENCH_ZERO3_PREFETCH", "2"))
+    bucket_mb = float(os.environ.get("DS_BENCH_ZERO3_BUCKET_MB", "32"))
+    group = int(os.environ.get("DS_BENCH_ZERO3_GROUP", "4"))
+    # remat off by default: the ddp/gspmd rows this one races do not
+    # remat either — apples to apples (the 125M fits with the gathered
+    # buffers resident; flip on for memory-bound shapes)
+    remat = os.environ.get("DS_BENCH_ZERO3_REMAT", "0") not in (
+        "0", "", "false")
+    bs_ladder = [int(b) for b in os.environ.get(
+        "DS_BENCH_ZERO3_BS", "48,32").split(",")]
 
-    def run(bs):
+    def run(bs, explicit):
         def thunk():
             batch = bs * n_chips
             rng = np.random.default_rng(0)
             tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
                                   dtype=np.int32)
-            eng = _neox_engine(model, params, batch, {"stage": 3})
+            zero_cfg = {"stage": 3}
+            tag = "zero3"
+            if explicit:
+                tag = "zero3_explicit"
+                zero_cfg["schedule"] = {
+                    "mode": "explicit", "prefetch_depth": prefetch,
+                    "bucket_mb": bucket_mb, "group_layers": group,
+                    "remat": remat}
+            eng = _neox_engine(model, params, batch, zero_cfg)
             steps = 12
             dt, _ = timed_steps(eng, (tokens, tokens), steps=steps,
                                 warmup=4)
             tps = batch * seq * steps / dt / n_chips
-            return {"zero3_tokens_per_sec_chip": round(tps, 1),
-                    "zero3_mfu": round(
-                        tps * _flops_per_token(cfg, seq) / peak, 4)}
+            out = {f"{tag}_tokens_per_sec_chip": round(tps, 1),
+                   f"{tag}_mfu": round(
+                       tps * _flops_per_token(cfg, seq) / peak, 4)}
+            if explicit:
+                out["zero3_explicit_prefetch_depth"] = prefetch
+                out["zero3_explicit_bucket_mb"] = bucket_mb
+                out["zero3_explicit_group_layers"] = group
+                out["zero3_explicit_remat"] = remat
+            return out
         return thunk
 
-    return _ladder([("bs48", run(48)), ("bs32", run(32))], {}, "zero3")
+    out = _ladder([(f"bs{b}", run(b, False)) for b in bs_ladder],
+                  {}, "zero3")
+    gc.collect()
+    return _ladder([(f"bs{b}", run(b, True)) for b in bs_ladder],
+                   out, "zero3_explicit")
+
+
+def row_pipe():
+    """Config-driven 1F1B pipeline rows (opt-in via DS_BENCH_PIPE=1):
+    NeoX-125M over 2/4 pipeline stages (DS_BENCH_PIPE_STAGES), the
+    remaining chips data-parallel with ZeRO-1, micro_batches =
+    DS_BENCH_PIPE_MICRO. Reports tokens/s/chip (all chips, stages
+    included), the analytic bubble fraction for the schedule, and a
+    zero-recompile check across the measured steps. DS_BENCH_PIPE_OVERLAP
+    = 1 also measures the comm_overlap (wire-latency-2) schedule."""
+    jax = _setup_jax()
+    from deeperspeed_tpu.parallel.schedule import bubble_fraction
+    n_chips = len(jax.devices())
+    peak = peak_flops_per_chip(jax.devices()[0])
+    cfg, model, params = _headline_setup(jax)
+    seq = min(int(os.environ.get("DS_BENCH_SEQ", "1024")),
+              cfg.max_seq_len)
+    n_micro = int(os.environ.get("DS_BENCH_PIPE_MICRO", "8"))
+    both_wires = os.environ.get("DS_BENCH_PIPE_OVERLAP", "1") not in (
+        "0", "", "false")
+    bs0 = int(os.environ.get("DS_BENCH_PIPE_BS", "48"))
+    stages_sel = [int(s) for s in os.environ.get(
+        "DS_BENCH_PIPE_STAGES", "2,4").split(",")]
+
+    out = {}
+    for stages in stages_sel:
+        name = f"pipe{stages}"
+        if n_chips % stages or cfg.num_layers % stages:
+            out[f"{name}_error"] = (
+                f"stages={stages} does not divide chips={n_chips} / "
+                f"layers={cfg.num_layers}")
+            continue
+        dp = n_chips // stages
+        for overlap in ([False, True] if both_wires else [False]):
+            tag = f"{name}_overlap" if overlap else name
+
+            def run(bs, stages=stages, overlap=overlap, dp=dp, tag=tag):
+                def thunk():
+                    bs_rank = max(n_micro, bs - bs % n_micro)
+                    batch = bs_rank * dp
+                    rng = np.random.default_rng(0)
+                    tokens = rng.integers(0, cfg.vocab_size,
+                                          size=(1, batch, seq),
+                                          dtype=np.int32)
+                    eng = _neox_engine(
+                        model, params, batch, {"stage": 1},
+                        {"pipeline": {"stages": stages,
+                                      "micro_batches": n_micro,
+                                      "comm_overlap": overlap}})
+                    steps = 10
+                    dt, _ = timed_steps(eng, (tokens, tokens),
+                                        steps=steps, warmup=3)
+                    compiled_before = len(eng._compiled_train)
+                    eng.train_batch(batch=(tokens, tokens))
+                    recompiles = len(eng._compiled_train) - \
+                        compiled_before
+                    tps = batch * seq * steps / dt / n_chips
+                    w = 2 if overlap else 1
+                    return {
+                        f"{tag}_tokens_per_sec_chip": round(tps, 1),
+                        f"{tag}_mfu": round(
+                            tps * _flops_per_token(cfg, seq) / peak, 4),
+                        f"{tag}_bubble_fraction": round(
+                            bubble_fraction(stages, n_micro, w), 4),
+                        f"{tag}_n_micro": n_micro,
+                        f"{tag}_recompiles": recompiles,
+                    }
+                return thunk
+
+            _ladder([("bs%d" % bs0, run(bs0)),
+                     ("bs%d" % max(bs0 // 2, n_micro),
+                      run(max(bs0 // 2, n_micro)))], out, tag)
+            gc.collect()
+    return out
 
 
 def _bert_row(seq_len, bs_ladder):
@@ -1231,7 +1350,8 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
            "sentinel": row_sentinel, "telemetry": row_telemetry,
            "packed": row_packed, "serve": row_serve,
-           "elastic": row_elastic, "fleet": row_fleet}
+           "elastic": row_elastic, "fleet": row_fleet,
+           "pipe": row_pipe}
 
 
 # ---------------------------------------------------------------------------
@@ -1257,6 +1377,8 @@ def rows_enabled():
         order.append("elastic")
     if os.environ.get("DS_BENCH_FLEET", "0") not in ("0", "", "false"):
         order.append("fleet")
+    if os.environ.get("DS_BENCH_PIPE", "0") not in ("0", "", "false"):
+        order.append("pipe")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -1265,7 +1387,7 @@ def rows_enabled():
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
-                   "elastic", "fleet"):
+                   "elastic", "fleet", "pipe"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

@@ -87,6 +87,30 @@ def _sharded_dim(spec):
 # phase 1: snapshot (device → host; the only training stall)
 # ---------------------------------------------------------------------------
 
+def _pipeline_manifest_info(engine):
+    """Stage-partition record for pipelined engines (None otherwise):
+    stages, per-stage layer ownership, and the wire schedule — enough
+    for tooling to map the pipe-sharded optimizer state back to layers
+    without the engine."""
+    ps = getattr(engine, "pipeline_schedule", None)
+    if not ps:
+        return None
+    info = {"stages": int(ps["stages"]),
+            "n_micro": int(ps["n_micro"]),
+            "wire_latency": int(ps["wire_latency"]),
+            # "rows" (PipelineModule packed rows — natural tree on disk,
+            # restores across any stage count) vs "stacked" (config-
+            # driven GPTNeoX — the stacked tree IS the disk layout)
+            "layout": ps.get("layout", "rows")}
+    if ps.get("layers_per_stage"):
+        info["layers_per_stage"] = int(ps["layers_per_stage"])
+    if ps.get("parts"):
+        # heterogeneous PipelineModule: stage s owns layers
+        # [parts[s], parts[s+1]) of the LayerSpec list
+        info["parts"] = [int(p) for p in ps["parts"]]
+    return info
+
+
 def snapshot_checkpoint(engine, client_state=None):
     """Build the full ``{relative_path: payload}`` dict for a checkpoint
     of the engine's CURRENT state, with every array materialized on the
@@ -130,6 +154,11 @@ def snapshot_checkpoint(engine, client_state=None):
         "micro_steps": engine.micro_steps,
         "dp_world_size": engine.dp_world_size,
         "mp_world_size": engine.mp_world_size,
+        # stage-local optimizer state: when a pipeline schedule is
+        # active the fp32 masters/moments are sharded over the `pipe`
+        # axis, so the manifest records which layer span each stage
+        # owns (and loads validate the stage count explicitly)
+        "pipeline": _pipeline_manifest_info(engine),
         "loss_scale_state": {
             "cur_scale": float(state.scale.cur_scale),
             "cur_iter": int(state.scale.cur_iter),
@@ -736,14 +765,52 @@ def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
     # axis changes are REJECTED loudly: model-parallel layouts differ
     # structurally (packed rows, per-shard fusion), and a silent re-place
     # would corrupt the weights.
-    saved_mp = model_state.get("mp_world_size")
-    if saved_mp is not None and int(saved_mp) != int(engine.mp_world_size):
+    # Pipeline-stage topology: checkpoints store the NATURAL layout, so
+    # a PIPE-axis change re-partitions cleanly (packed rows repack, the
+    # stacked blocks re-place) — it is absorbed like a dp change, not
+    # rejected like a model-axis change. Two hard walls remain:
+    #   (a) the config-driven GPTNeoX pipeline's "stacked" layout IS the
+    #       natural tree on disk ([L, ...] blocks + head), structurally
+    #       different from the sequential model's per-layer list — a
+    #       cross-layout load would fail deep in tree matching;
+    #   (b) the MODEL axis (tensor slicing) still rejects — that factor
+    #       is isolated by dividing the saved/current pipe stages out of
+    #       mp_world_size (the non-data product).
+    saved_pipe = model_state.get("pipeline") or {}
+    cur_pipe = _pipeline_manifest_info(engine) or {}
+    saved_stages = max(1, int(saved_pipe.get("stages", 1)))
+    cur_stages = max(1, int(cur_pipe.get("stages", 1)))
+    if (saved_pipe.get("layout") == "stacked") != \
+            (cur_pipe.get("layout") == "stacked"):
+        side = "saved by" if saved_pipe.get("layout") == "stacked" \
+            else "loading into"
         raise TopologyChangeError(
-            f"checkpoint was saved at mp_world_size={saved_mp} but this "
-            f"engine runs mp_world_size={engine.mp_world_size}: model-"
-            f"axis topology changes cannot be elastically resumed — "
-            f"restore the original mesh, or re-shard the checkpoint "
-            f"offline")
+            f"this checkpoint was {side} a config-driven pipeline "
+            f"engine whose stacked [L, ...] block layout IS the tree on "
+            f"disk: it only restores into an engine running the same "
+            f"'pipeline' block (any stage count), not a sequential one "
+            f"— add/drop the block to match, or convert offline")
+
+    saved_mp = model_state.get("mp_world_size")
+    if saved_mp is not None:
+        saved_model_world = max(1, int(saved_mp) // saved_stages)
+        cur_model_world = max(1, int(engine.mp_world_size) // cur_stages)
+        if saved_model_world != cur_model_world:
+            raise TopologyChangeError(
+                f"checkpoint was saved at model-axis world "
+                f"{saved_model_world} (mp_world_size={saved_mp} / "
+                f"{saved_stages} pipeline stage(s)) but this engine "
+                f"runs model-axis world {cur_model_world}: model-axis "
+                f"topology changes cannot be elastically resumed — "
+                f"restore the original mesh, or re-shard the "
+                f"checkpoint offline")
+    if saved_stages != cur_stages:
+        log_dist(
+            f"elastic resume: pipeline stages changed {saved_stages} "
+            f"-> {cur_stages}; the natural-layout checkpoint "
+            f"re-partitions under the current mesh (optimizer state "
+            f"re-shards stage-local)", ranks=[0])
+
     saved_dp = model_state.get("dp_world_size")
     dp_changed = (saved_dp is not None and
                   int(saved_dp) != int(engine.dp_world_size))

@@ -1170,6 +1170,198 @@ class GPTNeoX:
                              segment_ids=seg)
         return res[-1]
 
+    # -- config-driven pipeline parallelism (the "pipeline" JSON block) --
+
+    def to_pipe_spmd(self, mesh, n_micro, fp32_comm=None, wire_latency=1):
+        """Wrap this model for the compiled 1F1B executor (engine calls
+        this when the validated "pipeline" block is present): blocks
+        stack [L, ...] sharded over the ``pipe`` mesh axis, the loss
+        runs the microbatched 1F1B tick loop inside shard_map."""
+        from ..parallel.pipeline_spmd import GPTNeoXPipeSPMD
+        return GPTNeoXPipeSPMD(self.config, mesh, n_micro,
+                               fp32_comm=fp32_comm,
+                               use_pallas=self.use_pallas,
+                               wire_latency=wire_latency)
+
+    # -- explicit-dataflow ZeRO-3 (zero_optimization.schedule.mode =
+    #    "explicit"; parallel/schedule.py) ------------------------------
+
+    def build_explicit_zero3_loss(self, mesh, data_axis, param_specs,
+                                  param_padinfo, schedule):
+        """Build ``loss_and_grads(params, batch, rng, scale)`` running
+        the block stack under the explicit shard_map ZeRO-3 schedule:
+        params stay in the engine's stage-3 storage layout (dp-sharded
+        at rest), the layer loop issues bucketed all-gathers
+        ``schedule.prefetch_depth`` layers ahead of compute, and the
+        remat-group backward re-gathers params while the gather
+        transposes reduce-scatter each gradient to its owner shard.
+
+        Pure reordering vs the GSPMD stage-3 path: same math modulo
+        float reassociation (the loss is the dp-mean of per-rank means —
+        the reference's allreduce-of-means — identical to the global
+        mean whenever every rank sees the same valid-target count).
+
+        ``param_specs``/``param_padinfo`` are the engine's per-leaf
+        PartitionSpecs and FlatPad descriptors for the CURRENT state
+        layout, so the shard_map in/out specs can never drift from the
+        placement."""
+        cfg = self.config
+        if getattr(cfg, "moe_num_experts", 0):
+            raise NotImplementedError(
+                "the explicit ZeRO-3 schedule does not support MoE "
+                "blocks yet (aux-loss threading); use the GSPMD "
+                "schedule (zero_optimization.schedule.mode \"gspmd\")")
+        if cfg.attention_engine == "sparse" or self._attn_fn is not None:
+            raise NotImplementedError(
+                "the explicit ZeRO-3 schedule runs the dense flash/XLA "
+                "attention core; sparse_attention and sequence_parallel "
+                "need the GSPMD schedule")
+        from ..compat import shard_map
+        from ..parallel.schedule import (LayerPlan, gather_leaf,
+                                         leaf_placement,
+                                         prefetched_block_scan)
+        from ..runtime.activation_checkpointing.checkpointing import \
+            make_remat_policy
+
+        P_ = P
+        world = int(mesh.shape[data_axis])
+        use_pallas = self.use_pallas
+        depth = schedule.prefetch_depth
+        L = cfg.num_layers
+        if self.number_checkpoints:
+            # the model's segmented-checkpoint knob IS the remat-group
+            # geometry here: groups == recompute spans
+            group = max(1, -(-L // int(self.number_checkpoints)))
+        else:
+            group = schedule.group_layers
+        policy = None
+        # schedule.remat False skips the group checkpoint (no backward
+        # re-gather; gathered buffers become residuals) — unless the
+        # model itself asked for remat, which wins
+        remat = (schedule.remat or self.remat_blocks
+                 or self.remat_policy is not None
+                 or bool(self.number_checkpoints))
+        if self.remat_policy is not None:
+            policy, _ = make_remat_policy(self.remat_policy)
+
+        block_specs = param_specs["blocks"][0]
+        block_pads = param_padinfo["blocks"][0]
+        state = {"plan": None, "outer": None}
+
+        def map_with_specs(fn, tree, spec_tree, pad_tree):
+            """tree_map that treats PartitionSpec values as leaves (a
+            PartitionSpec is itself a pytree, so a naive tree_map over
+            mixed trees mis-aligns)."""
+            leaves, tdef = jax.tree_util.tree_flatten(tree)
+            specs = jax.tree_util.tree_leaves(
+                spec_tree, is_leaf=lambda x: isinstance(x, P))
+            pads = jax.tree_util.tree_leaves(pad_tree)
+            return tdef.unflatten(
+                [fn(l, s, p) for l, s, p in zip(leaves, specs, pads)])
+
+        def get_plan(params):
+            if state["plan"] is None:
+                state["plan"] = LayerPlan(
+                    params["blocks"][0], block_specs, block_pads,
+                    data_axis, world, schedule.bucket_bytes)
+                outer = {}
+                for key in ("embed", "final_ln", "embed_out"):
+                    if key not in params:
+                        continue
+                    outer[key] = map_with_specs(
+                        lambda l, s, p: leaf_placement(
+                            np.shape(l), np.result_type(l), s, p or None,
+                            data_axis, world),
+                        params[key], param_specs[key],
+                        param_padinfo[key])
+                state["outer"] = outer
+            return state["plan"], state["outer"]
+
+        def loss_and_grads(params, batch, rng, scale=None):
+            tokens, labels, seg = split_lm_batch(batch)
+            if cfg.use_segment_ids and seg is None:
+                raise ValueError(
+                    "packing is enabled (use_segment_ids) but the batch "
+                    "has no segment_ids")
+            plan, outer = get_plan(params)
+            if scale is None:
+                scale = jnp.asarray(1.0, jnp.float32)
+
+            def body(lp, tokens, labels, seg, rng, scale):
+                def gathered(sub, placements):
+                    return jax.tree_util.tree_map(
+                        lambda l, pl: gather_leaf(l, pl, data_axis,
+                                                  world),
+                        sub, placements,
+                        is_leaf=lambda x: hasattr(x, "kind"))
+
+                def local_loss(lp):
+                    embed_wte = gathered(lp["embed"],
+                                         outer["embed"])["wte"]
+                    x = embed_wte[tokens]
+                    cos, sin, rot_dim = _rotary_cache(cfg,
+                                                      tokens.shape[1])
+                    lab = labels
+                    if seg is not None:
+                        from ..runtime.packing import (
+                            mask_cross_document_labels,
+                            segment_relative_positions)
+                        lab = mask_cross_document_labels(labels, seg)
+                        if rot_dim:
+                            pos = segment_relative_positions(seg)
+                            cos, sin = cos[pos], sin[pos]
+
+                    def block_fn(bp, x):
+                        return block_forward(
+                            cfg, bp, x, (cos, sin, rot_dim),
+                            use_pallas=use_pallas, segment_ids=seg)
+
+                    layer_leaves = [
+                        jax.tree_util.tree_flatten(bp)[0]
+                        for bp in lp["blocks"]]
+                    x = prefetched_block_scan(
+                        block_fn, x, layer_leaves, plan, L,
+                        prefetch_depth=depth, group_layers=group,
+                        policy=policy, remat=remat)
+
+                    fl = gathered(lp["final_ln"], outer["final_ln"])
+                    x = layer_norm(x, fl["scale"], fl["bias"],
+                                   cfg.layernorm_eps)
+                    if "embed_out" in lp:
+                        head_wte = gathered(lp["embed_out"],
+                                            outer["embed_out"])["wte"]
+                    else:
+                        head_wte = embed_wte
+                    loss = fused_lm_head_loss(x, head_wte, lab)
+                    return loss * scale.astype(loss.dtype), loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    local_loss, has_aux=True)(lp)
+                # gather transposes delivered each sharded leaf's grad
+                # as the rank-SUM reduce-scatter: divide for the dp
+                # mean; replicated leaves pmean their per-rank grads
+                grads = map_with_specs(
+                    lambda g, s, p: g / world
+                    if (p or any(a is not None for a in s))
+                    else jax.lax.pmean(g, data_axis),
+                    grads, param_specs, param_padinfo)
+                return jax.lax.pmean(loss, data_axis), grads
+
+            batch_spec = P_(data_axis)
+            seg_in = seg if seg is not None else jnp.zeros((), jnp.int32)
+            seg_spec = batch_spec if seg is not None else P_()
+            mapped = shard_map(
+                lambda lp, t, lb, sg, r, sc: body(
+                    lp, t, lb, sg if seg is not None else None, r, sc),
+                mesh=mesh,
+                in_specs=(param_specs, batch_spec, batch_spec, seg_spec,
+                          P_(), P_()),
+                out_specs=(P_(), param_specs),
+                check_vma=False)
+            return mapped(params, tokens, labels, seg_in, rng, scale)
+
+        return loss_and_grads
+
 
 # ---------------------------------------------------------------------------
 # autoregressive generation (KV cache; single jitted prefill + scan decode)

@@ -133,8 +133,15 @@ def last_stage_value(value, axis_name, n_stages):
 
 
 def pipeline_1f1b_ticks(stage_apply, diff_args, buf_template, n_stages,
-                        n_micro, axis_name, rng, fp32_comm=None):
+                        n_micro, axis_name, rng, fp32_comm=None,
+                        wire_latency=1):
     """Interleaved forward+backward 1F1B loop; returns (loss, grads).
+
+    ``wire_latency=2`` dispatches to the software-pipelined executor
+    (`parallel.schedule.pipeline_1f1b_overlapped_ticks`): each tick
+    issues the PREVIOUS tick's ppermutes before its compute, hiding the
+    p2p transfers behind the stage matmuls at the cost of doubled
+    fill/drain (the ``pipeline.comm_overlap`` knob).
 
     Args (inside shard_map over `axis_name`):
       stage_apply: (diff_args, buf, m_idx, rng) -> (out_buf, loss_f32).
@@ -158,6 +165,15 @@ def pipeline_1f1b_ticks(stage_apply, diff_args, buf_template, n_stages,
     bounded by pipeline depth, not micro-batch count.
     """
     from ..runtime.pipe import p2p
+
+    if int(wire_latency) == 2:
+        from .schedule import pipeline_1f1b_overlapped_ticks
+        return pipeline_1f1b_overlapped_ticks(
+            stage_apply, diff_args, buf_template, n_stages, n_micro,
+            axis_name, rng, fp32_comm=fp32_comm)
+    if int(wire_latency) != 1:
+        raise ValueError(f"wire_latency must be 1 or 2, got "
+                         f"{wire_latency}")
 
     stage = jax.lax.axis_index(axis_name)
     D = min(n_stages, n_micro)
@@ -286,7 +302,7 @@ def pipeline_forward_ticks(stage_apply, diff_args, buf_template, n_stages,
 def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
                      axis_name=PIPE_AXIS, remat=True, fp32_comm=None,
                      data_axis=None, blocks_specs=None, embed_specs=None,
-                     head_specs=None):
+                     head_specs=None, wire_latency=1):
     """Build loss(params, batch, rng) running the block stack pipelined.
 
     params = {"embed": ..., "blocks": stacked leaves [L, ...],
@@ -402,7 +418,8 @@ def pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, mesh, n_micro,
             if mode == "grad":
                 loss, gacc = pipeline_1f1b_ticks(
                     stage_apply, diff_args, buf_tmpl, n_stages, n_micro,
-                    axis_name, rng, fp32_comm=fp32_comm)
+                    axis_name, rng, fp32_comm=fp32_comm,
+                    wire_latency=wire_latency)
                 loss = last_stage_value(loss, axis_name, n_stages)
                 if dp_active:
                     loss = jax.lax.pmean(loss, data_axis)
@@ -590,7 +607,8 @@ class ModulePackMeta:
 
 def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
                             data_axis=None, fp32_comm=None, remat=True,
-                            packed_io=False, param_templates=None):
+                            packed_io=False, param_templates=None,
+                            wire_latency=1):
     """Lower an arbitrary `PipelineModule` (heterogeneous LayerSpec list)
     onto the compiled 1F1B executor (reference `pipe/engine.py:654-1139`
     executes any layer list across stages; here the whole 1F1B batch —
@@ -763,7 +781,8 @@ def module_pipeline_loss_fn(module, mesh, n_micro, axis_name=PIPE_AXIS,
             if mode == "grad":
                 loss, (rows_g, tied_g) = pipeline_1f1b_ticks(
                     stage_apply, diff_args, buf_tmpl, n_stages, n_micro,
-                    axis_name, rng, fp32_comm=fp32_comm)
+                    axis_name, rng, fp32_comm=fp32_comm,
+                    wire_latency=wire_latency)
                 loss = last_stage_value(loss, axis_name, n_stages)
                 # tied params are replicated over pipe: sum each stage's
                 # contribution (reference allreduce_tied_weight_gradients)
@@ -890,18 +909,26 @@ class GPTNeoXPipeSPMD:
     """
 
     def __init__(self, config, mesh, n_micro, remat=True, fp32_comm=None,
-                 use_pallas=True):
+                 use_pallas=True, wire_latency=1):
         from ..models import gpt_neox as M
         from .mesh import DATA_AXIS, MODEL_AXIS
         self.cfg = config
+        self.config = config   # engine-protocol alias (module.config)
         self.mesh = mesh
         self.n_micro = n_micro
+        self.wire_latency = int(wire_latency)
         if getattr(config, "moe_num_experts", 0):
             # see models.gpt_neox.to_layer_specs: aux loss is not
             # threaded through the stage buffers
             raise NotImplementedError(
                 "MoE layers cannot be pipelined yet: the expert aux "
                 "loss is not threaded through the inter-stage buffers")
+        if getattr(config, "tie_word_embeddings", False):
+            raise NotImplementedError(
+                "tie_word_embeddings is unsupported on the SPMD "
+                "pipeline executor (embedding and head live on "
+                "different stages); use a PipelineModule with "
+                "TiedLayerSpec, or untie")
         self.n_stages = int(mesh.shape[PIPE_AXIS])
         self.mp = int(mesh.shape[MODEL_AXIS]) \
             if MODEL_AXIS in mesh.axis_names else 1
@@ -1011,7 +1038,27 @@ class GPTNeoXPipeSPMD:
             fp32_comm=fp32_comm, data_axis=DATA_AXIS,
             blocks_specs=self._tp_specs["blocks"] if mp > 1 else None,
             embed_specs=self._tp_specs["embed"] if mp > 1 else None,
-            head_specs=self._tp_specs["head"] if mp > 1 else None)
+            head_specs=self._tp_specs["head"] if mp > 1 else None,
+            wire_latency=self.wire_latency)
+
+    @staticmethod
+    def stack_natural_params(params):
+        """Natural GPTNeoX params ({embed, blocks: [per-layer dicts],
+        final_ln, embed_out?}) -> the stacked pipeline layout this
+        wrapper trains ({embed, blocks: [L, ...] leaves, head})."""
+        if "head" in params and not isinstance(params.get("blocks"),
+                                               (list, tuple)):
+            return params   # already stacked
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *params["blocks"])
+        head_wte = params["embed_out"]["wte"] if "embed_out" in params \
+            else params["embed"]["wte"]
+        return {
+            "embed": {"wte": params["embed"]["wte"]},
+            "blocks": stacked,
+            "head": {"final_ln": dict(params["final_ln"]),
+                     "wte": head_wte},
+        }
 
     def init_params(self, rng):
         M, cfg = self._M, self.cfg

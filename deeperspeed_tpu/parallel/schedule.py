@@ -1,0 +1,504 @@
+"""Explicit-dataflow collective schedules.
+
+DeepCompile (arXiv:2504.09983) argues that distributed collectives should
+be *scheduled* like a compiler pass — prefetch, bucketing, overlap decided
+by the framework — instead of handed to the partitioner to guess. This
+module is that pass for the two schedules the engine runs:
+
+1. **Explicit ZeRO-3** (`LayerPlan` + `prefetched_block_scan`): parameters
+   live sharded over the ``data`` axis; inside ``shard_map`` the layer
+   stack runs as a grouped scan whose body issues **bucketed all-gathers
+   `prefetch_depth` layers ahead of compute** in program order, so XLA's
+   latency-hiding scheduler overlaps the gather of layer ``i+d`` with the
+   matmuls of layer ``i`` (the chunked-overlap discipline the MoE a2a path
+   proved). Each group body is `jax.checkpoint`ed, so backward
+   **re-gathers** the group's params and the transpose of each
+   `all_gather` lands as a **reduce-scatter at the layer-backward
+   boundary** — gradients arrive pre-sharded to their owner rank.
+
+2. **Software-pipelined 1F1B** (`pipeline_1f1b_overlapped_ticks`): the
+   wire-latency-2 variant of the 1F1B tick loop
+   (`parallel/pipeline_spmd.pipeline_1f1b_ticks`): each tick FIRST issues
+   the `ppermute` of the previous tick's boundary payloads, THEN runs the
+   stage compute — activation/grad transfers overlap stage compute at the
+   cost of 2·(S-1) extra fill/drain ticks (`bubble_fraction` quantifies
+   the trade). Selected by ``pipeline.comm_overlap``.
+
+The shared ``ScheduleConfig`` (parsed from ``zero_optimization.schedule``)
+carries the ZeRO-gather knobs: `prefetch_depth` is the layers-ahead
+window, `bucket_mb` bounds each all-gather's payload, `group_layers` is
+the remat/prefetch window (gathered params live at most one group, and
+prefetch resets at group boundaries). The pipeline's
+``pipeline.comm_overlap`` flag applies the same double-buffer discipline
+to the p2p wire — a fixed depth-1 prefetch (wire latency 2); it does not
+read `prefetch_depth` (deeper wire pipelining has no payoff: each tick
+produces exactly one boundary buffer).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.config_utils import DeepSpeedConfigError
+
+SCHEDULE_MODES = ("gspmd", "explicit")
+
+
+@dataclass(frozen=True)
+class ScheduleConfig:
+    """Knobs of the explicit collective schedule (the
+    ``zero_optimization.schedule`` block; shared by the ZeRO-3 gather
+    schedule and the pipeline comm-overlap path)."""
+    mode: str = "gspmd"          # "gspmd" (partitioner) | "explicit"
+    prefetch_depth: int = 1      # layers gathered ahead of compute
+    bucket_mb: float = 32.0      # max bytes per all-gather bucket
+    group_layers: int = 4        # layers per remat/prefetch group
+    # remat the gather groups: backward RE-GATHERS params (gathered
+    # weights never outlive their group — the ZeRO-3 memory story).
+    # False keeps the gathered buffers as backward residuals instead —
+    # ~one full gathered param copy of extra live memory in exchange
+    # for no recompute (apples-to-apples with a no-remat DDP run).
+    remat: bool = True
+
+    @property
+    def bucket_bytes(self):
+        return int(self.bucket_mb * 1024 * 1024)
+
+
+# ---------------------------------------------------------------------------
+# per-leaf placement (how one array is stored across the data axis)
+# ---------------------------------------------------------------------------
+
+REPLICATED, DIM_SHARDED, FLAT_SHARDED = "replicated", "dim", "flat"
+
+
+class LeafPlacement:
+    """Static description of how one param leaf rests on the dp axis:
+    ``replicated`` (persistence-threshold smalls), ``dim`` (one natural
+    dim carries the data axis), or ``flat`` (stored as a padded 1-D
+    buffer sharded over data — ragged leaves, see
+    `runtime.zero.partition_parameters.FlatPad`)."""
+
+    __slots__ = ("kind", "dim", "pad", "shape", "dtype", "local_shape",
+                 "size")
+
+    def __init__(self, kind, shape, dtype, world, dim=None, pad=None):
+        self.kind = kind
+        self.dim = dim
+        self.pad = pad
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        if kind == DIM_SHARDED:
+            local = list(shape)
+            if local[dim] % world:
+                raise ValueError(
+                    f"dim {dim} of {tuple(shape)} does not divide the dp "
+                    f"world {world}")
+            local[dim] //= world
+            self.local_shape = tuple(local)
+        elif kind == FLAT_SHARDED:
+            if pad.padded % world:
+                raise ValueError(
+                    f"flat-padded length {pad.padded} does not divide "
+                    f"the dp world {world}")
+            self.local_shape = (pad.padded // world,)
+        else:
+            self.local_shape = tuple(shape)
+        self.size = int(np.prod(self.local_shape)) if self.local_shape \
+            else 1
+
+    @property
+    def gathered(self):
+        return self.kind != REPLICATED
+
+    def __repr__(self):
+        return (f"LeafPlacement({self.kind}, shape={self.shape}, "
+                f"dim={self.dim})")
+
+
+def leaf_placement(shape, dtype, spec, pad, axis_name, world):
+    """Classify one leaf from its engine-side PartitionSpec + pad info.
+    Only the data axis may appear in ``spec`` — an explicit schedule over
+    a tensor/expert-parallel leaf is not supported here."""
+    if pad:
+        return LeafPlacement(FLAT_SHARDED, pad.shape, dtype, world,
+                             pad=pad)
+    dims = []
+    for d, part in enumerate(spec):
+        if part is None:
+            continue
+        axes = part if isinstance(part, tuple) else (part,)
+        for a in axes:
+            if a != axis_name:
+                raise DeepSpeedConfigError(
+                    f"explicit schedule supports pure data-parallel "
+                    f"placements; leaf spec {spec} uses mesh axis {a!r}")
+        dims.append(d)
+    if not dims:
+        return LeafPlacement(REPLICATED, shape, dtype, world)
+    if len(dims) > 1:
+        raise DeepSpeedConfigError(
+            f"leaf spec {spec} shards more than one dim over the data "
+            f"axis; the explicit schedule expects at most one")
+    return LeafPlacement(DIM_SHARDED, shape, dtype, world, dim=dims[0])
+
+
+def gather_leaf(local, placement, axis_name, world):
+    """All-gather ONE leaf's local shard back to its full natural shape
+    (embed / head / any non-layer leaf). Replicated leaves pass through."""
+    if placement.kind == REPLICATED:
+        return local
+    pieces = jax.lax.all_gather(jnp.ravel(local), axis_name, tiled=False)
+    return _reassemble(pieces, placement, world)
+
+
+def _reassemble(pieces, placement, world):
+    """[world, size] rank-major pieces -> full natural-shaped leaf."""
+    if placement.kind == FLAT_SHARDED:
+        flat = pieces.reshape(-1)[:placement.pad.numel]
+        return flat.reshape(placement.shape)
+    k = placement.dim
+    stacked = pieces.reshape((world,) + placement.local_shape)
+    # rank-major concat along dim k == the NamedSharding shard order
+    moved = jnp.moveaxis(stacked, 0, k)
+    return moved.reshape(placement.shape)
+
+
+# ---------------------------------------------------------------------------
+# layer gather plan: bucketing math + traced gather/rebuild
+# ---------------------------------------------------------------------------
+
+class LayerPlan:
+    """Gather plan for ONE transformer layer's parameter pytree.
+
+    The sharded leaves' local shards concatenate (raveled, in flatten
+    order) into one [S] row per layer; `buckets` split that row into
+    <= ``bucket_bytes`` chunks, each all-gathered as its own collective
+    (the DeepCompile bucketing knob: one huge gather serializes behind
+    itself; many tiny ones are latency-bound). The last bucket absorbs
+    the non-divisible tail. `rebuild` reassembles the gathered [world, S]
+    buffer plus the replicated leaves into the natural block pytree.
+    """
+
+    def __init__(self, template, specs, pads, axis_name, world,
+                 bucket_bytes):
+        leaves, self.treedef = jax.tree_util.tree_flatten(template)
+        spec_leaves = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(
+                x, jax.sharding.PartitionSpec))
+        pad_leaves = jax.tree_util.tree_leaves(pads)
+        if not (len(leaves) == len(spec_leaves) == len(pad_leaves)):
+            raise ValueError(
+                f"template/specs/pads disagree: {len(leaves)} vs "
+                f"{len(spec_leaves)} vs {len(pad_leaves)} leaves")
+        self.axis_name = axis_name
+        self.world = int(world)
+        self.placements = [
+            leaf_placement(np.shape(l), np.result_type(l), s,
+                           p or None, axis_name, self.world)
+            for l, s, p in zip(leaves, spec_leaves, pad_leaves)]
+
+        # concat layout of the gathered leaves' shards
+        self.offsets = []
+        off = 0
+        dtypes = set()
+        for pl in self.placements:
+            if pl.gathered:
+                self.offsets.append(off)
+                off += pl.size
+                dtypes.add(pl.dtype)
+            else:
+                self.offsets.append(None)
+        self.shard_size = off            # S: per-rank elements per layer
+        if len(dtypes) > 1:
+            raise ValueError(
+                f"gathered leaves of one layer must share a dtype for "
+                f"bucketed gathers; got {sorted(map(str, dtypes))}")
+        self.dtype = dtypes.pop() if dtypes else jnp.dtype(jnp.float32)
+        self.buckets = plan_buckets(self.shard_size,
+                                    self.dtype.itemsize, bucket_bytes)
+
+    @property
+    def n_replicated(self):
+        return sum(1 for pl in self.placements if not pl.gathered)
+
+    # -- traced helpers ----------------------------------------------------
+
+    def split_leaves(self, leaves):
+        """Flatten-order leaves -> (gathered shards, replicated leaves)."""
+        gath = [l for l, pl in zip(leaves, self.placements)
+                if pl.gathered]
+        rep = [l for l, pl in zip(leaves, self.placements)
+               if not pl.gathered]
+        return gath, rep
+
+    def concat_shards(self, leaves):
+        """This layer's flatten-order leaves -> one [S] row of the
+        gathered leaves' raveled local shards (None if all replicated)."""
+        parts, _ = self.split_leaves(leaves)
+        parts = [jnp.ravel(l) for l in parts]
+        if not parts:
+            return None
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def gather_row(self, row):
+        """Bucketed all-gather of one layer row: each bucket is its own
+        collective -> [world, S]."""
+        pieces = [
+            jax.lax.all_gather(
+                jax.lax.slice_in_dim(row, start, start + size, axis=0),
+                self.axis_name, tiled=False)
+            for start, size in self.buckets]
+        return pieces[0] if len(pieces) == 1 else \
+            jnp.concatenate(pieces, axis=1)
+
+    def rebuild(self, gathered, rep_leaves):
+        """Gathered [world, S] buffer + this layer's replicated leaves
+        (in flatten order of the replicated subset) -> natural block
+        pytree."""
+        out = []
+        rep_iter = iter(rep_leaves)
+        for pl, off in zip(self.placements, self.offsets):
+            if not pl.gathered:
+                out.append(next(rep_iter))
+                continue
+            piece = jax.lax.slice_in_dim(gathered, off, off + pl.size,
+                                         axis=1)
+            out.append(_reassemble(piece, pl, self.world))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+
+def plan_buckets(shard_size, itemsize, bucket_bytes):
+    """[(start, size)] chunks of a [shard_size] row, each at most
+    ``bucket_bytes`` big; the final bucket takes the ragged tail. A
+    non-positive bucket size is one whole-row bucket."""
+    if shard_size <= 0:
+        return []
+    elems = max(1, int(bucket_bytes) // max(1, int(itemsize)))
+    if bucket_bytes <= 0 or elems >= shard_size:
+        return [(0, shard_size)]
+    out = []
+    start = 0
+    while start < shard_size:
+        size = min(elems, shard_size - start)
+        out.append((start, size))
+        start += size
+    return out
+
+
+def _segment_sizes(n_layers, n_groups):
+    """As-equal-as-possible group sizes (mirror of
+    models.gpt_neox.segment_sizes, kept local to avoid a models import
+    cycle)."""
+    n = max(1, min(int(n_groups), n_layers))
+    return [n_layers // n + (1 if i < n_layers % n else 0)
+            for i in range(n)]
+
+
+def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
+                          prefetch_depth, group_layers, policy=None,
+                          remat=True):
+    """Run ``n_layers`` uniform blocks over dp-sharded params with the
+    explicit gather schedule.
+
+    Args (inside shard_map over ``plan.axis_name``):
+      block_fn: (block_params, x) -> x, the layer body.
+      layer_leaves: per-layer lists of LOCAL leaves (flatten order of
+        the plan's template): sharded leaves are shards, replicated
+        leaves full.
+      prefetch_depth: gathers issued this many layers ahead of compute,
+        clamped to the group size (a depth past the remat group cannot
+        be honored — gathered params live at most one group).
+      group_layers: layers per `jax.checkpoint` group. Residuals per
+        group are the boundary carry only, so backward RE-GATHERS the
+        group's params (and the gather transposes place each grad
+        shard via reduce-scatter at the layer-backward boundary).
+      policy: optional jax.checkpoint policy for the group bodies.
+      remat: False skips the group checkpoint — backward consumes the
+        gathered buffers saved as scan residuals (no re-gather, no
+        recompute, ~one gathered param copy of extra live memory). The
+        grad reduce-scatters still come from the gather transposes.
+
+    Groups of equal size ride an outer `lax.scan` (compile O(group), not
+    O(L)); ragged layer counts fall back to a Python loop over <= 2
+    distinct group shapes.
+    """
+    depth = max(1, int(prefetch_depth))
+    split = [plan.split_leaves(lv) for lv in layer_leaves]
+    rows = [plan.concat_shards(lv) for lv in layer_leaves]
+    rep_by_layer = [rep for _, rep in split]
+    has_rows = bool(rows) and rows[0] is not None
+
+    def group_body(x, rows_g, rep_g):
+        """One remat group: python-unrolled layers, gathers issued
+        ``depth`` layers ahead in program order (the double-buffer XLA's
+        latency-hiding scheduler overlaps with the layer matmuls).
+        rows_g: list of g [S] rows (or Nones); rep_g: list of g
+        replicated-leaf lists."""
+        g = len(rep_g)
+        d = min(depth, g)
+        gathered = {}
+        if has_rows:
+            for j in range(d):
+                gathered[j] = plan.gather_row(rows_g[j])
+        for i in range(g):
+            if has_rows and i + d < g:
+                gathered[i + d] = plan.gather_row(rows_g[i + d])
+            bp = plan.rebuild(gathered.pop(i) if has_rows else None,
+                              rep_g[i])
+            x = block_fn(bp, x)
+        return x
+
+    sizes = _segment_sizes(n_layers, -(-n_layers // max(1,
+                                                        int(group_layers))))
+    uniform = len(set(sizes)) == 1
+
+    if uniform and len(sizes) > 1:
+        g = sizes[0]
+        n_groups = len(sizes)
+        stacked_rows = (jnp.stack(rows).reshape(
+            (n_groups, g, plan.shard_size)) if has_rows
+            else jnp.zeros((n_groups, g, 0), plan.dtype))
+        # replicated leaves stacked over layers -> [n_groups, g, ...]
+        stacked_rep = [
+            jnp.stack([rep_by_layer[i][k] for i in range(n_layers)]
+                      ).reshape((n_groups, g)
+                                + np.shape(rep_by_layer[0][k]))
+            for k in range(plan.n_replicated)]
+
+        body = (lambda x, rg, lg: group_body(
+            x, [rg[j] for j in range(g)],
+            [[lv[i] for lv in lg] for i in range(g)]))
+        ck = jax.checkpoint(body, policy=policy) if remat else body
+
+        def scan_body(carry, xs):
+            rg, lg = xs
+            return ck(carry, rg, lg), None
+
+        return jax.lax.scan(scan_body, x, (stacked_rows, stacked_rep))[0]
+
+    # ragged (or single-group) layer counts: python loop over groups
+    idx = 0
+    ck = jax.checkpoint(group_body, policy=policy) if remat else group_body
+    for size in sizes:
+        x = ck(x, rows[idx:idx + size], rep_by_layer[idx:idx + size])
+        idx += size
+    return x
+
+
+# ---------------------------------------------------------------------------
+# 1F1B with software-pipelined p2p (wire latency 2)
+# ---------------------------------------------------------------------------
+
+def bubble_fraction(n_stages, n_micro, wire_latency=1):
+    """Analytic 1F1B bubble fraction: fill+drain ticks over total.
+    ``wire_latency`` 1 is the classic schedule (transfer serialized with
+    compute); 2 is the comm-overlap schedule (transfers hidden behind
+    compute, fill/drain doubled)."""
+    w = int(wire_latency)
+    s, m = int(n_stages), int(n_micro)
+    if m <= 0:
+        return 0.0
+    return w * (s - 1) / (m + w * (s - 1))
+
+
+def pipeline_1f1b_overlapped_ticks(stage_apply, diff_args, buf_template,
+                                   n_stages, n_micro, axis_name, rng,
+                                   fp32_comm=None):
+    """`pipeline_1f1b_ticks` with the inter-stage wire double-buffered:
+    each tick FIRST issues the ppermute of the PREVIOUS tick's boundary
+    payloads (no data dependence on this tick's compute, so XLA overlaps
+    transfer with the stage matmuls), then computes. The wire gains one
+    tick of latency, so the clock relations stretch:
+
+      forward  of micro m on stage s at t = 2s + 2m          (even ticks)
+      backward of micro m on stage s at t = 4S - 2s + 2m - 3 (odd ticks)
+
+    Fill/drain grows from 2(S-1) to 4(S-1) half-ticks — `bubble_fraction`
+    with wire_latency=2 — in exchange for p2p transfers that cost ~zero
+    wall-clock in steady state. Same contract as `pipeline_1f1b_ticks`:
+    returns (mean loss on the last stage, fp32 grad accumulators).
+    """
+    from ..runtime.pipe import p2p
+
+    stage = jax.lax.axis_index(axis_name)
+    S, M = n_stages, n_micro
+    D = min(2 * S - 1, M)
+    total = 2 * (M + 2 * (S - 1))
+    buf0 = jnp.zeros(buf_template.shape, buf_template.dtype)
+    gacc0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), diff_args)
+
+    def tick(carry, t):
+        fwd_wire, bwd_wire, fwd_in, bwd_in, stash, gacc, loss_acc = carry
+        is_fwd = (t % 2) == 0
+
+        # --- transfers of LAST tick's outputs, issued before compute ----
+        # down-wire payloads are produced at even ticks (forwards of
+        # stages 0..S-2), up-wire at odd ticks (backwards of stages
+        # 1..S-1) — so each tick runs exactly ONE live ppermute, gated
+        # off entirely outside its useful range (bubble bandwidth).
+        down_live = jnp.logical_not(is_fwd) & (t <= 2 * S + 2 * M - 5)
+        up_live = is_fwd & (t >= 2 * S) & (t <= 4 * S + 2 * M - 6)
+        fwd_in_next = jax.lax.cond(
+            down_live,
+            lambda v: p2p.send_to_next(v, axis_name, S,
+                                       fp32_comm=fp32_comm),
+            lambda v: jnp.zeros_like(v), fwd_wire)
+        bwd_in_next = jax.lax.cond(
+            up_live,
+            lambda v: p2p.send_to_prev(v, axis_name, S,
+                                       fp32_comm=fp32_comm),
+            lambda v: jnp.zeros_like(v), bwd_wire)
+
+        # --- this tick's compute ---------------------------------------
+        tf = t - 2 * stage
+        m_f = jnp.clip(tf // 2, 0, M - 1)
+        valid_f = is_fwd & (tf >= 0) & (tf <= 2 * (M - 1))
+        tb = t - (4 * S - 2 * stage - 3)
+        m_b = jnp.clip(tb // 2, 0, M - 1)
+        valid_b = jnp.logical_not(is_fwd) & (tb >= 0) & \
+            (tb <= 2 * (M - 1))
+
+        def fwd_tick(fwd_in, bwd_in, stash, gacc):
+            y, l = stage_apply(diff_args, fwd_in, m_f, rng)
+            slot = m_f % D
+            keep = jax.lax.dynamic_index_in_dim(stash, slot, 0,
+                                                keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_f, fwd_in, keep), slot, 0)
+            return y, buf0, l.astype(jnp.float32), stash, gacc
+
+        def bwd_tick(fwd_in, bwd_in, stash, gacc):
+            x = jax.lax.dynamic_index_in_dim(stash, m_b % D, 0,
+                                             keepdims=False)
+            cot_y = jnp.where(stage == S - 1, jnp.zeros_like(bwd_in),
+                              bwd_in)
+            cot_l = jnp.asarray(1.0 / M, jnp.float32)
+            _, pull = jax.vjp(
+                lambda args, xx: stage_apply(args, xx, m_b, rng),
+                diff_args, x)
+            args_bar, x_bar = pull((cot_y.astype(buf_template.dtype),
+                                    cot_l))
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(valid_b,
+                                           g.astype(jnp.float32), 0.0),
+                gacc, args_bar)
+            return buf0, x_bar, jnp.asarray(0.0, jnp.float32), stash, gacc
+
+        y_out, xbar_out, l, stash, gacc = jax.lax.cond(
+            is_fwd, fwd_tick, bwd_tick, fwd_in, bwd_in, stash, gacc)
+        loss_acc = loss_acc + jnp.where(
+            valid_f & (stage == S - 1), l, 0.0)
+        return (y_out, xbar_out, fwd_in_next, bwd_in_next, stash, gacc,
+                loss_acc), None
+
+    stash0 = jnp.zeros((D,) + buf_template.shape, buf_template.dtype)
+    carry0 = (buf0, buf0, buf0, buf0, stash0, gacc0,
+              jnp.asarray(0.0, jnp.float32))
+    (_, _, _, _, _, gacc, loss_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(total))
+    return loss_acc / M, gacc

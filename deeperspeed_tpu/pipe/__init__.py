@@ -1,2 +1,10 @@
 # Re-export for parity with `deepspeed.pipe` (reference deepspeed/pipe/).
 from ..runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec
+
+# The compiled 1F1B runtime pieces (config-driven via the "pipeline"
+# JSON block; see docs/parallelism.md): the shard_map executor, the
+# flagship-model wrapper, and the schedule's bubble arithmetic.
+from ..parallel.pipeline_spmd import (GPTNeoXPipeSPMD,  # noqa: F401
+                                      module_pipeline_loss_fn,
+                                      pipeline_loss_fn)
+from ..parallel.schedule import bubble_fraction  # noqa: F401

@@ -533,6 +533,7 @@ class DeepSpeedConfig:
         self._parse_training_health_block(d)
         self._parse_telemetry_block(d)
         self._parse_packing_block(d)
+        self._parse_pipeline_block(d)
 
         # Elastic resilience sub-blocks ("elasticity": {"heartbeat",
         # "supervisor"}) — validated at the same parse-time strictness
@@ -557,6 +558,95 @@ class DeepSpeedConfig:
 
         self.vocabulary_size = d.get(c.VOCABULARY_SIZE,
                                      c.VOCABULARY_SIZE_DEFAULT)
+
+    def _parse_pipeline_block(self, d):
+        """Parse + validate the "pipeline" block (config-driven 1F1B
+        schedule over a ``pipe`` mesh axis) at checkpoint-block
+        strictness. Unsupported combos reject HERE, at parse: a pipeline
+        block silently ignored next to an offload tier or ZeRO >= 2
+        would train unscheduled while the user believes it pipelines."""
+        pipe = d.get(c.PIPELINE)
+        if pipe is None:
+            self.pipeline_config = None
+            return
+        if not isinstance(pipe, dict):
+            raise DeepSpeedConfigError(
+                f"'{c.PIPELINE}' must be a dict, got {pipe!r}")
+        known = {c.PIPELINE_STAGES, c.PIPELINE_MICRO_BATCHES,
+                 c.PIPELINE_COMM_OVERLAP}
+        unknown = sorted(set(pipe) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown 'pipeline' key(s) {unknown}; valid keys: "
+                f"{sorted(known)}")
+        if c.PIPELINE_STAGES not in pipe:
+            raise DeepSpeedConfigError(
+                f"pipeline.{c.PIPELINE_STAGES} is required (the number "
+                f"of pipeline stages, >= 2)")
+        stages = as_int(pipe[c.PIPELINE_STAGES],
+                        f"pipeline.{c.PIPELINE_STAGES}")
+        if stages < 2:
+            raise DeepSpeedConfigError(
+                f"pipeline.{c.PIPELINE_STAGES} must be >= 2 (a 1-stage "
+                f"pipeline is the plain engine — drop the block), got "
+                f"{stages}")
+        micro = pipe.get(c.PIPELINE_MICRO_BATCHES)
+        if micro is not None:
+            micro = as_int(micro, f"pipeline.{c.PIPELINE_MICRO_BATCHES}")
+            if micro < 1:
+                raise DeepSpeedConfigError(
+                    f"pipeline.{c.PIPELINE_MICRO_BATCHES} must be >= 1, "
+                    f"got {micro}")
+        overlap = pipe.get(c.PIPELINE_COMM_OVERLAP,
+                           c.PIPELINE_COMM_OVERLAP_DEFAULT)
+        if not isinstance(overlap, bool):
+            raise DeepSpeedConfigError(
+                f"pipeline.{c.PIPELINE_COMM_OVERLAP} must be a boolean, "
+                f"got {overlap!r}")
+
+        # -- unsupported combos: reject loudly at parse ------------------
+        if self.zero_optimization_stage >= 2:
+            raise DeepSpeedConfigError(
+                f"pipeline parallelism composes with ZeRO stage <= 1 "
+                f"only (the reference makes the same restriction): "
+                f"grads/params are stage-local, not dp-flat. Got stage "
+                f"{self.zero_optimization_stage}; for dp-axis param "
+                f"sharding use zero_optimization.schedule.mode="
+                f"\"explicit\" without the pipeline block")
+        if self.zero_config.offload_optimizer is not None or \
+                self.zero_config.offload_param is not None:
+            tier = ("streamed-NVMe" if self.zero_config.nvme_offload
+                    else "host-offload")
+            raise DeepSpeedConfigError(
+                f"pipeline parallelism is unsupported with the {tier} "
+                f"offload tier: the offload paths accumulate per-micro-"
+                f"batch grads outside the fused 1F1B program (the run "
+                f"would silently train unscheduled)")
+        if self.moe_enabled:
+            raise DeepSpeedConfigError(
+                "pipeline + moe is unsupported: the expert aux loss is "
+                "not threaded through the inter-stage buffers")
+        if self.sequence_parallel_enabled:
+            raise DeepSpeedConfigError(
+                "pipeline + sequence_parallel is unsupported: the SP "
+                "ring owns its own mesh axis and full-sequence layouts")
+        if getattr(self, "packing_params", None):
+            raise DeepSpeedConfigError(
+                "pipeline + packing is unsupported: segment_ids are not "
+                "threaded through the inter-stage buffers")
+        if self.sparse_attention:
+            raise DeepSpeedConfigError(
+                "pipeline + sparse_attention is unsupported: the "
+                "pipelined stage body runs the dense block")
+        if self.pld_enabled:
+            raise DeepSpeedConfigError(
+                "pipeline + progressive_layer_drop is unsupported: "
+                "theta is not threaded through the 1F1B program")
+        self.pipeline_config = {
+            "stages": stages,
+            "micro_batches": micro,
+            "comm_overlap": overlap,
+        }
 
     def _parse_moe_block(self, d):
         """Parse + validate the "moe" block with the same parse-time

@@ -373,6 +373,22 @@ PACKING_DROP_TAIL = "drop_tail"
 PACKING_DROP_TAIL_DEFAULT = False
 
 # ---------------------------------------------------------------------------
+# Pipeline block (config-driven 1F1B schedule; parallel/pipeline_spmd.py
+# + parallel/schedule.py)
+# ---------------------------------------------------------------------------
+PIPELINE = "pipeline"
+# number of pipeline stages (the `pipe` mesh axis size)
+PIPELINE_STAGES = "stages"
+# micro-batches per 1F1B batch; None = gradient_accumulation_steps when
+# > 1, else the stage count (a full pipeline)
+PIPELINE_MICRO_BATCHES = "micro_batches"
+# software-pipeline the p2p ppermutes against stage compute (wire
+# latency 2 — transfers hidden, fill/drain doubled; see
+# parallel/schedule.bubble_fraction)
+PIPELINE_COMM_OVERLAP = "comm_overlap"
+PIPELINE_COMM_OVERLAP_DEFAULT = False
+
+# ---------------------------------------------------------------------------
 # Inference block (serving engine; deeperspeed_tpu/inference)
 # ---------------------------------------------------------------------------
 INFERENCE = "inference"

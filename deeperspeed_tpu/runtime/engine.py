@@ -128,13 +128,29 @@ class DeepSpeedEngine:
                 "DeepSpeed requires --deepspeed_config or config_params")
 
         # --- mesh ---------------------------------------------------------
+        # The "pipeline" block changes the mesh SHAPE (a `pipe` axis),
+        # and the full config parse needs the data-parallel world the
+        # mesh defines — so the stage count is peeked from the raw dict
+        # here and validated by the strict parser right after.
+        peek_stages = self._peek_pipeline_stages(config_arg, config_params)
         if mesh is not None:
             self.mesh = mesh
         elif mpu is not None and hasattr(mpu, "mesh"):
             self.mesh = mpu.mesh
         else:
             devices = jax.devices()
-            topo = ProcessTopology(axes=[DATA_AXIS], dims=[len(devices)])
+            if peek_stages >= 2:
+                from ..parallel.mesh import PIPE_AXIS
+                if len(devices) % peek_stages:
+                    raise DeepSpeedConfigError(
+                        f"pipeline.stages = {peek_stages} does not "
+                        f"divide the device count {len(devices)}")
+                topo = ProcessTopology(
+                    axes=[PIPE_AXIS, DATA_AXIS],
+                    dims=[peek_stages, len(devices) // peek_stages])
+            else:
+                topo = ProcessTopology(axes=[DATA_AXIS],
+                                       dims=[len(devices)])
             self.mesh = build_mesh(topo, devices)
         self.data_axis = DATA_AXIS if DATA_AXIS in self.mesh.axis_names \
             else self.mesh.axis_names[-1]
@@ -171,6 +187,19 @@ class DeepSpeedEngine:
             param_persistence_threshold=(
                 self._config.zero_config.param_persistence_threshold),
             data_axis=self.data_axis)
+
+        # --- config-driven 1F1B pipeline (the "pipeline" block) -----------
+        # Wraps a stage-scannable model (GPTNeoX-style `to_pipe_spmd`
+        # hook) onto the compiled 1F1B executor over the `pipe` mesh
+        # axis. PipelineModule models keep their own path (PipelineEngine
+        # consumes the block's comm knobs itself).
+        self.pipeline_schedule = None
+        pipe_cfg = getattr(self._config, "pipeline_config", None)
+        if pipe_cfg is not None and not hasattr(self, "pipeline_module"):
+            model, model_parameters = self._wrap_pipeline_model(
+                model, model_parameters, pipe_cfg)
+            self.module_obj = model
+            self.loss_fn = self._resolve_model(model)
 
         # --- optimizer / schedulers --------------------------------------
         self.optimizer = self._configure_optimizer(optimizer)
@@ -432,6 +461,14 @@ class DeepSpeedEngine:
                 "model_parameters (a pytree of arrays) is required")
         self.state = self._init_state(model_parameters)
 
+        # --- explicit-dataflow ZeRO-3 schedule ----------------------------
+        # (after _init_state: the shard_map in/out specs are the leaf
+        # shardings _compute_shardings just derived)
+        self._explicit_zero3_loss = None
+        zsched = self._config.zero_config.schedule
+        if zsched.mode == "explicit":
+            self._configure_explicit_zero3(zsched)
+
         # --- bookkeeping --------------------------------------------------
         self.global_steps = 0
         self.global_samples = 0
@@ -551,6 +588,129 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # construction helpers
     # ------------------------------------------------------------------
+
+    @staticmethod
+    def _peek_pipeline_stages(config_arg, config_params):
+        """Raw-dict peek at pipeline.stages (mesh shape is decided before
+        the full parse; the strict parser validates right after)."""
+        d = None
+        if config_params is not None:
+            d = config_params
+        elif isinstance(config_arg, dict):
+            d = config_arg
+        elif isinstance(config_arg, str):
+            try:
+                import json
+                with open(config_arg) as f:
+                    d = json.load(f)
+            except (OSError, ValueError):
+                return 0   # the real parser reports the real error
+        if not isinstance(d, dict):
+            return 0
+        pipe = d.get("pipeline")
+        if not isinstance(pipe, dict):
+            return 0
+        try:
+            return int(pipe.get("stages", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _wrap_pipeline_model(self, model, model_parameters, pipe_cfg):
+        """Lower a stage-scannable model onto the compiled 1F1B executor
+        per the validated "pipeline" block: build/validate the `pipe`
+        mesh axis, wrap via the model's `to_pipe_spmd` hook, and convert
+        natural params to the stacked [L, ...] pipeline layout."""
+        from ..parallel.mesh import PIPE_AXIS
+        if not hasattr(model, "to_pipe_spmd"):
+            raise DeepSpeedConfigError(
+                "the 'pipeline' config block needs a model exposing "
+                "to_pipe_spmd(mesh, n_micro, ...) (models.gpt_neox."
+                "GPTNeoX implements it) or a PipelineModule")
+        stages = pipe_cfg["stages"]
+        if PIPE_AXIS not in self.mesh.axis_names or \
+                int(self.mesh.shape[PIPE_AXIS]) != stages:
+            have = {a: int(self.mesh.shape[a])
+                    for a in self.mesh.axis_names}
+            raise DeepSpeedConfigError(
+                f"pipeline.stages = {stages} needs a mesh with a "
+                f"'{PIPE_AXIS}' axis of that size; got {have} (pass no "
+                f"mesh to let the engine build [pipe, data], or build "
+                f"one with parallel.mesh.build_mesh)")
+        gas = self._config.gradient_accumulation_steps
+        n_micro = pipe_cfg["micro_batches"]
+        if n_micro is None:
+            # gas micro-batches when accumulating (the reference's
+            # micro_batches == gas identity), else fill the pipeline
+            n_micro = gas if gas > 1 else stages
+        wire_latency = 2 if pipe_cfg["comm_overlap"] else 1
+        if self._config.activation_checkpointing_config.active:
+            # the 1F1B backward recomputes each stage from its stashed
+            # boundary input by construction; the block's policy/span
+            # knobs do not shape the pipelined program
+            logger.warning(
+                "activation_checkpointing block with the pipeline "
+                "schedule: stage recompute is built into the 1F1B "
+                "executor — the remat policy/span knobs are ignored")
+        wrapped = model.to_pipe_spmd(self.mesh, n_micro,
+                                     wire_latency=wire_latency)
+        self.pipeline_schedule = {
+            "stages": stages,
+            "n_micro": int(n_micro),
+            "wire_latency": wire_latency,
+            "layout": "stacked",
+            "layers_per_stage": getattr(model, "config", None)
+            and model.config.num_layers // stages,
+        }
+        if model_parameters is not None:
+            converter = getattr(wrapped, "stack_natural_params", None)
+            if converter is None:
+                raise DeepSpeedConfigError(
+                    "model_parameters were provided but the pipelined "
+                    "wrapper cannot convert them; pass "
+                    "model_parameters=None to init from the wrapper")
+            model_parameters = converter(model_parameters)
+        return wrapped, model_parameters
+
+    def _configure_explicit_zero3(self, sched):
+        """Swap the ZeRO-3 hot loop from GSPMD sharding constraints to
+        the explicit shard_map collective schedule
+        (zero_optimization.schedule.mode = "explicit";
+        parallel/schedule.py). State layout, optimizer update and
+        checkpoints are untouched — only `_loss_and_grads` runs the
+        scheduled program, so trajectory parity with the GSPMD path
+        holds to float tolerance."""
+        if self.host_offload or self.param_offload:
+            raise DeepSpeedConfigError(
+                "zero_optimization.schedule.mode \"explicit\" is "
+                "unsupported with the offload tiers: their host-side "
+                "grad paths bypass the in-jit schedule (the run would "
+                "silently train unscheduled)")
+        if self._onebit_packed_active():
+            raise DeepSpeedConfigError(
+                "explicit schedule + packed-transport 1-bit optimizers "
+                "is unsupported (both own the whole-step shard_map)")
+        if self._config.pld_enabled:
+            raise DeepSpeedConfigError(
+                "explicit schedule + progressive_layer_drop is "
+                "unsupported (theta is not threaded through the "
+                "scheduled block scan)")
+        if not hasattr(self.module_obj, "build_explicit_zero3_loss"):
+            raise DeepSpeedConfigError(
+                "zero_optimization.schedule.mode \"explicit\" needs a "
+                "model exposing build_explicit_zero3_loss(...) "
+                "(models.gpt_neox.GPTNeoX implements it)")
+        for axis in self.mesh.axis_names:
+            if axis != self.data_axis and int(self.mesh.shape[axis]) > 1:
+                raise DeepSpeedConfigError(
+                    f"the explicit ZeRO-3 schedule runs over a pure "
+                    f"data-parallel mesh; axis {axis!r} has size "
+                    f"{int(self.mesh.shape[axis])}")
+        specs = jax.tree_util.tree_map(lambda sh: sh.spec, self._param_sh)
+        self._explicit_zero3_loss = self.module_obj.\
+            build_explicit_zero3_loss(
+                mesh=self.mesh, data_axis=self.data_axis,
+                param_specs=specs, param_padinfo=self._param_padinfo,
+                schedule=sched)
 
     @staticmethod
     def _resolve_model(model):
@@ -1128,6 +1288,15 @@ class DeepSpeedEngine:
         kw = {}
         if pld_theta is not None and self._pld_in_loss:
             kw["pld_theta"] = pld_theta
+
+        if getattr(self, "_explicit_zero3_loss", None) is not None:
+            # explicit shard_map ZeRO-3 (parallel/schedule.py): bucketed
+            # layer-ahead param gathers + reduce-scatters at layer-bwd
+            # boundaries are scheduled in the program, and the grads
+            # come back already in the stage-3 storage sharding — the
+            # GSPMD constraint below would be a no-op
+            return self._explicit_zero3_loss(params, batch, rng,
+                                             scale=scale)
 
         direct = getattr(self.loss_fn, "loss_and_grads", None)
         # gated on flat-padded params: the slow path's VJP through
@@ -1868,7 +2037,11 @@ class DeepSpeedEngine:
         names = [n for n, _ in plan.segments]
         carries, carry = [], None
         for k, name in enumerate(names):
-            dev = self._coord.fetch(name)
+            # fetch blocks until the segment's upload lands: that wait
+            # IS the compute stream stalling on parameters — charged to
+            # the goodput param_wait bucket (data_wait-style)
+            with self.telemetry.span("param_gather"):
+                dev = self._coord.fetch(name)
             if k + 1 < len(names):
                 self._coord.prefetch(names[k + 1])
             carries.append(carry)
@@ -1899,7 +2072,8 @@ class DeepSpeedEngine:
         ct = jnp.asarray(float(self.state.scale.cur_scale), jnp.float32)
         for k in range(len(names) - 1, -1, -1):
             name = names[k]
-            dev = self._coord.fetch(name)
+            with self.telemetry.span("param_gather"):
+                dev = self._coord.fetch(name)
             if k > 0:
                 self._coord.prefetch(names[k - 1])
             dparams, dcarry = self._seg_bwd[plan.kind(name)](
@@ -2293,6 +2467,13 @@ class DeepSpeedEngine:
             scalars["Train/Samples/step_time_ms"] = \
                 (now - self._last_step_stamp) * 1e3
         self._last_step_stamp = now
+        ps = getattr(self, "pipeline_schedule", None)
+        if ps:
+            # analytic 1F1B fill/drain share for the running schedule —
+            # the denominator for any measured overlap win
+            from ..parallel.schedule import bubble_fraction
+            scalars["Train/Pipe/bubble_fraction"] = bubble_fraction(
+                ps["stages"], ps["n_micro"], ps["wire_latency"])
         if self.peer_monitor is not None:
             # worst peer-heartbeat staleness: a rising series is a peer
             # going quiet BEFORE the fail threshold declares it dead

@@ -142,6 +142,37 @@ class PipelineEngine(DeepSpeedEngine):
         self.log_batch_step_id = -1
         self.agg_train_loss = None
 
+        # "pipeline" config block on a PipelineModule engine: stages come
+        # from the module (a disagreeing block is a config error, not a
+        # silent override); comm_overlap selects the software-pipelined
+        # p2p executor (wire latency 2 — parallel/schedule.py).
+        wire_latency = 1
+        pipe_cfg = getattr(self._config, "pipeline_config", None)
+        if pipe_cfg is not None and not self._spmd_pipelined:
+            raise ValueError(
+                "the 'pipeline' config block on a PipelineModule engine "
+                "needs a mesh with a 'pipe' axis passed at initialize() "
+                "(the module decided its stage layout before the engine "
+                "could build one); build it with parallel.mesh."
+                "build_mesh(axes=['pipe','data'], dims=[stages, dp])")
+        if pipe_cfg is not None:
+            if pipe_cfg["stages"] != model.num_stages:
+                raise ValueError(
+                    f"pipeline.stages = {pipe_cfg['stages']} but the "
+                    f"PipelineModule has {model.num_stages} stages; the "
+                    f"module owns the stage partitioning — drop the key "
+                    f"or make them agree")
+            if pipe_cfg["micro_batches"] is not None and \
+                    pipe_cfg["micro_batches"] != self.micro_batches:
+                raise ValueError(
+                    f"pipeline.micro_batches = "
+                    f"{pipe_cfg['micro_batches']} but this engine runs "
+                    f"micro_batches == gradient_accumulation_steps == "
+                    f"{self.micro_batches} (reference identity); drop "
+                    f"the key or change gradient_accumulation_steps")
+            if pipe_cfg["comm_overlap"]:
+                wire_latency = 2
+
         if self._spmd_pipelined:
             # The pipelined loss re-splits its input into the 1F1B micro
             # geometry; paths that feed one micro-batch at a time (manual
@@ -166,7 +197,18 @@ class PipelineEngine(DeepSpeedEngine):
                 else None,
                 fp32_comm=self._fp32_comm or None,
                 remat=True, packed_io=True,
-                param_templates=self._pipe_templates)
+                param_templates=self._pipe_templates,
+                wire_latency=wire_latency)
+            # telemetry: Train/Pipe/bubble_fraction + checkpoint manifest
+            # stage-partition metadata ride on this record
+            self.pipeline_schedule = {
+                "stages": self.num_stages,
+                "n_micro": max(self.micro_batches, 1),
+                "wire_latency": wire_latency,
+                "layout": "rows",
+                "layers_per_stage": None,
+                "parts": list(model.parts),
+            }
 
     # ------------------------------------------------------------------
     # packed-rows storage layout (pipelined engines): checkpoints and

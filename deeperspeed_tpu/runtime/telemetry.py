@@ -248,8 +248,8 @@ class SpanTracer:
 
 
 # goodput bucket names, in emission order
-GOODPUT_BUCKETS = ("productive", "data_wait", "ckpt_stall", "quarantined",
-                   "rollback")
+GOODPUT_BUCKETS = ("productive", "data_wait", "param_wait", "ckpt_stall",
+                   "quarantined", "rollback")
 
 
 class GoodputMeter:
@@ -257,21 +257,29 @@ class GoodputMeter:
 
     Every `account()` call covers one step window of `dt` seconds and
     splits it: data-fetch span time is always charged to `data_wait`;
-    checkpoint snapshot stall (the delta of the async manager's
-    cumulative stall inside this window) to `ckpt_stall`; the rest goes
-    to `productive` for taken steps, `quarantined` for in-jit skipped
-    updates (sentinel quarantine or fp16 overflow — either way the step
-    burned time without advancing), and `rollback` for windows that
-    ended in a checkpoint restore."""
+    host-visible parameter-fetch stalls (`param_gather` spans — the
+    offload tiers waiting on a segment upload; the in-jit explicit
+    ZeRO-3 gathers are scheduled/overlapped inside the program and show
+    up in device traces, not here) to `param_wait`; checkpoint snapshot
+    stall (the delta of the async manager's cumulative stall inside
+    this window) to `ckpt_stall`; the rest goes to `productive` for
+    taken steps, `quarantined` for in-jit skipped updates (sentinel
+    quarantine or fp16 overflow — either way the step burned time
+    without advancing), and `rollback` for windows that ended in a
+    checkpoint restore."""
 
     def __init__(self):
         self.buckets = {name: 0.0 for name in GOODPUT_BUCKETS}
 
-    def account(self, dt, verdict, data_wait=0.0, ckpt_stall=0.0):
+    def account(self, dt, verdict, data_wait=0.0, param_wait=0.0,
+                ckpt_stall=0.0):
         data_wait = min(max(data_wait, 0.0), dt)
-        ckpt_stall = min(max(ckpt_stall, 0.0), dt - data_wait)
-        rest = dt - data_wait - ckpt_stall
+        param_wait = min(max(param_wait, 0.0), dt - data_wait)
+        ckpt_stall = min(max(ckpt_stall, 0.0),
+                         dt - data_wait - param_wait)
+        rest = dt - data_wait - param_wait - ckpt_stall
         self.buckets["data_wait"] += data_wait
+        self.buckets["param_wait"] += param_wait
         self.buckets["ckpt_stall"] += ckpt_stall
         if verdict == "rollback":
             self.buckets["rollback"] += rest
@@ -492,6 +500,7 @@ class Telemetry:
 
         scalars = {}
         data_wait = phases.get("data_fetch", 0.0)
+        param_wait = phases.get("param_gather", 0.0)
         ckpt_delta = 0.0
         if self.goodput_enabled or self.fleet is not None:
             # checkpoint stall is shared by the goodput meter and the
@@ -505,6 +514,7 @@ class Telemetry:
         if self.goodput_enabled:
             self.goodput.account(dt, verdict,
                                  data_wait=data_wait,
+                                 param_wait=param_wait,
                                  ckpt_stall=ckpt_delta)
             scalars.update(self.goodput.scalars())
         if self.fleet is not None:

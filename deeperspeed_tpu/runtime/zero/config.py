@@ -15,8 +15,79 @@ Parsed into frozen dataclasses. The semantics on TPU:
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ...parallel.schedule import SCHEDULE_MODES, ScheduleConfig
 from ..config_utils import DeepSpeedConfigError, as_int, get_scalar_param
 from . import constants as zc
+
+
+def _parse_schedule_block(d, stage):
+    """Parse + validate ``zero_optimization.schedule`` at checkpoint-block
+    strictness (unknown keys / bad ranges raise at parse with the valid
+    choices listed). This is the explicit-dataflow schedule surface
+    (parallel/schedule.py): mode "explicit" swaps the ZeRO-3 hot loop
+    from GSPMD sharding constraints to the shard_map collective schedule
+    with layer-ahead prefetch; the knobs are shared with the pipeline
+    comm-overlap path."""
+    sched = d.get(zc.ZERO_OPTIMIZATION_SCHEDULE)
+    if sched is None:
+        sched = {}
+    if not isinstance(sched, dict):
+        # only None means "absent": a falsy wrong type ([] / 0 / false)
+        # must not silently fall back to the gspmd default
+        raise DeepSpeedConfigError(
+            f"zero_optimization.{zc.ZERO_OPTIMIZATION_SCHEDULE} must be "
+            f"a dict, got {sched!r}")
+    known = {"mode", "prefetch_depth", "bucket_mb", "group_layers",
+             "remat"}
+    unknown = sorted(set(sched) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'zero_optimization.schedule' key(s) {unknown}; "
+            f"valid keys: {sorted(known)}")
+    mode = str(sched.get("mode", "gspmd"))
+    if mode not in SCHEDULE_MODES:
+        raise DeepSpeedConfigError(
+            f"zero_optimization.schedule.mode must be one of "
+            f"{list(SCHEDULE_MODES)} (gspmd = partitioner-scheduled "
+            f"collectives, explicit = shard_map schedule with "
+            f"layer-ahead prefetch), got {mode!r}")
+    if mode == "explicit" and stage != 3:
+        raise DeepSpeedConfigError(
+            f"zero_optimization.schedule.mode \"explicit\" requires "
+            f"stage 3 (it schedules the stage-3 parameter all-gathers); "
+            f"got stage {stage}")
+    prefetch_depth = as_int(sched.get("prefetch_depth", 1),
+                            "zero_optimization.schedule.prefetch_depth")
+    if prefetch_depth < 1:
+        raise DeepSpeedConfigError(
+            f"zero_optimization.schedule.prefetch_depth must be >= 1 "
+            f"(layers gathered ahead of compute), got {prefetch_depth}")
+    try:
+        bucket_mb = float(sched.get("bucket_mb", 32))
+    except (TypeError, ValueError):
+        raise DeepSpeedConfigError(
+            f"zero_optimization.schedule.bucket_mb must be a number "
+            f"(max MB per all-gather bucket), got "
+            f"{sched.get('bucket_mb')!r}")
+    if not bucket_mb > 0:
+        raise DeepSpeedConfigError(
+            f"zero_optimization.schedule.bucket_mb must be > 0, got "
+            f"{bucket_mb}")
+    group_layers = as_int(sched.get("group_layers", 4),
+                          "zero_optimization.schedule.group_layers")
+    if group_layers < 1:
+        raise DeepSpeedConfigError(
+            f"zero_optimization.schedule.group_layers must be >= 1 "
+            f"(layers per remat/prefetch group), got {group_layers}")
+    remat = sched.get("remat", True)
+    if not isinstance(remat, bool):
+        raise DeepSpeedConfigError(
+            f"zero_optimization.schedule.remat must be a boolean "
+            f"(True = backward re-gathers params, False = keep gathered "
+            f"buffers as residuals), got {remat!r}")
+    return ScheduleConfig(mode=mode, prefetch_depth=prefetch_depth,
+                          bucket_mb=bucket_mb, group_layers=group_layers,
+                          remat=remat)
 
 
 @dataclass(frozen=True)
@@ -112,6 +183,9 @@ class DeepSpeedZeroConfig:
         zc.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)
     gather_fp16_weights_on_model_save: bool = (
         zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)
+    # explicit-dataflow collective schedule (parallel/schedule.py): the
+    # "schedule" sub-block is parsed at checkpoint-block strictness
+    schedule: ScheduleConfig = field(default_factory=ScheduleConfig)
 
     @property
     def enabled(self):
@@ -189,6 +263,20 @@ class DeepSpeedZeroConfig:
             d.get(zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
                   zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT))
 
+        # stage-3 scheduler knobs: bad values fail at parse (the knobs
+        # are latency hints on the GSPMD path but REAL geometry for the
+        # explicit schedule — a negative bucket must not reach it)
+        for key in (zc.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
+                    zc.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE,
+                    zc.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE,
+                    zc.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS,
+                    zc.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE,
+                    zc.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD):
+            if key in d and as_int(d[key], key) < 0:
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.{key} must be >= 0, got "
+                    f"{d[key]!r}")
+
         return cls(
             stage=stage,
             contiguous_gradients=bool(get_scalar_param(
@@ -239,4 +327,5 @@ class DeepSpeedZeroConfig:
             gather_fp16_weights_on_model_save=bool(get_scalar_param(
                 d, zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE,
                 zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)),
+            schedule=_parse_schedule_block(d, stage),
         )

@@ -65,6 +65,11 @@ ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT = 1_000_000_000
 ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE = "stage3_prefetch_bucket_size"
 ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT = 50_000_000
 
+# explicit-dataflow collective schedule sub-block (parallel/schedule.py):
+# {"mode": "gspmd"|"explicit", "prefetch_depth", "bucket_mb",
+#  "group_layers"} — parsed at checkpoint-block strictness
+ZERO_OPTIMIZATION_SCHEDULE = "schedule"
+
 ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD = "stage3_param_persistence_threshold"
 ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT = 100_000
 

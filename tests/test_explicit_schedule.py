@@ -1,0 +1,665 @@
+"""Explicit-dataflow schedule tests (parallel/schedule.py + the
+``zero_optimization.schedule`` / ``pipeline`` config blocks).
+
+Covers: bucketing math units; gather/rebuild round trips for every
+placement kind; fast-lane trajectory parity of explicit shard_map ZeRO-3
+vs GSPMD ZeRO-3 vs plain DP on the 8-device CPU mesh; prefetch-depth
+edge cases (depth > num_layers, ragged bucket tails, 1-layer groups);
+config-driven 2-stage 1F1B vs single-stage loss parity (both wire
+latencies, comm_overlap bit-identical to the classic schedule);
+compile-count pins (zero recompiles across microbatches); parse-time
+validation of the new blocks; the param_wait goodput bucket; the
+Train/Pipe/bubble_fraction scalar; and the pipeline stage-count guard on
+checkpoint resume.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import deeperspeed_tpu
+from deeperspeed_tpu.compat import shard_map
+from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+from deeperspeed_tpu.parallel.schedule import (
+    DIM_SHARDED, FLAT_SHARDED, REPLICATED, LayerPlan, ScheduleConfig,
+    bubble_fraction, gather_leaf, leaf_placement, plan_buckets,
+    prefetched_block_scan)
+from deeperspeed_tpu.runtime.config import DeepSpeedConfig
+from deeperspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+from deeperspeed_tpu.runtime.telemetry import GOODPUT_BUCKETS, GoodputMeter
+from deeperspeed_tpu.runtime.zero.partition_parameters import FlatPad
+
+STEPS = 3
+SEQ = 32
+BATCH = 16
+
+
+class Recorder:
+    def __init__(self):
+        self.records = []
+
+    def record(self, sample, scalars):
+        self.records.append((int(sample), dict(scalars)))
+
+    def series(self, key):
+        return [s[key] for _, s in self.records if key in s]
+
+
+def tiny_cfg(num_layers=4):
+    return GPTNeoXConfig(vocab_size=128, hidden_size=32, num_layers=num_layers,
+                         num_heads=4, max_seq_len=64)
+
+
+def _train(config_overrides, num_layers=4, steps=STEPS, seed=0,
+           return_engine=False):
+    cfg = tiny_cfg(num_layers)
+    model = GPTNeoX(cfg, use_pallas=False)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    config = {
+        "train_batch_size": BATCH,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10_000,
+    }
+    config.update(config_overrides)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=config)
+    rng = np.random.default_rng(1)
+    losses = []
+    for _ in range(steps):
+        toks = rng.integers(0, cfg.vocab_size, (1, BATCH, SEQ), np.int32)
+        losses.append(float(engine.train_batch(batch=(toks, toks))))
+    if return_engine:
+        return np.asarray(losses), engine
+    return np.asarray(losses)
+
+
+def explicit_zero3(sched):
+    z = {"stage": 3, "stage3_param_persistence_threshold": 0,
+         "schedule": dict(sched, mode="explicit")}
+    return {"zero_optimization": z}
+
+
+# ---------------------------------------------------------------------------
+# bucketing / placement units
+# ---------------------------------------------------------------------------
+
+class TestBucketMath:
+    def test_divisible(self):
+        assert plan_buckets(64, 4, 64) == [(0, 16), (16, 16), (32, 16),
+                                           (48, 16)]
+
+    def test_ragged_tail(self):
+        assert plan_buckets(67, 4, 64) == [(0, 16), (16, 16), (32, 16),
+                                           (48, 16), (64, 3)]
+
+    def test_one_bucket_when_large(self):
+        assert plan_buckets(67, 4, 1 << 30) == [(0, 67)]
+
+    def test_non_positive_is_whole_row(self):
+        assert plan_buckets(10, 4, 0) == [(0, 10)]
+
+    def test_empty_row(self):
+        assert plan_buckets(0, 4, 64) == []
+
+    def test_coverage_is_exact(self):
+        for size, bucket in [(1, 1), (7, 8), (129, 16), (1000, 48)]:
+            bks = plan_buckets(size, 4, bucket)
+            assert sum(s for _, s in bks) == size
+            assert bks[0][0] == 0
+            for (s0, n0), (s1, _) in zip(bks, bks[1:]):
+                assert s0 + n0 == s1
+
+
+class TestLeafPlacement:
+    def test_kinds(self):
+        assert leaf_placement((8, 16), jnp.float32, P(None, "data"), None,
+                              "data", 8).kind == DIM_SHARDED
+        assert leaf_placement((8,), jnp.float32, P(), None,
+                              "data", 8).kind == REPLICATED
+        pad = FlatPad((17,), 17, 24)
+        pl = leaf_placement((24,), jnp.float32, P("data"), pad, "data", 8)
+        assert pl.kind == FLAT_SHARDED and pl.local_shape == (3,)
+
+    def test_foreign_axis_rejected(self):
+        with pytest.raises(DeepSpeedConfigError, match="model"):
+            leaf_placement((8, 16), jnp.float32, P(None, "model"), None,
+                           "data", 8)
+
+
+class TestGatherRoundTrip:
+    @pytest.fixture(scope="class")
+    def mesh(self, devices):
+        return Mesh(np.asarray(devices[:8]), ("data",))
+
+    @pytest.mark.parametrize("shape,spec,dim", [
+        ((16, 6), P("data", None), 0),
+        ((6, 16), P(None, "data"), 1),
+        ((4, 8, 6), P(None, "data", None), 1),
+    ])
+    def test_dim_sharded(self, mesh, shape, spec, dim):
+        full = jnp.arange(int(np.prod(shape)),
+                          dtype=jnp.float32).reshape(shape)
+        placed = jax.device_put(full, NamedSharding(mesh, spec))
+        pl = leaf_placement(shape, jnp.float32, spec, None, "data", 8)
+
+        def body(local):
+            return gather_leaf(local, pl, "data", 8)
+
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                                out_specs=P(), check_vma=False))(placed)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+
+    def test_flat_padded(self, mesh):
+        pad = FlatPad((3, 7), 21, 24)
+        natural = jnp.arange(21, dtype=jnp.float32).reshape(3, 7)
+        flat = jnp.pad(jnp.ravel(natural), (0, 3))
+        placed = jax.device_put(flat, NamedSharding(mesh, P("data")))
+        pl = leaf_placement((24,), jnp.float32, P("data"), pad, "data", 8)
+
+        def body(local):
+            return gather_leaf(local, pl, "data", 8)
+
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                                out_specs=P(), check_vma=False))(placed)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(natural))
+
+
+# ---------------------------------------------------------------------------
+# explicit ZeRO-3: trajectory parity + prefetch edge cases (fast lane)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ddp_baseline():
+    return _train({})
+
+
+@pytest.fixture(scope="module")
+def gspmd_zero3():
+    return _train({"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 0}})
+
+
+class TestExplicitZero3Parity:
+    def test_gspmd_zero3_matches_ddp(self, ddp_baseline, gspmd_zero3):
+        np.testing.assert_allclose(gspmd_zero3, ddp_baseline,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_explicit_matches_gspmd_and_ddp(self, ddp_baseline,
+                                            gspmd_zero3):
+        got = _train(explicit_zero3({"prefetch_depth": 1,
+                                     "bucket_mb": 32,
+                                     "group_layers": 2}))
+        np.testing.assert_allclose(got, gspmd_zero3, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(got, ddp_baseline, rtol=2e-4, atol=2e-4)
+
+    def test_prefetch_depth_exceeds_num_layers(self, gspmd_zero3):
+        """depth > num_layers clamps to the group size — parity holds."""
+        got = _train(explicit_zero3({"prefetch_depth": 64,
+                                     "group_layers": 4}))
+        np.testing.assert_allclose(got, gspmd_zero3, rtol=2e-4, atol=2e-4)
+
+    def test_tiny_buckets_ragged_tails(self, gspmd_zero3):
+        """A bucket size far below the layer row forces many buckets
+        with a ragged tail; numerics are unchanged."""
+        got = _train(explicit_zero3({"prefetch_depth": 2,
+                                     "bucket_mb": 0.001,
+                                     "group_layers": 1}))
+        np.testing.assert_allclose(got, gspmd_zero3, rtol=2e-4, atol=2e-4)
+
+    def test_ragged_groups(self, gspmd_zero3):
+        """num_layers not divisible by group_layers falls back to the
+        unrolled-groups path."""
+        got = _train(explicit_zero3({"group_layers": 3}))  # 4 layers
+        np.testing.assert_allclose(got, gspmd_zero3, rtol=2e-4, atol=2e-4)
+
+    def test_no_remat_variant(self, gspmd_zero3):
+        """remat: false keeps gathered buffers as backward residuals —
+        same math, no re-gather (grad reduce-scatters still come from
+        the gather transposes)."""
+        got = _train(explicit_zero3({"group_layers": 2, "remat": False}))
+        np.testing.assert_allclose(got, gspmd_zero3, rtol=2e-4, atol=2e-4)
+
+    def test_zero_recompiles_across_steps(self):
+        """After the donated-state layouts settle (one known retrace on
+        step 2), further steps add no compiles."""
+        _, eng = _train(explicit_zero3({"group_layers": 2}), steps=2,
+                        return_engine=True)
+        assert len(eng._compiled_train) == 1
+        fn = next(iter(eng._compiled_train.values()))
+        settled = fn._cache_size()
+        toks = np.zeros((1, BATCH, SEQ), np.int32)
+        for _ in range(3):
+            eng.train_batch(batch=(toks, toks))
+        assert len(eng._compiled_train) == 1
+        assert fn._cache_size() == settled
+
+
+class TestExplicitZero3Rejections:
+    def test_explicit_requires_stage3(self):
+        with pytest.raises(DeepSpeedConfigError, match="stage 3"):
+            _train({"zero_optimization": {
+                "stage": 2, "schedule": {"mode": "explicit"}}})
+
+    def test_explicit_rejects_offload(self):
+        with pytest.raises(DeepSpeedConfigError, match="offload"):
+            _train({"zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu"},
+                "schedule": {"mode": "explicit"}}})
+
+    def test_explicit_needs_model_hook(self):
+        def loss_fn(params, batch, rng=None):
+            return jnp.mean(params["w"] ** 2)
+
+        with pytest.raises(DeepSpeedConfigError,
+                           match="build_explicit_zero3_loss"):
+            deeperspeed_tpu.initialize(
+                model=loss_fn,
+                model_parameters={"w": jnp.ones((64, 64), jnp.float32)},
+                config_params={
+                    "train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 3, "schedule": {"mode": "explicit"}}})
+
+
+class TestScheduleConfigValidation:
+    def _parse(self, sched, stage=3):
+        return DeepSpeedConfig(None, param_dict={
+            "train_batch_size": 8,
+            "zero_optimization": {"stage": stage, "schedule": sched}})
+
+    def test_defaults(self):
+        cfg = DeepSpeedConfig(None, param_dict={"train_batch_size": 8})
+        s = cfg.zero_config.schedule
+        assert s.mode == "gspmd" and s.prefetch_depth == 1
+        assert s.group_layers == 4 and s.bucket_mb == 32
+
+    def test_parsed_values(self):
+        s = self._parse({"mode": "explicit", "prefetch_depth": 3,
+                         "bucket_mb": 8, "group_layers": 6})
+        sc = s.zero_config.schedule
+        assert sc.mode == "explicit" and sc.prefetch_depth == 3
+        assert sc.bucket_bytes == 8 * 1024 * 1024
+
+    @pytest.mark.parametrize("sched,msg", [
+        ({"mode": "magic"}, "gspmd"),
+        ({"bogus_knob": 1}, "bogus_knob"),
+        ({"prefetch_depth": 0}, "prefetch_depth"),
+        ({"prefetch_depth": "two"}, "prefetch_depth"),
+        ({"bucket_mb": 0}, "bucket_mb"),
+        ({"bucket_mb": "big"}, "bucket_mb"),
+        ({"group_layers": 0}, "group_layers"),
+        ({"remat": "yes"}, "remat"),
+    ])
+    def test_bad_values_raise(self, sched, msg):
+        with pytest.raises(DeepSpeedConfigError, match=msg):
+            self._parse(sched)
+
+    def test_explicit_on_stage2_raises(self):
+        with pytest.raises(DeepSpeedConfigError, match="stage 3"):
+            self._parse({"mode": "explicit"}, stage=2)
+
+    @pytest.mark.parametrize("bad", [[], 0, False, "explicit"])
+    def test_falsy_wrong_types_raise(self, bad):
+        """A falsy wrong-typed block must not silently parse as the
+        gspmd default (the 'silently train unscheduled' failure)."""
+        with pytest.raises(DeepSpeedConfigError, match="dict"):
+            self._parse(bad)
+
+
+# ---------------------------------------------------------------------------
+# pipeline block: config validation
+# ---------------------------------------------------------------------------
+
+class TestPipelineConfigValidation:
+    def _parse(self, pipe, extra=None):
+        d = {"train_batch_size": 8, "pipeline": pipe}
+        if extra:
+            d.update(extra)
+        return DeepSpeedConfig(None, param_dict=d)
+
+    def test_parsed(self):
+        cfg = self._parse({"stages": 2, "micro_batches": 4,
+                           "comm_overlap": True})
+        assert cfg.pipeline_config == {"stages": 2, "micro_batches": 4,
+                                       "comm_overlap": True}
+
+    def test_absent_is_none(self):
+        cfg = DeepSpeedConfig(None, param_dict={"train_batch_size": 8})
+        assert cfg.pipeline_config is None
+
+    @pytest.mark.parametrize("pipe,msg", [
+        ({"stages": 1}, "stages"),
+        ({"micro_batches": 4}, "stages"),
+        ({"stages": 2, "micro_batches": 0}, "micro_batches"),
+        ({"stages": 2, "comm_overlap": "yes"}, "comm_overlap"),
+        ({"stages": 2, "bogus": 1}, "bogus"),
+        ({"stages": "two"}, "stages"),
+    ])
+    def test_bad_values_raise(self, pipe, msg):
+        with pytest.raises(DeepSpeedConfigError, match=msg):
+            self._parse(pipe)
+
+    @pytest.mark.parametrize("extra,msg", [
+        ({"zero_optimization": {"stage": 2}}, "stage"),
+        ({"zero_optimization": {
+            "stage": 1, "offload_optimizer": {"device": "cpu"}}},
+         "offload"),
+        ({"zero_optimization": {
+            "stage": 1,
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": "/tmp/x"}}},
+         "streamed-NVMe"),
+        ({"moe": {"num_experts": 4}}, "moe"),
+        ({"packing": {"enabled": True}}, "packing"),
+        ({"progressive_layer_drop": {"enabled": True}}, "progressive"),
+    ])
+    def test_unsupported_combos_reject(self, extra, msg):
+        with pytest.raises(DeepSpeedConfigError, match=msg):
+            self._parse({"stages": 2}, extra)
+
+
+# ---------------------------------------------------------------------------
+# config-driven 1F1B pipeline (marker: pipeline)
+# ---------------------------------------------------------------------------
+
+pipeline_mark = pytest.mark.pipeline
+
+
+@pipeline_mark
+class TestPipelineSchedule:
+    @pytest.fixture(scope="class")
+    def single_stage(self):
+        return _train({}, num_layers=2)
+
+    def test_two_stage_matches_single(self, single_stage):
+        got, eng = _train({"pipeline": {"stages": 2, "micro_batches": 4}},
+                          num_layers=2, return_engine=True)
+        np.testing.assert_allclose(got, single_stage, rtol=2e-4,
+                                   atol=2e-4)
+        assert eng.pipeline_schedule["stages"] == 2
+        assert eng.pipeline_schedule["wire_latency"] == 1
+
+    def test_comm_overlap_bit_identical(self):
+        """wire_latency=2 is pure reordering: the same per-micro
+        computations, so losses match the classic schedule exactly."""
+        base = _train({"pipeline": {"stages": 2, "micro_batches": 4}},
+                      num_layers=2)
+        got, eng = _train({"pipeline": {"stages": 2, "micro_batches": 4,
+                                        "comm_overlap": True}},
+                          num_layers=2, return_engine=True)
+        np.testing.assert_array_equal(got, base)
+        assert eng.pipeline_schedule["wire_latency"] == 2
+
+    def test_zero_recompiles_across_microbatches(self):
+        """One compiled program regardless of how many micro-batches
+        flow through the 1F1B scan: after the donated-state layouts
+        settle, further steps add no compiles."""
+        _, eng = _train({"pipeline": {"stages": 2, "micro_batches": 4}},
+                        num_layers=2, steps=2, return_engine=True)
+        assert len(eng._compiled_train) == 1
+        fn = next(iter(eng._compiled_train.values()))
+        settled = fn._cache_size()
+        toks = np.zeros((1, BATCH, SEQ), np.int32)
+        for _ in range(3):
+            eng.train_batch(batch=(toks, toks))
+        assert len(eng._compiled_train) == 1
+        assert fn._cache_size() == settled
+
+    def test_four_stage_trains(self, single_stage):
+        got = _train({"pipeline": {"stages": 4}}, num_layers=4,
+                     steps=STEPS)
+        base4 = _train({}, num_layers=4)
+        np.testing.assert_allclose(got, base4, rtol=2e-4, atol=2e-4)
+
+    def test_bubble_fraction_scalar_emitted(self):
+        _, eng = _train({"pipeline": {"stages": 2, "micro_batches": 4}},
+                        num_layers=2, steps=2, return_engine=True)
+        rec = Recorder()
+        eng.monitor = rec
+        toks = np.zeros((1, BATCH, SEQ), np.int32)
+        eng.train_batch(batch=(toks, toks))
+        series = rec.series("Train/Pipe/bubble_fraction")
+        assert series and series[0] == pytest.approx(
+            bubble_fraction(2, 4, 1))
+
+    def test_same_stage_resume_bit_exact(self, tmp_path):
+        """save -> load at the SAME stage count continues the exact
+        trajectory (stacked-layout params + pipe-sharded masters round-
+        trip through the natural-layout checkpoint)."""
+        conf = {"train_batch_size": BATCH,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 10_000,
+                "pipeline": {"stages": 2, "micro_batches": 4}}
+        cfg = tiny_cfg(2)
+        toks = np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (1, BATCH, SEQ), np.int32)
+
+        def mk():
+            m = GPTNeoX(cfg, use_pallas=False)
+            p = m.init_params(jax.random.PRNGKey(0))
+            e, *_ = deeperspeed_tpu.initialize(
+                model=m, model_parameters=p, config_params=conf)
+            return e
+
+        ref = mk()
+        for _ in range(2):
+            ref.train_batch(batch=(toks, toks))
+        expected = float(ref.train_batch(batch=(toks, toks)))
+
+        saver = mk()
+        for _ in range(2):
+            saver.train_batch(batch=(toks, toks))
+        saver.save_checkpoint(str(tmp_path), tag="pipe-resume")
+
+        resumed = mk()
+        path, _ = resumed.load_checkpoint(str(tmp_path),
+                                          tag="pipe-resume")
+        assert path is not None
+        got = float(resumed.train_batch(batch=(toks, toks)))
+        assert got == expected
+
+    def test_cross_layout_guard_on_resume(self, tmp_path):
+        """A stacked-layout pipeline checkpoint does not load into a
+        sequential engine: the stacked [L, ...] tree IS the disk
+        layout, structurally different from the per-layer list — the
+        guard must name the mismatch instead of failing deep in tree
+        matching."""
+        _, eng = _train({"pipeline": {"stages": 2, "micro_batches": 4},
+                         "checkpoint": {"save_dir": str(tmp_path)}},
+                        num_layers=2, steps=2, return_engine=True)
+        eng.save_checkpoint(str(tmp_path), tag="pipe2")
+
+        from deeperspeed_tpu.elasticity.config import TopologyChangeError
+        cfg = tiny_cfg(2)
+        model = GPTNeoX(cfg, use_pallas=False)
+        params = model.init_params(jax.random.PRNGKey(0))
+        fresh, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params={
+                "train_batch_size": BATCH,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 10_000})
+        with pytest.raises(TopologyChangeError, match="pipeline"):
+            fresh.load_checkpoint(str(tmp_path), tag="pipe2")
+
+    def test_stage_count_change_resumes(self, tmp_path):
+        """Stage-count changes WITHIN the stacked layout re-partition
+        through the natural checkpoint (the pipe axis absorbs like a dp
+        change): a 2-stage save restores into a 4-stage engine with
+        identical params."""
+        _, eng = _train({"pipeline": {"stages": 2, "micro_batches": 4}},
+                        num_layers=4, steps=2, return_engine=True)
+        eng.save_checkpoint(str(tmp_path), tag="pipe2to4")
+        saved_params = jax.tree_util.tree_map(
+            np.asarray, eng.params_to_natural(eng.state.params))
+
+        cfg = tiny_cfg(4)
+        model = GPTNeoX(cfg, use_pallas=False)
+        params = model.init_params(jax.random.PRNGKey(3))
+        four, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params={
+                "train_batch_size": BATCH,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 10_000,
+                "pipeline": {"stages": 4, "micro_batches": 4}})
+        path, _ = four.load_checkpoint(str(tmp_path), tag="pipe2to4")
+        assert path is not None
+        got = jax.tree_util.tree_map(
+            np.asarray, four.params_to_natural(four.state.params))
+        jax.tree_util.tree_map(np.testing.assert_array_equal,
+                               saved_params, got)
+        toks = np.zeros((1, BATCH, SEQ), np.int32)
+        assert np.isfinite(float(four.train_batch(batch=(toks, toks))))
+
+
+@pipeline_mark
+class TestPipelineEngineWiring:
+    def test_stages_must_divide_devices(self):
+        with pytest.raises(DeepSpeedConfigError, match="divide"):
+            _train({"pipeline": {"stages": 3}}, num_layers=3)
+
+    def test_layers_must_divide_stages(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            _train({"pipeline": {"stages": 2}}, num_layers=3)
+
+    def test_model_without_hook_rejected(self):
+        def loss_fn(params, batch, rng=None):
+            return jnp.mean(params["w"] ** 2)
+
+        with pytest.raises(DeepSpeedConfigError, match="to_pipe_spmd"):
+            deeperspeed_tpu.initialize(
+                model=loss_fn,
+                model_parameters={"w": jnp.ones((8, 8), jnp.float32)},
+                config_params={
+                    "train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "pipeline": {"stages": 2}})
+
+
+@pipeline_mark
+def test_module_pipeline_comm_overlap_matches(devices):
+    """PipelineModule engines consume the block's comm_overlap knob:
+    the wire-latency-2 executor matches the classic one exactly on a
+    heterogeneous LayerSpec pipeline."""
+    from tests.simple_model import random_batches, simple_pipeline_module
+    mesh = Mesh(np.asarray(devices[:2]).reshape(2, 1), ("pipe", "data"))
+
+    def mk(overlap):
+        module = simple_pipeline_module(num_layers=4, dim=16,
+                                        num_stages=2)
+        params = module.init_params(
+            jax.random.PRNGKey(0),
+            example_input=np.zeros((1, 16), np.float32))
+        cfg = {"train_batch_size": 16,
+               "gradient_accumulation_steps": 2,
+               "steps_per_print": 10_000,
+               "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+               "pipeline": {"stages": 2, "comm_overlap": overlap}}
+        eng, *_ = deeperspeed_tpu.initialize(
+            model=module, model_parameters=params, config_params=cfg,
+            mesh=mesh)
+        return eng
+
+    base, over = mk(False), mk(True)
+    assert base._spmd_pipelined and over._spmd_pipelined
+    it1 = random_batches(12, 8, 16, seed=3)
+    it2 = random_batches(12, 8, 16, seed=3)
+    l_base = [float(base.train_batch(data_iter=it1)) for _ in range(4)]
+    l_over = [float(over.train_batch(data_iter=it2)) for _ in range(4)]
+    np.testing.assert_array_equal(l_base, l_over)
+
+
+@pipeline_mark
+@pytest.mark.slow
+def test_pipeline_soak_long_run():
+    """Multi-stage soak: a longer 4-stage comm-overlap run stays on the
+    single-stage trajectory (the slow pairing the `pipeline` marker
+    exists for)."""
+    base = _train({}, num_layers=4, steps=8)
+    got = _train({"pipeline": {"stages": 4, "micro_batches": 8,
+                               "comm_overlap": True}},
+                 num_layers=4, steps=8)
+    np.testing.assert_allclose(got, base, rtol=5e-4, atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# goodput param_wait bucket + bubble math
+# ---------------------------------------------------------------------------
+
+class TestParamWaitBucket:
+    def test_bucket_registered(self):
+        assert "param_wait" in GOODPUT_BUCKETS
+
+    def test_accounting(self):
+        m = GoodputMeter()
+        m.account(1.0, "ok", data_wait=0.2, param_wait=0.3,
+                  ckpt_stall=0.1)
+        assert m.buckets["param_wait"] == pytest.approx(0.3)
+        assert m.buckets["productive"] == pytest.approx(0.4)
+        s = m.scalars()
+        assert s["Train/Goodput/param_wait_s"] == pytest.approx(0.3)
+
+    def test_clamped_after_data_wait(self):
+        m = GoodputMeter()
+        m.account(1.0, "ok", data_wait=0.8, param_wait=0.9)
+        assert m.buckets["param_wait"] == pytest.approx(0.2)
+        assert m.buckets["productive"] == pytest.approx(0.0)
+
+
+class TestBubbleFraction:
+    def test_classic(self):
+        assert bubble_fraction(4, 12, 1) == pytest.approx(3 / 15)
+
+    def test_overlapped(self):
+        assert bubble_fraction(4, 12, 2) == pytest.approx(6 / 18)
+
+    def test_single_stage_is_zero(self):
+        assert bubble_fraction(1, 8, 1) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# substrate: prefetched scan against a plain layer loop
+# ---------------------------------------------------------------------------
+
+class TestPrefetchedScanUnit:
+    def test_matches_plain_loop(self, devices):
+        mesh = Mesh(np.asarray(devices[:8]), ("data",))
+        rng = np.random.default_rng(0)
+        L, H = 5, 16
+        blocks = [{"w": jnp.asarray(rng.normal(size=(H, H)), jnp.float32),
+                   "b": jnp.asarray(rng.normal(size=(H,)), jnp.float32)}
+                  for _ in range(L)]
+        specs = {"w": P(None, "data"), "b": P()}
+        pads = {"w": False, "b": False}
+        plan = LayerPlan(blocks[0], specs, pads, "data", 8, 64)
+        x0 = jnp.asarray(rng.normal(size=(32, H)), jnp.float32)
+
+        def block_fn(bp, x):
+            return x + jnp.tanh(x @ bp["w"]) + bp["b"]
+
+        ref = x0
+        for bp in blocks:
+            ref = block_fn(bp, ref)
+
+        placed = [
+            {"w": jax.device_put(bp["w"],
+                                 NamedSharding(mesh, P(None, "data"))),
+             "b": jax.device_put(bp["b"], NamedSharding(mesh, P()))}
+            for bp in blocks]
+        in_specs = ([specs] * L, P("data", None))
+
+        def body(blks, x):
+            leaves = [jax.tree_util.tree_flatten(bp)[0] for bp in blks]
+            return prefetched_block_scan(block_fn, x, leaves, plan, L,
+                                         prefetch_depth=2,
+                                         group_layers=2)
+
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
+                                out_specs=P("data", None),
+                                check_vma=False))(placed, x0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
