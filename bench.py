@@ -38,6 +38,13 @@ KEYS (default all):
              request stream through the InferenceEngine's paged KV
              cache; generated tokens/s/chip + p50/p99 per-token latency
              + zero-recompile check; opt-in via DS_BENCH_SERVE=1)
+  - serve_chaos (serving-under-failure row: the serve stream run clean
+             and again under a scripted fault storm — injected decode
+             errors, a decode stall, page-pool pressure — against a
+             bounded admission queue; success rate, shed fraction, p99
+             TTFT degradation storm-vs-clean, and the chaos invariants
+             (server up, zero leaked pages, zero post-warmup
+             recompiles); opt-in via DS_BENCH_SERVE_CHAOS=1)
   - elastic  (supervised-restart recovery: a hard mid-run kill under the
              elasticity supervisor — kill -> resumed-step wall clock
              (MTTR) and steps lost vs the committed checkpoint; opt-in
@@ -68,7 +75,8 @@ import numpy as np
 ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
-               "moe": 800, "serve": 800, "zero3": 800, "pipe": 900,
+               "moe": 800, "serve": 800, "serve_chaos": 900,
+               "zero3": 800, "pipe": 900,
                "elastic": 600, "fleet": 600}  # moe/longseq walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
@@ -1228,6 +1236,147 @@ def row_serve():
                    "serve")
 
 
+def row_serve_chaos():
+    """Serving-under-failure row (opt-in DS_BENCH_SERVE_CHAOS=1): the
+    fixed-seed open-loop serve stream run twice — CLEAN (robustness
+    layer on, no faults firing) and under a scripted FAULT STORM
+    (injected decode errors, a decode stall, page-pool pressure)
+    against a bounded admission queue. Reports per-variant success
+    rate, shed fraction, and p99 TTFT (plus the storm-vs-clean p99
+    TTFT degradation), and pins the chaos invariants: the server never
+    exits, every accepted request reaches exactly one terminal status,
+    zero KV pages leak, zero post-warmup recompiles."""
+    jax = _setup_jax()
+    cfg, model, params = _headline_setup(jax)
+
+    def run(n_req, faults, prefix):
+        def thunk():
+            from deeperspeed_tpu.inference import (InferenceEngine,
+                                                   RequestRejected)
+            max_batch = int(os.environ.get("DS_BENCH_SERVE_BATCH", "16"))
+            max_new = int(os.environ.get("DS_BENCH_SERVE_NEW", "64"))
+            block = {
+                "enabled": True, "page_size": 64,
+                "num_pages": int(os.environ.get("DS_BENCH_SERVE_PAGES",
+                                                "513")),
+                "max_batch_size": max_batch, "token_budget": 2048,
+                "prefill_batch_sizes": [4],
+                "decode_batch_sizes": [max_batch],
+                "admission": {"max_queue_depth": int(os.environ.get(
+                    "DS_BENCH_SERVE_CHAOS_QUEUE", "24"))},
+                "retry": {"max_attempts": 3, "backoff_base_ms": 5,
+                          "backoff_cap_ms": 50, "jitter": 0.25},
+            }
+            if faults:
+                block["fault_injection"] = {"faults": faults}
+            eng = InferenceEngine(model, config={"inference": block},
+                                  params=params)
+            rng = np.random.default_rng(0)
+            hi = min(768, eng.prefill_lengths[-1],
+                     eng.max_seq_len - max_new)
+            lens = np.clip(np.exp(rng.normal(5.0, 0.8, size=n_req)),
+                           8, hi).astype(int)
+            prompts = [list(rng.integers(1, cfg.vocab_size, size=int(n)))
+                       for n in lens]
+            eng.generate([list(rng.integers(1, cfg.vocab_size, size=b - 2))
+                          for b in eng.prefill_lengths], max_new_tokens=2)
+            compiled_warm = eng.compile_count()
+            base = {k: eng.stats[k] for k in
+                    ("requests_ok", "requests_deadline_exceeded",
+                     "requests_failed")}
+
+            submit_at, first_tok = {}, {}
+            shed = 0
+            submitted = 0
+            step = 0
+            died = None
+            t_start = time.perf_counter()
+            while submitted < len(prompts) or eng.scheduler.has_work:
+                while submitted < len(prompts) and submitted * 2 <= step:
+                    try:
+                        rid = eng.submit(prompts[submitted],
+                                         max_new_tokens=max_new)
+                        submit_at[rid] = time.perf_counter()
+                    except RequestRejected:
+                        shed += 1
+                    submitted += 1
+                if eng.scheduler.has_work:
+                    try:
+                        eng.step()
+                    except BaseException as e:  # noqa: BLE001
+                        died = f"{type(e).__name__}: {e}"
+                        break
+                now = time.perf_counter()
+                for r in list(eng.scheduler.running) + \
+                        eng.scheduler.finished:
+                    rid = r.request_id
+                    if rid in submit_at and rid not in first_tok and \
+                            r.generated:
+                        first_tok[rid] = now - submit_at[rid]
+                step += 1
+                if time.perf_counter() - t_start > 600:
+                    died = "stream timed out"
+                    break
+            gen = sum(len(r.generated) for r in eng.scheduler.finished
+                      if r.request_id in submit_at)
+            dt = time.perf_counter() - t_start
+            accepted = len(submit_at)
+            terminal = sum(eng.stats[k] - base[k] for k in base)
+            ttft = sorted(first_tok.values())
+
+            def pct(vals, q):
+                if not vals:
+                    return None
+                return round(float(np.percentile(np.asarray(vals), q))
+                             * 1e3, 2)
+
+            return {
+                f"{prefix}requests": submitted,
+                f"{prefix}success_rate": round(
+                    (eng.stats["requests_ok"] - base["requests_ok"]) /
+                    max(submitted, 1), 4),
+                f"{prefix}shed_fraction": round(
+                    shed / max(submitted, 1), 4),
+                f"{prefix}ttft_p50_ms": pct(ttft, 50),
+                f"{prefix}ttft_p99_ms": pct(ttft, 99),
+                f"{prefix}tokens_per_s": round(gen / dt, 1),
+                f"{prefix}quarantines": eng.stats["quarantines"],
+                f"{prefix}evictions": eng.stats["evictions"],
+                # invariants — all must hold for the row to mean anything
+                f"{prefix}server_up": died is None,
+                f"{prefix}died": died,
+                f"{prefix}all_terminal": terminal == accepted,
+                f"{prefix}pages_leaked":
+                    (eng.cache.num_pages - 1) - eng.cache.num_free,
+                f"{prefix}compile_delta":
+                    eng.compile_count() - compiled_warm,
+            }
+        return thunk
+
+    n0 = int(os.environ.get("DS_BENCH_SERVE_REQUESTS", "64"))
+    # the storm script scales with the stream: errors early and late,
+    # a stall mid-stream, pool pressure across a burst window
+    storm = [
+        {"kind": "decode_error", "step": 40, "times": 2},
+        {"kind": "decode_error", "step": 120, "times": 1},
+        {"kind": "decode_stall", "step": 80, "seconds": 0.05},
+        {"kind": "page_pool_pressure", "step": 60, "times": 5,
+         "factor": 0.7},
+    ]
+    out = {}
+    _ladder([("clean", run(n0, None, "chaos_clean_"))], out,
+            "serve_chaos_clean")
+    _ladder([("storm", run(n0, storm, "chaos_storm_"))], out,
+            "serve_chaos_storm")
+    p99c = out.get("chaos_clean_ttft_p99_ms")
+    p99s = out.get("chaos_storm_ttft_p99_ms")
+    if p99c and p99s:
+        # the headline number: how much tail TTFT the fault storm costs
+        out["chaos_ttft_p99_degradation_pct"] = round(
+            (p99s - p99c) / p99c * 100.0, 1)
+    return out
+
+
 _ELASTIC_WORKER = '''
 import json, os, sys, time
 workdir, target, crash = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
@@ -1350,6 +1499,7 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
            "sentinel": row_sentinel, "telemetry": row_telemetry,
            "packed": row_packed, "serve": row_serve,
+           "serve_chaos": row_serve_chaos,
            "elastic": row_elastic, "fleet": row_fleet,
            "pipe": row_pipe}
 
@@ -1373,6 +1523,9 @@ def rows_enabled():
         order.append("packed")
     if os.environ.get("DS_BENCH_SERVE", "0") not in ("0", "", "false"):
         order.append("serve")
+    if os.environ.get("DS_BENCH_SERVE_CHAOS", "0") not in \
+            ("0", "", "false"):
+        order.append("serve_chaos")
     if os.environ.get("DS_BENCH_ELASTIC", "0") not in ("0", "", "false"):
         order.append("elastic")
     if os.environ.get("DS_BENCH_FLEET", "0") not in ("0", "", "false"):
@@ -1387,7 +1540,7 @@ def rows_enabled():
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
-                   "elastic", "fleet", "pipe"):
+                   "serve_chaos", "elastic", "fleet", "pipe"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

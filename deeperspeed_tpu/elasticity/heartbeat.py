@@ -513,12 +513,21 @@ def build_peer_monitor(params, step_fn=None):
     import jax
 
     from ..utils.distributed import _distributed_client
+    from ..utils.kv_retry import wrap_kv_transport
     transport = None
     peers = ()
     if jax.process_count() > 1:
         client = _distributed_client()
         if client is not None:
-            transport = CoordinationTransport(client)
+            # shared retry policy (utils/kv_retry.py): transient KV
+            # blips are retried with capped backoff × jitter;
+            # PERSISTENT failure still raises into poll_once — the
+            # monitor's continuous-outage escalation (declare the
+            # coordination service dead after fail_after_s) depends on
+            # seeing it, so heartbeats never degrade-to-local
+            transport = wrap_kv_transport(
+                CoordinationTransport(client), degrade_to_local=False,
+                name="peer-health heartbeat")
             peers = [str(i) for i in range(jax.process_count())]
         else:  # pragma: no cover - private-API drift
             logger.warning(

@@ -121,15 +121,13 @@ class Supervisor:
     # -- policy ------------------------------------------------------------
 
     def backoff_s(self, attempt):
-        """Backoff before restart `attempt` (1-based): capped
-        exponential, scaled by a uniform jitter in
-        [1 - jitter, 1 + jitter]."""
-        base = min(self.backoff_base_s * (2.0 ** (attempt - 1)),
-                   self.backoff_max_s)
-        if self.backoff_jitter:
-            base *= 1.0 + self._rng.uniform(-self.backoff_jitter,
-                                            self.backoff_jitter)
-        return max(base, 0.0)
+        """Backoff before restart `attempt` (1-based): the shared
+        capped-exponential × uniform-jitter law
+        (`utils.kv_retry.backoff_delay`)."""
+        from ..utils.kv_retry import backoff_delay
+        return backoff_delay(attempt, self.backoff_base_s,
+                             self.backoff_max_s, self.backoff_jitter,
+                             self._rng)
 
     def _record_crash_step(self):
         progress = read_progress(self.state_dir)

@@ -7,11 +7,21 @@ Pallas paged decode-attention kernel (`docs/inference.md`).
   host-side allocator.
 - `ContinuousBatchingScheduler` / `Request` — per-step admission and
   eviction under a token + page budget.
+- `AdmissionController` + the typed request-terminal errors
+  (`RequestRejected` / `DeadlineExceeded` / `RequestFailed` /
+  `DrainAborted`) — the SLO-aware robustness layer
+  (docs/inference.md "Serving under failure").
 """
 
+from .admission import (AdmissionController, DeadlineExceeded,
+                        DrainAborted, PRIORITIES, RequestFailed,
+                        RequestRejected, REQUEST_STATUSES)
 from .engine import InferenceEngine
 from .kv_cache import PagedKVCache, pages_for_tokens
 from .scheduler import ContinuousBatchingScheduler, Request, StepPlan
 
 __all__ = ["InferenceEngine", "PagedKVCache", "pages_for_tokens",
-           "ContinuousBatchingScheduler", "Request", "StepPlan"]
+           "ContinuousBatchingScheduler", "Request", "StepPlan",
+           "AdmissionController", "RequestRejected", "DeadlineExceeded",
+           "RequestFailed", "DrainAborted", "PRIORITIES",
+           "REQUEST_STATUSES"]

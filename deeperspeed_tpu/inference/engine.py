@@ -34,6 +34,7 @@ config seed folded with the step counter — the same request stream
 always produces the same tokens.
 """
 
+import random
 import time
 import types
 from functools import partial
@@ -52,10 +53,17 @@ from ..ops.pallas.decode_attention import paged_decode_attention
 from ..parallel.mesh import MODEL_AXIS
 from ..runtime.config import DeepSpeedConfig, parse_inference_block
 from ..runtime.config_utils import (DeepSpeedConfigError, load_config_json)
+from ..runtime.fault_injection import (FaultInjector, InjectedServingFault,
+                                       SERVING_FAULT_KINDS)
 from ..runtime.precision import resolve_precision
+from ..utils.kv_retry import backoff_delay
+from ..utils.logging import logger
+from .admission import (AdmissionController, DrainAborted, RequestFailed,
+                        validate_priority)
 from .kv_cache import PagedKVCache, pages_for_tokens
-from .metrics import ServeRequestMetrics
-from .scheduler import FINISHED, ContinuousBatchingScheduler, Request
+from .metrics import REQUEST_STATUS_FAMILIES, ServeRequestMetrics
+from .scheduler import (FINISHED, RUNNING, ContinuousBatchingScheduler,
+                        Request)
 
 
 def _pow2_ladder(lo, hi):
@@ -265,7 +273,13 @@ class InferenceEngine:
                       "evictions": 0, "finished": 0,
                       "schedule_s": 0.0, "prefill_s": 0.0,
                       "decode_s": 0.0, "admission_wait_s": 0.0,
-                      "queue_depth": 0.0, "page_pool_util": 0.0}
+                      "queue_depth": 0.0, "page_pool_util": 0.0,
+                      # terminal-status taxonomy: every request reaches
+                      # exactly one (docs/inference.md)
+                      "requests_ok": 0, "requests_shed": 0,
+                      "requests_deadline_exceeded": 0,
+                      "requests_failed": 0,
+                      "quarantines": 0, "retries": 0}
         # request-level latency histograms (inference/metrics.py):
         # admission-wait / TTFT / inter-token distributions, fanned out
         # to the monitor's export backends (Prometheus histogram
@@ -278,6 +292,26 @@ class InferenceEngine:
         self._drain_requested = False
         self._drain_signum = None
         self._prev_handlers = {}
+
+        # -- robustness layer (docs/inference.md "Serving under
+        #    failure"): admission control, retry/poison policy, hang
+        #    watchdog, serving fault injection -------------------------
+        self.default_priority = ip["default_priority"]
+        self.retry_params = ip["retry"]
+        self._retry_rng = random.Random(ip["seed"])
+        self.admission = (AdmissionController(ip["admission"])
+                          if ip["admission"] else None)
+        self.fault_injector = FaultInjector.from_config_env(
+            config_spec=ip["fault_injection"])
+        self._step_faults = []      # serving faults fired this step
+        self._pressure_pages = []   # page_pool_pressure seizures
+        self.watchdog = None
+        self.watchdog_fires = 0
+        self.last_stack_dump = None
+        if ip["hang_timeout_s"] > 0:
+            from ..runtime.sentinel import HangWatchdog
+            self.watchdog = HangWatchdog(ip["hang_timeout_s"], self,
+                                         "_on_serving_hang")
 
     # ------------------------------------------------------------------
     # weights
@@ -479,11 +513,50 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, eos_token_id=None,
-               request_id=None):
-        """Enqueue one request; returns its id."""
+               request_id=None, priority=None, deadline_ms=None,
+               ttft_slo_ms=None):
+        """Enqueue one request; returns its id.
+
+        ``priority`` is a class name (``interactive``/``batch``;
+        config ``inference.default_priority`` when omitted) — typos
+        raise with the choices listed. ``deadline_ms`` bounds the
+        request's total wall clock (expired requests terminate with a
+        typed `DeadlineExceeded`); ``ttft_slo_ms`` is its
+        time-to-first-token objective (admission sheds the request when
+        the measured TTFT EMA already exceeds it).
+
+        Under overload the admission controller raises a typed
+        `RequestRejected` (terminal status ``shed``) carrying a
+        retry-after hint from the measured drain rate — the request
+        never enters the queue."""
+        priority = self.default_priority if priority is None else priority
+        validate_priority(priority)
+        for name, value in (("deadline_ms", deadline_ms),
+                            ("ttft_slo_ms", ttft_slo_ms)):
+            if value is not None and (
+                    not isinstance(value, (int, float)) or
+                    isinstance(value, bool) or value <= 0):
+                raise ValueError(
+                    f"{name} must be a number > 0 (milliseconds), got "
+                    f"{value!r}")
         req = Request(prompt=[int(t) for t in prompt],
                       max_new_tokens=int(max_new_tokens),
-                      eos_token_id=eos_token_id, request_id=request_id)
+                      eos_token_id=eos_token_id, request_id=request_id,
+                      priority=priority,
+                      deadline_ms=(None if deadline_ms is None
+                                   else float(deadline_ms)),
+                      ttft_slo_ms=(None if ttft_slo_ms is None
+                                   else float(ttft_slo_ms)))
+        if self.admission is not None:
+            usable = max(self.cache.num_pages - 1, 1)
+            try:
+                self.admission.admit(
+                    req, queue_depth=len(self.scheduler.waiting) +
+                    len(self.scheduler.quarantined),
+                    page_pool_util=1.0 - self.cache.num_free / usable)
+            except Exception:
+                self.stats["requests_shed"] += 1
+                raise
         return self.scheduler.add_request(req, now=time.perf_counter())
 
     def _next_rng(self):
@@ -493,62 +566,280 @@ class InferenceEngine:
 
     def step(self):
         """One scheduler step: admit + prefill new requests, decode one
-        token for every in-flight sequence. Returns a summary dict."""
+        token for every in-flight sequence. Returns a summary dict.
+
+        A prefill/decode exception QUARANTINES the implicated batch
+        (evict, free pages, capped-jittered retry; poisoned after
+        ``retry.max_attempts`` consecutive failures) instead of killing
+        the server — `step()` only raises on scheduler-invariant
+        violations. The hang watchdog (``inference.hang_timeout_s``) is
+        armed around the dispatch once the step's programs are warm
+        (an XLA compile is not a hang) and fed on exit — including when
+        the step DIES rather than hangs."""
+        self._plan_step_faults()
+        self._apply_page_pressure()
+        try:
+            return self._step_inner()
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.feed()
+            self._release_page_pressure()
+
+    def _step_inner(self):
         now = time.perf_counter()
         t0 = now
+        finished_before = len(self.scheduler.finished)
         with self.telemetry.span("schedule"):
             plan = self.scheduler.schedule(now=now)
         self.stats["schedule_s"] += time.perf_counter() - t0
         self.stats["evictions"] += len(plan.evicted)
+        if plan.empty and self.scheduler.quarantined:
+            # nothing dispatchable until a quarantine backoff window
+            # closes: sleep toward the earliest retry_at (capped so
+            # run()/drain() stay responsive to drain requests and
+            # deadlines) instead of busy-spinning step() at full CPU —
+            # an uncapped spin would also flood the monitor and burn
+            # scripted fault-injection step windows on idle serials
+            wake = min((r.retry_at for r in self.scheduler.quarantined
+                        if r.retry_at is not None), default=now)
+            time.sleep(min(max(wake - time.perf_counter(), 0.0), 0.05))
         for req in plan.prefills:
             if req.admitted_at is not None and req.enqueued_at is not None:
                 wait = req.admitted_at - req.enqueued_at
                 self.stats["admission_wait_s"] += wait
                 self.request_metrics.observe_admission_wait(wait)
         # per-step gauges: scheduler backlog + KV page-pool occupancy —
-        # the two saturation signals an autoscaler watches
+        # the two saturation signals an autoscaler watches (and the
+        # admission controller sheds on)
         usable = max(self.cache.num_pages - 1, 1)
         self.stats["queue_depth"] = float(len(self.scheduler.waiting))
         self.stats["page_pool_util"] = 1.0 - self.cache.num_free / usable
 
-        finished_before = len(self.scheduler.finished)
+        if self.watchdog is not None and self._programs_warm(plan):
+            self.watchdog.arm()
 
         if plan.prefills:
             t0 = time.perf_counter()
+            ok = True
             with self.telemetry.span("prefill"):
-                self._run_prefill(plan)
+                try:
+                    fault = self._fault_fired("prefill_error")
+                    if fault is not None:
+                        raise InjectedServingFault(
+                            "injected prefill_error fault")
+                    self._run_prefill(plan)
+                except Exception as e:  # noqa: BLE001 - quarantine, don't die
+                    ok = False
+                    self._quarantine_batch(plan.prefills, e, "prefill")
             self.stats["prefill_s"] += time.perf_counter() - t0
-            self.stats["prefill_requests"] += len(plan.prefills)
-            # r.cached is the pre-sampling context length (complete_
-            # prefill pins it before appending the first token) — len(
-            # r.context) here would double-count that token once decode
-            # accounting starts
-            self.stats["prefill_tokens"] += \
-                sum(r.cached for r in plan.prefills)
+            if ok:
+                self.stats["prefill_requests"] += len(plan.prefills)
+                # r.cached is the pre-sampling context length (complete_
+                # prefill pins it before appending the first token) —
+                # len(r.context) here would double-count that token once
+                # decode accounting starts
+                self.stats["prefill_tokens"] += \
+                    sum(r.cached for r in plan.prefills)
 
-        if plan.decodes:
+        # a mid-execution prefill failure may have run cache-loss
+        # recovery, evicting EVERY running sequence (their K/V is
+        # gone): the planned decode batch would read trash pages and
+        # append garbage tokens — skip it; the evicted requests
+        # re-prefill on later steps
+        decodes_intact = all(r.state == RUNNING for r in plan.decodes)
+        if plan.decodes and decodes_intact:
+            stall = self._fault_fired("decode_stall")
+            if stall is not None:
+                time.sleep(stall["seconds"])   # drives the watchdog
             t0 = time.perf_counter()
+            ok = True
             with self.telemetry.span("decode"):
-                self._run_decode(plan)
+                try:
+                    fault = self._fault_fired("decode_error")
+                    if fault is not None:
+                        raise InjectedServingFault(
+                            "injected decode_error fault")
+                    self._run_decode(plan)
+                except Exception as e:  # noqa: BLE001
+                    ok = False
+                    self._quarantine_batch(plan.decodes, e, "decode")
             self.stats["decode_s"] += time.perf_counter() - t0
-            self.stats["decode_tokens"] += len(plan.decodes)
+            if ok:
+                self.stats["decode_tokens"] += len(plan.decodes)
 
         finished = len(self.scheduler.finished) - finished_before
         self.stats["finished"] += finished
         self.stats["steps"] += 1
+        self._sync_status_counts()
+        if self.admission is not None and finished:
+            self.admission.note_finished(finished)
         self._record_request_spans(plan)
         if self.monitor is not None:
             # per-step saturation series keyed by total generated tokens
             # (the Serve/* convention); buffered — no per-step flush
             total = self.stats["prefill_tokens"] + \
                 self.stats["decode_tokens"]
-            self.monitor.record(total, {
+            scalars = {
                 "Serve/queue_depth": self.stats["queue_depth"],
                 "Serve/page_pool_util": self.stats["page_pool_util"],
-                "Serve/running": float(len(self.scheduler.running))})
+                "Serve/running": float(len(self.scheduler.running))}
+            # per-status terminal counters: exported through every
+            # monitor backend (Prometheus gauges + JSONL events)
+            for status, tag in REQUEST_STATUS_FAMILIES.items():
+                scalars[tag] = float(self.stats[f"requests_{status}"])
+            self.monitor.record(total, scalars)
         return {"prefilled": len(plan.prefills),
-                "decoded": len(plan.decodes),
+                "decoded": len(plan.decodes) if decodes_intact else 0,
                 "evicted": len(plan.evicted), "finished": finished}
+
+    def _sync_status_counts(self):
+        """Mirror the scheduler's terminal-status tallies into the
+        engine stats (``shed`` is engine-owned: shed requests never
+        enter the scheduler)."""
+        sc = self.scheduler.status_counts
+        self.stats["requests_ok"] = sc["ok"]
+        self.stats["requests_deadline_exceeded"] = sc["deadline_exceeded"]
+        self.stats["requests_failed"] = sc["failed"]
+
+    # ------------------------------------------------------------------
+    # step-failure quarantine + serving fault injection
+    # ------------------------------------------------------------------
+
+    def _plan_step_faults(self):
+        """One injector turn per serving step: pop the serving-kind
+        host faults fired for this step (training kinds in a shared
+        DS_FAULT_INJECT plan are ignored here)."""
+        self._step_faults = []
+        if self.fault_injector is None:
+            return
+        self.fault_injector.plan_next_step()
+        self._step_faults = [
+            f for f in self.fault_injector.take_host_faults()
+            if f["kind"] in SERVING_FAULT_KINDS]
+
+    def _fault_fired(self, kind):
+        return next((f for f in self._step_faults if f["kind"] == kind),
+                    None)
+
+    def _apply_page_pressure(self):
+        """``page_pool_pressure`` fault: seize a fraction of the FREE
+        pool for this step so scheduling runs under memory pressure
+        (eviction, admission shedding); released at step end."""
+        fault = self._fault_fired("page_pool_pressure")
+        if fault is None:
+            return
+        n = int(self.cache.num_free * fault["factor"])
+        got = self.cache.allocate(n)
+        if got:
+            self._pressure_pages.extend(got)
+            logger.warning(
+                f"fault injection: page_pool_pressure seized {len(got)} "
+                f"free page(s) for this step")
+
+    def _release_page_pressure(self):
+        if self._pressure_pages:
+            self.cache.free(self._pressure_pages)
+            self._pressure_pages = []
+
+    def _quarantine_batch(self, requests, exc, phase):
+        """A prefill/decode step failed: quarantine every implicated
+        request (attribution is batch-granular — the failing request
+        cannot be identified inside one compiled call; innocent
+        co-batched requests reset their failure run at their next
+        completed step). Transient failures get capped-jittered
+        retries; a request failing ``retry.max_attempts`` consecutive
+        steps is poisoned permanently with a typed `RequestFailed`
+        (the serving mirror of PR 9's poison-step detector)."""
+        now = time.perf_counter()
+        self._recover_cache_if_lost(now)
+        # the exception rides on poisoned requests (RequestFailed.
+        # last_error) that live until the caller pops them: drop its
+        # traceback NOW, or the stored frame graph pins this step's
+        # plan/batch arrays (and the engine) for that whole lifetime
+        exc.__traceback__ = None
+        rp = self.retry_params
+        poisoned = 0
+        for req in requests:
+            if req.state == FINISHED:
+                continue       # cache-loss recovery may have failed it
+            req.failures += 1
+            if req.failures >= rp["max_attempts"]:
+                poisoned += 1
+                self.scheduler.finish_failed(req, RequestFailed(
+                    f"request {req.request_id} failed {req.failures} "
+                    f"consecutive {phase} steps — poisoned "
+                    f"({type(exc).__name__}: {exc})",
+                    last_error=exc, attempts=req.failures))
+            else:
+                delay_ms = backoff_delay(
+                    req.failures, rp["backoff_base_ms"],
+                    rp["backoff_cap_ms"], rp["jitter"], self._retry_rng)
+                self.scheduler.quarantine_request(
+                    req, retry_at=now + delay_ms / 1e3, now=now)
+                self.stats["retries"] += 1
+        self.stats["quarantines"] += 1
+        logger.warning(
+            f"serving {phase} step failed ({type(exc).__name__}: {exc}) "
+            f"— quarantined {len(requests)} request(s) "
+            f"({poisoned} poisoned); the server stays up")
+
+    def _recover_cache_if_lost(self, now):
+        """A compiled call that died MID-EXECUTION consumed the donated
+        K/V pools: rebuild them zeroed and evict every running sequence
+        (their cached context is gone — eviction re-prefills it from
+        the full token history on readmission). Errors raised before
+        dispatch (the common case, incl. injected faults) leave the
+        donated buffers intact and skip this entirely."""
+        deleted = getattr(self.cache.k, "is_deleted", lambda: False)()
+        if not deleted:
+            return
+        logger.error(
+            "serving step died mid-execution with the KV pools donated "
+            "— rebuilding zeroed pools and re-prefilling every running "
+            "sequence")
+        self.cache.reset_pools()
+        while self.scheduler.running:
+            self.scheduler._evict_victim(now)
+
+    def _programs_warm(self, plan):
+        """True when every compiled program this plan dispatches has
+        at least one executable — the watchdog must not count a
+        first-call XLA compile as a hang (the PR 4 discipline)."""
+        def warm(key):
+            fn = self._compiled.get(key)
+            if fn is None:
+                return False
+            return (fn._cache_size() if hasattr(fn, "_cache_size")
+                    else 1) >= 1
+        if plan.empty:
+            return False
+        if plan.prefills and not warm(
+                ("prefill", plan.prefill_batch, plan.prefill_len)):
+            return False
+        if plan.decodes and not warm(("decode", plan.decode_batch)):
+            return False
+        return True
+
+    def _on_serving_hang(self):
+        """Watchdog expiry (watchdog thread): the serving step blew its
+        wall-clock deadline. Dump every thread's stack, then request a
+        drain-style emergency flush — admissions stop NOW (flag write,
+        async-signal-safe) and `run()` performs the full drain + typed
+        in-flight failure + metrics flush if/when the stuck step
+        returns."""
+        from ..runtime.sentinel import dump_all_stacks
+        self.watchdog_fires += 1
+        self.last_stack_dump = dump_all_stacks()
+        logger.error(
+            f"serving hang watchdog: step exceeded "
+            f"{self.watchdog.timeout_s:.1f}s — requesting an emergency "
+            f"drain; all-thread stacks:\n{self.last_stack_dump}")
+        self._drain_requested = True
+        try:
+            if self.monitor is not None:
+                self.monitor.flush()
+        except Exception:  # noqa: BLE001 - best-effort from the thread
+            pass
 
     def _record_request_spans(self, plan):
         """Per-request lifecycle records behind the telemetry capture
@@ -592,7 +883,11 @@ class InferenceEngine:
             # delivered and must not re-count
             if req.first_token_at is None and req.submitted_at is not None:
                 req.first_token_at = now
-                self.request_metrics.observe_ttft(now - req.submitted_at)
+                ttft_s = now - req.submitted_at
+                self.request_metrics.observe_ttft(ttft_s)
+                if self.admission is not None:
+                    # the shedding signal: measured TTFT EMA vs SLOs
+                    self.admission.observe_ttft(ttft_s * 1e3)
             req.last_token_at = now
 
     def _run_decode(self, plan):
@@ -673,7 +968,13 @@ class InferenceEngine:
         `deadline_s` (config `inference.drain_deadline_s` by default),
         then flush Serve/* telemetry. Returns a summary dict; fresh
         queued requests are left unserved (`unserved` counts them) for
-        the replacement instance."""
+        the replacement instance.
+
+        When the deadline elapses, still-in-flight requests are FAILED
+        with a typed `DrainAborted` terminal status and flushed to the
+        metrics before the process exits — previously they were
+        silently abandoned, so a client could never distinguish a
+        drain from a crash."""
         deadline_s = (self.drain_deadline_s if deadline_s is None
                       else float(deadline_s))
         self.scheduler.stop_admissions()
@@ -684,21 +985,29 @@ class InferenceEngine:
                 deadline_hit = True
                 break
             self.step()
+        abandoned = 0
+        for req in self.scheduler.inflight_requests():
+            self.scheduler.finish_failed(req, DrainAborted(
+                f"graceful-drain deadline ({deadline_s:.1f}s) elapsed "
+                f"with request {req.request_id} still in flight "
+                f"({len(req.generated)}/{req.max_new_tokens} tokens "
+                f"generated)", attempts=req.failures))
+            abandoned += 1
+        self._sync_status_counts()
         summary = {
             "drained_s": time.perf_counter() - t0,
             "deadline_hit": deadline_hit,
-            "inflight_abandoned": (len(self.scheduler.running) +
-                                   sum(1 for r in self.scheduler.waiting
-                                       if r.evictions)),
+            "inflight_abandoned": abandoned,
             "unserved": sum(1 for r in self.scheduler.waiting
                             if not r.evictions),
         }
-        self.serve_stats()          # pushes Serve/* scalars
+        self.serve_stats()          # pushes Serve/* scalars (incl. the
+        # per-status terminal counters — the DrainAborted failures land
+        # in Serve/requests_failed BEFORE the monitor closes)
         if self.monitor is not None:
             self.monitor.close()    # drain the buffered scalar queue
         self.telemetry.close()
         self.restore_signal_handlers()
-        from ..utils.logging import logger
         logger.info(f"inference drain complete: {summary}")
         return summary
 

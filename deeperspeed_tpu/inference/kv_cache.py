@@ -74,6 +74,25 @@ class PagedKVCache:
         # tests are deterministic
         self._free = list(range(self.num_pages - 1, 0, -1))
 
+    def reset_pools(self):
+        """Rebuild the K/V device pools zeroed, keeping the allocator
+        state. The serving engine's quarantine path calls this when a
+        compiled step died MID-EXECUTION with the pools donated (the
+        buffers are consumed and unusable); the engine then re-prefills
+        every running sequence, so the zeroed contents are never
+        read."""
+        shape = (self.num_layers, self.num_pages, self.num_heads,
+                 self.page_size, self.head_dim)
+        if self.sharding is not None:
+            import jax
+            self.k = jax.device_put(jnp.zeros(shape, self.dtype),
+                                    self.sharding)
+            self.v = jax.device_put(jnp.zeros(shape, self.dtype),
+                                    self.sharding)
+        else:
+            self.k = jnp.zeros(shape, self.dtype)
+            self.v = jnp.zeros(shape, self.dtype)
+
     # -- allocator (host-side) --------------------------------------------
 
     @property

@@ -23,11 +23,19 @@ the host, nothing here touches a device value.
 """
 
 from ..runtime.exporters import LATENCY_BUCKETS_MS, Histogram
+from .admission import REQUEST_STATUSES
 
 # monitor/Prometheus family names
 ADMISSION_WAIT = "Serve/admission_wait_ms"
 TTFT = "Serve/ttft_ms"
 INTER_TOKEN = "Serve/inter_token_ms"
+
+# per-terminal-status request counters (admission.REQUEST_STATUSES):
+# the engine records these every step as monitor scalars, so they ride
+# the single buffered drain into EVERY export backend — latest-value
+# gauges on the Prometheus scrape, per-drain events on the JSONL stream
+REQUEST_STATUS_FAMILIES = {
+    status: f"Serve/requests_{status}" for status in REQUEST_STATUSES}
 
 
 class ServeRequestMetrics:

@@ -35,11 +35,34 @@ init — a smaller budget could never admit such a prompt); an evicted
 request whose regrown context buckets above the user ladder is exempt
 from the budget for the step's first prefill, so the queue can never
 wedge behind it.
+
+Robustness layer (docs/inference.md "Serving under failure"):
+
+- every request reaches exactly ONE terminal status — ``ok`` /
+  ``shed`` / ``deadline_exceeded`` / ``failed`` (`Request.status`;
+  single assignment enforced) — surfaced via `pop_finished()` and the
+  per-status ``Serve/requests_*`` counters;
+- requests carrying a ``deadline_ms`` are expired at the top of every
+  `schedule()` (waiting AND running) with a typed `DeadlineExceeded`
+  instead of consuming further decode cadence;
+- eviction picks the LOWEST-priority / LATEST-deadline victim
+  (`_evict_victim`) instead of blanket youngest-first — ``batch``
+  traffic is preempted before ``interactive``, and within a class the
+  request with the most deadline slack goes first (youngest as the
+  final tiebreak, preserving the original policy for homogeneous
+  streams);
+- step-failure quarantine: the engine parks implicated requests here
+  (`quarantine_request`) with a capped-jittered ``retry_at``; they
+  re-admit at the queue front once eligible (eviction-regrowth
+  machinery reused: budget exemption, drain re-admission).
 """
 
+import math
 from collections import deque
 from dataclasses import dataclass, field
 
+from .admission import (DeadlineExceeded, PRIORITY_RANK, STATUS_DEADLINE,
+                        STATUS_FAILED, STATUS_OK)
 from .kv_cache import pages_for_tokens
 
 WAITING = "waiting"
@@ -54,6 +77,11 @@ class Request:
     max_new_tokens: int
     request_id: object = None
     eos_token_id: int = None
+    # SLO contract (admission.py): priority class, wall-clock deadline,
+    # TTFT service objective — all optional
+    priority: str = "interactive"
+    deadline_ms: float = None
+    ttft_slo_ms: float = None
     # runtime state (owned by the scheduler/engine)
     generated: list = field(default_factory=list)
     pages: list = field(default_factory=list)
@@ -62,6 +90,16 @@ class Request:
     evictions: int = 0
     enqueued_at: float = None
     admitted_at: float = None
+    deadline_at: float = None   # absolute clock: enqueue + deadline_ms
+    # terminal outcome: exactly one of ok/shed/deadline_exceeded/failed,
+    # assigned once; non-ok outcomes carry the typed error
+    status: str = None
+    error: Exception = None
+    # step-failure quarantine bookkeeping (engine `_quarantine_batch`):
+    # consecutive failed steps (reset on any completed step) and the
+    # earliest re-admission time of the current backoff window
+    failures: int = 0
+    retry_at: float = None
     # request-level latency observability (inference/metrics.py):
     # submitted_at survives evictions (TTFT measures from first submit,
     # once); last_token_at feeds the inter-token histogram
@@ -158,6 +196,9 @@ class ContinuousBatchingScheduler:
         self.waiting = deque()
         self.running = []
         self.finished = []
+        self.quarantined = []    # step-failure backoff (retry_at gates)
+        self.status_counts = {STATUS_OK: 0, STATUS_DEADLINE: 0,
+                              STATUS_FAILED: 0}
         self._counter = 0
         self.draining = False
 
@@ -188,12 +229,15 @@ class ContinuousBatchingScheduler:
         request.enqueued_at = now
         if request.submitted_at is None:
             request.submitted_at = now
+        if request.deadline_at is None and request.deadline_ms is not None \
+                and now is not None:
+            request.deadline_at = now + float(request.deadline_ms) / 1e3
         self.waiting.append(request)
         return request.request_id
 
     @property
     def has_work(self):
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.quarantined)
 
     # -- graceful drain ----------------------------------------------------
 
@@ -206,12 +250,21 @@ class ContinuousBatchingScheduler:
 
     @property
     def has_inflight_work(self):
-        """Work a graceful drain should still finish: running sequences
-        plus evicted ones awaiting re-prefill (their generation is
-        partial). Fresh queued requests do NOT count — a draining server
-        leaves them for the replacement instance."""
-        return bool(self.running or
+        """Work a graceful drain should still finish: running sequences,
+        evicted ones awaiting re-prefill, and quarantined ones awaiting
+        a retry (their generation is partial). Fresh queued requests do
+        NOT count — a draining server leaves them for the replacement
+        instance."""
+        return bool(self.running or self.quarantined or
                     any(r.evictions for r in self.waiting))
+
+    def inflight_requests(self):
+        """Every request a drain is still responsible for (the
+        complement of the fresh queued ones `has_inflight_work`
+        excludes) — what the drain-deadline path fails with a typed
+        terminal status instead of silently abandoning."""
+        return (list(self.running) + list(self.quarantined) +
+                [r for r in self.waiting if r.evictions])
 
     def pop_finished(self):
         """Drain completed requests (the caller owns them afterwards).
@@ -220,15 +273,118 @@ class ContinuousBatchingScheduler:
         out, self.finished = self.finished, []
         return out
 
+    # -- terminal statuses -------------------------------------------------
+
+    def _finish(self, request, status, error=None):
+        """The ONLY exit gate: pull the request out of whatever
+        collection holds it, free its pages, and stamp its terminal
+        status exactly once (a second assignment is an invariant
+        violation, raised loudly — the chaos soak pins this)."""
+        if request.status is not None:
+            raise RuntimeError(
+                f"request {request.request_id} already reached terminal "
+                f"status {request.status!r}; refusing to overwrite with "
+                f"{status!r}")
+        if request in self.running:
+            self.running.remove(request)
+        if request in self.quarantined:
+            self.quarantined.remove(request)
+        try:
+            self.waiting.remove(request)
+        except ValueError:
+            pass
+        self.cache.free(request.pages)
+        request.pages = []
+        request.status = status
+        if error is not None:
+            request.error = error
+        request.state = FINISHED
+        self.status_counts[status] += 1
+        self.finished.append(request)
+
+    def finish_failed(self, request, error):
+        """Terminal step failure (poison / drain abort): status
+        ``failed`` with the typed error attached."""
+        self._finish(request, STATUS_FAILED, error)
+
+    # -- deadline expiry ---------------------------------------------------
+
+    def expire_deadlines(self, now=None):
+        """Terminate every request whose ``deadline_ms`` elapsed —
+        waiting, quarantined, or running — with a typed
+        `DeadlineExceeded` and status ``deadline_exceeded``. Runs at
+        the top of every `schedule()` so an expired request never
+        consumes another decode step. Returns the expired requests."""
+        if now is None:
+            return []
+        expired = [r for r in list(self.waiting) + list(self.quarantined)
+                   + list(self.running)
+                   if r.deadline_at is not None and now >= r.deadline_at]
+        for req in expired:
+            self._finish(req, STATUS_DEADLINE, DeadlineExceeded(
+                f"request {req.request_id} missed its deadline "
+                f"(deadline_ms={req.deadline_ms}) with "
+                f"{len(req.generated)}/{req.max_new_tokens} tokens "
+                f"generated"))
+        return expired
+
+    # -- step-failure quarantine (engine `_quarantine_batch`) --------------
+
+    def quarantine_request(self, request, retry_at, now=None):
+        """Park a step-failed request for a capped-jittered retry:
+        evict it (pages freed, full-context re-prefill on readmission —
+        the eviction machinery's budget exemption and drain
+        re-admission apply) but gate re-admission on ``retry_at``."""
+        if request in self.running:
+            self.running.remove(request)
+        try:
+            # cache-loss recovery may have already evicted it into the
+            # waiting queue — it must not sit in BOTH collections
+            self.waiting.remove(request)
+        except ValueError:
+            pass
+        self.cache.free(request.pages)
+        request.pages = []
+        request.cached = 0
+        request.evictions += 1
+        request.state = WAITING
+        request.enqueued_at = now
+        request.retry_at = float(retry_at)
+        self.quarantined.append(request)
+
+    def _release_quarantined(self, now):
+        """Move backoff-expired quarantined requests to the FRONT of
+        the waiting queue (like any evicted request — their partial
+        generation finishes before fresh work starts)."""
+        if not self.quarantined or now is None:
+            return
+        due = [r for r in self.quarantined if r.retry_at is None or
+               now >= r.retry_at]
+        for req in due:
+            self.quarantined.remove(req)
+            req.retry_at = None
+            self.waiting.appendleft(req)
+
     # -- planning ----------------------------------------------------------
 
-    def _evict_youngest(self, now=None):
-        """Preempt the most recently admitted running request: free its
-        pages and requeue it (front of the queue, full context as the
-        new prompt). Returns the request, or None if nothing to evict."""
+    def _evict_victim(self, now=None):
+        """Preempt the lowest-priority / latest-deadline running
+        request: free its pages and requeue it (front of the queue,
+        full context as the new prompt). Victim order: ``batch`` before
+        ``interactive``; within a class, the request with the MOST
+        deadline slack (no deadline = infinite slack) goes first;
+        youngest-first as the final tiebreak (the pre-robustness
+        policy, preserved exactly for homogeneous streams). Returns the
+        request, or None if nothing to evict."""
         if not self.running:
             return None
-        req = self.running.pop()        # admission appends → last = youngest
+        req = max(
+            enumerate(self.running),
+            key=lambda kv: (PRIORITY_RANK.get(kv[1].priority, 0),
+                            kv[1].deadline_at if kv[1].deadline_at
+                            is not None else math.inf,
+                            kv[0]))[1]
+        self.running.remove(req)
         self.cache.free(req.pages)
         req.pages = []
         req.cached = 0
@@ -239,6 +395,10 @@ class ContinuousBatchingScheduler:
         req.enqueued_at = now
         self.waiting.appendleft(req)
         return req
+
+    # youngest-first was the pre-robustness policy; the name survives
+    # for callers/tests that drive an explicit eviction round-trip
+    _evict_youngest = _evict_victim
 
     def _grow_running(self, evicted, now=None):
         """Give every running sequence the page its next token needs;
@@ -268,7 +428,12 @@ class ContinuousBatchingScheduler:
     def schedule(self, now=None):
         """Build this step's `StepPlan` (see the module docstring for
         the policy). Mutates scheduler state: admitted requests move to
-        `running` with pages allocated; evicted ones back to `waiting`."""
+        `running` with pages allocated; evicted ones back to `waiting`;
+        deadline-expired ones terminate first (typed, never another
+        decode step); backoff-expired quarantined ones re-enter the
+        queue front."""
+        self.expire_deadlines(now)
+        self._release_quarantined(now)
         evicted = []
         self._grow_running(evicted, now)
         decodes = list(self.running)
@@ -290,9 +455,8 @@ class ContinuousBatchingScheduler:
                 # unreachable: the ladder tops at the aligned window and
                 # running contexts stay below it (_maybe_finish) — kept
                 # as a loud invariant guard rather than a queue wedge
-                self.waiting.popleft()
-                req.state = FINISHED
-                self.finished.append(req)
+                self.finish_failed(req, RuntimeError(
+                    "context outgrew the prefill bucket ladder"))
                 raise RuntimeError(
                     f"request {req.request_id} context "
                     f"({len(req.context)} tokens) outgrew the prefill "
@@ -345,6 +509,7 @@ class ContinuousBatchingScheduler:
         first generated token sampled."""
         request.cached = len(request.context)
         request.generated.append(int(first_token))
+        request.failures = 0     # a completed step ends the failure run
         self._maybe_finish(request)
 
     def complete_decode(self, request, token):
@@ -352,14 +517,10 @@ class ContinuousBatchingScheduler:
         cache at slot `cached`, and `token` was sampled from it."""
         request.cached += 1
         request.generated.append(int(token))
+        request.failures = 0
         self._maybe_finish(request)
 
     def _maybe_finish(self, request):
         total = len(request.prompt) + len(request.generated)
         if request.done or total >= self.max_seq_len:
-            if request in self.running:
-                self.running.remove(request)
-            self.cache.free(request.pages)
-            request.pages = []
-            request.state = FINISHED
-            self.finished.append(request)
+            self._finish(request, STATUS_OK)

@@ -151,7 +151,9 @@ def parse_inference_block(d):
              c.INFERENCE_PREFILL_LENGTHS, c.INFERENCE_PREFILL_BATCH_SIZES,
              c.INFERENCE_DECODE_BATCH_SIZES, c.INFERENCE_TEMPERATURE,
              c.INFERENCE_SEED, c.INFERENCE_KERNEL, c.INFERENCE_KV_DTYPE,
-             c.INFERENCE_DRAIN_DEADLINE}
+             c.INFERENCE_DRAIN_DEADLINE, c.INFERENCE_DEFAULT_PRIORITY,
+             c.INFERENCE_HANG_TIMEOUT, c.INFERENCE_ADMISSION,
+             c.INFERENCE_RETRY, c.INFERENCE_FAULT_INJECTION}
     unknown = sorted(set(inf) - known)
     if unknown:
         raise DeepSpeedConfigError(
@@ -265,6 +267,34 @@ def parse_inference_block(d):
             f">= 0 (seconds; 0 = stop immediately after the current "
             f"step), got {drain_deadline!r}")
 
+    # -- serving robustness (inference/admission.py) -------------------
+
+    from ..inference.admission import PRIORITIES
+    default_priority = inf.get(c.INFERENCE_DEFAULT_PRIORITY,
+                               c.INFERENCE_DEFAULT_PRIORITY_DEFAULT)
+    if default_priority not in PRIORITIES:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_DEFAULT_PRIORITY} must be one of "
+            f"{list(PRIORITIES)}, got {default_priority!r}")
+
+    hang_timeout = inf.get(c.INFERENCE_HANG_TIMEOUT,
+                           c.INFERENCE_HANG_TIMEOUT_DEFAULT)
+    if not isinstance(hang_timeout, (int, float)) or \
+            isinstance(hang_timeout, bool) or hang_timeout < 0:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_HANG_TIMEOUT} must be a number "
+            f">= 0 (seconds; 0 = watchdog off), got {hang_timeout!r}")
+
+    admission = _parse_inference_admission(
+        inf.get(c.INFERENCE_ADMISSION))
+    retry = _parse_inference_retry(inf.get(c.INFERENCE_RETRY))
+
+    fault_spec = inf.get(c.INFERENCE_FAULT_INJECTION)
+    if fault_spec is not None:
+        from .fault_injection import validate_fault_spec
+        validate_fault_spec(fault_spec,
+                            where=f"inference.{c.INFERENCE_FAULT_INJECTION}")
+
     return {
         "page_size": ints[c.INFERENCE_PAGE_SIZE],
         "num_pages": ints[c.INFERENCE_NUM_PAGES],
@@ -279,7 +309,150 @@ def parse_inference_block(d):
         "kernel": kernel,
         "kv_cache_dtype": kv_dtype,
         "drain_deadline_s": float(drain_deadline),
+        "default_priority": default_priority,
+        "hang_timeout_s": float(hang_timeout),
+        "admission": admission,
+        "retry": retry,
+        "fault_injection": fault_spec,
     }
+
+
+def _parse_inference_admission(block):
+    """Validate the ``inference.admission`` sub-block -> params dict,
+    or None when absent/disabled (no admission control: the
+    pre-robustness unbounded-queue behavior)."""
+    if block is None:
+        return None
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_ADMISSION} must be an object, got "
+            f"{type(block).__name__}")
+    known = {c.INFERENCE_ADMISSION_ENABLED,
+             c.INFERENCE_ADMISSION_MAX_QUEUE_DEPTH,
+             c.INFERENCE_ADMISSION_SHED_POOL_UTIL,
+             c.INFERENCE_ADMISSION_SHED_TTFT_EMA,
+             c.INFERENCE_ADMISSION_TTFT_EMA_BETA,
+             c.INFERENCE_ADMISSION_RETRY_AFTER_CAP}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'inference.{c.INFERENCE_ADMISSION}' key(s) "
+            f"{unknown}; valid keys: {sorted(known)}")
+    enabled = block.get(c.INFERENCE_ADMISSION_ENABLED,
+                        c.INFERENCE_ADMISSION_ENABLED_DEFAULT)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_ADMISSION}."
+            f"{c.INFERENCE_ADMISSION_ENABLED} must be a boolean, got "
+            f"{enabled!r}")
+    if not enabled:
+        return None
+
+    where = f"inference.{c.INFERENCE_ADMISSION}"
+    depth = as_int(block.get(c.INFERENCE_ADMISSION_MAX_QUEUE_DEPTH,
+                             c.INFERENCE_ADMISSION_MAX_QUEUE_DEPTH_DEFAULT),
+                   f"{where}.{c.INFERENCE_ADMISSION_MAX_QUEUE_DEPTH}")
+    if depth < 1:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_ADMISSION_MAX_QUEUE_DEPTH} must be "
+            f">= 1, got {depth}")
+
+    pool_util = block.get(c.INFERENCE_ADMISSION_SHED_POOL_UTIL,
+                          c.INFERENCE_ADMISSION_SHED_POOL_UTIL_DEFAULT)
+    if not isinstance(pool_util, (int, float)) or \
+            isinstance(pool_util, bool) or not 0 < pool_util <= 1:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_ADMISSION_SHED_POOL_UTIL} must be a "
+            f"number in (0, 1], got {pool_util!r}")
+
+    ttft_ms = block.get(c.INFERENCE_ADMISSION_SHED_TTFT_EMA,
+                        c.INFERENCE_ADMISSION_SHED_TTFT_EMA_DEFAULT)
+    if ttft_ms is not None and (
+            not isinstance(ttft_ms, (int, float)) or
+            isinstance(ttft_ms, bool) or ttft_ms <= 0):
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_ADMISSION_SHED_TTFT_EMA} must be a "
+            f"number > 0 (milliseconds) or null (signal off), got "
+            f"{ttft_ms!r}")
+
+    beta = block.get(c.INFERENCE_ADMISSION_TTFT_EMA_BETA,
+                     c.INFERENCE_ADMISSION_TTFT_EMA_BETA_DEFAULT)
+    if not isinstance(beta, (int, float)) or isinstance(beta, bool) or \
+            not 0 < beta < 1:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_ADMISSION_TTFT_EMA_BETA} must be a "
+            f"number in (0, 1), got {beta!r}")
+
+    cap = block.get(c.INFERENCE_ADMISSION_RETRY_AFTER_CAP,
+                    c.INFERENCE_ADMISSION_RETRY_AFTER_CAP_DEFAULT)
+    if not isinstance(cap, (int, float)) or isinstance(cap, bool) or \
+            cap <= 0:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_ADMISSION_RETRY_AFTER_CAP} must be a "
+            f"number > 0 (seconds), got {cap!r}")
+
+    return {"max_queue_depth": depth,
+            "shed_page_pool_util": float(pool_util),
+            "shed_ttft_ema_ms": (None if ttft_ms is None
+                                 else float(ttft_ms)),
+            "ttft_ema_beta": float(beta),
+            "retry_after_cap_s": float(cap)}
+
+
+def _parse_inference_retry(block):
+    """Validate the ``inference.retry`` sub-block -> params dict. The
+    retry/poison machinery is always on (a step failure must never kill
+    the server), so an absent block yields the defaults."""
+    if block is None:
+        block = {}
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_RETRY} must be an object, got "
+            f"{type(block).__name__}")
+    known = {c.INFERENCE_RETRY_MAX_ATTEMPTS,
+             c.INFERENCE_RETRY_BACKOFF_BASE,
+             c.INFERENCE_RETRY_BACKOFF_CAP, c.INFERENCE_RETRY_JITTER}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'inference.{c.INFERENCE_RETRY}' key(s) {unknown}; "
+            f"valid keys: {sorted(known)}")
+    where = f"inference.{c.INFERENCE_RETRY}"
+
+    attempts = as_int(block.get(c.INFERENCE_RETRY_MAX_ATTEMPTS,
+                                c.INFERENCE_RETRY_MAX_ATTEMPTS_DEFAULT),
+                      f"{where}.{c.INFERENCE_RETRY_MAX_ATTEMPTS}")
+    if attempts < 1:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_RETRY_MAX_ATTEMPTS} must be >= 1 "
+            f"(the first attempt counts), got {attempts}")
+
+    base = block.get(c.INFERENCE_RETRY_BACKOFF_BASE,
+                     c.INFERENCE_RETRY_BACKOFF_BASE_DEFAULT)
+    cap = block.get(c.INFERENCE_RETRY_BACKOFF_CAP,
+                    c.INFERENCE_RETRY_BACKOFF_CAP_DEFAULT)
+    for key, value in ((c.INFERENCE_RETRY_BACKOFF_BASE, base),
+                       (c.INFERENCE_RETRY_BACKOFF_CAP, cap)):
+        if not isinstance(value, (int, float)) or \
+                isinstance(value, bool) or value <= 0:
+            raise DeepSpeedConfigError(
+                f"{where}.{key} must be a number > 0 (milliseconds), "
+                f"got {value!r}")
+    if cap < base:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_RETRY_BACKOFF_CAP} ({cap}) must be "
+            f">= {c.INFERENCE_RETRY_BACKOFF_BASE} ({base})")
+
+    jitter = block.get(c.INFERENCE_RETRY_JITTER,
+                       c.INFERENCE_RETRY_JITTER_DEFAULT)
+    if not isinstance(jitter, (int, float)) or \
+            isinstance(jitter, bool) or not 0 <= jitter < 1:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_RETRY_JITTER} must be a number in "
+            f"[0, 1), got {jitter!r}")
+
+    return {"max_attempts": attempts, "backoff_base_ms": float(base),
+            "backoff_cap_ms": float(cap), "jitter": float(jitter)}
 
 
 class DeepSpeedConfigWriter:

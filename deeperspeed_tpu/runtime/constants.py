@@ -431,3 +431,46 @@ INFERENCE_KV_DTYPE_DEFAULT = None
 # for at most this many seconds, flush Serve/* telemetry, exit 0.
 INFERENCE_DRAIN_DEADLINE = "drain_deadline_s"
 INFERENCE_DRAIN_DEADLINE_DEFAULT = 30.0
+
+# -- serving robustness (inference/admission.py; docs/inference.md
+#    "Serving under failure") ------------------------------------------------
+
+# priority class a submit() without an explicit `priority` gets
+INFERENCE_DEFAULT_PRIORITY = "default_priority"
+INFERENCE_DEFAULT_PRIORITY_DEFAULT = "interactive"
+
+# hang watchdog around the serving step (PR 4 machinery): 0 = off
+INFERENCE_HANG_TIMEOUT = "hang_timeout_s"
+INFERENCE_HANG_TIMEOUT_DEFAULT = 0.0
+
+# admission control / load shedding sub-block (absent => no shedding,
+# the pre-robustness unbounded-queue behavior)
+INFERENCE_ADMISSION = "admission"
+INFERENCE_ADMISSION_ENABLED = "enabled"
+INFERENCE_ADMISSION_ENABLED_DEFAULT = True
+INFERENCE_ADMISSION_MAX_QUEUE_DEPTH = "max_queue_depth"
+INFERENCE_ADMISSION_MAX_QUEUE_DEPTH_DEFAULT = 256
+INFERENCE_ADMISSION_SHED_POOL_UTIL = "shed_page_pool_util"
+INFERENCE_ADMISSION_SHED_POOL_UTIL_DEFAULT = 0.95
+INFERENCE_ADMISSION_SHED_TTFT_EMA = "shed_ttft_ema_ms"
+INFERENCE_ADMISSION_SHED_TTFT_EMA_DEFAULT = None
+INFERENCE_ADMISSION_TTFT_EMA_BETA = "ttft_ema_beta"
+INFERENCE_ADMISSION_TTFT_EMA_BETA_DEFAULT = 0.9
+INFERENCE_ADMISSION_RETRY_AFTER_CAP = "retry_after_cap_s"
+INFERENCE_ADMISSION_RETRY_AFTER_CAP_DEFAULT = 60.0
+
+# step-failure retry/poison sub-block (always active; the defaults
+# apply when the block is absent)
+INFERENCE_RETRY = "retry"
+INFERENCE_RETRY_MAX_ATTEMPTS = "max_attempts"
+INFERENCE_RETRY_MAX_ATTEMPTS_DEFAULT = 3
+INFERENCE_RETRY_BACKOFF_BASE = "backoff_base_ms"
+INFERENCE_RETRY_BACKOFF_BASE_DEFAULT = 50.0
+INFERENCE_RETRY_BACKOFF_CAP = "backoff_cap_ms"
+INFERENCE_RETRY_BACKOFF_CAP_DEFAULT = 2000.0
+INFERENCE_RETRY_JITTER = "jitter"
+INFERENCE_RETRY_JITTER_DEFAULT = 0.25
+
+# serving fault injection (runtime/fault_injection.py serving kinds);
+# same schema as training_health.fault_injection
+INFERENCE_FAULT_INJECTION = "fault_injection"

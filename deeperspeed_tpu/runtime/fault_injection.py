@@ -20,17 +20,24 @@ bookkeeping.
 Fault schema (all faults validated at parse time)::
 
     {"kind": "nan_grads" | "loss_spike" | "stall"
-             | "peer_death" | "slow_peer" | "barrier_timeout",
+             | "peer_death" | "slow_peer" | "barrier_timeout"
+             | "prefill_error" | "decode_error" | "decode_stall"
+             | "page_pool_pressure",
      "step": N,          # 0-based optimizer-step serial in this process
      "times": 1,         # fires on steps [step, step+times)
-     "factor": 1e3,      # loss_spike only: loss multiplier
-     "seconds": 1.0,     # stall: sleep length; slow_peer: heartbeat gap
+     "factor": 1e3,      # loss_spike: loss multiplier;
+                         # page_pool_pressure: fraction of the FREE
+                         # page pool seized for the step (0 < f <= 1,
+                         # default 0.9)
+     "seconds": 1.0,     # stall/decode_stall: sleep length;
+                         # slow_peer: heartbeat gap
      "peer": "sim0"}     # peer_death/slow_peer: simulated peer name
 
 ``step`` counts train_batch invocations in THIS process (a monotonic
 serial, never rewound by rollback) — so a replayed window after a
 rollback does not re-trigger a one-shot fault, which is exactly the
-"transient corruption" scenario the recovery tests need.
+"transient corruption" scenario the recovery tests need. For the
+serving engine the serial counts `InferenceEngine.step()` calls.
 
 The elastic kinds are HOST faults (no device-step variant): the engine
 pops them via `take_host_faults()` right after `plan_next_step()`.
@@ -40,6 +47,18 @@ reproduce exactly what a dead/wedged remote host looks like to the
 observer; ``barrier_timeout`` arms `utils.distributed.barrier` to raise
 a typed `BarrierTimeoutError` on its next rendezvous (e.g. the next
 checkpoint commit), driving the fail-fast-and-hand-off path.
+
+The SERVING kinds are host faults too, consumed by `InferenceEngine`
+(the training engine ignores them): ``prefill_error`` /
+``decode_error`` raise an `InjectedServingFault` in place of the
+compiled prefill/decode call — driving the quarantine → retry → poison
+path; ``decode_stall`` sleeps inside the decode phase (drives the
+serving hang watchdog); ``page_pool_pressure`` seizes a fraction of
+the free page pool for the step (drives eviction under memory
+pressure and the admission controller's shedding signal). Together
+they make every shed/quarantine/retry/watchdog path single-host
+testable (`docs/inference.md`, the ``chaos`` test marker, and the
+``DS_BENCH_SERVE_CHAOS=1`` bench row).
 """
 
 import json
@@ -49,10 +68,22 @@ import jax.numpy as jnp
 
 from .config_utils import DeepSpeedConfigError
 
+SERVING_FAULT_KINDS = ("prefill_error", "decode_error", "decode_stall",
+                       "page_pool_pressure")
 FAULT_KINDS = ("nan_grads", "loss_spike", "stall",
-               "peer_death", "slow_peer", "barrier_timeout")
-HOST_FAULT_KINDS = ("peer_death", "slow_peer", "barrier_timeout")
+               "peer_death", "slow_peer", "barrier_timeout") + \
+    SERVING_FAULT_KINDS
+HOST_FAULT_KINDS = ("peer_death", "slow_peer", "barrier_timeout") + \
+    SERVING_FAULT_KINDS
 DEFAULT_SIM_PEER = "sim_peer_0"
+PAGE_POOL_PRESSURE_DEFAULT_FRACTION = 0.9
+
+
+class InjectedServingFault(RuntimeError):
+    """The exception `prefill_error`/`decode_error` faults raise in
+    place of the compiled serving call — a stand-in for a real
+    transient step failure (XLA runtime error, device OOM burst), typed
+    so tests can tell injected failures from genuine bugs."""
 
 # device-side injection modes (the (mode, factor) scalar pair)
 MODE_NONE = 0
@@ -108,7 +139,9 @@ def validate_fault_spec(spec, where="training_health.fault_injection"):
             raise DeepSpeedConfigError(
                 f"{where}.faults[{i}].times must be an int >= 1, got "
                 f"{times!r}")
-        factor = fault.get("factor", 1e3)
+        factor = fault.get("factor",
+                           PAGE_POOL_PRESSURE_DEFAULT_FRACTION
+                           if kind == "page_pool_pressure" else 1e3)
         seconds = fault.get("seconds", 1.0)
         for key, value in (("factor", factor), ("seconds", seconds)):
             if not isinstance(value, (int, float)) or \
@@ -116,6 +149,11 @@ def validate_fault_spec(spec, where="training_health.fault_injection"):
                 raise DeepSpeedConfigError(
                     f"{where}.faults[{i}].{key} must be a number > 0, "
                     f"got {value!r}")
+        if kind == "page_pool_pressure" and factor > 1:
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}].factor is the fraction of the "
+                f"free page pool to seize for a page_pool_pressure "
+                f"fault — must be in (0, 1], got {factor!r}")
         peer = fault.get("peer", DEFAULT_SIM_PEER)
         if not isinstance(peer, str) or not peer:
             raise DeepSpeedConfigError(
@@ -169,6 +207,10 @@ class FaultInjector:
     def has_device_faults(self):
         return any(f["kind"] in ("nan_grads", "loss_spike")
                    for f in self.faults)
+
+    @property
+    def has_serving_faults(self):
+        return any(f["kind"] in SERVING_FAULT_KINDS for f in self.faults)
 
     @property
     def simulated_peers(self):

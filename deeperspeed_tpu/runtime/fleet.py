@@ -60,19 +60,30 @@ FLEET_WINDOW_METRICS = ("step_time_ms", "data_wait_ms", "ckpt_stall_ms")
 
 def _default_transports():
     """(summary, trace) transports: coordination-service KV when a
-    multi-host client exists, process-local otherwise."""
+    multi-host client exists, process-local otherwise. Wrapped in the
+    shared retry policy (`utils/kv_retry.py`): transient KV blips are
+    retried with capped backoff × jitter, and persistent failure
+    degrades to a local in-memory store with ONE warning — fleet
+    scalars then cover this host only instead of erroring every
+    window (the aggregator's `_note_transport_error` stays as the
+    last-resort backstop for transports injected by tests)."""
     import jax
 
     from ..elasticity.heartbeat import (CoordinationTransport,
                                         InMemoryTransport)
+    from ..utils.kv_retry import wrap_kv_transport
     if jax.process_count() > 1:
         from ..utils.distributed import _distributed_client
         client = _distributed_client()
         if client is not None:
-            return (CoordinationTransport(client,
-                                          prefix=FLEET_SUMMARY_PREFIX),
-                    CoordinationTransport(client,
-                                          prefix=FLEET_TRACE_PREFIX))
+            return (wrap_kv_transport(
+                        CoordinationTransport(
+                            client, prefix=FLEET_SUMMARY_PREFIX),
+                        degrade_to_local=True, name="fleet summary"),
+                    wrap_kv_transport(
+                        CoordinationTransport(
+                            client, prefix=FLEET_TRACE_PREFIX),
+                        degrade_to_local=True, name="fleet trace"))
         logger.warning(  # pragma: no cover - private-API drift
             "fleet: no coordination client available; cross-host "
             "aggregation degrades to process-local summaries")

@@ -239,7 +239,14 @@ class HangWatchdog:
     On expiry the callback fires ONCE per armed window (a genuinely hung
     step must not spam a dump per poll tick). The thread holds only a
     weakref to its owner so discarded engines stay collectible; it exits
-    when the owner does."""
+    when the owner does.
+
+    Two owners share it: the training sentinel (`TrainingHealthSentinel`,
+    `training_health.hang_timeout_seconds`) and the serving engine
+    (`InferenceEngine._on_serving_hang`, `inference.hang_timeout_s` —
+    expiry there requests a drain-style emergency flush instead of an
+    emergency checkpoint). Both skip arming while the step's program is
+    still compiling: an XLA compile is not a hang."""
 
     def __init__(self, timeout_s, owner, on_expire_name):
         self.timeout_s = float(timeout_s)

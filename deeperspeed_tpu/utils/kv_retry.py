@@ -1,0 +1,126 @@
+"""Shared retry/degrade policy for coordination-service KV transports.
+
+PR 9's heartbeats (`elasticity/heartbeat.py`) and PR 10's fleet
+aggregation (`runtime/fleet.py`) both ride the jax.distributed
+coordination-service KV store, and each hand-rolled its own error
+handling: the heartbeat monitor counted every error toward coordinator
+death with no retry (one gRPC blip = a logged transport error), the
+fleet aggregator degraded to own-host scalars on the FIRST error of any
+publish/collect (one blip = a silently thinner window). This module is
+the one policy both now share:
+
+- `RetryingKVTransport` wraps any transport exposing
+  ``publish(peer, payload)`` / ``read_all()`` with **capped exponential
+  backoff × uniform jitter** retries (the PR 9 supervisor's backoff
+  law): transient coordination-service blips are absorbed before any
+  caller-visible failure.
+- With ``degrade_to_local=True`` (the fleet posture), attempts
+  exhausting on an op logs ONE warning and permanently degrades to an
+  in-process `InMemoryTransport` — callers keep own-host behavior (rank
+  0 still aggregates its own summaries) instead of erroring every
+  window.
+- With ``degrade_to_local=False`` (the heartbeat posture), the final
+  error is re-raised: `PeerHealthMonitor.poll_once` MUST see persistent
+  failure — its continuous-outage escalation (declare the coordination
+  service itself a dead peer after ``fail_after_s``) is the detection
+  path, and a silent local fallback would blind it.
+
+Retries sleep at most ``sum(min(base·2^i, cap))`` per op — keep
+``attempts`` small on paths polled from daemon threads.
+"""
+
+import random
+import time
+
+from .logging import logger
+
+
+def backoff_delay(attempt, base, cap, jitter, rng=None):
+    """THE capped-exponential-backoff × uniform-jitter law, shared by
+    every retry policy in the tree (this transport wrapper, the PR 9
+    restart supervisor, the serving quarantine): delay for 1-based
+    retry ``attempt`` is ``min(base · 2^(attempt-1), cap)`` scaled by a
+    uniform factor in ``[1 - jitter, 1 + jitter]``. Units are whatever
+    ``base``/``cap`` are in (the supervisor uses seconds, the serving
+    quarantine milliseconds). ``rng`` needs only ``.random()``."""
+    delay = min(float(base) * 2.0 ** (int(attempt) - 1), float(cap))
+    if jitter:
+        r = random.random() if rng is None else rng.random()
+        delay *= 1.0 + float(jitter) * (2.0 * r - 1.0)
+    return max(delay, 0.0)
+
+
+class RetryingKVTransport:
+    """Capped-exponential-backoff × jitter retry wrapper over a
+    heartbeat/fleet KV transport (see the module docstring for the two
+    degrade postures)."""
+
+    def __init__(self, transport, attempts=3, backoff_base_s=0.05,
+                 backoff_cap_s=1.0, jitter=0.5, degrade_to_local=False,
+                 name="kv", rng=None, sleep=time.sleep):
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
+        if not 0 <= jitter < 1:
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        self.transport = transport
+        self.attempts = int(attempts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.jitter = float(jitter)
+        self.degrade_to_local = bool(degrade_to_local)
+        self.name = str(name)
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._local = None           # set once degraded
+        self.retry_count = 0
+        self.error_count = 0
+
+    @property
+    def degraded(self):
+        return self._local is not None
+
+    def _backoff_s(self, attempt):
+        """Delay before retry `attempt` (1-based): the shared capped
+        exponential × jitter law — independent publishers must not
+        stampede a recovering coordinator in lockstep."""
+        return backoff_delay(attempt, self.backoff_base_s,
+                             self.backoff_cap_s, self.jitter, self._rng)
+
+    def _call(self, op, *args):
+        if self._local is not None:
+            return getattr(self._local, op)(*args)
+        last = None
+        for attempt in range(1, self.attempts + 1):
+            try:
+                return getattr(self.transport, op)(*args)
+            except Exception as e:  # noqa: BLE001 - the policy seam
+                last = e
+                self.error_count += 1
+                if attempt < self.attempts:
+                    self.retry_count += 1
+                    self._sleep(self._backoff_s(attempt))
+        if not self.degrade_to_local:
+            raise last
+        # single-warning degrade-to-local: all further ops run against
+        # an in-process store, preserving own-host behavior
+        from ..elasticity.heartbeat import InMemoryTransport
+        self._local = InMemoryTransport()
+        logger.warning(
+            f"{self.name}: coordination-service KV {op} still failing "
+            f"after {self.attempts} attempt(s) "
+            f"({type(last).__name__}: {last}) — degrading to a local "
+            f"in-memory store (this host only; warned once)")
+        return getattr(self._local, op)(*args)
+
+    def publish(self, peer, payload):
+        return self._call("publish", peer, payload)
+
+    def read_all(self):
+        return self._call("read_all")
+
+
+def wrap_kv_transport(transport, degrade_to_local, name):
+    """The standard wrapping both subsystems use (one knob site)."""
+    return RetryingKVTransport(transport,
+                               degrade_to_local=degrade_to_local,
+                               name=name)
