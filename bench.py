@@ -53,6 +53,12 @@ KEYS (default all):
              stages x remaining-chips ZeRO-1 data parallel, classic and
              comm-overlap wire schedules, analytic bubble fraction +
              zero-recompile check; opt-in via DS_BENCH_PIPE=1)
+  - offload  (tiered-offload rows: the explicit schedule on-chip vs
+             host-DRAM rows vs NVMe rows (DS_BENCH_OFFLOAD_NVME=path)
+             with step time / prefetch-stall fraction / h2d+d2h wire
+             volume, plus a DS_BENCH_OFFLOAD_RATIO x-HBM synthetic rung
+             trained on the host tier vs the flops-extrapolated on-chip
+             time; opt-in via DS_BENCH_OFFLOAD=1)
 
 The zero3 row additionally measures `zero3_explicit` — the explicit
 shard_map collective schedule (layer-ahead bucketed all-gather prefetch,
@@ -76,7 +82,7 @@ ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
                "moe": 800, "serve": 800, "serve_chaos": 900,
-               "zero3": 800, "pipe": 900,
+               "zero3": 800, "pipe": 900, "offload": 1100,
                "elastic": 600, "fleet": 600}  # moe/longseq walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
@@ -1494,6 +1500,145 @@ def row_elastic():
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def row_offload():
+    """Tiered-offload row (opt-in via DS_BENCH_OFFLOAD=1): the explicit
+    schedule run three ways — on-chip (the extrapolation baseline),
+    host-DRAM rows (offload_param+offload_optimizer cpu, double-buffered
+    prefetch), and NVMe rows when DS_BENCH_OFFLOAD_NVME names a path —
+    with step time, prefetch-stall fraction and h2d/d2h wire volume per
+    tier, plus a synthetic beyond-HBM rung: a model sized
+    DS_BENCH_OFFLOAD_RATIO x device HBM (fallback
+    DS_BENCH_OFFLOAD_SYNTH_GB when the backend reports no bytes_limit,
+    e.g. the CPU lane) trains on the host-DRAM tier, and its measured
+    step time is compared against the on-chip row extrapolated by the
+    flops ratio (`offload_synth_overlap_fraction` — the >0.8 target)."""
+    jax = _setup_jax()
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+    n_chips = len(jax.devices())
+    cfg, model, params = _headline_setup(jax)
+    seq = min(int(os.environ.get("DS_BENCH_SEQ", "1024")),
+              cfg.max_seq_len)
+    bs = int(os.environ.get("DS_BENCH_OFFLOAD_BS", "8"))
+    batch = bs * n_chips
+    prefetch = int(os.environ.get("DS_BENCH_OFFLOAD_PREFETCH", "2"))
+    group = int(os.environ.get("DS_BENCH_OFFLOAD_GROUP", "4"))
+    steps = int(os.environ.get("DS_BENCH_OFFLOAD_STEPS", "6"))
+    sched = {"mode": "explicit", "prefetch_depth": prefetch,
+             "group_layers": group}
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                          dtype=np.int32)
+    out = {"offload_prefetch_depth": prefetch,
+           "offload_group_layers": group,
+           "offload_batch_per_chip": bs, "offload_seq": seq}
+
+    def run(tag, zero_cfg, mdl=None, prm=None, toks=None, n_steps=steps,
+            warmup=2, bsize=None):
+        def thunk():
+            eng = _neox_engine(mdl or model, prm if prm is not None
+                               else params, bsize or batch, zero_cfg)
+            t = toks if toks is not None else tokens
+            # warmup OUTSIDE timed_steps, then snapshot the offload
+            # counters: compile-time waits and cold first uploads would
+            # otherwise inflate the stall fraction / wire volume of the
+            # timed window
+            for _ in range(warmup):
+                eng.train_batch(batch=(t, t))
+            base = dict(getattr(eng, "_offload_totals", {}))
+            dt, loss = timed_steps(eng, (t, t), steps=n_steps, warmup=0)
+            res = {f"offload_{tag}_step_ms": round(dt / n_steps * 1e3, 1),
+                   f"offload_{tag}_loss": round(loss, 3)}
+            tot = {k: v - base.get(k, 0)
+                   for k, v in dict(getattr(eng,
+                                            "_offload_totals",
+                                            {})).items()}
+            if tot.get("bytes_h2d"):
+                res[f"offload_{tag}_stall_fraction"] = round(
+                    tot.get("prefetch_stall_s", 0.0) / dt, 4)
+                res[f"offload_{tag}_h2d_gb"] = round(
+                    tot["bytes_h2d"] / 2**30, 3)
+                res[f"offload_{tag}_d2h_gb"] = round(
+                    tot["bytes_d2h"] / 2**30, 3)
+            del eng
+            gc.collect()
+            return res
+        return thunk
+
+    onchip_zero = {"stage": 3, "schedule": dict(sched)}
+    host_zero = {"stage": 3, "schedule": dict(sched),
+                 "offload_optimizer": {"device": "cpu"},
+                 "offload_param": {"device": "cpu"}}
+    out = _ladder([("explicit", run("onchip", onchip_zero))], out,
+                  "offload_onchip")
+    out = _ladder([("host_dram", run("host", host_zero))], out,
+                  "offload_host")
+    nvme_path = os.environ.get("DS_BENCH_OFFLOAD_NVME")
+    if nvme_path:
+        nvme_zero = {"stage": 3, "schedule": dict(sched),
+                     "offload_optimizer": {"device": "cpu"},
+                     "offload_param": {"device": "nvme",
+                                       "nvme_path": nvme_path}}
+        out = _ladder([("nvme", run("nvme", nvme_zero))], out,
+                      "offload_nvme")
+    if "offload_onchip_step_ms" in out and "offload_host_step_ms" in out:
+        out["offload_host_vs_onchip"] = round(
+            out["offload_onchip_step_ms"] / out["offload_host_step_ms"],
+            4)
+
+    # --- synthetic beyond-HBM rung ------------------------------------
+    try:
+        hbm = (jax.devices()[0].memory_stats() or {}).get("bytes_limit")
+    except Exception:  # noqa: BLE001 - backends without memory_stats
+        hbm = None
+    ratio = float(os.environ.get("DS_BENCH_OFFLOAD_RATIO", "4"))
+    if hbm:
+        target = ratio * hbm
+    else:
+        target = float(os.environ.get(
+            "DS_BENCH_OFFLOAD_SYNTH_GB", "0.5")) * 2**30
+    H, V = 2048, cfg.vocab_size
+    itemsize = 2   # bf16 compute rows are what rest in DRAM
+    L = max(2, int(-(-(target / itemsize - V * H) // (12 * H * H))))
+    synth_cfg = GPTNeoXConfig(vocab_size=V, hidden_size=H,
+                              num_layers=L, num_heads=16,
+                              max_seq_len=256)
+    synth_seq = min(256, seq)
+    sbs = max(n_chips, int(os.environ.get("DS_BENCH_OFFLOAD_SYNTH_BS",
+                                          str(n_chips))))
+    synth_model = GPTNeoX(synth_cfg, use_pallas=True)
+    cpu0 = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu0):
+        # init on HOST: the whole point is that this model does not fit
+        # HBM — params flow host-init -> row store, never full on chip
+        synth_params = synth_model.init_params(jax.random.PRNGKey(0))
+    synth_bytes = synth_cfg.num_params() * itemsize
+    out["offload_synth_params_m"] = round(synth_cfg.num_params() / 1e6, 1)
+    out["offload_synth_hbm_ratio"] = (
+        round(synth_bytes / hbm, 2) if hbm else None)
+    stoks = rng.integers(0, V, size=(1, sbs, synth_seq), dtype=np.int32)
+
+    def synth_done(res):
+        # extrapolate the on-chip row to the synthetic shape by the
+        # flops ratio (same schedule, same per-flop speed assumption)
+        if "offload_onchip_step_ms" in out:
+            base = out["offload_onchip_step_ms"]
+            scale = ((_flops_per_token(synth_cfg, synth_seq)
+                      * sbs * synth_seq)
+                     / (_flops_per_token(cfg, seq) * batch * seq))
+            extrapolated = base * scale
+            res["offload_synth_extrapolated_onchip_ms"] = round(
+                extrapolated, 1)
+            res["offload_synth_overlap_fraction"] = round(
+                extrapolated / res["offload_synth_step_ms"], 4)
+        return res
+
+    out = _ladder([("synth_host_dram", lambda: synth_done(run(
+        "synth", host_zero, mdl=synth_model, prm=synth_params,
+        toks=stoks, n_steps=2, warmup=1, bsize=sbs)()))], out,
+        "offload_synth")
+    return out
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
@@ -1501,7 +1646,7 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "packed": row_packed, "serve": row_serve,
            "serve_chaos": row_serve_chaos,
            "elastic": row_elastic, "fleet": row_fleet,
-           "pipe": row_pipe}
+           "pipe": row_pipe, "offload": row_offload}
 
 
 # ---------------------------------------------------------------------------
@@ -1532,6 +1677,8 @@ def rows_enabled():
         order.append("fleet")
     if os.environ.get("DS_BENCH_PIPE", "0") not in ("0", "", "false"):
         order.append("pipe")
+    if os.environ.get("DS_BENCH_OFFLOAD", "0") not in ("0", "", "false"):
+        order.append("offload")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -1540,7 +1687,7 @@ def rows_enabled():
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
-                   "serve_chaos", "elastic", "fleet", "pipe"):
+                   "serve_chaos", "elastic", "fleet", "pipe", "offload"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

@@ -1362,6 +1362,160 @@ class GPTNeoX:
 
         return loss_and_grads
 
+    # -- tiered parameter/optimizer offload on the explicit schedule
+    #    (offload_param + zero_optimization.schedule.mode = "explicit";
+    #    runtime/zero/offload_engine.py) -------------------------------
+
+    def build_tiered_offload_step(self, mesh, data_axis, schedule,
+                                  host_params):
+        """Per-segment jitted programs for the tiered-offload executor:
+        embed / block-group / head forward+backward, each a shard_map
+        over ``data_axis`` consuming rank-major parameter ROWS (the
+        `offload_layer_plan` layout the host store uploads). Inside
+        each group program the rows all-gather bucketed and
+        ``schedule.prefetch_depth`` layers ahead (`make_group_body` —
+        the SAME body the in-jit explicit schedule scans) and the
+        backward's gather transposes reduce-scatter each grad row to
+        its owner shard. ``host_params`` is the compute-dtype natural
+        host tree (template for shapes/dtypes only)."""
+        cfg = self.config
+        if getattr(cfg, "moe_num_experts", 0):
+            raise NotImplementedError(
+                "the tiered-offload executor does not support MoE "
+                "blocks (aux-loss threading)")
+        if cfg.attention_engine == "sparse" or self._attn_fn is not None:
+            raise NotImplementedError(
+                "the tiered-offload executor runs the dense flash/XLA "
+                "attention core; sparse_attention and sequence_parallel "
+                "are unsupported")
+        if cfg.use_segment_ids:
+            raise NotImplementedError(
+                "packing (use_segment_ids) is not supported on the "
+                "tiered-offload executor yet")
+        from ..compat import shard_map
+        from ..parallel.schedule import (_segment_sizes, make_group_body,
+                                         offload_layer_plan)
+        from ..runtime.zero.offload_engine import TieredPrograms
+
+        P_ = P
+        world = int(mesh.shape[data_axis])
+        depth = schedule.prefetch_depth
+        L = cfg.num_layers
+        if self.number_checkpoints:
+            group = max(1, -(-L // int(self.number_checkpoints)))
+        else:
+            group = schedule.group_layers
+        use_pallas = self.use_pallas
+        bucket = schedule.bucket_bytes
+        tied = "embed_out" not in host_params
+
+        plans = {
+            "embed": offload_layer_plan(
+                {"wte": host_params["embed"]["wte"]}, data_axis, world,
+                bucket),
+            "block": offload_layer_plan(
+                host_params["blocks"][0], data_axis, world, bucket),
+            "final_ln": offload_layer_plan(
+                host_params["final_ln"], data_axis, world, bucket),
+            "embed_out": None,
+        }
+        if not tied:
+            plans["embed_out"] = offload_layer_plan(
+                {"wte": host_params["embed_out"]["wte"]}, data_axis,
+                world, bucket)
+        we_plan = plans["embed"] if tied else plans["embed_out"]
+
+        R, RG, B = P_(data_axis), P_(None, data_axis), P_(data_axis)
+
+        def rebuild1(plan, local_row):
+            return plan.rebuild(plan.gather_row(local_row), [])
+
+        def smap(f, in_specs, out_specs, donate):
+            return jax.jit(
+                shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False),
+                donate_argnums=donate)
+
+        # --- embed ----------------------------------------------------
+        def _embed_fwd(row, tokens):
+            return rebuild1(plans["embed"], row)["wte"][tokens]
+
+        embed_fwd = smap(_embed_fwd, (R, B), B, (0,))
+
+        def _embed_grad(row, tokens, dx):
+            def f(r):
+                return rebuild1(plans["embed"], r)["wte"][tokens]
+
+            _, vjp = jax.vjp(f, row)
+            (drow,) = vjp(dx)
+            return drow
+
+        embed_grad = smap(_embed_grad, (R, B, B), R, (0, 2))
+
+        # --- block groups ---------------------------------------------
+        def group_chain(g):
+            def chain(rows, x):
+                cos_sin = _rotary_cache(cfg, x.shape[1])
+
+                def block_fn(bp, xx):
+                    return block_forward(cfg, bp, xx, cos_sin,
+                                         use_pallas=use_pallas)
+
+                body = make_group_body(block_fn, plans["block"], depth)
+                return body(x, [rows[j] for j in range(g)],
+                            [[] for _ in range(g)])
+            return chain
+
+        group_fwd, group_grad = {}, {}
+        sizes = _segment_sizes(L, -(-L // max(1, int(group))))
+        for g in sorted(set(sizes)):
+            chain = group_chain(g)
+            group_fwd[g] = smap(chain, (RG, B), B, (0,))
+
+            def _grad(rows, x_in, ct, _chain=chain):
+                _, vjp = jax.vjp(_chain, rows, x_in)
+                drows, dx = vjp(ct)
+                return dx, drows
+
+            group_grad[g] = smap(_grad, (RG, B, B), (B, RG), (0, 1, 2))
+
+        # --- head (final_ln + LM head; tied reuses the embed row) -----
+        def head_core(row_ln, row_we, x, labels):
+            ln = rebuild1(plans["final_ln"], row_ln)
+            wte = rebuild1(we_plan, row_we)["wte"]
+            h = layer_norm(x, ln["scale"], ln["bias"], cfg.layernorm_eps)
+            return fused_lm_head_loss(h, wte, labels)
+
+        def _head_loss(row_ln, row_we, x, labels):
+            return jax.lax.pmean(head_core(row_ln, row_we, x, labels),
+                                 data_axis)
+
+        head_loss = smap(_head_loss, (R, R, B, B), P_(), (0, 1, 2))
+
+        def _head_grad(row_ln, row_we, x, labels, scale):
+            def f(r_ln, r_we, xx):
+                loss = head_core(r_ln, r_we, xx, labels)
+                return loss * scale.astype(loss.dtype), loss
+
+            scaled, vjp, loss = jax.vjp(f, row_ln, row_we, x,
+                                        has_aux=True)
+            d_ln, d_we, dx = vjp(jnp.ones((), scaled.dtype))
+            return jax.lax.pmean(loss, data_axis), dx, d_ln, d_we
+
+        head_grad = smap(_head_grad, (R, R, B, B, P_()),
+                         (P_(), B, R, R), (0, 1, 2))
+
+        def split_batch(batch):
+            tokens, labels, _ = split_lm_batch(batch)
+            return tokens, labels
+
+        return TieredPrograms(
+            plans=plans, group_sizes=sizes, tied=tied,
+            embed_fwd=embed_fwd, embed_grad=embed_grad,
+            group_fwd=group_fwd, group_grad=group_grad,
+            head_loss=head_loss, head_grad=head_grad,
+            split_batch=split_batch)
+
 
 # ---------------------------------------------------------------------------
 # autoregressive generation (KV cache; single jitted prefill + scan decode)

@@ -289,6 +289,62 @@ def plan_buckets(shard_size, itemsize, bucket_bytes):
     return out
 
 
+# ---------------------------------------------------------------------------
+# tiered-offload row layout (host DRAM/NVMe <-> the gather schedule)
+# ---------------------------------------------------------------------------
+
+def offload_layer_plan(template, axis_name, world, bucket_bytes):
+    """`LayerPlan` for the tiered-offload executor: EVERY leaf stored
+    flat-padded and sharded over the data axis, so a segment's host
+    store is one uniform rank-major row (`pack_plan_rows`) and the
+    device side reuses the explicit schedule's bucketed `gather_row` /
+    `rebuild` unchanged. ``template`` must carry real shapes/dtypes
+    (the compute-dtype host params)."""
+    from ..runtime.zero.partition_parameters import FlatPad
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+
+    def pad_of(l):
+        numel = int(np.prod(np.shape(l))) if np.shape(l) else 1
+        padded = -(-numel // world) * world
+        return FlatPad(np.shape(l), numel, padded)
+
+    pads = treedef.unflatten([pad_of(l) for l in leaves])
+    specs = treedef.unflatten([jax.sharding.PartitionSpec(axis_name)
+                               for _ in leaves])
+    return LayerPlan(template, specs, pads, axis_name, world, bucket_bytes)
+
+
+def pack_plan_rows(plan, leaves):
+    """Flatten-order natural host leaves -> ONE rank-major [world * S]
+    row (the tiered-offload host/NVMe storage layout): uploading it with
+    a P(data) sharding hands each device exactly its `concat_shards`
+    local row, so `gather_row` + `rebuild` reproduce the natural leaves
+    bit-exactly. Pad tails are zero."""
+    world = plan.world
+    blocks = []
+    for l, pl in zip(leaves, plan.placements):
+        if not pl.gathered:
+            raise ValueError("pack_plan_rows requires an offload_layer_plan "
+                             "(every leaf flat-sharded)")
+        flat = np.ravel(np.asarray(l))
+        padded = np.zeros(pl.pad.padded, flat.dtype)
+        padded[:flat.size] = flat
+        blocks.append(padded.reshape(world, -1))
+    return np.hstack(blocks).reshape(-1)
+
+
+def unpack_plan_row(plan, row):
+    """Inverse of `pack_plan_rows`: rank-major [world * S] row ->
+    flatten-order natural numpy leaves (copies)."""
+    mat = np.asarray(row).reshape(plan.world, plan.shard_size)
+    out = []
+    for pl, off in zip(plan.placements, plan.offsets):
+        piece = mat[:, off:off + pl.size].reshape(-1)[:pl.pad.numel]
+        out.append(np.array(piece).reshape(pl.pad.shape))
+    return out
+
+
 def _segment_sizes(n_layers, n_groups):
     """As-equal-as-possible group sizes (mirror of
     models.gpt_neox.segment_sizes, kept local to avoid a models import
@@ -296,6 +352,36 @@ def _segment_sizes(n_layers, n_groups):
     n = max(1, min(int(n_groups), n_layers))
     return [n_layers // n + (1 if i < n_layers % n else 0)
             for i in range(n)]
+
+
+def make_group_body(block_fn, plan, depth, has_rows=True):
+    """One remat/prefetch group of uniform layers: python-unrolled, with
+    bucketed gathers issued ``depth`` layers ahead in program order (the
+    double-buffer XLA's latency-hiding scheduler overlaps with the layer
+    matmuls). Shared by `prefetched_block_scan` (in-jit scan over groups)
+    and the tiered-offload executor (host loop over per-group programs —
+    `runtime/zero/offload_engine.py`), so the two schedules cannot drift.
+
+    Returns ``group_body(x, rows_g, rep_g) -> x`` where ``rows_g`` is a
+    list of g per-layer [S] shard rows (or Nones when the plan has no
+    gathered leaves) and ``rep_g`` a list of g replicated-leaf lists."""
+
+    def group_body(x, rows_g, rep_g):
+        g = len(rep_g)
+        d = min(depth, g)
+        gathered = {}
+        if has_rows:
+            for j in range(d):
+                gathered[j] = plan.gather_row(rows_g[j])
+        for i in range(g):
+            if has_rows and i + d < g:
+                gathered[i + d] = plan.gather_row(rows_g[i + d])
+            bp = plan.rebuild(gathered.pop(i) if has_rows else None,
+                              rep_g[i])
+            x = block_fn(bp, x)
+        return x
+
+    return group_body
 
 
 def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
@@ -331,26 +417,7 @@ def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
     rows = [plan.concat_shards(lv) for lv in layer_leaves]
     rep_by_layer = [rep for _, rep in split]
     has_rows = bool(rows) and rows[0] is not None
-
-    def group_body(x, rows_g, rep_g):
-        """One remat group: python-unrolled layers, gathers issued
-        ``depth`` layers ahead in program order (the double-buffer XLA's
-        latency-hiding scheduler overlaps with the layer matmuls).
-        rows_g: list of g [S] rows (or Nones); rep_g: list of g
-        replicated-leaf lists."""
-        g = len(rep_g)
-        d = min(depth, g)
-        gathered = {}
-        if has_rows:
-            for j in range(d):
-                gathered[j] = plan.gather_row(rows_g[j])
-        for i in range(g):
-            if has_rows and i + d < g:
-                gathered[i + d] = plan.gather_row(rows_g[i + d])
-            bp = plan.rebuild(gathered.pop(i) if has_rows else None,
-                              rep_g[i])
-            x = block_fn(bp, x)
-        return x
+    group_body = make_group_body(block_fn, plan, depth, has_rows=has_rows)
 
     sizes = _segment_sizes(n_layers, -(-n_layers // max(1,
                                                         int(group_layers))))

@@ -51,6 +51,28 @@ def as_int(value, name):
     return ivalue
 
 
+def strict_positive_int(param_dict, key, default, scope):
+    """Checkpoint-block-strict positive-int knob: coerces JSON numerics,
+    rejects < 1. ``scope`` prefixes the error ('aio',
+    'zero_optimization.offload_param', ...)."""
+    value = as_int(get_scalar_param(param_dict, key, default),
+                   f"{scope}.{key}")
+    if value < 1:
+        raise DeepSpeedConfigError(
+            f"'{scope}.{key}' must be a positive integer, got {value}")
+    return value
+
+
+def strict_bool(param_dict, key, default, scope):
+    """Checkpoint-block-strict boolean knob: only real JSON booleans
+    pass ('true'/1 must not silently truthy-coerce)."""
+    value = get_scalar_param(param_dict, key, default)
+    if not isinstance(value, bool):
+        raise DeepSpeedConfigError(
+            f"'{scope}.{key}' must be a boolean, got {value!r}")
+    return value
+
+
 def dict_raise_error_on_duplicate_keys(ordered_pairs):
     """Alias kept for parity with the reference helper name."""
     return _reject_duplicate_keys(ordered_pairs)

@@ -277,6 +277,10 @@ class DeepSpeedEngine:
             self._config.telemetry_config, monitor=self.monitor,
             devices=local or jax.local_devices())
         self._step_flops = {}   # compiled-variant key -> per-device flops
+        # cumulative offload-tier counters (stall/bytes/flops) across the
+        # run — per-step values are drained into telemetry; bench rows
+        # read these totals
+        self._offload_totals = {}
 
         # MoE routing observability (moe.observability): the sort
         # engine's in-jit stats land host-side via an async callback and
@@ -300,13 +304,29 @@ class DeepSpeedEngine:
         self.param_offload = zc.offload_param is not None
         self._param_nvme = (self.param_offload and
                             zc.offload_param.device == "nvme")
+        # Tiered-offload executor (runtime/zero/offload_engine.py):
+        # offload_param composed with the EXPLICIT schedule runs the
+        # per-group schedule programs with double-buffered host->HBM row
+        # prefetch instead of the legacy one-segment-at-a-time stream.
+        self._tiered = None
+        self._tiered_mode = (self.param_offload and
+                             zc.schedule.mode == "explicit")
         if self.param_offload:
             if not self.host_offload:
                 raise DeepSpeedConfigError(
                     "offload_param requires offload_optimizer: the "
                     "ZeRO-Infinity host tier owns the fp32 masters that "
                     "the streamed update writes back")
-            if not hasattr(model, "stream_plan"):
+            if self._tiered_mode:
+                if not hasattr(model, "build_tiered_offload_step"):
+                    raise DeepSpeedConfigError(
+                        "offload_param with zero_optimization.schedule."
+                        "mode \"explicit\" needs a model exposing "
+                        "build_tiered_offload_step(...) (the tiered-"
+                        "offload group programs; models.gpt_neox.GPTNeoX "
+                        "implements it). Drop the schedule block for the "
+                        "legacy layer-streamed executor (stream_plan)")
+            elif not hasattr(model, "stream_plan"):
                 raise DeepSpeedConfigError(
                     "offload_param needs a model exposing stream_plan() "
                     "(a layer-streaming decomposition; see "
@@ -679,12 +699,19 @@ class DeepSpeedEngine:
         checkpoints are untouched — only `_loss_and_grads` runs the
         scheduled program, so trajectory parity with the GSPMD path
         holds to float tolerance."""
+        if self._tiered is not None:
+            # offload_param + explicit = the tiered-offload executor:
+            # the schedule's group programs were built in
+            # _init_tiered_state; the in-jit whole-step loss below does
+            # not apply (params never fully enter HBM)
+            return
         if self.host_offload or self.param_offload:
             raise DeepSpeedConfigError(
-                "zero_optimization.schedule.mode \"explicit\" is "
-                "unsupported with the offload tiers: their host-side "
-                "grad paths bypass the in-jit schedule (the run would "
-                "silently train unscheduled)")
+                "zero_optimization.schedule.mode \"explicit\" with "
+                "offload_optimizer alone is unsupported (the host-side "
+                "grad path bypasses the in-jit schedule); add "
+                "offload_param for the tiered-offload executor, or use "
+                "schedule.mode \"gspmd\"")
         if self._onebit_packed_active():
             raise DeepSpeedConfigError(
                 "explicit schedule + packed-transport 1-bit optimizers "
@@ -884,6 +911,13 @@ class DeepSpeedEngine:
 
     def params_to_natural(self, tree):
         """Engine params state → natural (user-facing) param tree."""
+        if getattr(self, "_tiered", None) is not None:
+            # tiered rows are the store of record: assemble natural
+            # leaves (transiently model-sized on host — export/
+            # checkpoint only)
+            treedef = jax.tree_util.tree_structure(self.state.params)
+            return jax.tree_util.tree_unflatten(
+                treedef, self._tiered.leaves_natural())
         if getattr(self, "_grad_spill", None) is not None:
             # NVMe store of record: materialize from the segment files
             # (transiently model-sized on host — export/checkpoint only)
@@ -895,7 +929,8 @@ class DeepSpeedEngine:
 
     def params_natural_like(self):
         """Structure template for the natural param tree."""
-        if getattr(self, "_grad_spill", None) is not None:
+        if getattr(self, "_tiered", None) is not None or \
+                getattr(self, "_grad_spill", None) is not None:
             # placeholder tree carries the full structure; no NVMe reads
             return self.state.params
         return self.params_to_natural(self.state.params)
@@ -907,6 +942,11 @@ class DeepSpeedEngine:
         the host/NVMe store instead — full params never enter HBM."""
         if getattr(self, "param_offload", False):
             dt = np.dtype(self.compute_dtype)
+            if getattr(self, "_tiered", None) is not None:
+                self._tiered.write_natural(
+                    [np.asarray(l, dt)
+                     for l in jax.tree_util.tree_leaves(tree)])
+                return self.state.params
             if getattr(self, "_grad_spill", None) is not None:
                 for name, sel in self._stream_plan.segments:
                     sub = jax.tree_util.tree_map(
@@ -1054,12 +1094,20 @@ class DeepSpeedEngine:
             from .zero.param_offload import LazyLeaf
             lazy = any(isinstance(l, LazyLeaf)
                        for l in jax.tree_util.tree_leaves(model_parameters))
+            if lazy and self._tiered_mode:
+                raise DeepSpeedConfigError(
+                    "LazyLeaf parameters need the legacy layer-streamed "
+                    "executor (its segment-by-segment spill is the "
+                    "beyond-DRAM init path); drop the explicit schedule "
+                    "block or materialize the parameters")
             if lazy and not (self.param_offload and self._param_nvme):
                 raise DeepSpeedConfigError(
                     "LazyLeaf parameters require offload_param "
                     "{device: nvme} (the NVMe store of record)")
             self._init_host_state(model_parameters, defer_masters=lazy)
         if self.param_offload:
+            if self._tiered_mode:
+                return self._init_tiered_state(model_parameters)
             return self._init_streamed_state(model_parameters)
 
         if self.host_offload or (not self.keep_master
@@ -1272,9 +1320,71 @@ class DeepSpeedEngine:
                         "host param store leaves must be writable "
                         "C-contiguous (in-place update writes would "
                         "silently vanish)")
-        self._seg_fwd, self._seg_bwd = make_segment_fns(plan)
+        self._seg_fwd, self._seg_bwd, self._stream_flops = \
+            make_segment_fns(plan,
+                             count_flops=self.telemetry.wants_flops)
 
         return EngineState(params=host_params, master=None, opt_state=(),
+                           scale=self._make_scale_state(),
+                           global_steps=jnp.asarray(0, jnp.int32),
+                           skipped_steps=jnp.asarray(0, jnp.int32))
+
+    def _init_tiered_state(self, model_parameters):
+        """Tiered offload on the explicit schedule (zero_optimization.
+        schedule.mode = "explicit" + offload_param; runtime/zero/
+        offload_engine.py): params rest as rank-major rows in host DRAM
+        or NVMe, streamed to HBM group by group with double-buffered
+        prefetch; masters/moments are the host tier from
+        `_init_host_state` (leaf-major, so checkpoints ride the
+        host-offload payload unchanged)."""
+        from .zero.offload_engine import TieredOffloadRunner
+
+        if jax.process_count() > 1:
+            raise DeepSpeedConfigError(
+                "the tiered-offload executor is single-process for now: "
+                "gradient rows are assembled across the whole dp axis "
+                "on one host (use the GSPMD streamed executor on "
+                "multi-host pods)")
+        for axis in self.mesh.axis_names:
+            if axis != self.data_axis and int(self.mesh.shape[axis]) > 1:
+                raise DeepSpeedConfigError(
+                    f"the tiered-offload executor runs over a pure "
+                    f"data-parallel mesh; axis {axis!r} has size "
+                    f"{int(self.mesh.shape[axis])}")
+
+        cdt = np.dtype(self.compute_dtype)
+
+        def to_host(p):
+            return np.array(np.asarray(jax.device_get(jnp.asarray(p))),
+                            dtype=cdt, order="C")
+
+        host_params = jax.tree_util.tree_map(to_host, model_parameters)
+        sched = self._config.zero_config.schedule
+        programs = self.module_obj.build_tiered_offload_step(
+            self.mesh, self.data_axis, sched, host_params)
+
+        nvme = None
+        if self._param_nvme:
+            op = self._config.zero_config.offload_param
+            if op.nvme_path is None:
+                raise DeepSpeedConfigError(
+                    "offload_param.device=nvme requires nvme_path")
+            nvme = {"nvme_path": op.nvme_path,
+                    "buffer_count": op.buffer_count,
+                    "aio_config": self._config.aio_config}
+
+        self._tiered = TieredOffloadRunner(
+            programs, host_params, cdt, self.mesh, self.data_axis,
+            sched.prefetch_depth, self.telemetry, nvme=nvme,
+            count_flops=self.telemetry.wants_flops)
+
+        # the engine state keeps the tree SHAPE via zero-strided
+        # broadcast views (metadata only); real bytes live in the
+        # runner's row store and surface through params_to_natural
+        placeholder = jax.tree_util.tree_map(
+            lambda l: np.broadcast_to(np.zeros((), cdt), np.shape(l)),
+            host_params)
+        return EngineState(params=placeholder, master=None, opt_state=(),
                            scale=self._make_scale_state(),
                            global_steps=jnp.asarray(0, jnp.int32),
                            skipped_steps=jnp.asarray(0, jnp.int32))
@@ -1770,8 +1880,27 @@ class DeepSpeedEngine:
             new_leaves = []
             # One optimizer step across all shards (bias correction).
             opt_step = self._host_opt.step_count + 1
+            tiered = self._tiered
+            emitted = {}
 
             def step_leaf(i, master, m, v):
+                if tiered is not None:
+                    # tiered executor: emit the fresh compute-dtype flat
+                    # for the runner to repack into its rows — only the
+                    # updated shard ever crosses back over the wire
+                    if use_bf16:
+                        out = np.empty(master.size, np.uint16)
+                        self._host_opt.step_flat(
+                            master, flat_grads[i], m, v, lr=lr,
+                            bf16_out=out, step=opt_step)
+                        emitted[i] = out.view(np.dtype(jnp.bfloat16))
+                    else:
+                        self._host_opt.step_flat(master, flat_grads[i],
+                                                 m, v, lr=lr,
+                                                 step=opt_step)
+                        emitted[i] = master.astype(
+                            np.dtype(self.compute_dtype))
+                    return None, master, m, v
                 if self.param_offload:
                     # write the fresh compute-dtype leaf STRAIGHT into the
                     # host param store (params never live on device)
@@ -1819,7 +1948,12 @@ class DeepSpeedEngine:
                                          hs["v"][i])
                     new_leaves.append(leaf)
 
-            if self.param_offload:
+            if tiered is not None:
+                # repack the stepped leaves into rows and write the
+                # store (DRAM in place / NVMe staged swap-outs)
+                tiered.publish_updated_leaves(emitted)
+                new_params = self.state.params
+            elif self.param_offload:
                 # host store already updated in place; respill NVMe tier
                 self._coord.publish_host_update()
                 new_params = self.state.params
@@ -2133,6 +2267,42 @@ class DeepSpeedEngine:
     def _streamed_eval(self, batch, rng):
         _, loss = self._stream_forward(batch, rng)
         return loss
+
+    # ------------------------------------------------------------------
+    # tiered offload on the explicit schedule
+    # (runtime/zero/offload_engine.py; design at the top of that module)
+    # ------------------------------------------------------------------
+
+    def _tiered_train_batch(self, batch):
+        """train_batch under the tiered-offload executor: per-micro
+        streamed fwd+bwd through the per-group schedule programs with
+        double-buffered row prefetch, host-side fp32 grad-row
+        accumulation, then the shared host CPU-Adam step repacking
+        fresh compute-dtype rows into the store."""
+        runner = self._tiered
+        gas = self.gradient_accumulation_steps()
+        runner.begin_step()
+        scale = float(self.state.scale.cur_scale)
+        micro_losses = []
+        for j in range(gas):
+            mb = jax.tree_util.tree_map(lambda b: np.asarray(b)[j], batch)
+            mb = self._shard_batch(mb)
+            # loss stays a device scalar per micro (a float() here is a
+            # host sync stalling the dispatch pipeline)
+            micro_losses.append(runner.fwd_bwd_micro(mb, scale))
+            self.micro_steps += 1
+        loss_sum = float(jnp.sum(jnp.stack(micro_losses)))
+        # /world recovers the dp-mean from the summed per-rank means
+        # (reduce-scatter semantics); /scale unscales the loss-scaled
+        # backward; /gas averages the micro-batches
+        flat_grads = runner.collect_leaf_grads(
+            1.0 / (gas * runner.world * scale))
+        metrics = self._host_step_flat(flat_grads, scale)
+        return metrics._replace(
+            loss=jnp.asarray(loss_sum / gas, jnp.float32))
+
+    def _tiered_eval(self, batch):
+        return self._tiered.eval_loss(batch)
 
     # ------------------------------------------------------------------
     # data
@@ -2680,15 +2850,26 @@ class DeepSpeedEngine:
             from .packing import packed_batch_token_stats
             tokens = packed_batch_token_stats(batch)
         if self.param_offload:
-            # ZeRO-Infinity: params stream from host/NVMe segment by
-            # segment — skip the whole-batch device upload and the
-            # full-params profiler below (both would materialize state
-            # this mode exists to keep out of HBM).
+            # ZeRO-Infinity: params stream from host/NVMe — skip the
+            # whole-batch device upload and the full-params profiler
+            # below (both would materialize state this mode exists to
+            # keep out of HBM).
             self.tput_timer.start()
-            metrics = self._streamed_train_batch(batch)
+            if self._tiered is not None:
+                metrics = self._tiered_train_batch(batch)
+                offload = self._tiered.stats.drain()
+                for k, v in offload.items():
+                    self._offload_totals[k] = \
+                        self._offload_totals.get(k, 0) + v
+                flops = offload["flops"] or None
+            else:
+                metrics = self._streamed_train_batch(batch)
+                offload = None   # stall rides the param_gather span
+                flops = self._stream_flops.drain()["flops"] or None
             verdict = self._after_step(metrics)
             self.tput_timer.stop()
-            tel.on_step_end(self, verdict=verdict, tokens=tokens)
+            tel.on_step_end(self, verdict=verdict, tokens=tokens,
+                            flops=flops, offload=offload)
             return metrics.loss
 
         self._maybe_profile_flops(batch)
@@ -2714,12 +2895,25 @@ class DeepSpeedEngine:
 
         if self.host_offload:
             key = ("grads", gas)
+            call_args = (self.state.params, sharded, self._next_rng(),
+                         self.state.scale.cur_scale,
+                         self.state.global_steps)
             if key not in self._compiled_train:
-                self._compiled_train[key] = self._build_grads_step(gas)
+                step_fn = self._build_grads_step(gas)
+                if tel.wants_flops:
+                    # host-offload tiers report MFU too: AOT-compile the
+                    # grads program against the concrete args and
+                    # harvest cost_analysis flops (PR 6 left these tiers
+                    # at `none`, making bench rows incomparable)
+                    from .telemetry import aot_compile_with_flops
+                    step_fn, flops = aot_compile_with_flops(
+                        step_fn, call_args,
+                        rebuild=lambda: self._build_grads_step(gas))
+                    self._step_flops[key] = flops
+                    tel.register_compiled(key, flops)
+                self._compiled_train[key] = step_fn
             with tel.span("train_dispatch"):
-                loss, grads = self._compiled_train[key](
-                    self.state.params, sharded, self._next_rng(),
-                    self.state.scale.cur_scale, self.state.global_steps)
+                loss, grads = self._compiled_train[key](*call_args)
             with tel.span("host_optimizer"):
                 metrics = self._host_apply_update(grads)
             metrics = metrics._replace(loss=loss)
@@ -2915,7 +3109,18 @@ class DeepSpeedEngine:
                     "return_logits is unsupported on the streamed "
                     "param-offload tier (its forward never materializes "
                     "full logits)")
-            return self._streamed_eval(batch, rng)
+            if self._tiered is not None:
+                loss = self._tiered_eval(batch)
+                # fold the eval's counters into the run totals NOW —
+                # left in the runner they would inflate the NEXT train
+                # step's MFU / Train/Offload/* scalars
+                for k, v in self._tiered.stats.drain().items():
+                    self._offload_totals[k] = \
+                        self._offload_totals.get(k, 0) + v
+                return loss
+            loss = self._streamed_eval(batch, rng)
+            self._stream_flops.drain()   # ditto: not the next step's flops
+            return loss
         if return_logits:
             if self._compiled_eval_logits is None:
                 self._compiled_eval_logits = self._build_eval_logits_fn()

@@ -2,12 +2,15 @@
 `deepspeed/runtime/swap_tensor/constants.py`, `aio_config.py`).
 
 Consumed by the C++ async-IO spool (csrc/aio) that tiers tensors between
-host DRAM and NVMe on a TPU-VM.
+host DRAM and NVMe on a TPU-VM. Parsed at checkpoint-block strictness:
+unknown keys, non-positive sizes/depths/thread counts and non-boolean
+flags raise at parse with the valid choices listed.
 """
 
 from dataclasses import dataclass
 
-from ..config_utils import as_int, get_scalar_param
+from ..config_utils import (DeepSpeedConfigError, strict_bool,
+                            strict_positive_int)
 
 AIO = "aio"
 AIO_BLOCK_SIZE = "block_size"
@@ -21,6 +24,9 @@ AIO_SINGLE_SUBMIT_DEFAULT = False
 AIO_OVERLAP_EVENTS = "overlap_events"
 AIO_OVERLAP_EVENTS_DEFAULT = True
 
+_KNOWN_KEYS = (AIO_BLOCK_SIZE, AIO_QUEUE_DEPTH, AIO_THREAD_COUNT,
+               AIO_SINGLE_SUBMIT, AIO_OVERLAP_EVENTS)
+
 
 @dataclass(frozen=True)
 class DeepSpeedAIOConfig:
@@ -32,22 +38,28 @@ class DeepSpeedAIOConfig:
 
     @classmethod
     def from_dict(cls, param_dict):
-        d = param_dict.get(AIO) or {}
+        d = param_dict.get(AIO)
+        if d is None:
+            d = {}
+        if not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"'{AIO}' must be a dict, got {d!r}")
+        unknown = sorted(set(d) - set(_KNOWN_KEYS))
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown '{AIO}' key(s) {unknown}; valid keys: "
+                f"{sorted(_KNOWN_KEYS)}")
         return cls(
-            block_size=as_int(
-                get_scalar_param(d, AIO_BLOCK_SIZE, AIO_BLOCK_SIZE_DEFAULT),
-                AIO_BLOCK_SIZE),
-            queue_depth=as_int(
-                get_scalar_param(d, AIO_QUEUE_DEPTH, AIO_QUEUE_DEPTH_DEFAULT),
-                AIO_QUEUE_DEPTH),
-            thread_count=as_int(
-                get_scalar_param(d, AIO_THREAD_COUNT,
-                                 AIO_THREAD_COUNT_DEFAULT),
-                AIO_THREAD_COUNT),
-            single_submit=bool(
-                get_scalar_param(d, AIO_SINGLE_SUBMIT,
-                                 AIO_SINGLE_SUBMIT_DEFAULT)),
-            overlap_events=bool(
-                get_scalar_param(d, AIO_OVERLAP_EVENTS,
-                                 AIO_OVERLAP_EVENTS_DEFAULT)),
+            block_size=strict_positive_int(d, AIO_BLOCK_SIZE,
+                                           AIO_BLOCK_SIZE_DEFAULT, AIO),
+            queue_depth=strict_positive_int(d, AIO_QUEUE_DEPTH,
+                                            AIO_QUEUE_DEPTH_DEFAULT,
+                                            AIO),
+            thread_count=strict_positive_int(d, AIO_THREAD_COUNT,
+                                             AIO_THREAD_COUNT_DEFAULT,
+                                             AIO),
+            single_submit=strict_bool(d, AIO_SINGLE_SUBMIT,
+                                      AIO_SINGLE_SUBMIT_DEFAULT, AIO),
+            overlap_events=strict_bool(d, AIO_OVERLAP_EVENTS,
+                                       AIO_OVERLAP_EVENTS_DEFAULT, AIO),
         )

@@ -2,7 +2,11 @@
 `deepspeed/runtime/swap_tensor/async_swapper.py:16`).
 
 Streams host-resident numpy tensors to/from files through the C++ aio
-engine, overlapping IO with whatever the caller does next; `wait()` fences.
+engine, overlapping IO with whatever the caller does next; `wait()`
+fences. Writes are crash-consistently staged: each `swap_out_tensors`
+write lands in a ``<path>.staging`` sibling and the fence atomically
+renames it into place, so a process killed mid-write can tear at most
+the staging copy — never a previously committed file.
 """
 
 import os
@@ -24,25 +28,38 @@ class AsyncTensorSwapper:
         self._pending_paths = []
 
     def swap_out_tensors(self, tensors, paths):
-        """Start writing each tensor to its path; returns immediately."""
+        """Start writing each tensor to its path (via the staging
+        sibling); returns immediately. The fence commits."""
         for tensor, path in zip(tensors, paths):
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self.engine.aio_write(np.ascontiguousarray(tensor), path)
+            self.engine.aio_write(np.ascontiguousarray(tensor),
+                                  path + ".staging")
             self._pending_paths.append(path)
 
     def swap_in_tensors(self, buffers, paths):
-        """Start reading each path into its (preallocated) buffer."""
+        """Start reading each path into its (preallocated) buffer.
+        Pending staged writes to a requested path are committed first
+        (read-after-write coherence)."""
+        pending = set(self._pending_paths)
+        if any(p in pending for p in paths):
+            self.wait()
         for buffer, path in zip(buffers, paths):
             self.engine.aio_read(buffer, path)
         return buffers
 
+    def _commit(self):
+        pending, self._pending_paths = self._pending_paths, []
+        for path in dict.fromkeys(pending):   # dedupe repeated writes
+            os.replace(path + ".staging", path)
+
     def synchronize_writes(self):
         self.engine.wait()
-        self._pending_paths = []
+        self._commit()
 
     def synchronize_reads(self):
         self.engine.wait()
+        self._commit()
 
     def wait(self):
         self.engine.wait()
-        self._pending_paths = []
+        self._commit()

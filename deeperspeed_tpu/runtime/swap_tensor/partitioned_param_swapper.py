@@ -3,8 +3,15 @@
 
 Holds each registered parameter shard on NVMe; `swap_in` materializes the
 requested params into a pooled host buffer set asynchronously, `swap_out`
-writes them back and releases the buffers. The ZeRO-3 offload tier reads
+writes them back and releases the buffers. The ZeRO-3 offload tiers read
 through this before device upload.
+
+Writes are **crash-consistently staged**: `swap_out` lands in a
+``<file>.staging`` sibling and `synchronize_writes` atomically renames
+it over the committed file only after the aio engine has fenced — a
+process killed mid-write can tear at most the staging copy, never the
+store of record the next run resumes from. `swap_in` of a param with a
+pending staged write fences first (read-after-write coherence).
 """
 
 import os
@@ -47,9 +54,13 @@ class AsyncPartitionedParameterSwapper:
 
         self.param_info = {}       # id → {"numel", "shape", "status"}
         self.param_buffer = {}     # id → (buffer_idx, view)
+        self._staged = set()       # ids with an un-committed staged write
 
     def _path(self, param_id):
         return os.path.join(self.nvme_path, f"param_{param_id}.tensor.swp")
+
+    def _staging_path(self, param_id):
+        return self._path(param_id) + ".staging"
 
     def swappable_tensor(self, param=None, numel=None):
         numel = numel if numel is not None else int(np.prod(param.shape))
@@ -63,11 +74,15 @@ class AsyncPartitionedParameterSwapper:
         }
 
     def swap_out(self, param_id, tensor, release=True):
-        """Write a param shard to NVMe (async; fence with synchronize)."""
+        """Write a param shard to NVMe (async; fence with synchronize).
+        The bytes land in the staging sibling; `synchronize_writes`
+        commits them atomically."""
         tensor = np.ascontiguousarray(tensor, self.dtype)
         if param_id not in self.param_info:
             self.register(param_id, tensor.shape)
-        self.engine.aio_write(tensor.reshape(-1), self._path(param_id))
+        self.engine.aio_write(tensor.reshape(-1),
+                              self._staging_path(param_id))
+        self._staged.add(param_id)
         info = self.param_info[param_id]
         info["status"] = PartitionedParamStatus.NOT_AVAILABLE
         if release and param_id in self.param_buffer:
@@ -76,6 +91,10 @@ class AsyncPartitionedParameterSwapper:
 
     def swap_in(self, param_ids, async_op=True):
         """Read shards into pooled buffers; returns {id: view}."""
+        if any(pid in self._staged for pid in param_ids):
+            # read-after-staged-write: commit the pending bytes first or
+            # the read would return the superseded committed version
+            self.synchronize_writes()
         views = {}
         for param_id in param_ids:
             info = self.param_info[param_id]
@@ -113,6 +132,13 @@ class AsyncPartitionedParameterSwapper:
 
     def synchronize_writes(self):
         self.engine.wait()
+        # commit: the staged bytes are durably written — atomically
+        # replace the store-of-record file (os.replace never leaves a
+        # torn destination; a crash before this point leaves the
+        # previous committed version intact)
+        staged, self._staged = self._staged, set()
+        for param_id in staged:
+            os.replace(self._staging_path(param_id), self._path(param_id))
 
     def available_swap_in_buffers(self):
         return len(self.free_buffers)

@@ -323,7 +323,7 @@ class _NullTelemetry:
         pass
 
     def on_step_end(self, engine, verdict="ok", flops=None, steps=1,
-                    tokens=None):
+                    tokens=None, offload=None):
         pass
 
     def on_anomaly(self, engine, kind, step=None):
@@ -481,10 +481,17 @@ class Telemetry:
             self._open_window(tag, n_steps)
 
     def on_step_end(self, engine, verdict="ok", flops=None, steps=1,
-                    tokens=None):
+                    tokens=None, offload=None):
         """Close one step window: goodput accounting, MFU/memory
         scalars, capture-window bookkeeping. `steps` > 1 for fused
         `train_steps` windows (one call covers n optimizer steps).
+
+        `offload` = the tiered-offload runner's per-step counters
+        ({prefetch_stall_s, bytes_h2d, bytes_d2h, ...}): emitted as
+        `Train/Offload/*` scalars so the streaming tier's wire traffic
+        and residual prefetch stalls sit next to the goodput series
+        (the stall seconds are ALSO in the param_wait bucket via the
+        param_gather span — this scalar is the per-step ms view).
 
         `tokens` = (effective, total) target counts for packed ragged
         batches (`runtime.packing.packed_batch_token_stats`): raw
@@ -542,6 +549,14 @@ class Telemetry:
                 # one credits only the fraction the loss consumed
                 scalars["Train/Samples/effective_mfu"] = (
                     flops / dt / self._peak()) * (eff / total)
+
+        if offload is not None:
+            scalars["Train/Offload/prefetch_stall_ms"] = \
+                offload.get("prefetch_stall_s", 0.0) * 1e3
+            scalars["Train/Offload/bytes_h2d"] = \
+                offload.get("bytes_h2d", 0)
+            scalars["Train/Offload/bytes_d2h"] = \
+                offload.get("bytes_d2h", 0)
 
         if (self.memory_watermark_interval > 0
                 and self._steps_seen % self.memory_watermark_interval < steps):

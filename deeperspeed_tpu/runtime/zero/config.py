@@ -16,7 +16,9 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ...parallel.schedule import SCHEDULE_MODES, ScheduleConfig
-from ..config_utils import DeepSpeedConfigError, as_int, get_scalar_param
+from ..config_utils import (DeepSpeedConfigError, as_int,
+                            get_scalar_param, strict_bool,
+                            strict_positive_int)
 from . import constants as zc
 
 
@@ -90,6 +92,45 @@ def _parse_schedule_block(d, stage):
                           remat=remat)
 
 
+_OFFLOAD_DEVICES = (zc.OFFLOAD_CPU_DEVICE, zc.OFFLOAD_NVME_DEVICE)
+
+
+def _check_offload_block(block, d, known):
+    """Bring an offload sub-block to checkpoint-block parse strictness:
+    it must be a dict, unknown keys raise with the valid choices
+    listed, and the device name is validated against the tier list."""
+    if not isinstance(d, dict):
+        raise DeepSpeedConfigError(
+            f"'zero_optimization.{block}' must be a dict "
+            f"(e.g. {{\"device\": \"cpu\"}}), got {d!r}")
+    unknown = sorted(set(d) - set(known))
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'zero_optimization.{block}' key(s) {unknown}; "
+            f"valid keys: {sorted(known)}")
+    device = get_scalar_param(d, "device", zc.OFFLOAD_CPU_DEVICE)
+    if device not in _OFFLOAD_DEVICES:
+        raise DeepSpeedConfigError(
+            f"zero_optimization.{block}.device must be one of "
+            f"{list(_OFFLOAD_DEVICES)} (cpu = host DRAM tier, nvme = "
+            f"aio swap-file tier), got {device!r}")
+    nvme_path = get_scalar_param(d, "nvme_path", None)
+    if nvme_path is not None and not isinstance(nvme_path, str):
+        raise DeepSpeedConfigError(
+            f"zero_optimization.{block}.nvme_path must be a string "
+            f"path, got {nvme_path!r}")
+    return device, nvme_path
+
+
+def _offload_positive_int(block, d, key, default):
+    return strict_positive_int(d, key, default,
+                               f"zero_optimization.{block}")
+
+
+def _offload_bool(block, d, key, default=False):
+    return strict_bool(d, key, default, f"zero_optimization.{block}")
+
+
 @dataclass(frozen=True)
 class DeepSpeedZeroOffloadParamConfig:
     device: str = zc.OFFLOAD_CPU_DEVICE
@@ -101,25 +142,24 @@ class DeepSpeedZeroOffloadParamConfig:
 
     @classmethod
     def from_dict(cls, d):
-        device = get_scalar_param(d, zc.OFFLOAD_PARAM_DEVICE,
-                                  zc.OFFLOAD_CPU_DEVICE)
-        if device not in (zc.OFFLOAD_CPU_DEVICE, zc.OFFLOAD_NVME_DEVICE):
-            raise DeepSpeedConfigError(
-                f"offload_param device must be cpu|nvme, got {device!r}")
+        device, nvme_path = _check_offload_block(
+            zc.OFFLOAD_PARAM, d,
+            (zc.OFFLOAD_PARAM_DEVICE, zc.OFFLOAD_PARAM_NVME_PATH,
+             zc.OFFLOAD_PARAM_BUFFER_COUNT, zc.OFFLOAD_PARAM_BUFFER_SIZE,
+             zc.OFFLOAD_PARAM_MAX_IN_CPU, zc.OFFLOAD_PARAM_PIN_MEMORY))
         return cls(
             device=device,
-            nvme_path=get_scalar_param(d, zc.OFFLOAD_PARAM_NVME_PATH, None),
-            buffer_count=as_int(
-                get_scalar_param(d, zc.OFFLOAD_PARAM_BUFFER_COUNT, 5),
-                zc.OFFLOAD_PARAM_BUFFER_COUNT),
-            buffer_size=as_int(
-                get_scalar_param(d, zc.OFFLOAD_PARAM_BUFFER_SIZE, 1e8),
-                zc.OFFLOAD_PARAM_BUFFER_SIZE),
-            max_in_cpu=as_int(
-                get_scalar_param(d, zc.OFFLOAD_PARAM_MAX_IN_CPU, 1e9),
-                zc.OFFLOAD_PARAM_MAX_IN_CPU),
-            pin_memory=bool(
-                get_scalar_param(d, zc.OFFLOAD_PARAM_PIN_MEMORY, False)),
+            nvme_path=nvme_path,
+            buffer_count=_offload_positive_int(
+                zc.OFFLOAD_PARAM, d, zc.OFFLOAD_PARAM_BUFFER_COUNT, 5),
+            buffer_size=_offload_positive_int(
+                zc.OFFLOAD_PARAM, d, zc.OFFLOAD_PARAM_BUFFER_SIZE,
+                100_000_000),
+            max_in_cpu=_offload_positive_int(
+                zc.OFFLOAD_PARAM, d, zc.OFFLOAD_PARAM_MAX_IN_CPU,
+                1_000_000_000),
+            pin_memory=_offload_bool(
+                zc.OFFLOAD_PARAM, d, zc.OFFLOAD_PARAM_PIN_MEMORY),
         )
 
 
@@ -139,26 +179,30 @@ class DeepSpeedZeroOffloadOptimizerConfig:
 
     @classmethod
     def from_dict(cls, d):
-        device = get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_DEVICE,
-                                  zc.OFFLOAD_CPU_DEVICE)
-        if device not in (zc.OFFLOAD_CPU_DEVICE, zc.OFFLOAD_NVME_DEVICE):
-            raise DeepSpeedConfigError(
-                f"offload_optimizer device must be cpu|nvme, got {device!r}")
+        device, nvme_path = _check_offload_block(
+            zc.OFFLOAD_OPTIMIZER, d,
+            (zc.OFFLOAD_OPTIMIZER_DEVICE, zc.OFFLOAD_OPTIMIZER_NVME_PATH,
+             zc.OFFLOAD_OPTIMIZER_BUFFER_COUNT,
+             zc.OFFLOAD_OPTIMIZER_PIN_MEMORY,
+             zc.OFFLOAD_OPTIMIZER_PIPELINE_READ,
+             zc.OFFLOAD_OPTIMIZER_PIPELINE_WRITE,
+             zc.OFFLOAD_OPTIMIZER_FAST_INIT))
         return cls(
             device=device,
-            nvme_path=get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_NVME_PATH, None),
-            buffer_count=as_int(
-                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_BUFFER_COUNT, 4),
-                zc.OFFLOAD_OPTIMIZER_BUFFER_COUNT),
-            pin_memory=bool(
-                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_PIN_MEMORY, False)),
-            pipeline_read=bool(
-                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_PIPELINE_READ, False)),
-            pipeline_write=bool(
-                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_PIPELINE_WRITE,
-                                 False)),
-            fast_init=bool(
-                get_scalar_param(d, zc.OFFLOAD_OPTIMIZER_FAST_INIT, False)),
+            nvme_path=nvme_path,
+            buffer_count=_offload_positive_int(
+                zc.OFFLOAD_OPTIMIZER, d,
+                zc.OFFLOAD_OPTIMIZER_BUFFER_COUNT, 4),
+            pin_memory=_offload_bool(
+                zc.OFFLOAD_OPTIMIZER, d, zc.OFFLOAD_OPTIMIZER_PIN_MEMORY),
+            pipeline_read=_offload_bool(
+                zc.OFFLOAD_OPTIMIZER, d,
+                zc.OFFLOAD_OPTIMIZER_PIPELINE_READ),
+            pipeline_write=_offload_bool(
+                zc.OFFLOAD_OPTIMIZER, d,
+                zc.OFFLOAD_OPTIMIZER_PIPELINE_WRITE),
+            fast_init=_offload_bool(
+                zc.OFFLOAD_OPTIMIZER, d, zc.OFFLOAD_OPTIMIZER_FAST_INIT),
         )
 
 

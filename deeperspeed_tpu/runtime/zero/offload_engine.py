@@ -1,0 +1,510 @@
+"""Tiered parameter/optimizer offload on the explicit schedule —
+ZeRO-Infinity for TPU (arXiv:2104.07857 + 2101.06840), composed with the
+PR 11 explicit-dataflow ZeRO-3 substrate (`parallel/schedule.py`).
+
+Where the legacy layer-streamed executor (`param_offload.py`) runs one
+jitted *segment* at a time with depth-1 prefetch and per-segment host
+grads, this executor runs the explicit schedule's *group programs*:
+
+- parameters rest off-device as **rank-major rows** — per remat/prefetch
+  group, one ``[g, world * S]`` buffer in the `pack_plan_rows` layout —
+  in host DRAM (the store of record) or NVMe (via the crash-consistent
+  `AsyncPartitionedParameterSwapper` staging path);
+- the host loop streams rows to HBM with **double-buffered prefetch
+  issued ``prefetch_depth`` layers ahead** of the group the device is
+  computing: `jax.device_put` is async, compute dispatch is async, and
+  uploaded rows are **donated** into their consuming program, so the
+  h2d wire rides under the previous group's matmuls (the discipline the
+  async-checkpoint writer and the MoE a2a-overlap path proved);
+- inside each group program the rows all-gather (bucketed, depth layers
+  ahead — `make_group_body`, the SAME body the in-jit explicit schedule
+  scans) and the backward's gather transposes **reduce-scatter each
+  gradient row to its owner shard** before it ever leaves the device;
+- gradient rows stream back device→host asynchronously (the d2h of
+  group i overlaps the backward of group i-1) and accumulate in fp32;
+- the **Adam update runs tier-side** on the engine's host-resident fp32
+  masters/moments (`_init_host_state` — DRAM, or NVMe via the pipelined
+  optimizer swapper), and only the fresh compute-dtype parameter rows
+  ever cross back over the wire.
+
+Peak HBM: one group's gathered params + the group-boundary activations
++ at most two in-flight gradient rows — host memory is the model-size
+bound, which is the ZeRO-Infinity capacity story.
+
+Telemetry: upload waits land in the `param_gather` span (the goodput
+``param_wait`` bucket) and the runner feeds
+``Train/Offload/{prefetch_stall_ms,bytes_h2d,bytes_d2h}`` scalars.
+"""
+
+import math
+import re
+from collections import deque
+
+import numpy as np
+
+import jax
+
+from ...parallel.schedule import pack_plan_rows, unpack_plan_row
+from ..telemetry import aot_compile_with_flops
+
+
+def _safe_name(key):
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(key))
+
+
+class TieredPrograms:
+    """Container for a model's tiered-offload step programs (built by
+    ``model.build_tiered_offload_step``; see `models/gpt_neox.py`).
+
+    plans: {"embed": LayerPlan, "block": LayerPlan, "final_ln":
+        LayerPlan, "embed_out": LayerPlan|None} — all-flat-sharded
+        `offload_layer_plan`s (one per segment kind).
+    group_sizes: layers per block group, in order.
+    tied: True when the LM head reuses the embedding row (its gradient
+        accumulates into the embed segment).
+    embed_fwd(row, tokens) -> x;  embed_grad(row, tokens, dx) -> grow
+    group_fwd[g](rows, x) -> x;   group_grad[g](rows, x_in, ct)
+        -> (ct_in, grows)  — grows arrive reduce-scattered (the gather
+        transposes), assembled to the global rank-major row layout.
+    head_loss(row_ln, row_we, x, labels) -> loss (dp-mean)
+    head_grad(row_ln, row_we, x, labels, scale)
+        -> (loss, dx, grow_ln, grow_we)
+    split_batch(batch) -> (tokens, labels)
+    """
+
+    def __init__(self, plans, group_sizes, tied, embed_fwd, embed_grad,
+                 group_fwd, group_grad, head_loss, head_grad,
+                 split_batch):
+        self.plans = plans
+        self.group_sizes = list(group_sizes)
+        self.tied = bool(tied)
+        self.embed_fwd = embed_fwd
+        self.embed_grad = embed_grad
+        self.group_fwd = dict(group_fwd)
+        self.group_grad = dict(group_grad)
+        self.head_loss = head_loss
+        self.head_grad = head_grad
+        self.split_batch = split_batch
+
+
+class OffloadStats:
+    """Per-step offload counters the engine drains into telemetry."""
+
+    __slots__ = ("prefetch_stall_s", "bytes_h2d", "bytes_d2h", "flops")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.prefetch_stall_s = 0.0
+        self.bytes_h2d = 0
+        self.bytes_d2h = 0
+        self.flops = 0.0
+
+    def drain(self):
+        out = {"prefetch_stall_s": self.prefetch_stall_s,
+               "bytes_h2d": self.bytes_h2d, "bytes_d2h": self.bytes_d2h,
+               "flops": self.flops}
+        self.reset()
+        return out
+
+
+class _CountingProgram:
+    """Jitted program wrapper: on first call (per program) AOT-compiles
+    to harvest `cost_analysis` flops — the executable IS what runs, so
+    the harvest is free — then adds the program's flops to the shared
+    stats at every dispatch. With counting off it is a transparent
+    passthrough (no AOT, no overhead)."""
+
+    def __init__(self, jitted, stats, count_flops):
+        self._fn = jitted
+        self._jitted = jitted
+        self._stats = stats
+        self._count = count_flops
+        self._flops = None
+        self._compiled_once = False
+
+    def __call__(self, *args):
+        if self._count and not self._compiled_once:
+            self._compiled_once = True
+            fn, flops = aot_compile_with_flops(
+                self._jitted, args, rebuild=lambda: self._jitted)
+            self._fn, self._flops = fn, flops
+        if self._flops:
+            self._stats.flops += self._flops
+        return self._fn(*args)
+
+
+class TieredRowStore:
+    """The off-device row store: {key: rank-major row array}. DRAM tier
+    holds writable numpy rows (the store of record); NVMe tier keeps one
+    crash-consistently staged swap file per key, DRAM holding only
+    shape/dtype templates plus the pooled aio buffers."""
+
+    def __init__(self, swapper=None):
+        self.swapper = swapper
+        self._rows = {}           # DRAM tier
+        self._templates = {}      # NVMe tier: key -> (shape, dtype)
+        self._inflight = set()    # NVMe reads issued
+
+    def put(self, key, row, async_op=True):
+        if self.swapper is None:
+            self._rows[key] = row
+            return
+        self._templates[key] = (row.shape, row.dtype)
+        self.swapper.swap_out(_safe_name(key),
+                              np.ascontiguousarray(row).reshape(-1)
+                              .view(np.uint8))
+        if not async_op:
+            self.swapper.synchronize_writes()
+
+    def synchronize(self):
+        if self.swapper is not None:
+            self.swapper.synchronize_writes()
+
+    def prefetch(self, key):
+        """NVMe: issue the aio read now (non-blocking); DRAM: no-op."""
+        if self.swapper is None or key in self._inflight:
+            return
+        self.swapper.swap_in([_safe_name(key)], async_op=True)
+        self._inflight.add(key)
+
+    def fetch(self, key):
+        """Host row bytes for `key` — ALWAYS a private copy: device_put
+        can be zero-copy on the CPU backend (an aliased upload would
+        read whatever the store holds when XLA lazily consumes it), and
+        the NVMe tier's pooled aio buffer is reused for the next
+        read."""
+        if self.swapper is None:
+            return np.array(self._rows[key])
+        self.prefetch(key)
+        self.swapper.synchronize_reads()
+        self._inflight.discard(key)
+        views = self.swapper.swap_in([_safe_name(key)], async_op=False)
+        shape, dtype = self._templates[key]
+        out = np.array(views[_safe_name(key)].view(dtype)).reshape(shape)
+        self.swapper.release([_safe_name(key)])
+        return out
+
+    def keys(self):
+        return (self._rows if self.swapper is None
+                else self._templates).keys()
+
+
+class _UploadWindow:
+    """One micro-batch's double-buffered upload pipeline over a linear
+    schedule of (slot, key) uploads: `ensure(i)` keeps `depth` uploads
+    issued beyond slot i (async `device_put`s the latency-hiding
+    scheduler overlaps with compute), `take(i)` hands slot i's device
+    rows over — timing any residual wait as a prefetch stall in the
+    `param_gather` span (the goodput ``param_wait`` bucket)."""
+
+    def __init__(self, order, store, shardings, depth, stats, telemetry):
+        self.order = list(order)
+        self.store = store
+        self.shardings = shardings
+        self.depth = max(1, int(depth))
+        self.stats = stats
+        self.telemetry = telemetry
+        self._slots = {}
+        self._issued = 0
+
+    def ensure(self, idx):
+        hi = min(len(self.order), idx + 1 + self.depth)
+        # NVMe reads for the whole lookahead go out first: the aio
+        # engine overlaps them with the device_puts below
+        for j in range(self._issued, hi):
+            self.store.prefetch(self.order[j])
+        while self._issued < hi:
+            j = self._issued
+            key = self.order[j]
+            row = self.store.fetch(key)
+            self._slots[j] = jax.device_put(row, self.shardings(key))
+            self.stats.bytes_h2d += row.nbytes
+            self._issued += 1
+
+    def take(self, idx):
+        import time
+        self.ensure(idx)
+        arr = self._slots.pop(idx)
+        ready = True
+        try:
+            ready = arr.is_ready()
+        except Exception:  # noqa: BLE001 - backends without is_ready
+            pass
+        if not ready:
+            # the compute stream is about to stall on this upload: that
+            # wait IS lost goodput — time it under param_gather
+            with self.telemetry.span("param_gather"):
+                t0 = time.perf_counter()
+                jax.block_until_ready(arr)
+                self.stats.prefetch_stall_s += time.perf_counter() - t0
+        return arr
+
+
+class TieredOffloadRunner:
+    """Owns the host row store, the upload pipeline and the per-group
+    program driver for the tiered-offload executor. The ENGINE keeps
+    owning the fp32 masters/moments (`_init_host_state` — leaf-major,
+    so checkpoints ride the existing host-offload manifest payload
+    bit-exactly) and the Adam step; the runner converts between the
+    leaf world and the row world at the step boundary."""
+
+    def __init__(self, programs, host_params, compute_dtype, mesh,
+                 data_axis, prefetch_depth, telemetry, nvme=None,
+                 count_flops=False):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.programs = programs
+        self.compute_dtype = np.dtype(compute_dtype)
+        self.telemetry = telemetry
+        self.stats = OffloadStats()
+        self._row_sh = NamedSharding(mesh, P(data_axis))
+        self._rows_sh = NamedSharding(mesh, P(None, data_axis))
+        self._scale_sh = NamedSharding(mesh, P())
+        self.world = int(mesh.shape[data_axis])
+
+        leaves, treedef = jax.tree_util.tree_flatten(host_params)
+        self.n_leaves = len(leaves)
+        self._leaf_shapes = [np.shape(l) for l in leaves]
+        idx_tree = jax.tree_util.tree_unflatten(
+            treedef, list(range(self.n_leaves)))
+
+        def ids_of(sub):
+            return [int(i) for i in jax.tree_util.tree_leaves(sub)]
+
+        G = len(programs.group_sizes)
+        self.group_keys = [("blocks", gi) for gi in range(G)]
+        # per-key: (plan, [leaf-id list per row]) — groups carry one id
+        # list per layer, the outer segments exactly one
+        self._layout = {}
+        self._layout["embed"] = (programs.plans["embed"],
+                                 [ids_of({"wte": idx_tree["embed"]["wte"]})])
+        self._layout["final_ln"] = (programs.plans["final_ln"],
+                                    [ids_of(idx_tree["final_ln"])])
+        if not programs.tied:
+            self._layout["embed_out"] = (
+                programs.plans["embed_out"],
+                [ids_of({"wte": idx_tree["embed_out"]["wte"]})])
+        li = 0
+        for gi, g in enumerate(programs.group_sizes):
+            self._layout[("blocks", gi)] = (
+                programs.plans["block"],
+                [ids_of(idx_tree["blocks"][li + j]) for j in range(g)])
+            li += g
+        self._we_key = "embed" if programs.tied else "embed_out"
+
+        # depth in GROUPS: prefetch_depth is a layers-ahead knob; the
+        # host pipeline's upload unit is one group — keep at least the
+        # double buffer
+        g0 = max(1, programs.group_sizes[0] if programs.group_sizes else 1)
+        self.depth = max(1, math.ceil(max(1, int(prefetch_depth)) / g0))
+
+        swapper = None
+        if nvme is not None:
+            # NVMe rows ride the crash-consistently staged swapper; the
+            # pool is sized to the fattest row, and holds at least the
+            # whole prefetch window (depth+1 reads can be in flight,
+            # each pinning one pooled buffer until its fetch) plus one
+            # spare — a deep prefetch_depth must not exhaust the pool
+            # mid-step
+            from ..swap_tensor.partitioned_param_swapper import \
+                AsyncPartitionedParameterSwapper
+            max_row = max(
+                len(per_row) * plan.shard_size * self.world
+                * self.compute_dtype.itemsize
+                for plan, per_row in self._layout.values())
+            swapper = AsyncPartitionedParameterSwapper(
+                nvme_path=nvme["nvme_path"],
+                buffer_count=max(3, int(nvme.get("buffer_count", 3)),
+                                 self.depth + 2),
+                buffer_size=max_row, aio_config=nvme.get("aio_config"),
+                dtype=np.uint8)
+        self.store = TieredRowStore(swapper=swapper)
+
+        # initial spill: pack every segment's host leaves into rows
+        flat = leaves
+        for key, (plan, per_row_ids) in self._layout.items():
+            self.store.put(key, self._pack_key(
+                key, {lid: np.asarray(flat[lid], self.compute_dtype)
+                      for ids in per_row_ids for lid in ids}),
+                async_op=True)
+        self.store.synchronize()
+
+        wrap = lambda fn: _CountingProgram(fn, self.stats, count_flops)  # noqa: E731
+        p = programs
+        self._embed_fwd = wrap(p.embed_fwd)
+        self._embed_grad = wrap(p.embed_grad)
+        self._head_loss = wrap(p.head_loss)
+        self._head_grad = wrap(p.head_grad)
+        self._group_fwd = {g: wrap(fn) for g, fn in p.group_fwd.items()}
+        self._group_grad = {g: wrap(fn) for g, fn in p.group_grad.items()}
+
+        self._grad_rows = {}
+        self._pending = deque()
+
+    # -- layout conversion -------------------------------------------------
+
+    def _pack_key(self, key, leaf_arrays):
+        """{leaf_id: natural array} -> this key's row buffer."""
+        plan, per_row_ids = self._layout[key]
+        rows = [pack_plan_rows(
+            plan, [np.asarray(leaf_arrays[lid], self.compute_dtype)
+                   .reshape(self._leaf_shapes[lid]) for lid in ids])
+            for ids in per_row_ids]
+        return rows[0] if len(rows) == 1 and key not in self.group_keys \
+            else np.stack(rows)
+
+    def _unpack_grads(self, key, grows):
+        """Accumulated fp32 grad row(s) of one key -> {leaf_id: flat
+        fp32 grad} (tied leaves already summed at the row level)."""
+        plan, per_row_ids = self._layout[key]
+        mat = grows if grows.ndim == 2 else grows[None]
+        out = {}
+        for row, ids in zip(mat, per_row_ids):
+            for lid, leaf in zip(ids, unpack_plan_row(plan, row)):
+                out[lid] = np.asarray(leaf, np.float32).reshape(-1)
+        return out
+
+    # -- gradient harvest --------------------------------------------------
+
+    def _harvest_later(self, key, dev):
+        """Queue one grad row's d2h: start the async copy now, drain it
+        after the NEXT backward dispatch (so the transfer rides under
+        compute instead of serializing the host loop)."""
+        try:
+            dev.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._pending.append((key, dev))
+        while len(self._pending) > 1:
+            self._drain_one()
+
+    def _drain_one(self):
+        key, dev = self._pending.popleft()
+        # count the bytes the WIRE moved (compute dtype), not the fp32
+        # accumulator they widen into — else bf16 runs report 2x d2h
+        self.stats.bytes_d2h += dev.nbytes
+        arr = np.asarray(jax.device_get(dev), np.float32)
+        acc = self._grad_rows.get(key)
+        if acc is None:
+            self._grad_rows[key] = np.array(arr) if not arr.flags.writeable \
+                else arr
+        else:
+            acc += arr
+
+    def _flush_harvest(self):
+        while self._pending:
+            self._drain_one()
+
+    # -- driver ------------------------------------------------------------
+
+    def begin_step(self):
+        self._grad_rows = {}
+        self._pending.clear()
+
+    def _forward(self, tokens, win):
+        x = self._embed_fwd(win.take(0), tokens)
+        stash = []
+        for i, g in enumerate(self.programs.group_sizes):
+            stash.append(x)
+            x = self._group_fwd[g](win.take(1 + i), x)
+        return x, stash
+
+    def fwd_bwd_micro(self, batch, scale):
+        """One micro-batch: streamed forward (group-boundary activations
+        stashed), head loss+grad, reverse streamed backward with re-
+        uploaded rows, grad rows accumulated host-side. Returns the
+        device loss scalar (do NOT float() it per micro — host sync)."""
+        tokens, labels = self.programs.split_batch(batch)
+        G = len(self.programs.group_sizes)
+        order = (["embed"] + self.group_keys                 # forward
+                 + ["final_ln", self._we_key]                # head
+                 + list(reversed(self.group_keys)) + ["embed"])  # backward
+        win = _UploadWindow(order, self.store, self._key_sharding,
+                            self.depth, self.stats, self.telemetry)
+        x, stash = self._forward(tokens, win)
+        scale_dev = jax.device_put(np.float32(scale), self._scale_sh)
+        loss, dx, g_ln, g_we = self._head_grad(
+            win.take(G + 1), win.take(G + 2), x, labels, scale_dev)
+        self._harvest_later("final_ln", g_ln)
+        self._harvest_later(self._we_key, g_we)
+        for i in range(G - 1, -1, -1):
+            g = self.programs.group_sizes[i]
+            slot = G + 3 + (G - 1 - i)
+            dx, grows = self._group_grad[g](win.take(slot), stash.pop(),
+                                            dx)
+            self._harvest_later(("blocks", i), grows)
+        g_e = self._embed_grad(win.take(2 * G + 3), tokens, dx)
+        self._harvest_later("embed", g_e)
+        self._flush_harvest()
+        return loss
+
+    def eval_loss(self, batch):
+        tokens, labels = self.programs.split_batch(batch)
+        G = len(self.programs.group_sizes)
+        order = ["embed"] + self.group_keys + ["final_ln", self._we_key]
+        win = _UploadWindow(order, self.store, self._key_sharding,
+                            self.depth, self.stats, self.telemetry)
+        x, _ = self._forward(tokens, win)
+        return self._head_loss(win.take(G + 1), win.take(G + 2), x,
+                               labels)
+
+    def _key_sharding(self, key):
+        return self._rows_sh if key in self.group_keys else self._row_sh
+
+    # -- step-boundary conversions (engine's host Adam owns the update) ----
+
+    def collect_leaf_grads(self, coef):
+        """Accumulated grad rows -> per-leaf natural flat fp32 grads in
+        tree_leaves order, scaled by `coef` (1 / (gas * world); the loss
+        scale divides in the engine's shared host step). psum_scatter
+        summed per-rank contributions of per-rank-MEAN losses, so /world
+        recovers the dp-mean gradient."""
+        flats = [None] * self.n_leaves
+        for key, grows in self._grad_rows.items():
+            for lid, flat in self._unpack_grads(key, grows).items():
+                flats[lid] = flat * coef
+        missing = [i for i, f in enumerate(flats) if f is None]
+        if missing:
+            raise RuntimeError(
+                f"tiered offload step produced no gradients for leaves "
+                f"{missing} — the segment layout lost track of them")
+        return flats
+
+    def publish_updated_leaves(self, emitted):
+        """{leaf_id: fresh compute-dtype flat} from the host Adam step →
+        repacked rows written back to the store (the ONLY h2d-relevant
+        state the update touches: masters/moments never leave the
+        host)."""
+        for key, (plan, per_row_ids) in self._layout.items():
+            arrs = {lid: emitted[lid] for ids in per_row_ids
+                    for lid in ids}
+            self.store.put(key, self._pack_key(key, arrs), async_op=True)
+        self.store.synchronize()
+
+    # -- natural-tree access (checkpoints / user surfaces) -----------------
+
+    def leaves_natural(self):
+        """All params as natural compute-dtype numpy leaves (flatten
+        order). Transiently model-sized on host — checkpoint/export
+        only."""
+        leaves = [None] * self.n_leaves
+        for key, (plan, per_row_ids) in self._layout.items():
+            rows = self.store.fetch(key)
+            mat = rows if key in self.group_keys else rows[None]
+            for row, ids in zip(mat, per_row_ids):
+                for lid, leaf in zip(ids, unpack_plan_row(plan, row)):
+                    leaves[lid] = leaf
+        return leaves
+
+    def write_natural(self, tree_leaves_list):
+        """Inverse of `leaves_natural`: replace the whole store from
+        natural leaves (checkpoint restore, gathered_parameters
+        write-back)."""
+        for key, (plan, per_row_ids) in self._layout.items():
+            arrs = {lid: np.asarray(tree_leaves_list[lid],
+                                    self.compute_dtype)
+                    for ids in per_row_ids for lid in ids}
+            self.store.put(key, self._pack_key(key, arrs), async_op=True)
+        self.store.synchronize()

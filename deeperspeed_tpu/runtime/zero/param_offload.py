@@ -306,14 +306,22 @@ class GradSpillStore:
         return out
 
 
-def make_segment_fns(plan, donate_carry=True):
+def make_segment_fns(plan, donate_carry=True, count_flops=False):
     """Compiled forward/backward per segment *kind*.
 
     fwd(p, carry, batch, rng) -> carry
     bwd(p, carry, ct, batch, rng) -> (dparams, dcarry)
         recomputes the segment forward under `jax.vjp` (layer-granular
         remat) and pulls cotangents back to params and carry.
-    """
+
+    Returns (fwd, bwd, stats): with ``count_flops`` each program is
+    AOT-compiled at first call and its `cost_analysis` flops accumulate
+    into ``stats.flops`` per dispatch, so the streamed tier can report
+    MFU like the on-chip step variants (`stats` is an `OffloadStats`;
+    a no-op accumulator when counting is off)."""
+    from .offload_engine import OffloadStats, _CountingProgram
+
+    stats = OffloadStats()
     fwd_jit, bwd_jit = {}, {}
     for name, _ in plan.segments:
         kind = plan.kind(name)
@@ -321,7 +329,7 @@ def make_segment_fns(plan, donate_carry=True):
             continue
         fn = plan.forward[name]
 
-        fwd_jit[kind] = jax.jit(fn)
+        fwd_jit[kind] = _CountingProgram(jax.jit(fn), stats, count_flops)
 
         def bwd(p, carry, ct, batch, rng, _fn=fn):
             if carry is None:
@@ -333,8 +341,8 @@ def make_segment_fns(plan, donate_carry=True):
             dp, dc = vjp(ct)
             return dp, dc
 
-        bwd_jit[kind] = jax.jit(bwd)
-    return fwd_jit, bwd_jit
+        bwd_jit[kind] = _CountingProgram(jax.jit(bwd), stats, count_flops)
+    return fwd_jit, bwd_jit, stats
 
 
 def segment_leaf_indices(plan, params):

@@ -20,7 +20,7 @@ from deeperspeed_tpu.runtime.config_utils import DeepSpeedConfigError
 
 # heavy jit/training integration file: excluded from the <3-min fast lane
 # (run the full suite, or -m slow, to include it)
-pytestmark = pytest.mark.slow
+pytestmark = [pytest.mark.slow, pytest.mark.offload]
 
 STEPS = 4
 

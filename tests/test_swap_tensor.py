@@ -1,0 +1,216 @@
+"""Fast-lane units for `runtime/swap_tensor/` — the NVMe tier's aio
+engine, the pooled param swapper and the generic tensor swapper
+(the package previously had zero fast-lane coverage; the heavy engine
+integrations live in test_offload.py / test_param_offload.py behind
+`slow`).
+
+Covers: aio round trips + read/write overlap, pooled-buffer lifecycle
+and exhaustion, crash-consistent staged writes (a torn/partial write
+never corrupts the committed store of record; read-after-staged-write
+coherence), and the strict "aio" config block parse.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+from deeperspeed_tpu.runtime.swap_tensor.aio_config import (
+    DeepSpeedAIOConfig)
+from deeperspeed_tpu.runtime.swap_tensor.aio_engine import AsyncIOEngine
+from deeperspeed_tpu.runtime.swap_tensor.async_swapper import (
+    AsyncTensorSwapper)
+from deeperspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import (
+    AsyncPartitionedParameterSwapper, PartitionedParamStatus)
+
+pytestmark = pytest.mark.offload
+
+needs_aio = pytest.mark.skipif(not AsyncIOEngine.available(),
+                               reason="aio engine unavailable (no g++)")
+
+
+# ---------------------------------------------------------------------------
+# aio engine
+# ---------------------------------------------------------------------------
+
+@needs_aio
+class TestAioEngine:
+    def test_write_read_roundtrip(self, tmp_path):
+        eng = AsyncIOEngine()
+        data = np.arange(4096, dtype=np.float32)
+        path = str(tmp_path / "x.bin")
+        eng.sync_pwrite(data, path)
+        out = np.empty_like(data)
+        eng.sync_pread(out, path)
+        np.testing.assert_array_equal(out, data)
+
+    def test_async_overlap_then_wait(self, tmp_path):
+        eng = AsyncIOEngine()
+        bufs = [np.full(1024, i, np.float32) for i in range(8)]
+        for i, b in enumerate(bufs):
+            eng.aio_write(b, str(tmp_path / f"f{i}.bin"))
+        eng.wait()
+        outs = [np.empty(1024, np.float32) for _ in range(8)]
+        for i, o in enumerate(outs):
+            eng.aio_read(o, str(tmp_path / f"f{i}.bin"))
+        eng.wait()
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o, bufs[i])
+
+    def test_read_refuses_readonly_buffer(self, tmp_path):
+        eng = AsyncIOEngine()
+        path = str(tmp_path / "x.bin")
+        eng.sync_pwrite(np.zeros(16, np.float32), path)
+        buf = np.zeros(16, np.float32)
+        buf.setflags(write=False)
+        with pytest.raises(ValueError, match="writable"):
+            eng.aio_read(buf, path)
+
+
+# ---------------------------------------------------------------------------
+# partitioned param swapper (pooled buffers + staged commits)
+# ---------------------------------------------------------------------------
+
+@needs_aio
+class TestPartitionedParamSwapper:
+    def _swapper(self, tmp_path, **kw):
+        kw.setdefault("buffer_count", 3)
+        kw.setdefault("buffer_size", 64)
+        return AsyncPartitionedParameterSwapper(
+            nvme_path=str(tmp_path), dtype=np.float32, **kw)
+
+    def test_roundtrip_and_buffer_lifecycle(self, tmp_path):
+        sw = self._swapper(tmp_path)
+        a = np.arange(48, dtype=np.float32).reshape(6, 8)
+        sw.swap_out("a", a)
+        sw.synchronize_writes()
+        assert sw.available_swap_in_buffers() == 3
+        views = sw.swap_in(["a"], async_op=False)
+        np.testing.assert_array_equal(views["a"], a)
+        assert sw.available_swap_in_buffers() == 2
+        sw.release(["a"])
+        assert sw.available_swap_in_buffers() == 3
+        assert sw.param_info["a"]["status"] == \
+            PartitionedParamStatus.NOT_AVAILABLE
+
+    def test_buffer_exhaustion_raises(self, tmp_path):
+        sw = self._swapper(tmp_path, buffer_count=1)
+        for name in ("a", "b"):
+            sw.swap_out(name, np.zeros(8, np.float32))
+        sw.synchronize_writes()
+        sw.swap_in(["a"], async_op=False)
+        with pytest.raises(RuntimeError, match="buffer_count"):
+            sw.swap_in(["b"], async_op=False)
+
+    def test_staged_write_commits_on_fence(self, tmp_path):
+        """swap_out lands in .staging; only synchronize_writes installs
+        it as the store of record."""
+        sw = self._swapper(tmp_path)
+        sw.swap_out("p", np.ones(8, np.float32))
+        sw.engine.wait()   # bytes durable, but NOT committed
+        final = sw._path("p")
+        assert not os.path.exists(final)
+        assert os.path.exists(sw._staging_path("p"))
+        sw.synchronize_writes()
+        assert os.path.exists(final)
+        assert not os.path.exists(sw._staging_path("p"))
+
+    def test_torn_write_never_corrupts_committed(self, tmp_path):
+        """A crash mid-write can tear at most the staging sibling: the
+        committed file still holds the previous version."""
+        sw = self._swapper(tmp_path)
+        good = np.arange(16, dtype=np.float32)
+        sw.swap_out("p", good)
+        sw.synchronize_writes()
+        # simulate a torn in-flight update: partial staging bytes, then
+        # the process dies (no fence ever runs)
+        with open(sw._staging_path("p"), "wb") as f:
+            f.write(b"\x00" * 7)   # partial garbage
+        # a new swapper (restart) reads the COMMITTED version
+        sw2 = self._swapper(tmp_path)
+        sw2.register("p", good.shape)
+        views = sw2.swap_in(["p"], async_op=False)
+        np.testing.assert_array_equal(views["p"], good)
+
+    def test_read_after_staged_write_sees_fresh_bytes(self, tmp_path):
+        sw = self._swapper(tmp_path)
+        sw.swap_out("p", np.zeros(8, np.float32))
+        sw.synchronize_writes()
+        fresh = np.full(8, 7.0, np.float32)
+        sw.swap_out("p", fresh)          # staged, not yet fenced
+        views = sw.swap_in(["p"], async_op=False)
+        np.testing.assert_array_equal(views["p"], fresh)
+
+
+# ---------------------------------------------------------------------------
+# generic tensor swapper
+# ---------------------------------------------------------------------------
+
+@needs_aio
+class TestAsyncTensorSwapper:
+    def test_roundtrip(self, tmp_path):
+        sw = AsyncTensorSwapper()
+        tensors = [np.full(256, i, np.float32) for i in range(4)]
+        paths = [str(tmp_path / f"t{i}.swp") for i in range(4)]
+        sw.swap_out_tensors(tensors, paths)
+        sw.synchronize_writes()
+        for p in paths:
+            assert os.path.exists(p) and not os.path.exists(p + ".staging")
+        bufs = [np.empty(256, np.float32) for _ in range(4)]
+        sw.swap_in_tensors(bufs, paths)
+        sw.synchronize_reads()
+        for b, t in zip(bufs, tensors):
+            np.testing.assert_array_equal(b, t)
+
+    def test_read_fences_pending_write_to_same_path(self, tmp_path):
+        sw = AsyncTensorSwapper()
+        path = str(tmp_path / "t.swp")
+        sw.swap_out_tensors([np.zeros(64, np.float32)], [path])
+        sw.wait()
+        fresh = np.full(64, 3.0, np.float32)
+        sw.swap_out_tensors([fresh], [path])    # staged
+        buf = np.empty(64, np.float32)
+        sw.swap_in_tensors([buf], [path])       # must commit first
+        sw.synchronize_reads()
+        np.testing.assert_array_equal(buf, fresh)
+
+    def test_repeated_write_same_path_commits_once(self, tmp_path):
+        sw = AsyncTensorSwapper()
+        path = str(tmp_path / "t.swp")
+        sw.swap_out_tensors([np.zeros(8, np.float32)], [path])
+        sw.swap_out_tensors([np.ones(8, np.float32)], [path])
+        sw.wait()   # deduped commit must not raise on the missing second
+        assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# "aio" config block strictness
+# ---------------------------------------------------------------------------
+
+class TestAioConfig:
+    def test_defaults(self):
+        cfg = DeepSpeedAIOConfig.from_dict({})
+        assert cfg.block_size == 1048576 and cfg.queue_depth == 8
+        assert cfg.thread_count == 1 and cfg.overlap_events
+
+    def test_parsed(self):
+        cfg = DeepSpeedAIOConfig.from_dict({"aio": {
+            "block_size": 4096, "queue_depth": 2, "thread_count": 2,
+            "single_submit": True, "overlap_events": False}})
+        assert (cfg.block_size, cfg.queue_depth, cfg.thread_count) == \
+            (4096, 2, 2)
+        assert cfg.single_submit and not cfg.overlap_events
+
+    @pytest.mark.parametrize("block,msg", [
+        ({"aio": {"bogus": 1}}, "Unknown 'aio'"),
+        ({"aio": {"block_size": 0}}, "positive"),
+        ({"aio": {"queue_depth": -2}}, "positive"),
+        ({"aio": {"thread_count": 0}}, "positive"),
+        ({"aio": {"single_submit": "yes"}}, "boolean"),
+        ({"aio": {"overlap_events": 1}}, "boolean"),
+        ({"aio": []}, "dict"),
+    ])
+    def test_bad_values_raise(self, block, msg):
+        with pytest.raises(DeepSpeedConfigError, match=msg):
+            DeepSpeedAIOConfig.from_dict(block)
