@@ -151,6 +151,15 @@ class AsyncCheckpointManager:
                             save_latest=save_latest)
             return tag
 
+        # cross-host tag agreement is checked on the calling thread,
+        # before the snapshot: the KV compare must never ride the
+        # writer thread (the same rule as the commit barrier), and a
+        # FAIL-mode mismatch must abort before any stall is paid
+        # (the streamed-NVMe branch above validates inside its sync
+        # save_checkpoint instead)
+        from .checkpointing import _validate_checkpoint_tag
+        _validate_checkpoint_tag(engine, tag)
+
         from ..runtime.telemetry import NULL_TELEMETRY
         telemetry = getattr(engine, "telemetry", NULL_TELEMETRY)
         t0 = time.perf_counter()

@@ -26,6 +26,7 @@ verifies the manifest and falls back to the newest previously-committed
 checkpoint on corruption.
 """
 
+import itertools
 import os
 import shutil
 
@@ -44,6 +45,93 @@ from .serialization import (load_obj, save_obj, shard_slice,
                             unshard_concat)
 
 LATEST_FILE = mf.LATEST_FILE
+
+
+class CheckpointTagMismatchError(RuntimeError):
+    """Hosts tried to commit a checkpoint under different tags
+    (`checkpoint.tag_validation = "FAIL"`): the directory layout keys
+    every per-rank file by tag, so divergent tags shear one checkpoint
+    into several partial ones."""
+
+
+_tag_validation_serials = itertools.count()
+
+
+def check_checkpoint_tag_consistency(tag, fail=False, client=None,
+                                     process_index=None, process_count=None,
+                                     timeout_s=None, serial=None):
+    """Verify every host is saving under the same tag before anything
+    is written (reference `engine.py` `_checkpoint_tag_validation`):
+    rank 0 publishes its tag on the coordination-service KV store, every
+    other rank compares. Returns True when consistent (or unverifiable:
+    single process, or no coordination client to compare through —
+    logged once at debug, never a failure). On mismatch: warns
+    (`tag_validation = "WARN"`) or raises `CheckpointTagMismatchError`
+    (`"FAIL"`). The keyword seams (client/process_index/process_count)
+    exist so the logic is drivable single-host in tests."""
+    tag = str(tag)
+    if process_count is None:
+        process_count = jax.process_count()
+    if process_count <= 1:
+        return True
+    if client is None:
+        from ..utils.distributed import _distributed_client
+        client = _distributed_client()
+    if client is None:
+        logger.debug("checkpoint tag validation skipped: no coordination "
+                     "client to compare tags through")
+        return True
+    if process_index is None:
+        process_index = jax.process_index()
+    if timeout_s is None:
+        from ..utils.distributed import DEFAULT_BARRIER_TIMEOUT_S
+        timeout_s = DEFAULT_BARRIER_TIMEOUT_S
+    # serial-suffixed keys: every host derives the same serial for the
+    # same save-call order (one call per process per save), so repeated
+    # saves never read a stale tag. `serial` is an injection seam for
+    # single-host tests that simulate several ranks through one counter.
+    if serial is None:
+        serial = next(_tag_validation_serials)
+    key = f"deeperspeed_ckpt_tag/{serial}"
+    if process_index == 0:
+        client.key_value_set(key, tag)
+        return True
+    try:
+        expect = client.blocking_key_value_get(key, int(timeout_s * 1000))
+    except Exception as e:  # noqa: BLE001 - raw gRPC DEADLINE/transport
+        # Rank 0 never published (dead, or an emergency save fired on
+        # this host only): agreement is UNVERIFIABLE, which is not a
+        # mismatch — warn and let the save proceed. Peer liveness is the
+        # commit barrier's job; it fails with the typed
+        # BarrierTimeoutError -> PeerFailureError discipline, never this
+        # advisory check (even in FAIL mode, which gates on a *observed*
+        # disagreement, not on a missing peer).
+        logger.warning(f"checkpoint tag validation could not compare "
+                       f"against rank 0 ({e}); proceeding unverified — "
+                       f"the commit barrier still enforces liveness")
+        return True
+    if isinstance(expect, bytes):
+        expect = expect.decode("utf-8")
+    if expect == tag:
+        return True
+    msg = (f"checkpoint tag mismatch across hosts: rank 0 is saving "
+           f"{expect!r} but process {process_index} is saving {tag!r} — "
+           f"the per-rank files would land in different checkpoint "
+           f"directories")
+    if fail:
+        raise CheckpointTagMismatchError(msg)
+    logger.warning(msg)
+    return False
+
+
+def _validate_checkpoint_tag(engine, tag):
+    """The `checkpoint.tag_validation` knob's consumer: gate the
+    cross-host tag comparison on the parsed mode."""
+    cfg = getattr(engine, "_config", None)
+    if not getattr(cfg, "checkpoint_tag_validation_enabled", False):
+        return
+    check_checkpoint_tag_consistency(
+        tag, fail=getattr(cfg, "checkpoint_tag_validation_fail", False))
 
 
 def _commit_barrier(tag):
@@ -246,6 +334,7 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None,
     client_state = client_state or {}
     if tag is None:
         tag = f"global_step{engine.global_steps}"
+    _validate_checkpoint_tag(engine, tag)
 
     if getattr(engine, "_grad_spill", None) is not None:
         # NVMe store-of-record tier: the segment + optimizer-group files

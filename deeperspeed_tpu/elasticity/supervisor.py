@@ -59,7 +59,10 @@ def read_progress(state_dir):
 def write_progress(state_dir, global_steps, committed_step=None):
     """Atomic progress write (engine step boundary): the supervisor must
     never read a torn record mid-crash."""
-    record = {"global_steps": int(global_steps), "time": time.time()}
+    # true epoch timestamp: the record is read by ANOTHER process (the
+    # supervisor) — a per-process monotonic clock is meaningless there
+    record = {"global_steps": int(global_steps),
+              "time": time.time()}  # dslint: disable=wall-clock
     if committed_step is not None:
         record["committed_step"] = int(committed_step)
     tmp = os.path.join(state_dir, ec.PROGRESS_FILE + ".tmp")
@@ -229,7 +232,10 @@ class Supervisor:
 
     def _write_restart_record(self, exit_code, crash_step, backoff):
         record = {
-            "crash_time": time.time(),
+            # true epoch timestamp: MTTR is measured by the RESTARTED
+            # process (engine.py) against this value — monotonic clocks
+            # don't survive the process boundary
+            "crash_time": time.time(),  # dslint: disable=wall-clock
             "exit_code": int(exit_code),
             "crash_step": crash_step,
             "restart_count": self.restarts,

@@ -84,6 +84,16 @@ def env_fingerprint():
         info["flax"] = flax.__version__
     except ImportError:
         pass
+    # which static-analysis invariant set this checkout was gated on.
+    # tools/ is repo-local, not installed with the package — and "tools"
+    # is a common top-level name, so a foreign package on sys.path may
+    # sit there and raise anything at import: never let it break
+    # ds_report itself.
+    try:
+        from tools.dslint import RULESET_VERSION
+        info["dslint_ruleset"] = RULESET_VERSION
+    except Exception:  # noqa: BLE001 - absent or foreign tools package
+        info["dslint_ruleset"] = None
     return info
 
 

@@ -2715,8 +2715,11 @@ class DeepSpeedEngine:
                        float(self._elastic_restart_count)}
             record = self._elastic_restart_record
             if record and record.get("crash_time"):
+                # wall clock on purpose: crash_time was stamped by the
+                # PREVIOUS incarnation — epoch time is the only clock
+                # that crosses the process boundary
                 scalars["Train/Elastic/mttr_s"] = \
-                    _time.time() - float(record["crash_time"])
+                    _time.time() - float(record["crash_time"])  # dslint: disable=wall-clock
             self.monitor.record(self.global_samples, scalars)
         if self.peer_monitor is not None and self.peer_monitor.has_failure:
             self._escalate_peer_failure()

@@ -287,12 +287,15 @@ class JSONLBackend:
         # the serving DECODE loop (one per generated token) — a
         # synchronous file write per token would put disk latency on
         # the hot path
-        self._observations.append((time.time(), tag, float(value)))
+        # true epoch timestamp: JSONL "ts" fields are parsed by external
+        # tooling that correlates records across hosts/restarts
+        self._observations.append(
+            (time.time(), tag, float(value)))  # dslint: disable=wall-clock
 
     def flush(self):
         batches, self._batch = self._batch, {}
         obs, self._observations = self._observations, []
-        now = time.time()
+        now = time.time()  # dslint: disable=wall-clock  (JSONL epoch "ts")
         for sample in sorted(batches):
             self._file.write(json.dumps(
                 {"ts": now, "sample": sample,

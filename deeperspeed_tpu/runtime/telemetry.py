@@ -702,7 +702,9 @@ class Telemetry:
             return None
         path = os.path.join(self.trace_dir, f"memory_{tag}.json")
         os.makedirs(self.trace_dir, exist_ok=True)
-        payload = {"tag": tag, "time": time.time(),
+        # true epoch timestamp: snapshot files are correlated with logs
+        # and other hosts' artifacts offline
+        payload = {"tag": tag, "time": time.time(),  # dslint: disable=wall-clock
                    "devices": self._device_memory_stats()}
         with open(path, "w") as f:
             json.dump(payload, f, indent=2, default=str)

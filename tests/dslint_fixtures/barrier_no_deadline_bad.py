@@ -1,0 +1,11 @@
+"""True positives for barrier-no-deadline."""
+
+
+def commit(client, tag):
+    client.wait_at_barrier(tag)                  # BAD: hangs forever
+    value = client.blocking_key_value_get(tag)   # BAD: hangs forever
+    return value
+
+
+def commit_acknowledged(client, tag):
+    client.wait_at_barrier(tag)  # dslint: disable=barrier-no-deadline

@@ -1,0 +1,9 @@
+"""True negatives for barrier-no-deadline."""
+
+TIMEOUT_S = 900
+
+
+def commit(client, tag):
+    client.wait_at_barrier(tag, int(TIMEOUT_S * 1000))           # fine
+    client.wait_at_barrier(tag, timeout_in_ms=TIMEOUT_S * 1000)  # fine
+    return client.blocking_key_value_get(tag, TIMEOUT_S * 1000)  # fine

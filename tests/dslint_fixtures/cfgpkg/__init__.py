@@ -1,0 +1,1 @@
+"""Synthetic config package for the parse-only-key pass (parsed only)."""

@@ -1,0 +1,17 @@
+"""A strict block parser in the repo's house style: known-set
+unknown-key rejection, keys via the constants module."""
+
+from . import constants as c
+
+
+def parse_block(d):
+    known = {c.ALPHA, c.PHANTOM,
+             c.LAUNCHER}  # dslint: consumed-by-launcher
+    unknown = sorted(set(d) - known)
+    if unknown:
+        raise ValueError(f"Unknown key(s) {unknown}")
+    return {
+        c.ALPHA: d.get(c.ALPHA, 1),
+        c.PHANTOM: d.get(c.PHANTOM, 2),     # parsed... and never read
+        c.LAUNCHER: d.get(c.LAUNCHER, 3),
+    }

@@ -1,0 +1,5 @@
+"""Key-name constants for the synthetic block."""
+
+ALPHA = "alpha_knob"
+PHANTOM = "phantom_knob"
+LAUNCHER = "launcher_knob"

@@ -1,0 +1,7 @@
+"""Consumes alpha_knob (subscript read); phantom_knob has no reader."""
+
+from . import constants as c
+
+
+def apply(params):
+    return params[c.ALPHA] * 2

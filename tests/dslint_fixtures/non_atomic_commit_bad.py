@@ -1,0 +1,19 @@
+"""True positives for non-atomic-commit."""
+import json
+
+import numpy as np
+
+
+def write_manifest(ckpt_dir, payload):
+    with open(ckpt_dir + "/manifest.json", "w") as f:   # BAD: torn on crash
+        json.dump(payload, f)
+
+
+def save_weights(save_dir, arr):
+    np.save(save_dir + "/weights.npy", arr)             # BAD
+
+
+def write_acknowledged(ckpt_dir, payload):
+    # dslint: disable=non-atomic-commit
+    with open(ckpt_dir + "/notes.json", "w") as f:
+        json.dump(payload, f)

@@ -1,0 +1,27 @@
+"""True negatives for non-atomic-commit."""
+import json
+import os
+
+
+def write_manifest(ckpt_dir, payload):
+    # fine: staging sibling + atomic os.replace commit
+    tmp = os.path.join(ckpt_dir, "manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, os.path.join(ckpt_dir, "manifest.json"))
+
+
+def write_into_staging(staging_dir, payload):
+    # fine: the staging dir is invisible until the commit rename
+    with open(os.path.join(staging_dir, "part0.bin"), "w") as f:
+        f.write(payload)
+
+
+def write_log(log_dir, text):
+    with open(log_dir + "/events.log", "w") as f:   # fine: not a ckpt path
+        f.write(text)
+
+
+def read_manifest(ckpt_dir):
+    with open(ckpt_dir + "/manifest.json") as f:    # fine: read, not write
+        return json.load(f)

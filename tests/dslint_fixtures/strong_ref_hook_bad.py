@@ -1,0 +1,25 @@
+"""True positives for strong-ref-hook."""
+import atexit
+import signal
+
+from deeperspeed_tpu.runtime.monitor import MONITOR
+
+
+def install_global():
+    atexit.register(MONITOR.flush)   # BAD: bound method of a from-
+    #                                  imported OBJECT pins the instance
+
+
+class Monitor:
+    def close(self):
+        pass
+
+    def _on_term(self, sig, frame):
+        pass
+
+    def install(self):
+        atexit.register(self.close)                    # BAD: pins self
+        signal.signal(signal.SIGTERM, self._on_term)   # BAD: pins self
+
+    def install_acknowledged(self):
+        atexit.register(self.close)  # dslint: disable=strong-ref-hook

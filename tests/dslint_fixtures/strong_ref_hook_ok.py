@@ -1,0 +1,23 @@
+"""True negatives for strong-ref-hook."""
+import atexit
+import signal
+import weakref
+
+
+class Monitor:
+    def close(self):
+        pass
+
+    def install(self):
+        ref = weakref.ref(self)
+
+        def hook():
+            target = ref()
+            if target is not None:
+                target.close()
+
+        atexit.register(hook)      # fine: weakly bound local function
+
+    def restore(self, sig, prev_handler):
+        signal.signal(sig, prev_handler)        # fine: plain name
+        signal.signal(sig, signal.SIG_DFL)      # fine: module constant

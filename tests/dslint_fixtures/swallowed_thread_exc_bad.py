@@ -1,0 +1,35 @@
+"""True positives for swallowed-thread-exc."""
+import threading
+
+
+def _poll_loop(stop, work):
+    while not stop.is_set():
+        try:
+            work()
+        except Exception:      # BAD: the daemon dies/corrupts silently
+            pass
+
+
+def _drain_loop(stop, queue):
+    while not stop.is_set():
+        try:
+            queue.get_nowait()
+        except:                # BAD: bare except, swallowed   # noqa: E722
+            continue
+
+
+def _quiet_loop(stop, work):
+    while not stop.is_set():
+        try:
+            work()
+        except Exception:  # dslint: disable=swallowed-thread-exc
+            pass
+
+
+def start(stop, work, queue):
+    threading.Thread(target=_poll_loop, args=(stop, work),
+                     daemon=True).start()
+    threading.Thread(target=_drain_loop, args=(stop, queue),
+                     daemon=True).start()
+    threading.Thread(target=_quiet_loop, args=(stop, work),
+                     daemon=True).start()

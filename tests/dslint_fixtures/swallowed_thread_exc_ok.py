@@ -1,0 +1,31 @@
+"""True negatives for swallowed-thread-exc."""
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+def _poll_loop(stop, work):
+    while not stop.is_set():
+        try:
+            work()
+        except Exception as e:          # fine: surfaced
+            logger.error("poll loop failed: %s", e)
+        try:
+            work()
+        except ValueError:              # fine: narrow, deliberate
+            pass
+
+
+def start(stop, work):
+    threading.Thread(target=_poll_loop, args=(stop, work),
+                     daemon=True).start()
+
+
+def plain_helper(x):
+    # fine for THIS rule: not a thread target (broad-except hygiene
+    # outside threads is a review matter, not a silent-death hazard)
+    try:
+        return int(x)
+    except Exception:
+        pass

@@ -1,0 +1,47 @@
+"""True positives for trace-host-call: host calls inside traced code."""
+import random
+import time
+
+import numpy as np
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.shard_map import shard_map
+
+
+@jax.jit
+def decorated_step(x):
+    t0 = time.monotonic()           # BAD: frozen at trace time
+    print("step at", t0)            # BAD: prints once, at compile
+    return x * random.random()      # BAD: one sample, baked into the graph
+
+
+def loss_fn(x):
+    noise = np.random.normal()      # BAD: loss_fn is jitted below
+    return x + noise
+
+
+step = jax.jit(loss_fn)
+
+
+def kernel(x_ref, o_ref):
+    print("tile", x_ref.shape)      # BAD: pallas_call kernel
+
+
+def launch(x):
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def mapped(x):
+    with open("/tmp/debug.txt", "w") as f:   # BAD: shard_mapped below
+        f.write(str(x))
+    return x
+
+
+wrapped = shard_map(mapped, mesh=None, in_specs=(), out_specs=())
+
+
+@jax.jit
+def suppressed_step(x):
+    print("acknowledged")  # dslint: disable=trace-host-call
+    return x

@@ -1,0 +1,31 @@
+"""True negatives for trace-host-call."""
+import time
+
+import jax
+
+
+def host_step(x):
+    t0 = time.monotonic()    # fine: plain host function, never traced
+    print("host step", t0)
+    return x
+
+
+@jax.jit
+def traced(x):
+    def host_stats(v):
+        print("routed to host:", v)   # fine: jax.debug.callback target
+
+    jax.debug.callback(host_stats, x)
+    jax.debug.print("x = {}", x)      # fine: jax.debug.print, not print
+    return x
+
+
+class Reporter:
+    def print(self, msg):
+        return msg
+
+
+@jax.jit
+def method_named_print(x):
+    Reporter().print("not the builtin")   # fine: bound method, not print()
+    return x

@@ -1,0 +1,12 @@
+"""True positives for wall-clock."""
+import time as _time
+
+
+def measure_step(fn):
+    t0 = _time.time()              # BAD: NTP step corrupts the delta
+    fn()
+    return _time.time() - t0       # BAD
+
+
+def stamp():
+    return _time.time()  # dslint: disable=wall-clock  (true timestamp)

@@ -1,0 +1,14 @@
+"""True negatives for wall-clock."""
+import time
+
+
+def measure_step(fn):
+    t0 = time.monotonic()          # fine
+    fn()
+    return time.monotonic() - t0
+
+
+def bench(fn):
+    t0 = time.perf_counter()       # fine
+    fn()
+    return time.perf_counter() - t0

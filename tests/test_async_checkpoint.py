@@ -530,3 +530,125 @@ def test_manifest_json_is_human_auditable(tmp_path, devices):
     entry = manifest["files"]["mp_rank_00_model_states.pt"]
     assert entry["bytes"] == os.path.getsize(
         tmp_path / "t" / "mp_rank_00_model_states.pt")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint.tag_validation (the knob dslint's parse-only-key pass
+# surfaced as parse-only in PR 14 — these pin its wired consumer)
+# ---------------------------------------------------------------------------
+
+class _FakeKVClient:
+    """Single-host stand-in for the coordination-service KV store."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        assert timeout_ms > 0   # the deadline discipline must hold
+        return self.store[key]
+
+
+def test_tag_validation_consistent_tags_pass():
+    from deeperspeed_tpu.checkpoint.checkpointing import \
+        check_checkpoint_tag_consistency
+
+    client = _FakeKVClient()
+    assert check_checkpoint_tag_consistency(
+        "global_step5", client=client, process_index=0, process_count=2,
+        serial=0)
+    assert check_checkpoint_tag_consistency(
+        "global_step5", client=client, process_index=1, process_count=2,
+        serial=0)
+
+
+def test_tag_validation_mismatch_warns_then_fails():
+    from deeperspeed_tpu.checkpoint.checkpointing import (
+        CheckpointTagMismatchError, check_checkpoint_tag_consistency)
+
+    # WARN mode: mismatch returns False, does not raise
+    client = _FakeKVClient()
+    assert check_checkpoint_tag_consistency(
+        "tag_a", client=client, process_index=0, process_count=2,
+        serial=0)
+    assert not check_checkpoint_tag_consistency(
+        "tag_b", fail=False, client=client, process_index=1,
+        process_count=2, serial=0)
+
+    # FAIL mode: typed error before anything is written
+    client = _FakeKVClient()
+    check_checkpoint_tag_consistency(
+        "tag_a", client=client, process_index=0, process_count=2,
+        serial=1)
+    with pytest.raises(CheckpointTagMismatchError):
+        check_checkpoint_tag_consistency(
+            "tag_b", fail=True, client=client, process_index=1,
+            process_count=2, serial=1)
+
+
+def test_tag_validation_repeated_saves_use_fresh_keys():
+    """Serial-suffixed keys: save N's comparison can never read save
+    N-1's published tag."""
+    from deeperspeed_tpu.checkpoint.checkpointing import \
+        check_checkpoint_tag_consistency
+
+    client = _FakeKVClient()
+    for step in (1, 2, 3):
+        tag = f"global_step{step}"
+        check_checkpoint_tag_consistency(
+            tag, client=client, process_index=0, process_count=2,
+            serial=step)
+        assert check_checkpoint_tag_consistency(
+            tag, client=client, process_index=1, process_count=2,
+            serial=step)
+    assert len(client.store) == 3
+
+
+def test_tag_validation_single_process_and_config_gate(tmp_path):
+    """Single process: trivially consistent. And the engine-side gate
+    reads the parsed checkpoint_tag_validation_* config attrs."""
+    from deeperspeed_tpu.checkpoint.checkpointing import (
+        _validate_checkpoint_tag, check_checkpoint_tag_consistency)
+    from deeperspeed_tpu.runtime.config import DeepSpeedConfig
+
+    assert check_checkpoint_tag_consistency("t", process_count=1)
+
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "checkpoint": {"tag_validation": "FAIL"}})
+    assert cfg.checkpoint_tag_validation_enabled
+    assert cfg.checkpoint_tag_validation_fail
+    cfg_warn = DeepSpeedConfig({"train_batch_size": 8})
+    assert cfg_warn.checkpoint_tag_validation_enabled   # default WARN
+    assert not cfg_warn.checkpoint_tag_validation_fail
+    cfg_off = DeepSpeedConfig({"train_batch_size": 8,
+                               "checkpoint": {"tag_validation": "IGNORE"}})
+    assert not cfg_off.checkpoint_tag_validation_enabled
+
+    class _Eng:
+        _config = cfg_off
+
+    # IGNORE mode: no client lookup at all (would raise on this host
+    # if it tried to compare through a real coordination client)
+    _validate_checkpoint_tag(_Eng(), "any_tag")
+
+
+def test_tag_validation_unverifiable_peer_proceeds():
+    """Rank 0 never publishing (dead peer, or an emergency save that
+    fired on this host only) is UNVERIFIABLE, not a mismatch: the save
+    proceeds with a warning in BOTH modes — peer liveness belongs to
+    the commit barrier's typed-error discipline, not this check."""
+    from deeperspeed_tpu.checkpoint.checkpointing import \
+        check_checkpoint_tag_consistency
+
+    class _DeadRankZero:
+        def blocking_key_value_get(self, key, timeout_ms):
+            raise RuntimeError("DEADLINE_EXCEEDED: key not found")
+
+    assert check_checkpoint_tag_consistency(
+        "t", fail=False, client=_DeadRankZero(), process_index=1,
+        process_count=2, serial=0)
+    assert check_checkpoint_tag_consistency(
+        "t", fail=True, client=_DeadRankZero(), process_index=1,
+        process_count=2, serial=1)

@@ -1,0 +1,1 @@
+"""Repo-native developer tooling (not shipped with the package)."""

@@ -1,0 +1,43 @@
+"""dslint — repo-native static analysis for DeeperSpeed-TPU.
+
+Thirteen PRs of review history show the same host-side defect classes
+recurring: parse-only config knobs that silently do nothing, strong-ref
+atexit/signal handlers that pin engines for the process lifetime,
+non-atomic writes into checkpoint directories, wall-clock timers that
+jump with NTP, timed autotune loops that measure the Pallas interpreter
+for minutes on CPU, and daemon threads that swallow their own death.
+Each of these invariants is mechanically checkable, so dslint checks
+them mechanically — in tier-1, before any TPU is touched.
+
+Usage:
+
+    python -m tools.dslint                 # lint the default path set
+    python -m tools.dslint deeperspeed_tpu # lint one tree
+    bin/ds_lint --json                     # machine-readable findings
+    bin/ds_lint --baseline-update          # intentionally re-baseline
+
+The rule catalog lives in ``docs/static-analysis.md``; suppression is
+per-line (``# dslint: disable=<rule>``) and grandfathered findings live
+in the committed ``tools/dslint/baseline.json``.
+"""
+
+# Bumped whenever a rule is added/removed or a rule's detection surface
+# changes materially. `ds_report --json` embeds this in the environment
+# fingerprint so a fleet trace records which invariant set the producing
+# checkout was linted against.
+RULESET_VERSION = "1.0"
+
+from .core import Finding, LintContext, SourceFile, iter_source_files  # noqa: E402
+from .engine import DEFAULT_PATHS, run_lint  # noqa: E402
+from .rules import REGISTRY  # noqa: E402
+
+__all__ = [
+    "RULESET_VERSION",
+    "Finding",
+    "LintContext",
+    "SourceFile",
+    "iter_source_files",
+    "run_lint",
+    "DEFAULT_PATHS",
+    "REGISTRY",
+]

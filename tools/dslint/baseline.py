@@ -1,0 +1,77 @@
+"""Committed baseline for grandfathered findings.
+
+The baseline is a JSON file mapping findings (by rule + path +
+content fingerprint, never line number) that are knowingly tolerated.
+Policy (docs/static-analysis.md): the baseline should stay empty or
+near-empty — a true positive gets *fixed*, a justified exception gets a
+per-line suppression with a reason; the baseline exists so a new rule
+can land gated before every historical finding is burned down, and so
+CI can fail on *new* findings immediately.
+
+Matching is count-aware: two identical offending lines in one file
+share a fingerprint, and a baseline entry with ``"count": 2`` covers
+exactly two live occurrences — a third is a new finding.
+"""
+
+import collections
+import json
+import os
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                                     "baseline.json")
+
+
+def load_baseline(path):
+    """{(rule, path, fingerprint): count}; an absent file is empty."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for entry in data.get("findings", []):
+        key = (entry["rule"], entry["path"], entry["fingerprint"])
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def split_by_baseline(findings, baseline):
+    """(new, grandfathered): consume baseline budget per fingerprint."""
+    budget = dict(baseline)
+    new, old = [], []
+    for f in findings:
+        key = (f.rule, f.path, f.fingerprint)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(findings, path, ruleset_version):
+    """Regenerate the baseline from the current finding set (the
+    intentional `--baseline-update` path). Atomic: a crash mid-write
+    must not leave a torn baseline that silently ignores findings."""
+    counter = collections.Counter(
+        (f.rule, f.path, f.fingerprint) for f in findings)
+    snippets = {}
+    for f in findings:
+        snippets.setdefault((f.rule, f.path, f.fingerprint), f.snippet)
+    entries = [
+        {"rule": rule, "path": p, "fingerprint": fp, "count": count,
+         "snippet": snippets[(rule, p, fp)]}
+        for (rule, p, fp), count in sorted(counter.items())
+    ]
+    payload = {
+        "comment": ("Grandfathered dslint findings. Keep this empty: fix "
+                    "true positives, suppress justified exceptions "
+                    "per-line with a reason. See docs/static-analysis.md."),
+        "ruleset": ruleset_version,
+        "findings": entries,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return entries

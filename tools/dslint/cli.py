@@ -1,0 +1,108 @@
+"""``ds_lint`` — the dslint command line (mirrors ``ds_report``).
+
+    ds_lint [paths...]          lint (default: tier-1 path set), text report
+    ds_lint --json              machine-readable findings on stdout
+    ds_lint --baseline-update   regenerate the committed baseline from the
+                                current finding set (intentional act)
+    ds_lint --list-rules        print the rule catalog
+    ds_lint --select a,b        run only the named rules
+
+Exit code 0 when no non-baselined findings (and no unparseable files),
+1 otherwise — usable directly as a pre-commit hook or CI step.
+"""
+
+import argparse
+import json
+import sys
+
+from . import RULESET_VERSION
+from .baseline import DEFAULT_BASELINE_PATH, write_baseline
+from .engine import DEFAULT_PATHS, run_lint
+from .rules import REGISTRY
+
+
+def _print_text(result, show_baselined):
+    for path, message in result.errors:
+        print(f"{path}: [parse-error] {message}")
+    for f in result.findings:
+        print(f.render())
+        if f.snippet:
+            print(f"    {f.snippet}")
+    if show_baselined:
+        for f in result.baselined:
+            print(f"{f.render()}  (baselined)")
+    status = "clean" if result.ok else "FAILED"
+    print(f"dslint {RULESET_VERSION}: {len(result.findings)} finding(s), "
+          f"{len(result.baselined)} baselined, {len(result.errors)} parse "
+          f"error(s) over {result.files_checked} file(s) — {status}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_lint",
+        description="DeeperSpeed-TPU repo-native static analysis")
+    parser.add_argument("paths", nargs="*",
+                        help=f"files/dirs to lint (default: "
+                             f"{' '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--root", default=None,
+                        help="repo root paths are relative to "
+                             "(default: the checkout containing tools/)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule names to run")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: "
+                             f"{DEFAULT_BASELINE_PATH})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report every finding")
+    parser.add_argument("--baseline-update", action="store_true",
+                        help="rewrite the baseline to cover the current "
+                             "finding set (intentional re-baseline)")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="also print grandfathered findings")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            rule = REGISTRY[name]
+            print(f"{name} [{rule.scope}]")
+            print(f"    {rule.summary}")
+            if rule.incident:
+                print(f"    incident: {rule.incident}")
+        return 0
+
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    if select:
+        unknown = sorted(set(select) - set(REGISTRY))
+        if unknown:
+            print(f"ds_lint: unknown rule(s) {unknown}; valid: "
+                  f"{sorted(REGISTRY)}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or DEFAULT_BASELINE_PATH
+    result = run_lint(paths=args.paths or None, root=args.root,
+                      select=select, baseline_path=baseline_path,
+                      use_baseline=not args.no_baseline
+                      and not args.baseline_update)
+
+    if args.baseline_update:
+        entries = write_baseline(result.findings, baseline_path,
+                                 RULESET_VERSION)
+        print(f"ds_lint: baseline rewritten with {len(entries)} entry "
+              f"group(s) covering {len(result.findings)} finding(s) -> "
+              f"{baseline_path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_dict(RULESET_VERSION), indent=2))
+    else:
+        _print_text(result, args.show_baselined)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,258 @@
+"""Rule 8: the cross-file parse-only config-key pass.
+
+The PR 9 defect class: a strict block parser accepts and validates a
+key, stores the value — and nothing ever reads it. The knob is
+documented, type-checked, and silently does nothing (the supervisor
+block shipped exactly like this; `a2a_overlap_chunks` sat inert on the
+GSPMD path).
+
+Mechanics:
+
+1. **Harvest** — every string-resolvable element of a ``known = {...}``
+   / ``*_known`` / ``*_KEYS`` set literal (the unknown-key-rejection
+   discipline every strict block parser in this repo follows). Elements
+   are string constants or ``c.CONSTANT`` attributes resolved through
+   the module's own imports into ``*constants*.py`` assignment tables.
+2. **Consume** — a key counts as consumed when its string appears, in
+   code *outside* parser functions, as: a Load-context attribute
+   (``cfg.prefetch_depth``), a Load-context subscript
+   (``params["prefetch_depth"]`` or ``params[c.KEY]``), a
+   ``.get("prefetch_depth")``/``.pop`` call, a ``"key" in x``
+   membership test, a call keyword argument (``Telemetry(mfu=...)``) or
+   a function parameter name (``def __init__(self, mfu=True)`` — how
+   ``Thing(**parsed_block)`` consumption manifests), or as a substring
+   of a Load-context attribute (derived attributes:
+   ``tag_validation`` -> ``self.checkpoint_tag_validation_mode``). A
+   parser function is one that performs unknown-key rejection (contains
+   a known-set assignment) or is named ``parse_*``/``_parse_*``; reads
+   there are the parse itself, not a consumer.
+3. **Escape** — a key legitimately read outside the package (the
+   launcher re-parses the config JSON; external dashboards read some
+   blocks) carries ``# dslint: consumed-by-launcher`` on its known-set
+   element line.
+"""
+
+import ast
+import re
+
+from .resolve import call_name, import_aliases, last_component
+from .rules import Rule, register
+
+_KNOWN_SET_NAME = re.compile(r"(^|_)(known|keys)$", re.IGNORECASE)
+CONSUMED_ANNOTATION = "consumed-by-launcher"
+
+# Keys every block shares whose consumption is structural (the parser
+# itself gates on them); their absence elsewhere is not the PR 9 class.
+_STRUCTURAL_KEYS = {"enabled"}
+
+
+def _constants_tables(sources):
+    """{relpath: {CONST_NAME: "string value"}} for *constants*.py files."""
+    tables = {}
+    for src in sources:
+        if "constants" not in src.path.rsplit("/", 1)[-1]:
+            continue
+        table = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                table[node.targets[0].id] = node.value.value
+        if table:
+            tables[src.path] = table
+    return tables
+
+
+def _constants_aliases(src, tables):
+    """Map import alias -> constants table for this module.
+
+    Any imported module whose last path component contains 'constants'
+    is resolved against the harvested tables, preferring the table
+    whose path shares the longest suffix with the import."""
+    out = {}
+    for node in src.nodes():
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [(a.asname or a.name.split(".")[0], a.name)
+                    for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.names:
+            mod = node.module or ""
+            mods = [(a.asname or a.name, f"{mod}.{a.name}" if mod else a.name)
+                    for a in node.names if a.name != "*"]
+        for alias, target in mods:
+            if "constants" not in last_component(target):
+                continue
+            # match 'runtime.constants' to '<...>/runtime/constants.py';
+            # ambiguous suffixes (a bare `from . import constants`)
+            # prefer the table closest to the importing module's dir
+            suffix = target.lstrip(".").replace(".", "/") + ".py"
+            src_dir = src.path.rsplit("/", 1)[0] if "/" in src.path else ""
+
+            def _proximity(path):
+                common = 0
+                for a, b in zip(path.split("/"), src_dir.split("/")):
+                    if a != b:
+                        break
+                    common += 1
+                return common
+
+            candidates = [p for p in tables if p.endswith(suffix)]
+            if not candidates and len(tables) == 1:
+                candidates = list(tables)
+            if candidates:
+                out[alias] = tables[max(candidates, key=_proximity)]
+    return out
+
+
+def _resolve_key(elt, const_aliases):
+    """A known-set element to its key string, or None."""
+    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+        return elt.value
+    if isinstance(elt, ast.Attribute) and isinstance(elt.value, ast.Name):
+        table = const_aliases.get(elt.value.id)
+        if table is not None:
+            return table.get(elt.attr)
+    return None
+
+
+def _parser_functions(src):
+    """Function nodes that ARE the parse: contain a known-set assignment
+    or are named like a parser."""
+    out = set()
+    for node in src.nodes():
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.startswith(("parse_", "_parse_")):
+            out.add(node)
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and any(
+                    isinstance(t, ast.Name)
+                    and _KNOWN_SET_NAME.search(t.id)
+                    for t in sub.targets):
+                out.add(node)
+                break
+    return out
+
+
+def _known_set_assignments(src):
+    for node in src.nodes():
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                _KNOWN_SET_NAME.search(node.targets[0].id) and \
+                isinstance(node.value, ast.Set):
+            yield node
+
+
+@register
+class ParseOnlyKeyRule(Rule):
+    name = "parse-only-key"
+    scope = "project"
+    summary = ("config key accepted by a strict block parser with no "
+               "read site anywhere else in the package — the knob "
+               "parses, validates, and silently does nothing")
+    incident = ("PR 9: the documented elasticity.supervisor block was "
+                "parse-only for a whole PR; PR 5: a2a_overlap_chunks "
+                "sat silently inert on the GSPMD path")
+
+    def check_project(self, ctx):
+        sources = ctx.sources
+        tables = _constants_tables(sources)
+
+        # -- harvest ------------------------------------------------------
+        harvested = {}   # key -> list of (src, elt_node)
+        for src in sources:
+            const_aliases = _constants_aliases(src, tables)
+            for assign in _known_set_assignments(src):
+                for elt in assign.value.elts:
+                    key = _resolve_key(elt, const_aliases)
+                    if key is not None:
+                        harvested.setdefault(key, []).append((src, elt))
+        if not harvested:
+            return
+
+        # -- consumption scan --------------------------------------------
+        consumed = set(_STRUCTURAL_KEYS)
+        attr_reads = set()   # for the derived-attribute substring pass
+        for src in sources:
+            if "constants" in src.path.rsplit("/", 1)[-1]:
+                continue
+            const_aliases = _constants_aliases(src, tables)
+            # flat membership set: every node under a parser function
+            # (walking parent chains per node dominated the pass)
+            parser_fns = _parser_functions(src)
+            parser_nodes = set()
+            for fn in parser_fns:
+                parser_nodes.update(ast.walk(fn))
+
+            def in_parser(node):
+                return node in parser_nodes
+
+            for node in src.nodes():
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.ctx, ast.Load):
+                    if not in_parser(node):
+                        consumed.add(node.attr)
+                        attr_reads.add(node.attr)
+                elif isinstance(node, ast.Subscript) and \
+                        isinstance(node.ctx, ast.Load):
+                    key = _resolve_key(node.slice, const_aliases)
+                    if key is not None and not in_parser(node):
+                        consumed.add(key)
+                elif isinstance(node, ast.Call):
+                    if not in_parser(node):
+                        for kw in node.keywords:
+                            if kw.arg:   # Thing(mfu=...) consumes 'mfu'
+                                consumed.add(kw.arg)
+                    # read the method name off the Attribute directly:
+                    # `(d.get(a) or {}).get(b)` has no dotted root
+                    tail = (node.func.attr
+                            if isinstance(node.func, ast.Attribute)
+                            else last_component(call_name(node)))
+                    if tail in ("get", "pop", "setdefault") and node.args:
+                        key = _resolve_key(node.args[0], const_aliases)
+                        if key is not None and not in_parser(node):
+                            consumed.add(key)
+                    if isinstance(node.func, ast.Name) and \
+                            node.func.id in ("getattr", "hasattr") and \
+                            len(node.args) >= 2 and not in_parser(node):
+                        key = _resolve_key(node.args[1], const_aliases)
+                        if key is not None:
+                            consumed.add(key)
+                            attr_reads.add(key)
+                elif isinstance(node, ast.Compare) and \
+                        any(isinstance(op, (ast.In, ast.NotIn))
+                            for op in node.ops):
+                    key = _resolve_key(node.left, const_aliases)
+                    if key is not None and not in_parser(node):
+                        consumed.add(key)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    # def __init__(self, mfu=True): how **parsed_block
+                    # expansion consumption manifests
+                    if node not in parser_fns:
+                        a = node.args
+                        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                            consumed.add(arg.arg)
+
+        def _derived_attr(key):
+            return any(key in attr and key != attr for attr in attr_reads)
+
+        # -- report -------------------------------------------------------
+        for key in sorted(set(harvested) - consumed):
+            if _derived_attr(key):
+                continue
+            for src, elt in harvested[key]:
+                if src.suppressed(self.name, elt.lineno):
+                    continue
+                if src.annotated(CONSUMED_ANNOTATION, elt.lineno):
+                    continue
+                yield src.finding(
+                    self.name, elt,
+                    f"config key '{key}' is accepted by this strict "
+                    f"parser but never read (no attribute/subscript/"
+                    f".get site outside parse code): the knob silently "
+                    f"does nothing. Wire it to a consumer, or mark the "
+                    f"element line '# dslint: {CONSUMED_ANNOTATION}' if "
+                    f"it is read outside the engine.")

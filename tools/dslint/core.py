@@ -1,0 +1,211 @@
+"""dslint core: findings, per-line suppressions, source-file loading.
+
+A finding is identified across edits by a *fingerprint* — a hash of
+(rule, path, stripped source line) — not by its line number, so an
+unrelated edit above a grandfathered finding does not invalidate the
+committed baseline. Two identical offending lines in one file share a
+fingerprint; the baseline matcher is count-aware (see baseline.py).
+"""
+
+import ast
+import dataclasses
+import hashlib
+import io
+import os
+import re
+import tokenize
+
+# `# dslint: disable=rule-a,rule-b` — suppresses those rules on the
+# same line, or on the following line when the comment stands alone.
+# `# dslint: disable-file=rule-a` anywhere suppresses for the file.
+_SUPPRESS_RE = re.compile(
+    r"#\s*dslint:\s*disable(?P<file>-file)?\s*=\s*(?P<rules>[\w,\- ]+)")
+# `# dslint: consumed-by-launcher` — annotation escape for config keys
+# that are read outside the engine package (launcher, external tooling);
+# recognized by the parse-only-key pass, not a generic suppression.
+_ANNOTATION_RE = re.compile(r"#\s*dslint:\s*(?P<note>[a-z][\w\-]*)\b")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-root-relative, forward slashes
+    line: int          # 1-based
+    col: int
+    message: str
+    snippet: str = ""  # the offending source line, stripped
+
+    @property
+    def fingerprint(self):
+        payload = f"{self.rule}\x00{self.path}\x00{self.snippet.strip()}"
+        return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self):
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self):
+        return (f"{self.path}:{self.line}:{self.col}: [{self.rule}] "
+                f"{self.message}")
+
+
+class SourceFile:
+    """A parsed module plus its comment directives.
+
+    ``suppressions``/``annotations`` map 1-based line numbers to the
+    rule names / note tags attached to that line. A directive on a
+    comment-only line applies to the next line as well (for findings on
+    lines too long to carry a trailing comment).
+    """
+
+    def __init__(self, abspath, relpath, text):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)   # SyntaxError propagates to caller
+        self.suppressions = {}        # line -> set of rule names
+        self.file_suppressions = set()
+        self.annotations = {}         # line -> set of note tags
+        self._scan_directives()
+        self._parents = None
+        self._nodes = None
+        self._aliases = None
+
+    def _scan_directives(self):
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            line = tok.start[0]
+            standalone = self.lines[line - 1].lstrip().startswith("#")
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group("rules").split(",")
+                         if r.strip()}
+                if m.group("file"):
+                    self.file_suppressions |= rules
+                else:
+                    self.suppressions.setdefault(line, set()).update(rules)
+                    if standalone:
+                        self.suppressions.setdefault(
+                            line + 1, set()).update(rules)
+                continue
+            m = _ANNOTATION_RE.search(tok.string)
+            if m and m.group("note") != "disable":
+                self.annotations.setdefault(line, set()).add(m.group("note"))
+                if standalone:
+                    self.annotations.setdefault(
+                        line + 1, set()).add(m.group("note"))
+
+    def suppressed(self, rule, line):
+        if rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        rules = self.suppressions.get(line, ())
+        return rule in rules or "all" in rules
+
+    def annotated(self, note, line):
+        return note in self.annotations.get(line, ())
+
+    def line_text(self, line):
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def nodes(self):
+        """Flat node list, walked once per file (every rule iterates
+        the whole tree; re-walking per rule dominated lint time)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def aliases(self):
+        """Import alias map (resolve.import_aliases), cached."""
+        if self._aliases is None:
+            from .resolve import import_aliases
+            self._aliases = import_aliases(self)
+        return self._aliases
+
+    def parents(self):
+        """node -> parent map, built lazily once per file."""
+        if self._parents is None:
+            self._parents = {}
+            for parent in self.nodes():
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def finding(self, rule, node, message):
+        return Finding(rule=rule, path=self.path, line=node.lineno,
+                       col=node.col_offset + 1, message=message,
+                       snippet=self.line_text(node.lineno))
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Shared state handed to every rule invocation."""
+    root: str                      # repo root all paths are relative to
+    sources: list = None           # every SourceFile in this run
+    errors: list = None            # (path, message) for unparseable files
+
+    def __post_init__(self):
+        if self.sources is None:
+            self.sources = []
+        if self.errors is None:
+            self.errors = []
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules",
+              ".ipynb_checkpoints"}
+
+
+def iter_python_files(paths, root):
+    """Yield absolute paths of .py files under ``paths`` (files or
+    directories, relative to ``root`` unless absolute), sorted for
+    deterministic reports."""
+    out = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            if ap.endswith(".py"):
+                out.append(ap)
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    seen = set()
+    for ap in out:
+        real = os.path.normpath(ap)
+        if real not in seen:
+            seen.add(real)
+            yield real
+
+
+def iter_source_files(paths, root, errors=None):
+    """Load every lintable file into a SourceFile; unparseable files are
+    recorded in ``errors`` (they must fail the gate loudly, not vanish
+    from coverage)."""
+    for abspath in iter_python_files(paths, root):
+        relpath = os.path.relpath(abspath, root)
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                text = f.read()
+            yield SourceFile(abspath, relpath, text)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            if errors is not None:
+                errors.append((relpath.replace(os.sep, "/"), str(e)))

@@ -1,0 +1,225 @@
+"""Scope and name resolution shared by the rules.
+
+AST-only, intentionally conservative: dslint never imports the code it
+lints. Alias maps come from the module's own import statements, traced
+scopes from decorator/call-site syntax. When resolution is uncertain the
+helpers answer "not traced"/"unknown" — a lint rule must miss an exotic
+construction rather than fabricate a finding.
+"""
+
+import ast
+
+# Wrapping one of these around a function makes its body traced code:
+# host-side calls inside run at trace time (once, at compile) — or not
+# at all — never per step.
+JIT_MARKERS = {"jit", "pjit", "pallas_call", "shard_map", "named_call"}
+
+# Functions handed to these run host-side even when lexically nested in
+# a traced function (jax.debug.callback / io_callback / pure_callback /
+# jax.debug.print's callee, host_callback.call).
+_CALLBACK_TOKEN = "callback"
+
+
+def dotted_name(node):
+    """'jax.jit' for Attribute chains / Names; None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node):
+    """Dotted name of a Call's callee (unwrapping nothing)."""
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func)
+    return None
+
+
+def last_component(dotted):
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def _iter_nodes(src_or_tree):
+    """Accept a SourceFile (cached flat node list) or a bare AST node."""
+    if hasattr(src_or_tree, "nodes"):
+        return src_or_tree.nodes()
+    return ast.walk(src_or_tree)
+
+
+def import_aliases(tree):
+    """Map local alias -> imported dotted module/name.
+
+    ``import time as _time`` -> {'_time': 'time'};
+    ``from jax.experimental import pallas as pl`` ->
+    {'pl': 'jax.experimental.pallas'};
+    ``from time import monotonic`` -> {'monotonic': 'time.monotonic'}.
+    Relative imports keep their leading dots ('.constants').
+    """
+    aliases = {}
+    for node in _iter_nodes(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    aliases[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            mod = "." * node.level + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                # dot-join unless mod is empty or bare dots (`from .
+                # import x` must give '.x', not '..x')
+                sep = "." if mod and not mod.endswith(".") else ""
+                aliases[a.asname or a.name] = f"{mod}{sep}{a.name}"
+    return aliases
+
+
+def resolve_dotted(aliases, dotted):
+    """Substitute the first component of ``dotted`` through the module's
+    alias map: with ``import time as _time``, '_time.time' resolves to
+    'time.time'."""
+    if not dotted:
+        return dotted
+    head, _, rest = dotted.partition(".")
+    real = aliases.get(head, head)
+    return f"{real}.{rest}" if rest else real
+
+
+def _is_jit_marker(dotted):
+    return last_component(dotted) in JIT_MARKERS
+
+
+def _decorator_markers(dec):
+    """Dotted names asserted by one decorator expression, unwrapping
+    ``partial(jax.jit, ...)`` to inspect its arguments too."""
+    names = []
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn:
+            names.append(fn)
+        if last_component(fn) == "partial":
+            for arg in dec.args:
+                sub = dotted_name(arg)
+                if sub:
+                    names.append(sub)
+    else:
+        d = dotted_name(dec)
+        if d:
+            names.append(d)
+    return names
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class TracedScopes:
+    """Classify every function node in a module as traced / host.
+
+    A function is a *traced root* when it carries a jit-marker decorator
+    (directly or through ``partial``) or is passed by name/lambda to a
+    jit-marker call (``jax.jit(f)``, ``shard_map(f, mesh=...)``,
+    ``pl.pallas_call(kernel, ...)``). A function passed to a
+    callback-flavored call is a *host root* — it runs on the host even
+    inside a traced scope. Everything else inherits the nearest marked
+    ancestor's classification.
+    """
+
+    def __init__(self, src):
+        self.src = src
+        self.parents = src.parents()
+        self._traced_roots = set()
+        self._host_roots = set()
+        self._classify()
+
+    def _defs_by_name(self):
+        by_name = {}
+        for node in self.src.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        return by_name
+
+    def _classify(self):
+        by_name = self._defs_by_name()
+        for node in self.src.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if any(_is_jit_marker(n) for n in _decorator_markers(dec)):
+                        self._traced_roots.add(node)
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node)
+            is_jit = _is_jit_marker(callee)
+            is_cb = (last_component(callee) or "").find(_CALLBACK_TOKEN) >= 0
+            if not (is_jit or is_cb):
+                continue
+            cands = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in cands:
+                targets = []
+                if isinstance(arg, ast.Lambda):
+                    targets = [arg]
+                elif isinstance(arg, ast.Name):
+                    targets = by_name.get(arg.id, [])
+                for t in targets:
+                    (self._traced_roots if is_jit
+                     else self._host_roots).add(t)
+
+    def enclosing_function(self, node):
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_NODES):
+            cur = self.parents.get(cur)
+        return cur
+
+    def is_traced(self, node):
+        """True when ``node`` sits inside traced code: walk outward from
+        its enclosing function; the first traced/host root met decides."""
+        fn = node if isinstance(node, _FUNC_NODES) \
+            else self.enclosing_function(node)
+        while fn is not None:
+            if fn in self._host_roots:
+                return False
+            if fn in self._traced_roots:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+
+def thread_target_functions(src):
+    """Function defs passed as ``target=`` to ``threading.Thread(...)``
+    (by local name), plus every def nested inside one — the scope where
+    a swallowed exception dies silently instead of crashing the run."""
+    parents = src.parents()
+    by_name = {}
+    for node in src.nodes():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    roots = set()
+    for node in src.nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        if last_component(call_name(node)) != "Thread":
+            continue
+        target = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None and node.args:  # Thread(group, target, ...)
+            target = node.args[1] if len(node.args) > 1 else None
+        if isinstance(target, ast.Name):
+            roots.update(by_name.get(target.id, []))
+    members = set(roots)
+    for node in src.nodes():
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cur = parents.get(node)
+        while cur is not None:
+            if cur in roots:
+                members.add(node)
+                break
+            cur = parents.get(cur)
+    return members

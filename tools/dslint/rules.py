@@ -1,0 +1,466 @@
+"""The dslint rule catalog.
+
+Every rule encodes a defect class this repo has actually shipped and
+had to fix in review; the ``incident`` string names the PR that paid
+for it. See ``docs/static-analysis.md`` for the full catalog and the
+policy on suppressions vs. baseline entries.
+
+File rules run per module; project rules (``scope = "project"``) see
+every parsed module at once — the parse-only config-key pass lives in
+``config_keys.py`` and registers here.
+"""
+
+import ast
+
+from .resolve import (TracedScopes, call_name, import_aliases,
+                      last_component, resolve_dotted,
+                      thread_target_functions)
+
+
+class Rule:
+    name = ""
+    summary = ""
+    incident = ""
+    scope = "file"           # or "project"
+
+    def check_file(self, src, ctx):
+        return ()
+
+    def check_project(self, ctx):
+        return ()
+
+
+REGISTRY = {}
+
+
+def register(cls):
+    rule = cls()
+    assert rule.name and rule.name not in REGISTRY
+    REGISTRY[rule.name] = rule
+    return cls
+
+
+def _emit(src, rule, node, message):
+    if not src.suppressed(rule, node.lineno):
+        yield src.finding(rule, node, message)
+
+
+# ---------------------------------------------------------------------------
+# 1. trace-unsafe host calls inside jitted / shard_mapped / Pallas code
+# ---------------------------------------------------------------------------
+
+@register
+class TraceHostCallRule(Rule):
+    name = "trace-host-call"
+    summary = ("host-side call (time/random/np.random/print/open) inside "
+               "a function traced by jax.jit/shard_map/pallas_call")
+    incident = ("traced host calls run once at compile time (or never), "
+                "not per step — timing/randomness silently freezes, "
+                "I/O silently disappears")
+
+    _BANNED_PREFIXES = ("time.", "random.", "numpy.random.")
+    _BANNED_BUILTINS = {"print", "open", "input"}
+
+    def check_file(self, src, ctx):
+        scopes = TracedScopes(src)
+        aliases = src.aliases()
+        for node in src.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = resolve_dotted(aliases, call_name(node))
+            bad = None
+            if dotted in self._BANNED_BUILTINS:
+                # builtin by bare name only — a method `.print()` or a
+                # local override is not the builtin
+                if isinstance(node.func, ast.Name):
+                    bad = dotted
+            elif dotted and any(dotted.startswith(p)
+                                for p in self._BANNED_PREFIXES):
+                bad = dotted
+            if bad and scopes.is_traced(node):
+                yield from _emit(
+                    src, self.name, node,
+                    f"'{bad}(...)' inside traced code: this executes at "
+                    f"trace time, not per step. Use jax.debug.callback / "
+                    f"jax PRNG keys, or hoist it out of the jitted scope.")
+
+
+# ---------------------------------------------------------------------------
+# 2. wall-clock ban: time.time() outside annotated true-timestamp sites
+# ---------------------------------------------------------------------------
+
+@register
+class WallClockRule(Rule):
+    name = "wall-clock"
+    summary = ("time.time() used where an interval is measured — NTP "
+               "steps corrupt wall-clock deltas; use time.monotonic()")
+    incident = ("PR 6: utils/timer.py measured step time on time.time(); "
+                "an NTP jump corrupted elapsed/samples-per-sec")
+
+    def check_file(self, src, ctx):
+        aliases = src.aliases()
+        for node in src.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = resolve_dotted(aliases, call_name(node))
+            if dotted == "time.time":
+                yield from _emit(
+                    src, self.name, node,
+                    "time.time() is wall-clock and jumps with NTP: use "
+                    "time.monotonic() for intervals. A true epoch "
+                    "timestamp site must carry "
+                    "'# dslint: disable=wall-clock  (why)'.")
+
+
+# ---------------------------------------------------------------------------
+# 3. strong-ref lifecycle hooks (atexit/signal holding bound methods)
+# ---------------------------------------------------------------------------
+
+@register
+class StrongRefHookRule(Rule):
+    name = "strong-ref-hook"
+    summary = ("atexit.register/signal.signal given a bound method — the "
+               "registry pins the owner (engine/monitor/manager) for the "
+               "process lifetime; use runtime.utils.register_weak_atexit "
+               "or a weakref-bound handler")
+    incident = ("PRs 3/4/6/9: atexit + signal registries kept whole "
+                "engines alive across bench ladders and tests")
+
+    @staticmethod
+    def _module_paths(ctx):
+        """Dotted-path set of every linted module, cached on the run
+        context (used to tell `from pkg import module` apart from
+        `from pkg import OBJECT` — only the former's attributes are
+        module functions, not bound methods)."""
+        paths = getattr(ctx, "_dslint_module_paths", None)
+        if paths is None:
+            paths = set()
+            for s in ctx.sources:
+                p = s.path[:-3] if s.path.endswith(".py") else s.path
+                if p.endswith("/__init__"):
+                    p = p[:-len("/__init__")]
+                paths.add(p)
+            ctx._dslint_module_paths = paths
+        return paths
+
+    def _is_module_base(self, base, src, ctx, plain_imports, from_targets):
+        """True only when ``base`` provably names a MODULE: a plain
+        ``import x [as y]`` alias (always a module), or a from-import
+        whose target resolves to a module file in the linted set. A
+        from-imported NAME that is an object (engine/monitor instance)
+        stays flagged — that is exactly the incident class."""
+        if not isinstance(base, ast.Name):
+            return False
+        if base.id in plain_imports:
+            return True
+        target = from_targets.get(base.id)
+        if target is None:
+            return False
+        paths = self._module_paths(ctx)
+        if target.startswith("."):
+            level = len(target) - len(target.lstrip("."))
+            rest = target.lstrip(".")
+            base_dir = src.path.rsplit("/", 1)[0] if "/" in src.path else ""
+            for _ in range(level - 1):
+                base_dir = base_dir.rsplit("/", 1)[0] \
+                    if "/" in base_dir else ""
+            cand = (f"{base_dir}/" if base_dir else "") + \
+                rest.replace(".", "/")
+            return cand in paths
+        cand = target.replace(".", "/")
+        return any(p == cand or p.endswith("/" + cand) for p in paths)
+
+    def check_file(self, src, ctx):
+        aliases = src.aliases()
+        plain_imports = set()
+        from_targets = {}
+        for node in src.nodes():
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    plain_imports.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                mod = "." * node.level + (node.module or "")
+                for a in node.names:
+                    if a.name != "*":
+                        # dot-join unless mod is empty or bare dots
+                        # (`from . import x` must give '.x', not '..x')
+                        sep = "." if mod and not mod.endswith(".") else ""
+                        from_targets[a.asname or a.name] = \
+                            f"{mod}{sep}{a.name}"
+        for node in src.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = resolve_dotted(aliases, call_name(node))
+            if dotted == "atexit.register":
+                handlers = node.args[:1]
+                what = "atexit.register"
+            elif dotted == "signal.signal":
+                handlers = node.args[1:2]
+                what = "signal.signal"
+            else:
+                continue
+            for h in handlers:
+                if not isinstance(h, ast.Attribute):
+                    continue
+                if self._is_module_base(h.value, src, ctx,
+                                        plain_imports, from_targets):
+                    continue
+                yield from _emit(
+                    src, self.name, h,
+                    f"{what} holds a strong reference to bound method "
+                    f"'{ast.unparse(h)}': the registry pins its owner "
+                    f"for the process lifetime. Route through "
+                    f"register_weak_atexit / bind via weakref.")
+
+
+# ---------------------------------------------------------------------------
+# 4. non-atomic writes into checkpoint/save directories
+# ---------------------------------------------------------------------------
+
+_CKPT_TOKENS = ("ckpt", "checkpoint", "save_dir", "snapshot", "latest")
+_SAFE_TOKENS = ("staging", "tmp", "temp", ".part")
+
+
+@register
+class NonAtomicCommitRule(Rule):
+    name = "non-atomic-commit"
+    summary = ("direct write into a checkpoint/save path without the "
+               "staging-sibling + os.replace commit discipline")
+    incident = ("PR 3: `latest` was rewritten in place pre-barrier — a "
+                "crash mid-write left a torn pointer that read as a "
+                "corrupt checkpoint")
+
+    def _path_expr(self, node, dotted):
+        tail = last_component(dotted)
+        if tail == "open" and isinstance(node.func, ast.Name):
+            if len(node.args) >= 2:
+                mode = node.args[1]
+                if isinstance(mode, ast.Constant) and \
+                        isinstance(mode.value, str) and "w" in mode.value:
+                    return node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                        and "w" in str(kw.value.value):
+                    return node.args[0] if node.args else None
+            return None
+        if dotted in ("numpy.save", "numpy.savez", "numpy.savez_compressed"):
+            return node.args[0] if node.args else None
+        return None
+
+    def check_file(self, src, ctx):
+        aliases = src.aliases()
+        parents = src.parents()
+
+        def enclosing_body(node):
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cur = parents.get(cur)
+            return cur if cur is not None else src.tree
+
+        def has_replace(scope):
+            for n in ast.walk(scope):
+                if isinstance(n, ast.Call) and \
+                        resolve_dotted(aliases, call_name(n)) in (
+                            "os.replace", "os.rename"):
+                    return True
+            return False
+
+        for node in src.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = resolve_dotted(aliases, call_name(node))
+            path_expr = self._path_expr(node, dotted)
+            if path_expr is None:
+                continue
+            try:
+                path_src = ast.unparse(path_expr).lower()
+            except Exception:  # pragma: no cover - exotic expr
+                continue
+            if not any(t in path_src for t in _CKPT_TOKENS):
+                continue
+            if any(t in path_src for t in _SAFE_TOKENS):
+                continue
+            if has_replace(enclosing_body(node)):
+                continue
+            yield from _emit(
+                src, self.name, node,
+                f"write targets checkpoint-flavored path "
+                f"({ast.unparse(path_expr)}) with no staging sibling + "
+                f"os.replace in scope: a crash mid-write leaves a torn "
+                f"file that later reads as a corrupt checkpoint. Write "
+                f"to '<path>.tmp'/staging and os.replace() it in.")
+
+
+# ---------------------------------------------------------------------------
+# 5. coordination-service barriers without a deadline
+# ---------------------------------------------------------------------------
+
+@register
+class BarrierNoDeadlineRule(Rule):
+    name = "barrier-no-deadline"
+    summary = ("wait_at_barrier / blocking KV wait without a timeout — a "
+               "dead peer hangs the job forever instead of failing typed")
+    incident = ("PR 9: commit barriers gained a deadline floor so a dead "
+                "host fails the commit fast instead of wedging every "
+                "peer in wait_at_barrier")
+
+    _WAITERS = {"wait_at_barrier", "blocking_key_value_get"}
+    _TIMEOUT_KWS = {"timeout", "timeout_in_ms", "timeout_ms", "deadline",
+                    "timeout_s"}
+
+    def check_file(self, src, ctx):
+        for node in src.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            tail = last_component(call_name(node))
+            if tail not in self._WAITERS:
+                continue
+            if len(node.args) >= 2:
+                continue
+            if any(kw.arg in self._TIMEOUT_KWS for kw in node.keywords):
+                continue
+            yield from _emit(
+                src, self.name, node,
+                f"'{tail}' call without a deadline: a missing peer hangs "
+                f"this host forever. Thread a timeout (the commit-barrier "
+                f"floor is DEFAULT_BARRIER_TIMEOUT_S).")
+
+
+# ---------------------------------------------------------------------------
+# 6. swallowed exceptions inside thread targets / daemon loops
+# ---------------------------------------------------------------------------
+
+@register
+class SwallowedThreadExcRule(Rule):
+    name = "swallowed-thread-exc"
+    summary = ("`except Exception: pass` inside a threading.Thread target "
+               "— the daemon dies or corrupts state with no trace")
+    incident = ("PR 9: a gRPC failure silently killed the peer-health "
+                "poll thread — the exact dead-coordinator case the "
+                "subsystem existed to catch")
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def check_file(self, src, ctx):
+        targets = thread_target_functions(src)
+        if not targets:
+            return
+        for fn in targets:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is not None:
+                    tname = last_component(
+                        ast.unparse(node.type)) if node.type else None
+                    if tname not in self._BROAD:
+                        continue
+                if all(isinstance(s, (ast.Pass, ast.Continue))
+                       for s in node.body):
+                    yield from _emit(
+                        src, self.name, node,
+                        "broad except with an empty body inside a thread "
+                        "target: the failure vanishes and the loop keeps "
+                        "running (or dies) silently. Log it, count it, or "
+                        "escalate it — never drop it.")
+
+
+# ---------------------------------------------------------------------------
+# 7. timed measurement over Pallas calls without an interpret-mode guard
+# ---------------------------------------------------------------------------
+
+@register
+class TimedPallasNoInterpretRule(Rule):
+    name = "timed-pallas-no-interpret"
+    summary = ("monotonic-delta / timeit measurement over a Pallas call "
+               "with no interpret-mode bail-out — on CPU this times the "
+               "Pallas interpreter, minutes per candidate")
+    incident = ("PR 7: the flash fwd block tuner had no interpret guard; "
+                "a 16k-seq CPU dispatch measured interpreter candidates "
+                "for 58 minutes")
+
+    _CLOCKS = {"time.monotonic", "time.perf_counter", "timeit.timeit",
+               "timeit.repeat"}
+
+    def _timing_calls(self, fn, aliases):
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                dotted = resolve_dotted(aliases, call_name(node))
+                if dotted in self._CLOCKS:
+                    out.append(node)
+        return out
+
+    def _mentions_interpret(self, fn):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and "interpret" in node.id.lower():
+                return True
+            if isinstance(node, ast.Attribute) and \
+                    "interpret" in node.attr.lower():
+                return True
+        return False
+
+    def _touches_pallas(self, fn, aliases):
+        for node in ast.walk(fn):
+            dotted = None
+            if isinstance(node, ast.Call):
+                dotted = resolve_dotted(aliases, call_name(node))
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names]
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    mods.append(node.module)
+                if any("pallas" in m for m in mods):
+                    return True
+            if dotted and "pallas" in dotted:
+                return True
+            if isinstance(node, ast.Name) and \
+                    node.id in aliases and "pallas" in aliases[node.id]:
+                return True
+        return False
+
+    def check_file(self, src, ctx):
+        aliases = src.aliases()
+        fns = [n for n in src.nodes()
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        by_name = {}
+        for fn in fns:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        def callers_guarded(fn):
+            """One level up: every in-module caller mentions interpret
+            (the autotune pattern — the public tuner guards, a private
+            _measure helper does the timing)."""
+            callers = []
+            for other in fns:
+                if other is fn:
+                    continue
+                for node in ast.walk(other):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name) and \
+                            node.func.id == fn.name:
+                        callers.append(other)
+                        break
+            return bool(callers) and all(self._mentions_interpret(c)
+                                         for c in callers)
+
+        for fn in fns:
+            timing = self._timing_calls(fn, aliases)
+            if len(timing) < 2 and not any(
+                    resolve_dotted(aliases, call_name(t)).startswith(
+                        "timeit.") for t in timing):
+                continue
+            if not self._touches_pallas(fn, aliases):
+                continue
+            if self._mentions_interpret(fn) or callers_guarded(fn):
+                continue
+            yield from _emit(
+                src, self.name, timing[0],
+                f"'{fn.name}' times a Pallas-flavored call with no "
+                f"interpret-mode bail-out: on CPU this measures the "
+                f"Pallas interpreter (minutes per candidate). Check "
+                f"`_interpret()` / interpret mode and return the "
+                f"deterministic default first.")
+
+
+# Project-scope rule 8 registers itself on import.
+from . import config_keys  # noqa: E402,F401  (registration side effect)
