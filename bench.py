@@ -59,6 +59,12 @@ KEYS (default all):
              volume, plus a DS_BENCH_OFFLOAD_RATIO x-HBM synthetic rung
              trained on the host tier vs the flops-extrapolated on-chip
              time; opt-in via DS_BENCH_OFFLOAD=1)
+  - quant    (low-precision rows: bf16 vs int8-weight decode tokens/s +
+             p50 inter-token on a decode-heavy serve stream, int8-KV
+             resident-session capacity at fixed pool bytes (scale pools
+             included), compressed vs dense cross-host DP-grad step
+             time on the explicit ZeRO-3 schedule; knobs in
+             quant_knobs; opt-in via DS_BENCH_QUANT=1)
 
 The zero3 row additionally measures `zero3_explicit` — the explicit
 shard_map collective schedule (layer-ahead bucketed all-gather prefetch,
@@ -83,7 +89,8 @@ ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
                "moe": 800, "serve": 800, "serve_chaos": 900,
                "zero3": 800, "pipe": 900, "offload": 1100,
-               "elastic": 600, "fleet": 600}  # moe/longseq walk both engines
+               "elastic": 600, "fleet": 600,
+               "quant": 1100}  # moe/longseq/quant walk both engines
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -1224,6 +1231,12 @@ def row_serve():
                 # serving runs on one chip unless a mesh is attached
                 "serve_tokens_per_s_chip": round(gen / dt, 1),
                 "serve_chips": 1,
+                # precision identity: BENCH history needs to attribute
+                # serving deltas to weight/compute/KV dtype changes
+                # (docs/quantization.md)
+                "serve_weight_dtype": eng.dtypes["weight"],
+                "serve_compute_dtype": eng.dtypes["compute"],
+                "serve_kv_dtype": eng.dtypes["kv_cache"],
                 "serve_p50_token_ms": pct(itl, 50),
                 "serve_p99_token_ms": pct(itl, 99),
                 "serve_ttft_p50_ms": pct(ttft, 50),
@@ -1639,6 +1652,177 @@ def row_offload():
     return out
 
 
+def row_quant():
+    """Low-precision row (opt-in DS_BENCH_QUANT=1; docs/quantization.md).
+    Three measurements on the headline 125M shape:
+
+    (a) bf16 vs int8-WEIGHT decode: a fixed decode-heavy serve stream
+        run at both weight precisions — decode tokens/s and p50
+        inter-token. Decode is weight-bandwidth bound, so the ≥1.5×
+        acceptance gate applies ON TPU (the Pallas dequant-in-kernel
+        path); CPU hosts record the row through the XLA fallback, where
+        the ratio is informational only.
+    (b) int8-KV capacity: resident sessions at a FIXED pool byte budget
+        (DS_BENCH_QUANT_POOL_MB) for bf16 vs int8 pools — the ≥1.9×
+        gate is pure accounting (per-page scale pools included).
+    (c) compressed vs dense cross-host DP gradients: explicit ZeRO-3
+        step time with and without the error-feedback sign-compressed
+        reduce-scatter (quantization.gradient_compression).
+
+    Knobs ride in extra; DS_BENCH_QUANT_* envs override the defaults.
+    """
+    jax = _setup_jax()
+    n_chips = len(jax.devices())
+    cfg, model, params = _headline_setup(jax)
+    out = {}
+
+    max_new = int(os.environ.get("DS_BENCH_QUANT_NEW", "48"))
+    n_req = int(os.environ.get("DS_BENCH_QUANT_REQUESTS", "16"))
+    prompt_len = int(os.environ.get("DS_BENCH_QUANT_PROMPT", "62"))
+
+    def serve(tag, weight_quant, kv_dtype=None):
+        def thunk():
+            from deeperspeed_tpu.inference import InferenceEngine
+            conf = {"inference": {
+                "enabled": True, "page_size": 64, "num_pages": 257,
+                "max_batch_size": 16, "token_budget": 2048,
+                "prefill_batch_sizes": [4],
+                "prefill_lengths": [64],
+                "decode_batch_sizes": [16]}}
+            if kv_dtype:
+                conf["inference"]["kv_cache_dtype"] = kv_dtype
+            if weight_quant:
+                conf["quantization"] = {"weights": weight_quant}
+            eng = InferenceEngine(model, config=conf, params=params)
+            rng = np.random.default_rng(0)
+            prompts = [list(rng.integers(1, cfg.vocab_size,
+                                         size=prompt_len))
+                       for _ in range(n_req)]
+            # warm both programs, then measure a decode-heavy stream
+            eng.generate([prompts[0]], max_new_tokens=2)
+            warm = dict(eng.stats)
+            itl = []
+            last = {}
+            t0 = time.perf_counter()
+            for p in prompts:
+                eng.submit(p, max_new_tokens=max_new)
+            while eng.scheduler.has_work:
+                eng.step()
+                now = time.perf_counter()
+                for r in eng.scheduler.running:
+                    k = len(r.generated)
+                    if last.get(r.request_id, (0, 0))[0] < k:
+                        prev = last.get(r.request_id)
+                        if prev is not None:
+                            itl.append(now - prev[1])
+                        last[r.request_id] = (k, now)
+            dt = time.perf_counter() - t0
+            dtok = eng.stats["decode_tokens"] - warm["decode_tokens"]
+            dsec = eng.stats["decode_s"] - warm["decode_s"]
+            return {
+                f"quant_decode_tok_s_{tag}": round(dtok / max(dsec,
+                                                              1e-9), 1),
+                f"quant_stream_tok_s_{tag}": round(dtok / dt, 1),
+                f"quant_p50_token_ms_{tag}": (
+                    round(float(np.percentile(itl, 50)) * 1e3, 2)
+                    if itl else None),
+                f"quant_weight_dtype_{tag}": eng.dtypes["weight"],
+                f"quant_kv_dtype_{tag}": eng.dtypes["kv_cache"],
+            }
+        return thunk
+
+    # three rungs, one axis at a time: the ≥1.5× weight gate must
+    # measure WEIGHTS alone (int8 KV changes attention numerics and
+    # adds quantize/dequantize work — conflating them makes the ratio
+    # unattributable); the combined rung records the deployment config
+    out = _ladder([("bf16", serve("bf16", None))], out, "quant_bf16")
+    gc.collect()
+    out = _ladder([("int8w", serve("int8w", "int8"))], out, "quant_int8w")
+    gc.collect()
+    out = _ladder([("int8w_int8kv", serve("int8w_int8kv", "int8",
+                                          "int8"))],
+                  out, "quant_int8w_int8kv")
+    gc.collect()
+    a, b = (out.get("quant_decode_tok_s_int8w"),
+            out.get("quant_decode_tok_s_bf16"))
+    if a and b:
+        out["quant_int8_weight_decode_speedup"] = round(a / b, 3)
+
+    # (b) int8-KV resident-session capacity at fixed pool bytes —
+    # accounting over the real cache geometry (scale pools included)
+    def kv_capacity():
+        def thunk():
+            from deeperspeed_tpu.inference.kv_cache import PagedKVCache
+            import jax.numpy as jnp
+            pool_mb = int(os.environ.get("DS_BENCH_QUANT_POOL_MB", "1024"))
+            sess_tokens = int(os.environ.get("DS_BENCH_QUANT_SESSION_TOK",
+                                             "1024"))
+            res = {}
+            for tag, dt_ in (("bf16", jnp.bfloat16), ("int8", jnp.int8)):
+                c = PagedKVCache(num_layers=cfg.num_layers, num_pages=2,
+                                 num_heads=cfg.num_heads, page_size=64,
+                                 head_dim=cfg.head_dim, dtype=dt_)
+                sessions = (pool_mb << 20) // (c.bytes_per_token()
+                                               * sess_tokens)
+                res[f"quant_kv_sessions_{tag}"] = int(sessions)
+                res[f"quant_kv_bytes_per_token_{tag}"] = \
+                    c.bytes_per_token()
+            res["quant_kv_capacity_ratio"] = round(
+                res["quant_kv_sessions_int8"] /
+                max(res["quant_kv_sessions_bf16"], 1), 3)
+            res["quant_kv_pool_mb"] = pool_mb
+            res["quant_kv_session_tokens"] = sess_tokens
+            return res
+        return thunk
+
+    out = _ladder([("acct", kv_capacity())], out, "quant_kv")
+
+    # (c) compressed vs dense DP-grad step time on the explicit schedule
+    seq = min(int(os.environ.get("DS_BENCH_QUANT_SEQ", "256")),
+              cfg.max_seq_len)
+    bs = int(os.environ.get("DS_BENCH_QUANT_BS", "4"))
+    steps = int(os.environ.get("DS_BENCH_QUANT_STEPS", "6"))
+
+    def grads(tag, compress):
+        def thunk():
+            batch = bs * n_chips
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                                  dtype=np.int32)
+            zero_cfg = {"stage": 3,
+                        "stage3_param_persistence_threshold": 0,
+                        "schedule": {"mode": "explicit"}}
+            extra_cfg = {}
+            if compress:
+                extra_cfg["quantization"] = {
+                    "gradient_compression": {"enabled": True}}
+            eng = _neox_engine(model, params, batch, zero_cfg, extra_cfg)
+            dt, _ = timed_steps(eng, (tokens, tokens), steps=steps,
+                                warmup=2)
+            return {f"quant_grad_step_ms_{tag}": round(
+                dt / steps * 1e3, 1)}
+        return thunk
+
+    out = _ladder([("dense", grads("dense", False))], out, "quant_gdense")
+    gc.collect()
+    if n_chips > 1:
+        out = _ladder([("compressed", grads("compressed", True))], out,
+                      "quant_gcomp")
+    else:
+        # a 1-chip dp world has no gather to compress (every leaf rests
+        # replicated) — record the skip instead of a misleading error
+        out["quant_gcomp_skipped"] = "single-chip dp world: no " \
+            "cross-host gradient collective to compress"
+    a, b = (out.get("quant_grad_step_ms_dense"),
+            out.get("quant_grad_step_ms_compressed"))
+    if a and b:
+        out["quant_grad_compress_speedup"] = round(a / b, 3)
+    out["quant_knobs"] = {
+        "max_new": max_new, "requests": n_req, "prompt": prompt_len,
+        "seq": seq, "bs": bs, "steps": steps}
+    return out
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
@@ -1646,7 +1830,8 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "packed": row_packed, "serve": row_serve,
            "serve_chaos": row_serve_chaos,
            "elastic": row_elastic, "fleet": row_fleet,
-           "pipe": row_pipe, "offload": row_offload}
+           "pipe": row_pipe, "offload": row_offload,
+           "quant": row_quant}
 
 
 # ---------------------------------------------------------------------------
@@ -1679,6 +1864,8 @@ def rows_enabled():
         order.append("pipe")
     if os.environ.get("DS_BENCH_OFFLOAD", "0") not in ("0", "", "false"):
         order.append("offload")
+    if os.environ.get("DS_BENCH_QUANT", "0") not in ("0", "", "false"):
+        order.append("quant")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -1687,7 +1874,8 @@ def rows_enabled():
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
-                   "serve_chaos", "elastic", "fleet", "pipe", "offload"):
+                   "serve_chaos", "elastic", "fleet", "pipe", "offload",
+                   "quant"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

@@ -236,6 +236,12 @@ def snapshot_checkpoint(engine, client_state=None):
         "gradient_noise_scale": (gns.state_dict()
                                  if gns is not None else None),
         "csr_tensor_module_names": [],
+        # quantization state (EngineState.quant): delayed-scaling amax
+        # history + compressed-gradient error feedback — bit-exact
+        # resume needs both (docs/quantization.md)
+        "quantization_state": (engine._quant_state_dict()
+                               if hasattr(engine, "_quant_state_dict")
+                               else None),
         "skipped_steps": engine.skipped_steps,
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
@@ -763,7 +769,7 @@ def _resolve_committed_state(load_dir, tag):
 # full and module-only loads exclude them from the returned client_state
 _TRAINING_STATE_KEYS = ("module", "optimizer", "lr_scheduler",
                         "batch_size_scheduler", "dataloader",
-                        "gradient_noise_scale")
+                        "gradient_noise_scale", "quantization_state")
 
 
 def _client_state(model_state):
@@ -1020,6 +1026,12 @@ def _apply_checkpoint(engine, load_dir, tag, ckpt_dir, model_state,
         scale=scale_state,
         global_steps=jnp.asarray(engine.global_steps, jnp.int32),
         skipped_steps=jnp.asarray(engine.skipped_steps, jnp.int32))
+
+    # quantization state (amax history / compressed-grad error feedback):
+    # restored AFTER the state replace so the engine's reconciliation
+    # (dp-change EF reshape rules) sees the final topology
+    if hasattr(engine, "_restore_quant_state"):
+        engine._restore_quant_state(model_state.get("quantization_state"))
 
     client_state = _client_state(model_state)
     log_dist(f"Loaded checkpoint {tag} from {load_dir}", ranks=[0])

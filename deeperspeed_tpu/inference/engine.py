@@ -51,16 +51,18 @@ from ..models import gpt_neox as neox
 from ..module_inject.replace_module import prepare_inference_params
 from ..ops.pallas.decode_attention import paged_decode_attention
 from ..parallel.mesh import MODEL_AXIS
-from ..runtime.config import DeepSpeedConfig, parse_inference_block
+from ..runtime.config import (DeepSpeedConfig, parse_inference_block,
+                              parse_quantization_block)
 from ..runtime.config_utils import (DeepSpeedConfigError, load_config_json)
 from ..runtime.fault_injection import (FaultInjector, InjectedServingFault,
                                        SERVING_FAULT_KINDS)
-from ..runtime.precision import resolve_precision
+from ..runtime.precision import resolve_kv_cache_dtype
 from ..utils.kv_retry import backoff_delay
 from ..utils.logging import logger
 from .admission import (AdmissionController, DrainAborted, RequestFailed,
                         validate_priority)
-from .kv_cache import PagedKVCache, pages_for_tokens
+from .kv_cache import (PagedKVCache, QuantizedPages, pages_for_tokens,
+                       quantize_kv)
 from .metrics import REQUEST_STATUS_FAMILIES, ServeRequestMetrics
 from .scheduler import (FINISHED, RUNNING, ContinuousBatchingScheduler,
                         Request)
@@ -160,6 +162,7 @@ class InferenceEngine:
         if isinstance(raw, DeepSpeedConfig):
             self.inference_params = raw.inference_params
             telemetry_config = raw.telemetry_config
+            quantization = raw.quantization_config
         else:
             if raw is None:
                 raise DeepSpeedConfigError(
@@ -167,6 +170,7 @@ class InferenceEngine:
                     "'inference' block")
             d = raw if isinstance(raw, dict) else load_config_json(raw)
             self.inference_params = parse_inference_block(d)
+            quantization = parse_quantization_block(d) or None
             # reuse the training parser's telemetry validation without
             # dragging in the batch triad it also wants
             ns = types.SimpleNamespace()
@@ -234,13 +238,31 @@ class InferenceEngine:
         self.compute_dtype = next(
             (leaf.dtype for leaf in leaves
              if getattr(leaf, "ndim", 0) >= 2), leaves[0].dtype)
-        # kv_cache_dtype overrides the CACHE pools only (K/V are cast on
-        # write, attention runs at pool dtype) — it never re-casts the
-        # weights
+        # kv_cache_dtype overrides the CACHE pools only (K/V are cast —
+        # or int8-quantized with per-page scales — on write, attention
+        # runs at pool dtype) — it never re-casts the weights
         kv_dtype = ip["kv_cache_dtype"]
-        self.kv_cache_dtype = (resolve_precision(kv_dtype) if kv_dtype
-                               else self.compute_dtype)
-        params = prepare_inference_params(params, self.compute_dtype)
+        self.kv_cache_dtype = (resolve_kv_cache_dtype(kv_dtype)
+                               if kv_dtype else self.compute_dtype)
+        self.kv_quant = self.kv_cache_dtype == jnp.int8
+        # the validated "quantization" block (weights choice): int8
+        # block matmul weights at rest (docs/quantization.md)
+        self.weight_quant = (quantization or {}).get("weights")
+        if self.weight_quant and self.mp > 1:
+            raise DeepSpeedConfigError(
+                "quantization.weights with a model-parallel mesh is "
+                "unsupported: the per-channel scale leaves have no "
+                "tensor-parallel placement yet — serve quantized "
+                "weights on a replicated (mp=1) mesh")
+        # structure template for params-only checkpoint loads: the
+        # QUANTIZED tree splits each weight into (qval, scale) leaves,
+        # but checkpoints store the natural layout — keep an abstract
+        # natural-structure template (shapes only, nothing resident)
+        self._natural_like = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l),
+                                           jnp.result_type(l)), params)
+        params = prepare_inference_params(params, self.compute_dtype,
+                                          weight_quant=self.weight_quant)
         self._set_params(params)
 
         # -- cache / scheduler ---------------------------------------------
@@ -258,6 +280,16 @@ class InferenceEngine:
             decode_batch_sizes=self.decode_batch_sizes)
         self.n_pages_max = pages_for_tokens(self.max_seq_len,
                                             self.page_size)
+        # precision identity of this serving engine: the bench serve row
+        # records it in `extra` so BENCH history can attribute serving
+        # deltas to precision changes (docs/quantization.md)
+        self.dtypes = {
+            "weight": self.weight_quant or
+            str(jnp.dtype(self.compute_dtype)),
+            "compute": str(jnp.dtype(self.compute_dtype)),
+            "kv_cache": ("int8" if self.kv_quant
+                         else str(jnp.dtype(self.kv_cache_dtype))),
+        }
 
         # -- telemetry (spans: schedule / prefill / decode; admission
         #    wait is a per-request scalar — docs/inference.md) ------------
@@ -349,10 +381,11 @@ class InferenceEngine:
         a serving restart never touches Adam moments."""
         from ..checkpoint.checkpointing import load_module_checkpoint
         path, natural, client_state = load_module_checkpoint(
-            load_dir, tag=tag, like=self.params)
+            load_dir, tag=tag, like=self._natural_like)
         if path is None:
             return None, {}
-        params = prepare_inference_params(natural, self.compute_dtype)
+        params = prepare_inference_params(natural, self.compute_dtype,
+                                          weight_quant=self.weight_quant)
         # the compiled programs take params as runtime arguments, so the
         # warmed bucket executables stay valid across a weight hot-swap
         # (same avals = jit cache hit) — no recompile ladder to repay
@@ -382,20 +415,34 @@ class InferenceEngine:
     def _attention(self, q, k_pages, v_pages, page_table, lengths):
         """Paged decode attention, shard_mapped over the model axis when
         the mesh shards heads (attention is head-independent, so each
-        shard runs the kernel on its local heads — no collective)."""
+        shard runs the kernel on its local heads — no collective).
+        Int8 pools arrive as `QuantizedPages`; the per-page scale pools
+        ride the same head-sharded placement as the data pools."""
+        scales = {}
+        if isinstance(k_pages, QuantizedPages):
+            scales = {"k_scales": k_pages.scale, "v_scales": v_pages.scale}
+            k_pages, v_pages = k_pages.data, v_pages.data
         if self.mp > 1:
+            def mapped(q, k, v, pt, ln, *sc):
+                kw = ({"k_scales": sc[0], "v_scales": sc[1]} if sc
+                      else {})
+                return paged_decode_attention(
+                    q, k, v, pt, ln, backend=self._attn_backend, **kw)
+
+            pool_spec = P(None, MODEL_AXIS, None, None)
+            scale_specs = ((P(None, MODEL_AXIS, None),) * 2 if scales
+                           else ())
             f = shard_map(
-                partial(paged_decode_attention, backend=self._attn_backend),
-                self.mesh,
-                in_specs=(P(None, MODEL_AXIS, None),
-                          P(None, MODEL_AXIS, None, None),
-                          P(None, MODEL_AXIS, None, None),
-                          P(None, None), P(None)),
+                mapped, self.mesh,
+                in_specs=(P(None, MODEL_AXIS, None), pool_spec,
+                          pool_spec, P(None, None), P(None)) + scale_specs,
                 out_specs=P(None, MODEL_AXIS, None),
                 check_vma=False)
-            return f(q, k_pages, v_pages, page_table, lengths)
+            return f(q, k_pages, v_pages, page_table, lengths,
+                     *scales.values())
         return paged_decode_attention(q, k_pages, v_pages, page_table,
-                                      lengths, backend=self._attn_backend)
+                                      lengths, backend=self._attn_backend,
+                                      **scales)
 
     @staticmethod
     def _stacked_blocks(params):
@@ -442,6 +489,14 @@ class InferenceEngine:
                 tiles = new.reshape(B, n_pages_row, ps, H, D)
                 tiles = jnp.moveaxis(tiles, 3, 2)
                 tiles = tiles.reshape(B * n_pages_row, H, ps, D)
+                if isinstance(pool, QuantizedPages):
+                    # int8 pages: quantize each (head, slot) vector and
+                    # scatter data + scale through the same page ids
+                    q8, sc = quantize_kv(tiles)
+                    return QuantizedPages(
+                        pool.data.at[flat_pt].set(q8),
+                        pool.scale.at[flat_pt].set(
+                            sc.astype(pool.scale.dtype)))
                 return pool.at[flat_pt].set(tiles.astype(pool.dtype))
 
             k_pool = jax.vmap(write)(k_pool, ks)
@@ -480,15 +535,28 @@ class InferenceEngine:
                 page_table, (pos // ps)[:, None], axis=1)[:, 0]
             slot = pos % ps
 
+            def store(pool, vec):
+                """One decoded token's K or V row into its page slot;
+                int8 pools quantize per (head) vector and land the
+                scale in the page-aligned scale pool."""
+                if isinstance(pool, QuantizedPages):
+                    q8, sc = quantize_kv(vec)
+                    return QuantizedPages(
+                        pool.data.at[page_idx, :, slot].set(q8),
+                        pool.scale.at[page_idx, :, slot].set(
+                            sc.astype(pool.scale.dtype)))
+                return pool.at[page_idx, :, slot].set(
+                    vec.astype(pool.dtype))
+
             def body(carry, xs):
                 bp, kp, vp = xs
                 q, k, v = neox._block_qkv(cfg, bp, carry, cos, sin,
                                           rot_dim, H)
-                kp = kp.at[page_idx, :, slot].set(
-                    k[:, 0].astype(kp.dtype))
-                vp = vp.at[page_idx, :, slot].set(
-                    v[:, 0].astype(vp.dtype))
-                attn = self._attention(q[:, 0].astype(kp.dtype), kp, vp,
+                kp = store(kp, k[:, 0])
+                vp = store(vp, v[:, 0])
+                qrow = q[:, 0] if isinstance(kp, QuantizedPages) \
+                    else q[:, 0].astype(kp.dtype)
+                attn = self._attention(qrow, kp, vp,
                                        page_table, lengths)
                 attn = attn.astype(carry.dtype)
                 out = neox._block_post_attn(
@@ -790,7 +858,8 @@ class InferenceEngine:
         the full token history on readmission). Errors raised before
         dispatch (the common case, incl. injected faults) leave the
         donated buffers intact and skip this entirely."""
-        deleted = getattr(self.cache.k, "is_deleted", lambda: False)()
+        k_data = self.cache.data_array(self.cache.k)
+        deleted = getattr(k_data, "is_deleted", lambda: False)()
         if not deleted:
             return
         logger.error(

@@ -16,13 +16,68 @@ Allocation is host-side (scheduling is host-side anyway): a free list of
 page ids. The device arrays are functional jax values — the engine
 rebinds them after every compiled prefill/decode call (donated, so XLA
 updates in place).
-"""
 
+**Int8 pages** (``kv_cache_dtype: "int8"``): each pool becomes a
+`QuantizedPages` pytree — the int8 data pool plus a per-page SCALE pool
+``[L, P, H, page_size]`` (one bf16 scale per head-slot, stored page-row
+aligned so the decode kernel resolves both through the same page-table
+LUT). K/V vectors quantize symmetrically per (head, slot) at write time;
+the decode-attention kernel dequantizes at the DMA boundary
+(`ops/pallas/decode_attention.py`). A resident token costs
+``2·L·H·(D + 2)`` bytes instead of ``2·L·H·D·2`` at bf16 — ~1.94× more
+sessions at a fixed pool budget for D = 64 (bf16 scales deliberately:
+fp32 would cost D + 4 and cap the ratio at 1.88×)."""
+
+import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import jax.numpy as jnp
 
 from ..parallel.mesh import MODEL_AXIS
+
+
+class QuantizedPages:
+    """Int8 page pool + its per-page scale pool, as a pytree node: the
+    engine's compiled calls donate/rebind it like a plain pool array
+    (both leaves ride every jit/scan/vmap unchanged)."""
+
+    __slots__ = ("data", "scale")
+
+    def __init__(self, data, scale):
+        self.data = data
+        self.scale = scale
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    def __repr__(self):
+        return (f"QuantizedPages(shape={tuple(self.data.shape)}, "
+                f"scale={tuple(self.scale.shape)})")
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedPages,
+    lambda qp: ((qp.data, qp.scale), None),
+    lambda _, children: QuantizedPages(*children))
+
+
+KV_QMAX = 127.0
+
+
+def quantize_kv(vec):
+    """Symmetric per-vector int8 quantization over the trailing (head
+    dim) axis: returns (q int8, scale fp32 [...]) with
+    ``dequant = q · scale[..., None]``. Zero vectors keep scale 1."""
+    amax = jnp.max(jnp.abs(vec.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / KV_QMAX, 1.0)
+    q = jnp.clip(jnp.round(vec.astype(jnp.float32) / scale[..., None]),
+                 -KV_QMAX, KV_QMAX).astype(jnp.int8)
+    return q, scale
 
 
 def pages_for_tokens(n_tokens, page_size):
@@ -50,10 +105,10 @@ class PagedKVCache:
         self.page_size = int(page_size)
         self.head_dim = int(head_dim)
         self.dtype = dtype
+        self.quantized = jnp.dtype(dtype) == jnp.int8
         self.mesh = mesh
-        shape = (self.num_layers, self.num_pages, self.num_heads,
-                 self.page_size, self.head_dim)
         self.sharding = None
+        self.scale_sharding = None
         if mesh is not None and MODEL_AXIS in mesh.axis_names and \
                 mesh.shape[MODEL_AXIS] > 1:
             if self.num_heads % mesh.shape[MODEL_AXIS]:
@@ -63,16 +118,36 @@ class PagedKVCache:
                     f"({mesh.shape[MODEL_AXIS]} shards)")
             self.sharding = NamedSharding(
                 mesh, P(None, None, MODEL_AXIS, None, None))
-        if self.sharding is not None:
-            import jax
-            self.k = jax.device_put(jnp.zeros(shape, dtype), self.sharding)
-            self.v = jax.device_put(jnp.zeros(shape, dtype), self.sharding)
-        else:
-            self.k = jnp.zeros(shape, dtype)
-            self.v = jnp.zeros(shape, dtype)
+            self.scale_sharding = NamedSharding(
+                mesh, P(None, None, MODEL_AXIS, None))
+        self.k = self._make_pool()
+        self.v = self._make_pool()
         # free list: every page except the trash page, low ids first so
         # tests are deterministic
         self._free = list(range(self.num_pages - 1, 0, -1))
+
+    def _make_pool(self):
+        shape = (self.num_layers, self.num_pages, self.num_heads,
+                 self.page_size, self.head_dim)
+        data = jnp.zeros(shape, self.dtype)
+        if self.sharding is not None:
+            data = jax.device_put(data, self.sharding)
+        if not self.quantized:
+            return data
+        # unit scales on the zero pool: dequant of the trash page stays
+        # exact zero, and a scale of 0 could never be divided back in.
+        # bf16 scales: the scale's relative rounding (2^-9) is noise
+        # under the int8 mantissa (2^-7), and fp32 scales would eat the
+        # capacity win at head_dim 64 (128/68 = 1.88× vs 128/66 = 1.94×)
+        scale = jnp.ones(shape[:-1], jnp.bfloat16)
+        if self.scale_sharding is not None:
+            scale = jax.device_put(scale, self.scale_sharding)
+        return QuantizedPages(data, scale)
+
+    def data_array(self, pool):
+        """The raw data leaf of a pool (the array itself when the cache
+        is not quantized) — liveness checks poke this."""
+        return pool.data if isinstance(pool, QuantizedPages) else pool
 
     def reset_pools(self):
         """Rebuild the K/V device pools zeroed, keeping the allocator
@@ -81,17 +156,8 @@ class PagedKVCache:
         buffers are consumed and unusable); the engine then re-prefills
         every running sequence, so the zeroed contents are never
         read."""
-        shape = (self.num_layers, self.num_pages, self.num_heads,
-                 self.page_size, self.head_dim)
-        if self.sharding is not None:
-            import jax
-            self.k = jax.device_put(jnp.zeros(shape, self.dtype),
-                                    self.sharding)
-            self.v = jax.device_put(jnp.zeros(shape, self.dtype),
-                                    self.sharding)
-        else:
-            self.k = jnp.zeros(shape, self.dtype)
-            self.v = jnp.zeros(shape, self.dtype)
+        self.k = self._make_pool()
+        self.v = self._make_pool()
 
     # -- allocator (host-side) --------------------------------------------
 
@@ -126,7 +192,8 @@ class PagedKVCache:
         self._free.extend(int(p) for p in pages)
 
     def bytes_per_token(self):
-        """K + V bytes of cache one token occupies across all layers."""
+        """K + V bytes of cache one token occupies across all layers
+        (int8 pools count the per-slot bf16 scale)."""
         itemsize = jnp.dtype(self.dtype).itemsize
-        return 2 * self.num_layers * self.num_heads * self.head_dim * \
-            itemsize
+        per_head = self.head_dim * itemsize + (2 if self.quantized else 0)
+        return 2 * self.num_layers * self.num_heads * per_head

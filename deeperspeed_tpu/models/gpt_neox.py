@@ -83,6 +83,13 @@ class GPTNeoXConfig:
     # "sparse" (SparseSelfAttention over the JSON `sparse_attention`
     # block's pattern — local+global / strided per the reference)
     attention_engine: str = "dense"
+    # delayed-scaling quantized FFN (ops/pallas/quant_matmul): None =
+    # full-precision, "int8"/"fp8" quantize both FFN matmul operands
+    # against per-layer amax histories threaded through the block scan.
+    # Config-drivable via the JSON `quantization.ffn` block.
+    ffn_quant_recipe: object = None
+    ffn_quant_margin: float = 1.0
+    ffn_quant_history: int = 16
 
     @property
     def head_dim(self):
@@ -357,12 +364,29 @@ def causal_attention(q, k, v, use_pallas=True, segment_ids=None):
     return tag_attn_residual(jnp.einsum("bhqk,bkhd->bqhd", probs, v))
 
 
+def _wmat(x, w):
+    """``x @ w`` for a plain weight leaf or a serving-time
+    `QuantizedWeight` (int8 at rest + per-output-channel scales,
+    `ops/pallas/quant_matmul`). Training params are never quantized, so
+    every training trace keeps the plain matmul; the serving engine's
+    `prepare_inference_params(weight_quant="int8")` swaps the block
+    matmul weights and this ONE dispatch point covers prefill and decode
+    on every family that shares the block body."""
+    from ..ops.pallas.quant_matmul import QuantizedWeight, quant_matmul
+    if isinstance(w, QuantizedWeight):
+        from ..ops.autotune import quant_matmul_blocks
+        m = int(np.prod(x.shape[:-1]))
+        blocks = quant_matmul_blocks(m, w.shape[0], w.shape[1], x.dtype)
+        return quant_matmul(x, w, blocks=blocks)
+    return x @ w.astype(x.dtype)
+
+
 def _block_qkv(cfg, params, x, cos, sin, rot_dim, nh_local):
     """ln1 + QKV projection + rotary; shared by training and decode."""
     B, S, _ = x.shape
     ln1 = layer_norm(x, params["ln_attn"]["scale"], params["ln_attn"]["bias"],
                      cfg.layernorm_eps)
-    qkv = ln1 @ params["attn"]["qkv_w"].astype(x.dtype) + \
+    qkv = _wmat(ln1, params["attn"]["qkv_w"]) + \
         params["attn"]["qkv_b"].astype(x.dtype)
     qkv = qkv.reshape(B, S, nh_local, 3 * cfg.head_dim)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -370,13 +394,17 @@ def _block_qkv(cfg, params, x, cos, sin, rot_dim, nh_local):
     return q, k, v
 
 
-def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
+def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None,
+                     ffn_quant=None):
     """Everything after the attention core: out projection, residuals,
     ln2, MLP (dense or MoE) — shared by training and decode.
     `attn_flat` is the flattened [B, S, h/mp] attention output. With
-    MoE enabled the return is (out, aux_load_balance_loss)."""
+    MoE enabled the return is (out, aux_load_balance_loss).
+    `ffn_quant` = (recipe, margin, amax_row [4, H]) runs the dense FFN
+    under delayed-scaling quantization and makes the return
+    (out, new_amax_row) — see `ops/pallas/quant_matmul`."""
     out_b = params["attn"]["out_b"].astype(x.dtype)
-    attn_partial = attn_flat @ params["attn"]["out_w"].astype(x.dtype)
+    attn_partial = _wmat(attn_flat, params["attn"]["out_w"])
 
     if cfg.use_parallel_residual:
         ln2_in = x
@@ -389,7 +417,7 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
     if getattr(cfg, "moe_num_experts", 0):
         from ..moe.layer import moe_ffn_dense
         B, S, h = ln2.shape
-        y, aux = moe_ffn_dense(
+        y = moe_ffn_dense(
             params["mlp"], ln2.reshape(B * S, h),
             capacity_factor=cfg.moe_capacity_factor,
             top_k=cfg.moe_top_k, rng=rng,
@@ -398,17 +426,43 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
             dispatch=getattr(cfg, "moe_dispatch", "einsum"),
             renorm_kept_choices=getattr(cfg, "moe_renorm_kept_choices",
                                         False),
-            observe=getattr(cfg, "moe_observability", False))
+            observe=getattr(cfg, "moe_observability", False),
+            ffn_quant=ffn_quant)
+        new_amax_row = None
+        if ffn_quant is not None:
+            y, aux, new_amax_row = y
+        else:
+            y, aux = y
         moe_out = y.reshape(ln2.shape)
         if cfg.use_parallel_residual:
-            return x + reduce_fn(attn_partial) + out_b + moe_out, aux
-        return ln2_in + moe_out, aux
+            out = x + reduce_fn(attn_partial) + out_b + moe_out
+        else:
+            out = ln2_in + moe_out
+        if ffn_quant is not None:
+            return out, aux, new_amax_row
+        return out, aux
 
     mlp_b = params["mlp"]["out_b"].astype(x.dtype)
-    hmid = ln2 @ params["mlp"]["in_w"].astype(x.dtype) + \
+    if ffn_quant is not None:
+        # delayed-scaling quantized FFN (ops/pallas/quant_matmul):
+        # amax_row [4, H] carries the histories for in-x/in-w/out-x/out-w
+        from ..ops.pallas.quant_matmul import ffn_scaled_matmuls
+        recipe, margin, amax_row = ffn_quant
+        B, S, h = ln2.shape
+        y2d, new_amax_row = ffn_scaled_matmuls(
+            ln2.reshape(B * S, h), params["mlp"]["in_w"],
+            params["mlp"]["in_b"], params["mlp"]["out_w"],
+            amax_row, recipe, margin)
+        mlp_partial = y2d.reshape(B, S, -1)
+        if cfg.use_parallel_residual:
+            out = x + reduce_fn(attn_partial + mlp_partial) + out_b + mlp_b
+        else:
+            out = ln2_in + reduce_fn(mlp_partial) + mlp_b
+        return out, new_amax_row
+    hmid = _wmat(ln2, params["mlp"]["in_w"]) + \
         params["mlp"]["in_b"].astype(x.dtype)
     hmid = jax.nn.gelu(hmid)
-    mlp_partial = hmid @ params["mlp"]["out_w"].astype(x.dtype)
+    mlp_partial = _wmat(hmid, params["mlp"]["out_w"])
 
     if cfg.use_parallel_residual:
         # one reduce for both partials (the Megatron fusion win)
@@ -418,7 +472,7 @@ def _block_post_attn(cfg, params, x, attn_flat, reduce_fn, rng=None):
 
 def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
                 return_kv=False, rng=None, attn_fn=None,
-                segment_ids=None):
+                segment_ids=None, ffn_quant=None):
     """Shared block body: `mp == 1` with identity `reduce_fn` is the
     dense block; TP callers pass pre-sliced params (column/row parallel)
     and a psum reduce; the KV-cached decode step reuses the same
@@ -439,8 +493,12 @@ def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
     else:
         attn = causal_attention(q, k, v, use_pallas=use_pallas,
                                 segment_ids=segment_ids)
+    if return_kv and ffn_quant is not None:
+        raise ValueError("return_kv and ffn_quant cannot combine (the "
+                         "KV-returning decode path serves quantized "
+                         "WEIGHTS, not the delayed-scaling FFN)")
     out = _block_post_attn(cfg, params, x, attn.reshape(B, S, h // mp),
-                           reduce_fn, rng=rng)
+                           reduce_fn, rng=rng, ffn_quant=ffn_quant)
     if return_kv:
         return out, (k, v)
     return out
@@ -448,13 +506,14 @@ def _block_core(cfg, params, x, cos_sin, use_pallas, mp, reduce_fn,
 
 def block_forward(cfg, params, x, cos_sin, compute_dtype=None,
                   use_pallas=True, rng=None, attn_fn=None,
-                  segment_ids=None):
+                  segment_ids=None, ffn_quant=None):
     """One GPT-NeoX block with parallel residual:
     x + attn(ln1(x)) + ffn(ln2(x)). With `cfg.moe_num_experts` the FFN
-    is the MoE layer and the return is (out, aux_loss)."""
+    is the MoE layer and the return is (out, aux_loss); with `ffn_quant`
+    (delayed-scaling quantized FFN) it is (out, new_amax_row)."""
     return _block_core(cfg, params, x, cos_sin, use_pallas, mp=1,
                        reduce_fn=lambda t: t, rng=rng, attn_fn=attn_fn,
-                       segment_ids=segment_ids)
+                       segment_ids=segment_ids, ffn_quant=ffn_quant)
 
 
 def block_forward_tp(cfg, params, x, cos_sin, model_axis, mp,
@@ -580,7 +639,7 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
                    collect_hidden=False, rng=None, attn_fn=None,
                    scan_blocks=False, remat_policy=None,
                    number_checkpoints=None, boundary_fn=None,
-                   segment_ids=None):
+                   segment_ids=None, ffn_amax=None):
     """tokens [B, S] int32 → final-norm hidden states [B, S, H]; with
     `collect_hidden` also returns [embed, block outputs..., final norm]
     (the activation-capture path shares this exact forward). With MoE
@@ -607,6 +666,25 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
     moe = bool(getattr(cfg, "moe_num_experts", 0))
     do_remat, policy, n_ckpt = resolve_remat(remat_blocks, remat_policy,
                                              number_checkpoints)
+    quant = None
+    if ffn_amax is not None:
+        # delayed-scaling quantized FFN: `ffn_amax` [L, 4, H] carries
+        # per-layer amax histories; each block consumes its row and the
+        # advanced rows come back stacked as an extra return value.
+        # `ffn_quant_recipe`/`ffn_quant_margin` ride the config
+        # (apply_ds_config wires the "quantization" JSON block).
+        quant = (cfg.ffn_quant_recipe, getattr(cfg, "ffn_quant_margin",
+                                               1.0))
+        if n_ckpt is not None:
+            raise ValueError(
+                "quantization.ffn + number_checkpoints (segmented-scan "
+                "checkpointing) is unsupported: the amax rows do not "
+                "thread through the segment spans; use a remat policy "
+                "without number_checkpoints")
+        if collect_hidden:
+            raise ValueError(
+                "quantization.ffn does not thread amax through the "
+                "hidden-state capture path (collect_hidden)")
     x = params["embed"]["wte"][tokens]
     cos, sin, rot_dim = _rotary_cache(cfg, tokens.shape[1])
     if segment_ids is not None and rot_dim:
@@ -616,27 +694,35 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
         cos, sin = cos[pos], sin[pos]
     hidden = [x] if collect_hidden else None
 
-    plain_block = lambda bp, x, r: block_forward(       # noqa: E731
+    def _quant_arg(arow):
+        return None if arow is None else (quant[0], quant[1], arow)
+
+    plain_block = lambda bp, x, r, arow=None: block_forward(  # noqa: E731
         cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
-        rng=r, attn_fn=attn_fn, segment_ids=segment_ids)
+        rng=r, attn_fn=attn_fn, segment_ids=segment_ids,
+        ffn_quant=_quant_arg(arow))
     if do_remat and n_ckpt is None:
         # rot_dim must stay a STATIC python int: routed through
         # jax.checkpoint's traced args it becomes an int32 tracer and
         # the rotary slice bound blows up; close over it instead
         # (segment_ids rides as an explicit traced arg so per-block remat
-        # replays see the same operand, not a stale closure constant)
+        # replays see the same operand, not a stale closure constant;
+        # the amax row rides the same way — its advanced value is a
+        # block OUTPUT, recomputed identically in the backward replay)
         ck = jax.checkpoint(
-            lambda bp, x, cos, sin, seg, r: block_forward(
+            lambda bp, x, cos, sin, seg, r, arow: block_forward(
                 cfg, bp, x, (cos, sin, rot_dim), use_pallas=use_pallas,
-                rng=r, attn_fn=attn_fn, segment_ids=seg), policy=policy)
+                rng=r, attn_fn=attn_fn, segment_ids=seg,
+                ffn_quant=_quant_arg(arow)), policy=policy)
         # boundary_fn on every block input: per-block remat saves each
         # block's carry, so partition_activations constrains them all
         edge = boundary_fn if boundary_fn is not None else (lambda c: c)
-        block_fn = lambda bp, x, r: ck(bp, edge(x), cos, sin,  # noqa: E731
-                                       segment_ids, r)
+        block_fn = lambda bp, x, r, arow=None: ck(  # noqa: E731
+            bp, edge(x), cos, sin, segment_ids, r, arow)
     else:
         block_fn = plain_block
     aux_total = jnp.asarray(0.0, jnp.float32)
+    new_amax = None
     uniform = not moe and not collect_hidden
     if n_ckpt is not None and not uniform:
         raise ValueError(
@@ -650,29 +736,54 @@ def forward_hidden(cfg, params, tokens, use_pallas=True, remat_blocks=False,
             lambda bp, x: plain_block(bp, x, None), x, params["blocks"],
             n_ckpt, policy=policy, boundary_fn=boundary_fn)
     elif scan_blocks and uniform and len(params["blocks"]) > 1:
-        x = scan_stacked_blocks(lambda bp, x: block_fn(bp, x, None),
-                                x, params["blocks"])
+        if quant is not None:
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                             *params["blocks"])
+
+            def sbody(carry, xs):
+                bp, arow = xs
+                return block_fn(bp, carry, None, arow)
+
+            x, new_amax = jax.lax.scan(sbody, x, (stacked, ffn_amax))
+        else:
+            x = scan_stacked_blocks(lambda bp, x: block_fn(bp, x, None),
+                                    x, params["blocks"])
     else:
+        new_rows = []
         for i, bp in enumerate(params["blocks"]):
             brng = jax.random.fold_in(rng, i) if (moe and rng is not None) \
                 else None
-            y = block_fn(bp, x, brng)
-            if moe:
+            y = block_fn(bp, x, brng,
+                         ffn_amax[i] if quant is not None else None)
+            if moe and quant is not None:
+                x, aux, row = y
+                aux_total = aux_total + aux
+                new_rows.append(row)
+            elif moe:
                 x, aux = y
                 aux_total = aux_total + aux
+            elif quant is not None:
+                x, row = y
+                new_rows.append(row)
             else:
                 x = y
             if collect_hidden:
                 hidden.append(x)
+        if quant is not None:
+            new_amax = jnp.stack(new_rows)
 
     out = layer_norm(x, params["final_ln"]["scale"],
                      params["final_ln"]["bias"], cfg.layernorm_eps)
     if moe:
         if collect_hidden:
             return out, aux_total, hidden + [out]
+        if quant is not None:
+            return out, aux_total, new_amax
         return out, aux_total
     if collect_hidden:
         return out, hidden + [out]
+    if quant is not None:
+        return out, new_amax
     return out
 
 
@@ -800,6 +911,12 @@ def reject_unsupported_ds_blocks(ds_config, family):
             f"{family} does not implement the sparse_attention config "
             "block (the run would silently train with dense attention); "
             "the block-sparse engine lives on models.gpt_neox.GPTNeoX")
+    qz = getattr(ds_config, "quantization_config", None)
+    if qz and qz.get("ffn"):
+        raise NotImplementedError(
+            f"{family} does not implement the quantization.ffn block "
+            "(the run would silently train full-precision); the "
+            "delayed-scaling FFN lives on models.gpt_neox.GPTNeoX")
 
 
 def apply_activation_checkpointing_config(model, ds_config, mesh=None):
@@ -983,6 +1100,21 @@ class GPTNeoX:
         if packing:
             self.config = dataclasses.replace(self.config,
                                               use_segment_ids=True)
+        qz = getattr(ds_config, "quantization_config", None)
+        if qz and qz.get("ffn"):
+            f = qz["ffn"]
+            if self.config.moe_num_experts and \
+                    self.config.moe_dispatch != "sort":
+                raise ValueError(
+                    "quantization.ffn on an MoE model requires "
+                    "moe.dispatch = \"sort\" (the delayed-scaling path "
+                    "quantizes the grouped expert matmul; the einsum "
+                    "engine's flops sit in the one-hot dispatch tensor)")
+            self.config = dataclasses.replace(
+                self.config,
+                ffn_quant_recipe=f["recipe"],
+                ffn_quant_margin=f["margin"],
+                ffn_quant_history=f["amax_history_len"])
         sparse = getattr(ds_config, "sparse_attention", None)
         if sparse:
             if packing:
@@ -1036,9 +1168,10 @@ class GPTNeoX:
                        remat_policy=self.remat_policy,
                        number_checkpoints=self.number_checkpoints)
 
-    def _lm_forward(self, params, batch, rng=None):
+    def _lm_forward(self, params, batch, rng=None, ffn_amax=None):
         """Shared body of `loss_fn` / `loss_and_logits`: one block-stack
-        forward → (final-norm hidden, masked labels, moe aux or None)."""
+        forward → (final-norm hidden, masked labels, moe aux or None,
+        advanced amax state or None)."""
         tokens, labels, seg = split_lm_batch(batch)
         if self.config.use_segment_ids and seg is None:
             raise ValueError(
@@ -1060,11 +1193,16 @@ class GPTNeoX:
                                 remat_policy=self.remat_policy,
                                 number_checkpoints=self.number_checkpoints,
                                 boundary_fn=self._ckpt_boundary_fn,
-                                segment_ids=seg)
+                                segment_ids=seg, ffn_amax=ffn_amax)
         aux = None
-        if self.config.moe_num_experts:
+        new_amax = None
+        if self.config.moe_num_experts and ffn_amax is not None:
+            hidden, aux, new_amax = hidden
+        elif self.config.moe_num_experts:
             hidden, aux = hidden
-        return hidden, labels, aux
+        elif ffn_amax is not None:
+            hidden, new_amax = hidden
+        return hidden, labels, aux, new_amax
 
     def _head_loss(self, params, hidden, labels, aux):
         out_embed = params.get("embed_out", params["embed"])["wte"]
@@ -1074,15 +1212,32 @@ class GPTNeoX:
                 aux / max(self.config.num_layers, 1)
         return loss
 
-    def loss_fn(self, params, batch, rng=None):
-        hidden, labels, aux = self._lm_forward(params, batch, rng)
-        return self._head_loss(params, hidden, labels, aux)
+    def loss_fn(self, params, batch, rng=None, ffn_amax=None):
+        """Scalar LM loss; with `ffn_amax` (delayed-scaling quantized
+        FFN state, [L, 4, H]) the return is (loss, new_ffn_amax) — the
+        engine threads the state through `EngineState.quant`."""
+        hidden, labels, aux, new_amax = self._lm_forward(
+            params, batch, rng, ffn_amax=ffn_amax)
+        loss = self._head_loss(params, hidden, labels, aux)
+        if ffn_amax is not None:
+            return loss, new_amax
+        return loss
+
+    def init_ffn_amax(self):
+        """Zero amax-history state for `loss_fn(..., ffn_amax=)` —
+        [num_layers, 4, ffn_quant_history] (quant_matmul layout); None
+        when the config has no quantized-FFN recipe."""
+        if self.config.ffn_quant_recipe is None:
+            return None
+        from ..ops.pallas.quant_matmul import init_amax_history
+        return init_amax_history(self.config.num_layers,
+                                 self.config.ffn_quant_history)
 
     def loss_and_logits(self, params, batch, rng=None):
         """(loss, [B, S, V] fp32 logits) from ONE forward — what
         `eval_batch(return_logits=True)` compiles, instead of tracing
         the block stack twice for loss and `apply`."""
-        hidden, labels, aux = self._lm_forward(params, batch, rng)
+        hidden, labels, aux, _ = self._lm_forward(params, batch, rng)
         out_embed = params.get("embed_out", params["embed"])["wte"]
         logits = jnp.einsum("bsh,vh->bsv", hidden,
                             out_embed.astype(hidden.dtype),
@@ -1277,7 +1432,7 @@ class GPTNeoX:
                 state["outer"] = outer
             return state["plan"], state["outer"]
 
-        def loss_and_grads(params, batch, rng, scale=None):
+        def loss_and_grads(params, batch, rng, scale=None, ef=None):
             tokens, labels, seg = split_lm_batch(batch)
             if cfg.use_segment_ids and seg is None:
                 raise ValueError(
@@ -1287,7 +1442,10 @@ class GPTNeoX:
             if scale is None:
                 scale = jnp.asarray(1.0, jnp.float32)
 
-            def body(lp, tokens, labels, seg, rng, scale):
+            def body(lp, ef_l, tokens, labels, seg, rng, scale):
+                if ef_l is not None:
+                    ef_l = ef_l[0]      # [1, L, world, S] local block
+
                 def gathered(sub, placements):
                     return jax.tree_util.tree_map(
                         lambda l, pl: gather_leaf(l, pl, data_axis,
@@ -1295,7 +1453,7 @@ class GPTNeoX:
                         sub, placements,
                         is_leaf=lambda x: hasattr(x, "kind"))
 
-                def local_loss(lp):
+                def local_loss(lp, ef_l):
                     embed_wte = gathered(lp["embed"],
                                          outer["embed"])["wte"]
                     x = embed_wte[tokens]
@@ -1322,7 +1480,7 @@ class GPTNeoX:
                     x = prefetched_block_scan(
                         block_fn, x, layer_leaves, plan, L,
                         prefetch_depth=depth, group_layers=group,
-                        policy=policy, remat=remat)
+                        policy=policy, remat=remat, ef=ef_l)
 
                     fl = gathered(lp["final_ln"], outer["final_ln"])
                     x = layer_norm(x, fl["scale"], fl["bias"],
@@ -1335,8 +1493,19 @@ class GPTNeoX:
                     loss = fused_lm_head_loss(x, head_wte, lab)
                     return loss * scale.astype(loss.dtype), loss
 
+                # the error-feedback state is a differentiated INPUT:
+                # its "gradient" is the advanced error buffer smuggled
+                # out of the compressed reduce-scatter's custom_vjp
+                # (parallel.schedule.make_ef_gather)
+                argnums = (0,) if ef_l is None else (0, 1)
                 (_, loss), grads = jax.value_and_grad(
-                    local_loss, has_aux=True)(lp)
+                    local_loss, argnums=argnums, has_aux=True)(lp, ef_l)
+                new_ef = None
+                if ef_l is not None:
+                    grads, new_ef = grads
+                    new_ef = new_ef[None]       # restore the dp dim
+                else:
+                    grads = grads[0]
                 # gather transposes delivered each sharded leaf's grad
                 # as the rank-SUM reduce-scatter: divide for the dp
                 # mean; replicated leaves pmean their per-rank grads
@@ -1345,20 +1514,35 @@ class GPTNeoX:
                     if (p or any(a is not None for a in s))
                     else jax.lax.pmean(g, data_axis),
                     grads, param_specs, param_padinfo)
-                return jax.lax.pmean(loss, data_axis), grads
+                loss = jax.lax.pmean(loss, data_axis)
+                if ef_l is not None:
+                    return loss, grads, new_ef
+                return loss, grads
 
             batch_spec = P_(data_axis)
             seg_in = seg if seg is not None else jnp.zeros((), jnp.int32)
             seg_spec = batch_spec if seg is not None else P_()
+            if ef is None:
+                mapped = shard_map(
+                    lambda lp, t, lb, sg, r, sc: body(
+                        lp, None, t, lb,
+                        sg if seg is not None else None, r, sc),
+                    mesh=mesh,
+                    in_specs=(param_specs, batch_spec, batch_spec,
+                              seg_spec, P_(), P_()),
+                    out_specs=(P_(), param_specs),
+                    check_vma=False)
+                return mapped(params, tokens, labels, seg_in, rng, scale)
             mapped = shard_map(
-                lambda lp, t, lb, sg, r, sc: body(
-                    lp, t, lb, sg if seg is not None else None, r, sc),
+                lambda lp, e, t, lb, sg, r, sc: body(
+                    lp, e, t, lb, sg if seg is not None else None, r,
+                    sc),
                 mesh=mesh,
-                in_specs=(param_specs, batch_spec, batch_spec, seg_spec,
-                          P_(), P_()),
-                out_specs=(P_(), param_specs),
+                in_specs=(param_specs, P_(data_axis), batch_spec,
+                          batch_spec, seg_spec, P_(), P_()),
+                out_specs=(P_(), param_specs, P_(data_axis)),
                 check_vma=False)
-            return mapped(params, tokens, labels, seg_in, rng, scale)
+            return mapped(params, ef, tokens, labels, seg_in, rng, scale)
 
         return loss_and_grads
 

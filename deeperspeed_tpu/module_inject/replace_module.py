@@ -18,7 +18,7 @@ from ..ops.transformer import (DeepSpeedTransformerConfig,
                                DeepSpeedTransformerLayer)
 
 
-def prepare_inference_params(params, dtype):
+def prepare_inference_params(params, dtype, weight_quant=None):
     """Inference-side module surgery for the serving engine: pre-cast
     every matmul weight (ndim >= 2) of a parameter pytree to the serving
     compute dtype ONCE at load, keeping 1-D leaves (layernorm scales/
@@ -29,13 +29,43 @@ def prepare_inference_params(params, dtype):
     inference kernels at injection time; here the block body's
     per-call ``.astype(x.dtype)`` becomes an XLA no-op because the
     weights already REST in the compute dtype — no per-step cast
-    traffic, half the weight HBM at bf16."""
+    traffic, half the weight HBM at bf16.
+
+    ``weight_quant="int8"`` (the ``quantization.weights`` config choice)
+    additionally converts the BLOCK matmul weights (ndim >= 2 leaves
+    under ``params["blocks"]``) to `QuantizedWeight` — int8 at rest with
+    per-output-channel fp32 scales, dequantized inside the matmul kernel
+    (`ops/pallas/quant_matmul`). Decode is weight-bandwidth bound, so
+    int8 weights halve the bytes every decode step streams. The
+    embedding / LM head / final-norm leaves stay at the compute dtype
+    (the embedding doubles as a gather table and, tied, as the head)."""
     def cast(leaf):
         if getattr(leaf, "ndim", 0) >= 2:
             return jnp.asarray(leaf, dtype)
         return jnp.asarray(leaf, jnp.float32)
 
-    return jax.tree_util.tree_map(cast, params)
+    out = jax.tree_util.tree_map(cast, params)
+    if weight_quant is None:
+        return out
+    if weight_quant != "int8":
+        raise ValueError(
+            f"weight_quant must be None or 'int8', got {weight_quant!r}")
+    if not (isinstance(out, dict) and "blocks" in out):
+        raise ValueError(
+            "weight_quant='int8' quantizes the block matmul weights and "
+            "needs a params tree with a 'blocks' entry (the GPT-NeoX / "
+            "GPT-2 family layout)")
+    from ..ops.pallas.quant_matmul import quantize_weight
+
+    def quant(leaf):
+        if getattr(leaf, "ndim", 0) >= 2:
+            return quantize_weight(leaf)
+        return leaf
+
+    out = dict(out)
+    out["blocks"] = [jax.tree_util.tree_map(quant, b)
+                     for b in out["blocks"]]
+    return out
 
 
 def _t(x):

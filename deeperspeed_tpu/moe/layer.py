@@ -398,11 +398,16 @@ def _fill_buffer(x, route, capacity, span):
 
 
 def _sort_ffn(params, buf, sizes, span, lut, n_w, rows_per_w, block_m,
-              block_n, backend):
+              block_n, backend, ffn_quant=None):
     """Expert FFN over the packed buffer as two grouped matmuls. Biases
     ride a [n_w, rows, ·] reshape (no per-row gather). Masked tail rows
     come out of the second matmul as exact zeros plus a bias term; the
-    combine never gathers them."""
+    combine never gathers them.
+
+    `ffn_quant` = (recipe, margin, amax_row [4, H]) runs both grouped
+    matmuls with delayed-scaling fake-quantized operands
+    (`ops.pallas.quant_matmul.grouped_scaled_operands`) and makes the
+    return (out, new_amax_row)."""
     from ..ops.pallas.grouped_matmul import grouped_matmul
     dt = buf.dtype
     w_in = params["w_in"].astype(dt)
@@ -410,14 +415,29 @@ def _sort_ffn(params, buf, sizes, span, lut, n_w, rows_per_w, block_m,
     w_out = params["w_out"].astype(dt)
     b_out = params["b_out"].astype(dt)
     inter = w_in.shape[-1]
+    new_row = None
+    if ffn_quant is not None:
+        from ..ops.pallas.quant_matmul import grouped_scaled_operands
+        recipe, margin, amax_row = ffn_quant
+        buf, w_in, hx_in, hw_in = grouped_scaled_operands(
+            buf, w_in, amax_row[0], amax_row[1], recipe, margin)
     h = grouped_matmul(buf, w_in, sizes, span, lut, block_m, block_n,
                        backend)
     h = jax.nn.gelu(h.reshape(n_w, rows_per_w, inter) + b_in[:, None, :])
-    out = grouped_matmul(h.reshape(-1, inter), w_out, sizes, span, lut,
+    h = h.reshape(-1, inter)
+    if ffn_quant is not None:
+        from ..ops.pallas.quant_matmul import grouped_scaled_operands
+        h, w_out, hx_out, hw_out = grouped_scaled_operands(
+            h, w_out, amax_row[2], amax_row[3], recipe, margin)
+        new_row = jnp.stack([hx_in, hw_in, hx_out, hw_out])
+    out = grouped_matmul(h, w_out, sizes, span, lut,
                          block_m, block_n, backend)
     hidden = w_out.shape[-1]
     out = out.reshape(n_w, rows_per_w, hidden) + b_out[:, None, :]
-    return out.reshape(-1, hidden)
+    out = out.reshape(-1, hidden)
+    if ffn_quant is not None:
+        return out, new_row
+    return out
 
 
 def _sort_combine(out_buf, route, span, T, dtype):
@@ -451,7 +471,8 @@ def _gmm_geometry(capacity, k_dim, n_dim, dtype, block_m, block_n,
 def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
                   jitter_eps=0.0, groups=1, dispatch="einsum",
                   renorm_kept_choices=False, gmm_block_m=None,
-                  gmm_block_n=None, gmm_backend=None, observe=False):
+                  gmm_block_n=None, gmm_backend=None, observe=False,
+                  ffn_quant=None):
     """Reference semantics on one device. params: stacked expert weights
     {"w_in" [E, H, I], "b_in" [E, I], "w_out" [E, I, H], "b_out" [E, H],
     "gate" [H, E]}; x [T, H] → (y [T, H], aux_loss). `groups` splits the
@@ -466,6 +487,12 @@ def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
         raise ValueError(
             "observe=True requires dispatch='sort': the routing stats "
             "come from the sort engine's position-in-expert bookkeeping")
+    if ffn_quant is not None and dispatch != "sort":
+        raise ValueError(
+            "quantization.ffn on MoE blocks requires dispatch='sort': "
+            "the delayed-scaling path quantizes the grouped expert "
+            "matmul operands (the einsum engine spends its flops on the "
+            "one-hot dispatch tensor, which quantization cannot help)")
     T, H = x.shape
     E = params["w_in"].shape[0]
     g = _resolve_groups(groups, T)
@@ -496,7 +523,11 @@ def moe_ffn_dense(params, x, capacity_factor=1.25, top_k=1, rng=None,
     buf, sizes = _fill_buffer(x, route, capacity, span)
     lut = tuple(np.repeat(np.arange(E), g))
     out_buf = _sort_ffn(params, buf, sizes, span, lut, E, g * span,
-                        bm, bn, gmm_backend)
+                        bm, bn, gmm_backend, ffn_quant=ffn_quant)
+    if ffn_quant is not None:
+        out_buf, new_amax_row = out_buf
+        return (_sort_combine(out_buf, route, span, T, x.dtype),
+                route.aux, new_amax_row)
     return _sort_combine(out_buf, route, span, T, x.dtype), route.aux
 
 

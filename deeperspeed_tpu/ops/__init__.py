@@ -15,12 +15,17 @@ def dispatch_report():
 
     Keys (present once the corresponding kernel has dispatched):
     ``flash``: {"fwd": (bq, bk), "fwd_variant", "dkv", "dq",
-    "bwd_variant"}; ``decode_attention``: {"decode": backend}.
+    "bwd_variant"}; ``decode_attention``: {"decode": backend,
+    "decode_kv": pool dtype — "int8" when the paged pools are
+    quantized}; ``quant_matmul``: {"quant_matmul": backend} for the
+    int8 weight-only matmul.
     """
     from .pallas.decode_attention import _LAST_BACKEND
     from .pallas.flash_attention import _LAST_BLOCKS
+    from .pallas.quant_matmul import _LAST_BACKEND as _QMM_BACKEND
     return {"flash": dict(_LAST_BLOCKS),
-            "decode_attention": dict(_LAST_BACKEND)}
+            "decode_attention": dict(_LAST_BACKEND),
+            "quant_matmul": dict(_QMM_BACKEND)}
 
 
 __all__ = ["adam", "lamb", "op_builder", "pallas", "sparse_attention",

@@ -333,6 +333,85 @@ def grouped_matmul_blocks(capacity, k_dim, n_dim, dtype, tuner=None):
     return tuner.pick(key, survivors, measure)
 
 
+# ---------------------------------------------------------------------------
+# quantized weight-only matmul (ops/pallas/quant_matmul.py — the serving
+# int8 decode/prefill weight path)
+# ---------------------------------------------------------------------------
+
+# (block_m, block_k, block_n) targets, fattest first. The weight tile is
+# int8 (1 byte/element), so fat k-blocks are cheap on the wire; the fp32
+# accumulator block is the VMEM limiter.
+QMM_BLOCK_CANDIDATES = ((256, 512, 256), (512, 512, 256), (256, 512, 512),
+                        (256, 256, 256), (128, 512, 256), (128, 256, 256),
+                        (128, 256, 128))
+
+_QMM_VMEM_BUDGET = 10 << 20
+
+
+def qmm_vmem_bytes(block_m, block_k, block_n, itemsize):
+    """Estimated VMEM working set of one quant-matmul instance:
+    double-buffered x (compute dtype) and weight (int8) tiles, the fp32
+    accumulator block, the scale row and the output tile."""
+    return (2 * block_m * block_k * itemsize        # x tiles
+            + 2 * block_k * block_n * 1             # int8 weight tiles
+            + block_m * block_n * 4                 # fp32 accumulator
+            + block_n * 4                           # scale row
+            + block_m * block_n * itemsize)         # output tile
+
+
+def quant_matmul_blocks(m, k, n, dtype, tuner=None):
+    """(block_m, block_k, block_n) for `quant_matmul` at the given call
+    geometry: VMEM-model screen always, measured pick on the live device
+    under DS_TPU_AUTOTUNE=1 (measure-once-use-forever, like the flash and
+    grouped-matmul blocks). Without opt-in the first screened candidate
+    wins — a deterministic static pick, no probe launches at trace
+    time."""
+    itemsize = _gmm_itemsize(dtype)
+    screened = [c for c in QMM_BLOCK_CANDIDATES
+                if qmm_vmem_bytes(*c, itemsize=itemsize)
+                <= _QMM_VMEM_BUDGET]
+    if not screened:
+        screened = [QMM_BLOCK_CANDIDATES[-1]]
+    if not autotune_enabled():
+        return screened[0]
+
+    tuner = tuner or _global_tuner
+    key = ("qmm", int(m), int(k), int(n), str(dtype))
+    hit = tuner.cached(key)
+    if hit is not None:
+        return hit
+
+    import jax.numpy as jnp
+    from .pallas.quant_matmul import (_fit, _interpret, quant_matmul,
+                                      quantize_weight)
+
+    if len(screened) == 1 or jax.process_count() > 1 or _interpret():
+        # multi-host: per-host wall-clock picks can disagree → different
+        # programs per host → deadlock at the first collective.
+        # interpret mode: timing the Pallas interpreter ranks emulation
+        # cost, not kernel geometry
+        return tuner.store(key, screened[0])
+
+    # dedupe candidates on their FITTED geometry
+    fitted, seen = [], set()
+    for c in screened:
+        fit = (_fit(c[0], m, 8), _fit(c[1], k, 32), _fit(c[2], n, 128))
+        if fit in seen:
+            continue
+        seen.add(fit)
+        fitted.append(c)
+    if len(fitted) == 1:
+        return tuner.store(key, fitted[0])
+
+    x = jnp.zeros((m, k), dtype)
+    qw = quantize_weight(jnp.zeros((k, n), jnp.float32))
+
+    def measure(cand):
+        return quant_matmul(x, qw, backend="pallas", blocks=cand)
+
+    return tuner.pick(key, fitted, measure)
+
+
 def flash_bwd_blocks_for(shape, dtype, causal, fwd_blocks=None,
                          tuner=None):
     """Dispatch-time block geometry for the flash BACKWARD (dkv/dq)

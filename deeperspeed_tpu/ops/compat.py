@@ -48,6 +48,24 @@ def flash_attention_available():
     return True
 
 
+def quant_matmul_available():
+    # int8 weight-only matmul (per-channel scales, dequant-in-kernel)
+    # for the serving decode/prefill weight path + the delayed-scaling
+    # fp8/int8 training matmuls (docs/quantization.md)
+    from .pallas.quant_matmul import quant_matmul  # noqa: F401
+    return True
+
+
+def int8_kv_decode_available():
+    # the dequant-at-DMA int8 decode-attention variant. Probing the
+    # KERNEL module only — importing inference.kv_cache would execute
+    # the whole serving package __init__, and an unrelated serving-stack
+    # import failure would misreport THIS op as unavailable
+    from .pallas.decode_attention import (  # noqa: F401
+        _decode_kernel_quant, paged_decode_attention)
+    return True
+
+
 def sparse_attn_available():
     from .sparse_attention import SparseSelfAttention  # noqa: F401
     return True
@@ -70,12 +88,15 @@ def _builder_checks():
     from .op_builder import ALL_OPS as BUILDERS
     checks = {name: builder.is_compatible
               for name, builder in BUILDERS.items()}
-    # keep flash_attention between the transformer and sparse_attn rows
+    # keep flash_attention between the transformer and sparse_attn rows;
+    # the quant kernel backends follow it (docs/quantization.md)
     ordered = {}
     for name in checks:
         ordered[name] = checks[name]
         if name == "stochastic_transformer":
             ordered["flash_attention"] = flash_attention_available
+            ordered["quant_matmul"] = quant_matmul_available
+            ordered["int8_kv_decode"] = int8_kv_decode_available
     return ordered
 
 

@@ -71,17 +71,24 @@ def _log_first_dispatch():
                 f"backend={_LAST_BACKEND.get('decode')}")
 
 
-def paged_decode_supported(head_dim, page_size):
+def paged_decode_supported(head_dim, page_size, quantized=False):
     """Mosaic constraints for the real-TPU kernel: MXU-friendly head
-    dim, sublane-aligned page size. Interpret mode (CPU tests) has no
-    tiling rules."""
+    dim, sublane-aligned page size (int8 pools need the int8 sublane
+    tile, 32). Interpret mode (CPU tests) has no tiling rules."""
     if _interpret():
         return True
-    return head_dim in (64, 128, 256) and page_size % 8 == 0
+    align = 32 if quantized else 8
+    return head_dim in (64, 128, 256) and page_size % align == 0
 
 
 def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, sm_scale, page_size):
+                   m_scr, l_scr, acc_scr, *, sm_scale, page_size,
+                   ks_ref=None, vs_ref=None):
+    """One (batch row, head, page) step of paged flash decode. With
+    int8 pools (`ks_ref`/`vs_ref` scale blocks, resolved through the
+    SAME page-table LUT as the data blocks), the K/V tiles dequantize
+    right after the DMA — the wire moved 1 byte/element, the math runs
+    fp32."""
     b = pl.program_id(0)
     p = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -97,6 +104,10 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     def _compute():
         q = q_ref[0, 0].reshape(1, -1)                         # [1, D]
         k = k_ref[0, 0]                                        # [ps, D]
+        if ks_ref is not None:
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * \
+                ks_ref[0, 0].astype(jnp.float32)[:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale     # [1, ps]
@@ -117,10 +128,17 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_new = alpha * l_prev + jnp.sum(prob, axis=1, keepdims=True)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
-        pv = jax.lax.dot_general(
-            prob.astype(v_ref.dtype), v_ref[0, 0],
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                # [1, D]
+        if vs_ref is not None:
+            v = v_ref[0, 0].astype(jnp.float32) * \
+                vs_ref[0, 0].astype(jnp.float32)[:, None]      # [ps, D]
+            pv = jax.lax.dot_general(
+                prob, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)            # [1, D]
+        else:
+            pv = jax.lax.dot_general(
+                prob.astype(v_ref.dtype), v_ref[0, 0],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)            # [1, D]
         acc_scr[:] = acc_scr[:] * alpha + pv
 
     @pl.when(p == n_pages - 1)
@@ -131,12 +149,42 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l_safe).reshape(-1).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(pt_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                         vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                         sm_scale, page_size):
+    """Positional-arg adapter for the int8 variant (pallas passes refs
+    in in_specs order: data pools then scale pools)."""
+    _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
+                   l_scr, acc_scr, sm_scale=sm_scale,
+                   page_size=page_size, ks_ref=ks_ref, vs_ref=vs_ref)
+
+
 def paged_decode_attention_pallas(q, k_pages, v_pages, page_table, lengths,
-                                  sm_scale):
+                                  sm_scale, k_scales=None, v_scales=None):
     B, H, D = q.shape
     page_size = k_pages.shape[2]
     NP = page_table.shape[1]
-    kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
+    quant = k_scales is not None
+    pool_spec = pl.BlockSpec((1, 1, page_size, D),
+                             lambda b, h, p, pt, ln: (pt[b, p], h, 0, 0))
+    # the scale pool rides the SAME scalar-prefetch LUT that resolves
+    # the data pool's page indirection — one page id, two DMAs
+    scale_spec = pl.BlockSpec((1, 1, page_size),
+                              lambda b, h, p, pt, ln: (pt[b, p], h, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, D), lambda b, h, p, pt, ln: (b, h, 0)),
+        pool_spec, pool_spec,
+    ]
+    args = [q, k_pages, v_pages]
+    kernel_fn = _decode_kernel
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        # scale pools stay at their storage dtype (bf16) on the wire;
+        # the kernel widens each [ps] tile in VMEM — a whole-pool fp32
+        # cast here would materialize a pool-sized copy every step
+        args += [k_scales, v_scales]
+        kernel_fn = _decode_kernel_quant
+    kernel = functools.partial(kernel_fn, sm_scale=sm_scale,
                                page_size=page_size)
     call = pl.pallas_call(
         kernel,
@@ -144,14 +192,7 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, page_table, lengths,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, H, NP),
-            in_specs=[
-                pl.BlockSpec((1, 1, D),
-                             lambda b, h, p, pt, ln: (b, h, 0)),
-                pl.BlockSpec((1, 1, page_size, D),
-                             lambda b, h, p, pt, ln: (pt[b, p], h, 0, 0)),
-                pl.BlockSpec((1, 1, page_size, D),
-                             lambda b, h, p, pt, ln: (pt[b, p], h, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, D),
                                    lambda b, h, p, pt, ln: (b, h, 0)),
             scratch_shapes=[
@@ -164,22 +205,31 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, page_table, lengths,
         interpret=_interpret(),
     )
     return call(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-                q, k_pages, v_pages)
+                *args)
 
 
 def paged_decode_attention_xla(q, k_pages, v_pages, page_table, lengths,
-                               sm_scale):
+                               sm_scale, k_scales=None, v_scales=None):
     """Pure-XLA reference/fallback: gather the sequence's pages back
     into a contiguous [B, H, S_max, D] view and run a masked softmax.
     Identical semantics to the kernel, including exact-zero outputs for
-    inactive (length 0) rows."""
+    inactive (length 0) rows and the int8 dequant at the gather."""
     B, H, D = q.shape
+    out_dtype = q.dtype
     page_size = k_pages.shape[2]
     NP = page_table.shape[1]
     k = jnp.moveaxis(k_pages[page_table], 2, 1).reshape(B, H, NP * page_size,
                                                         D)
     v = jnp.moveaxis(v_pages[page_table], 2, 1).reshape(B, H, NP * page_size,
                                                         D)
+    if k_scales is not None:
+        ks = jnp.moveaxis(k_scales[page_table], 2, 1).reshape(
+            B, H, NP * page_size)
+        vs = jnp.moveaxis(v_scales[page_table], 2, 1).reshape(
+            B, H, NP * page_size)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
+        q = q.astype(jnp.float32)
     s = jnp.einsum("bhd,bhsd->bhs", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     pos = jnp.arange(NP * page_size, dtype=jnp.int32)
@@ -191,14 +241,21 @@ def paged_decode_attention_xla(q, k_pages, v_pages, page_table, lengths,
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = jnp.einsum("bhs,bhsd->bhd", (prob / l_safe).astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.astype(out_dtype)
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
-                           sm_scale=None, backend=None):
+                           sm_scale=None, backend=None, k_scales=None,
+                           v_scales=None):
     """One decode step of paged attention: ``out[b, h] = softmax(q[b, h]
     · K[b]) · V[b]`` with K/V read through ``page_table[b]`` and masked
     at ``lengths[b]``.
+
+    ``k_scales``/``v_scales`` [P, page_size... = [P, H, page_size]]
+    mark int8 pools (`inference.kv_cache.QuantizedPages`): the kernel
+    dequantizes each page tile at the DMA boundary through the same
+    page-table LUT; the fallback dequantizes at the gather. Kernel and
+    fallback agree to float tolerance either way.
 
     backend: None = auto (Pallas kernel on TPU when
     `paged_decode_supported`, XLA fallback otherwise — CPU test runs
@@ -218,19 +275,33 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
                          f"[{B}, n_pages]")
     if lengths.shape != (B,):
         raise ValueError(f"lengths shape {lengths.shape} != ({B},)")
+    quant = k_scales is not None
+    if quant and (k_scales.shape != (P, Hk, page_size) or
+                  v_scales is None or
+                  v_scales.shape != (P, Hk, page_size)):
+        raise ValueError(
+            f"int8 pool scales must both be [{P}, {Hk}, {page_size}]; "
+            f"got {getattr(k_scales, 'shape', None)} / "
+            f"{getattr(v_scales, 'shape', None)}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
 
     if backend is None:
         on_tpu = not _interpret()
-        backend = ("pallas" if on_tpu and paged_decode_supported(D, page_size)
+        backend = ("pallas" if on_tpu and
+                   paged_decode_supported(D, page_size, quantized=quant)
                    else "xla")
     _LAST_BACKEND["decode"] = backend
+    _LAST_BACKEND["decode_kv"] = "int8" if quant else str(k_pages.dtype)
     _log_first_dispatch()
     if backend == "xla":
         return paged_decode_attention_xla(q, k_pages, v_pages, page_table,
-                                          lengths, sm_scale)
+                                          lengths, sm_scale,
+                                          k_scales=k_scales,
+                                          v_scales=v_scales)
     if backend != "pallas":
         raise ValueError(f"unknown paged decode backend {backend!r}")
     return paged_decode_attention_pallas(q, k_pages, v_pages, page_table,
-                                         lengths, sm_scale)
+                                         lengths, sm_scale,
+                                         k_scales=k_scales,
+                                         v_scales=v_scales)

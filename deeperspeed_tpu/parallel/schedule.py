@@ -345,6 +345,61 @@ def unpack_plan_row(plan, row):
     return out
 
 
+def plan_valid_mask(plan):
+    """Static [world, S] 0/1 mask of REAL lanes in a layer's gathered
+    row: flat-padded leaves contribute zeros past their natural numel
+    (rank-major layout — lane (r, off + i) is flat element r·size + i).
+    Their cotangents are exact zeros (`rebuild` slices them away), and
+    the compressed transport must keep them zero — sign(0) = +scale
+    would otherwise pollute grad norms and the flat-padded Adam tails."""
+    mask = np.ones((plan.world, plan.shard_size), np.float32)
+    for pl, off in zip(plan.placements, plan.offsets):
+        if not pl.gathered or pl.kind != FLAT_SHARDED:
+            continue
+        size = pl.size
+        flat_idx = (np.arange(plan.world)[:, None] * size
+                    + np.arange(size)[None, :])
+        mask[:, off:off + size] = (flat_idx < pl.pad.numel)
+    return mask
+
+
+def make_ef_gather(plan):
+    """Wrap `plan.gather_row` in a `custom_vjp` whose BACKWARD replaces
+    the plain `psum_scatter` transpose with the error-feedback
+    sign-compressed reduce-scatter (`runtime.comm.compressed`): the
+    cotangent of the gathered [world, S] buffer is exactly this rank's
+    full-size gradient contribution, i.e. the tensor 1-bit Adam
+    compresses on the DP wire.
+
+    The updated error buffer leaves the backward as the COTANGENT of
+    the error input — differentiate the loss w.r.t. (params, ef) and
+    the ef "gradient" IS the advanced error-feedback state (the
+    cotangent-smuggling idiom; no side channel exists out of a
+    transpose). Error state is fp32 regardless of the wire dtype.
+    Flat-pad lanes are masked out of the quantization scale and pinned
+    to zero (`plan_valid_mask`).
+    """
+    from ..runtime.comm.compressed import compressed_reduce_scatter
+
+    mask = plan_valid_mask(plan)
+    valid = None if mask.all() else jnp.asarray(mask)
+
+    @jax.custom_vjp
+    def gather_ef(row, werr):
+        return plan.gather_row(row)
+
+    def fwd(row, werr):
+        return plan.gather_row(row), werr
+
+    def bwd(werr, g):
+        out, new_err = compressed_reduce_scatter(
+            g, werr, plan.axis_name, plan.world, valid=valid)
+        return out.astype(plan.dtype), new_err
+
+    gather_ef.defvjp(fwd, bwd)
+    return gather_ef
+
+
 def _segment_sizes(n_layers, n_groups):
     """As-equal-as-possible group sizes (mirror of
     models.gpt_neox.segment_sizes, kept local to avoid a models import
@@ -354,7 +409,7 @@ def _segment_sizes(n_layers, n_groups):
             for i in range(n)]
 
 
-def make_group_body(block_fn, plan, depth, has_rows=True):
+def make_group_body(block_fn, plan, depth, has_rows=True, gather_fn=None):
     """One remat/prefetch group of uniform layers: python-unrolled, with
     bucketed gathers issued ``depth`` layers ahead in program order (the
     double-buffer XLA's latency-hiding scheduler overlaps with the layer
@@ -364,7 +419,11 @@ def make_group_body(block_fn, plan, depth, has_rows=True):
 
     Returns ``group_body(x, rows_g, rep_g) -> x`` where ``rows_g`` is a
     list of g per-layer [S] shard rows (or Nones when the plan has no
-    gathered leaves) and ``rep_g`` a list of g replicated-leaf lists."""
+    gathered leaves) and ``rep_g`` a list of g replicated-leaf lists.
+    ``gather_fn`` overrides the per-row gather (the error-feedback
+    compressed-gradient path passes (row, werr) entries through
+    `make_ef_gather`)."""
+    gather = gather_fn or plan.gather_row
 
     def group_body(x, rows_g, rep_g):
         g = len(rep_g)
@@ -372,10 +431,10 @@ def make_group_body(block_fn, plan, depth, has_rows=True):
         gathered = {}
         if has_rows:
             for j in range(d):
-                gathered[j] = plan.gather_row(rows_g[j])
+                gathered[j] = gather(rows_g[j])
         for i in range(g):
             if has_rows and i + d < g:
-                gathered[i + d] = plan.gather_row(rows_g[i + d])
+                gathered[i + d] = gather(rows_g[i + d])
             bp = plan.rebuild(gathered.pop(i) if has_rows else None,
                               rep_g[i])
             x = block_fn(bp, x)
@@ -386,7 +445,7 @@ def make_group_body(block_fn, plan, depth, has_rows=True):
 
 def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
                           prefetch_depth, group_layers, policy=None,
-                          remat=True):
+                          remat=True, ef=None):
     """Run ``n_layers`` uniform blocks over dp-sharded params with the
     explicit gather schedule.
 
@@ -407,6 +466,11 @@ def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
         gathered buffers saved as scan residuals (no re-gather, no
         recompute, ~one gathered param copy of extra live memory). The
         grad reduce-scatters still come from the gather transposes.
+      ef: optional [n_layers, world, S] error-feedback state (TRACED,
+        part of the caller's differentiated inputs): gathers route
+        through `make_ef_gather`, whose backward swaps the psum_scatter
+        transpose for the sign-compressed reduce-scatter — the advanced
+        error state comes back as the cotangent of ``ef``.
 
     Groups of equal size ride an outer `lax.scan` (compile O(group), not
     O(L)); ragged layer counts fall back to a Python loop over <= 2
@@ -417,7 +481,16 @@ def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
     rows = [plan.concat_shards(lv) for lv in layer_leaves]
     rep_by_layer = [rep for _, rep in split]
     has_rows = bool(rows) and rows[0] is not None
-    group_body = make_group_body(block_fn, plan, depth, has_rows=has_rows)
+    gather_fn = None
+    if ef is not None:
+        if not has_rows:
+            raise ValueError(
+                "gradient compression needs gathered (dp-sharded) "
+                "leaves; this plan holds only replicated leaves")
+        ef_g = make_ef_gather(plan)
+        gather_fn = lambda entry: ef_g(*entry)  # noqa: E731
+    group_body = make_group_body(block_fn, plan, depth, has_rows=has_rows,
+                                 gather_fn=gather_fn)
 
     sizes = _segment_sizes(n_layers, -(-n_layers // max(1,
                                                         int(group_layers))))
@@ -436,14 +509,29 @@ def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
                                 + np.shape(rep_by_layer[0][k]))
             for k in range(plan.n_replicated)]
 
-        body = (lambda x, rg, lg: group_body(
-            x, [rg[j] for j in range(g)],
+        def rows_of(rg, eg):
+            if eg is None:
+                return [rg[j] for j in range(g)]
+            return [(rg[j], eg[j]) for j in range(g)]
+
+        body = (lambda x, rg, eg, lg: group_body(
+            x, rows_of(rg, eg),
             [[lv[i] for lv in lg] for i in range(g)]))
         ck = jax.checkpoint(body, policy=policy) if remat else body
 
+        if ef is not None:
+            stacked_ef = ef.reshape((n_groups, g) + ef.shape[1:])
+
+            def scan_body(carry, xs):
+                rg, eg, lg = xs
+                return ck(carry, rg, eg, lg), None
+
+            return jax.lax.scan(
+                scan_body, x, (stacked_rows, stacked_ef, stacked_rep))[0]
+
         def scan_body(carry, xs):
             rg, lg = xs
-            return ck(carry, rg, lg), None
+            return ck(carry, rg, None, lg), None
 
         return jax.lax.scan(scan_body, x, (stacked_rows, stacked_rep))[0]
 
@@ -451,7 +539,10 @@ def prefetched_block_scan(block_fn, x, layer_leaves, plan, n_layers,
     idx = 0
     ck = jax.checkpoint(group_body, policy=policy) if remat else group_body
     for size in sizes:
-        x = ck(x, rows[idx:idx + size], rep_by_layer[idx:idx + size])
+        entries = rows[idx:idx + size]
+        if ef is not None:
+            entries = [(rows[i], ef[i]) for i in range(idx, idx + size)]
+        x = ck(x, entries, rep_by_layer[idx:idx + size])
         idx += size
     return x
 

@@ -82,6 +82,77 @@ def compressed_allreduce_dense_two_phase(x, worker_error, server_error,
     return out, new_worker_error, new_server_error
 
 
+def _sign_scale_masked(compensated, valid=None):
+    """The quantization law shared by the reduce-scatter transport and
+    its host oracle: sign() with an L1-mean magnitude over the VALID
+    lanes only. ``valid`` (0/1 mask, or None = all valid) marks
+    flat-pad tails: pad lanes must quantize to exactly 0 — sign(0)=+1
+    would write ±scale into lanes whose cotangents are exact zeros
+    (`LayerPlan` rebuild slices them away) and leak into grad norms and
+    the flat-padded Adam moment/master tails (the hazard
+    `compressed_allreduce_dense_two_phase` documents)."""
+    if valid is None:
+        scale = jnp.mean(jnp.abs(compensated))
+        q = jnp.where(compensated >= 0, scale, -scale)
+    else:
+        n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+        scale = jnp.sum(jnp.abs(compensated)) / n_valid
+        q = jnp.where(compensated >= 0, scale, -scale) * valid
+    return q, compensated - q
+
+
+def compressed_reduce_scatter(x, worker_error, axis_name, world,
+                              valid=None):
+    """Error-compensated 1-bit **reduce-scatter** — the worker phase of
+    the reference's two-phase allreduce without the server broadcast,
+    which is exactly what the explicit ZeRO-3 schedule needs at the
+    layer-backward boundary: each rank contributes a full-size gradient
+    buffer and keeps only ITS shard of the sum (the gather transpose's
+    `psum_scatter`), so the server requantization/allgather of the
+    allreduce variant has no consumer.
+
+    Args (inside shard_map over ``axis_name``):
+      x: [world, S] this rank's full cotangent of one gathered layer row
+         (chunk j is rank j's shard-gradient contribution).
+      worker_error: [world, S] fp32 error-feedback buffer (rank-local).
+      valid: optional static [world, S] 0/1 mask of REAL lanes —
+        flat-pad tails are excluded from the scale and pinned to 0 in
+        the output and the error buffer (`_sign_scale_masked`).
+    Returns ([S] sign-compressed rank-SUM of this rank's chunk,
+    new_worker_error). Wire volume ≈ n/8 sign bytes + one fp32 scale per
+    rank vs 4·n bytes for the fp32 reduce-scatter (here carried by dense
+    collectives — the repo's documented transport discipline: parity
+    targets the quantization numerics, a packed wire swaps in under the
+    same API).
+    """
+    compensated = x.astype(jnp.float32) + worker_error
+    if valid is not None:
+        compensated = compensated * valid
+    quantized, new_error = _sign_scale_masked(compensated, valid)
+    if axis_name is None or world == 1:
+        return quantized.reshape(-1), new_error
+    out = jax.lax.psum_scatter(quantized, axis_name,
+                               scatter_dimension=0, tiled=True)
+    return out.reshape(-1), new_error
+
+
+def compressed_reduce_scatter_host(xs, worker_errors, valid=None):
+    """Single-process oracle of `compressed_reduce_scatter` (one
+    [world, S] buffer per simulated rank): returns (per-rank [S] output
+    chunks, new per-rank worker errors)."""
+    world = len(xs)
+    quantized, new_errors = [], []
+    for x, err in zip(xs, worker_errors):
+        compensated = jnp.asarray(x, jnp.float32) + err
+        if valid is not None:
+            compensated = compensated * valid
+        q, e = _sign_scale_masked(compensated, valid)
+        quantized.append(q)
+        new_errors.append(e)
+    outs = [sum(q[r] for q in quantized) for r in range(world)]
+    return outs, new_errors
+
+
 def pack_signs(bits):
     """Pack a sign-bit array (bool/int, last dim % 8 == 0) into uint8 —
     the XLA equivalent of the reference's cupy bit packing

@@ -252,11 +252,28 @@ def parse_inference_block(d):
 
     kv_dtype = inf.get(c.INFERENCE_KV_DTYPE, c.INFERENCE_KV_DTYPE_DEFAULT)
     if kv_dtype is not None:
-        if not isinstance(kv_dtype, str):
+        # validated against the POOL dtypes the paged cache implements,
+        # not resolve_precision's full spelling table: an unsupported
+        # pool dtype must fail here with the choices listed, not as a
+        # late kernel error far from the config
+        if not isinstance(kv_dtype, str) or \
+                kv_dtype.lower() not in c.INFERENCE_KV_DTYPE_CHOICES:
             raise DeepSpeedConfigError(
-                f"inference.{c.INFERENCE_KV_DTYPE} must be a dtype name "
-                f"string or null, got {kv_dtype!r}")
-        resolve_precision(kv_dtype)   # raises on unknown names
+                f"inference.{c.INFERENCE_KV_DTYPE} must be null (the "
+                f"params' compute dtype) or a supported pool precision "
+                f"{sorted(c.INFERENCE_KV_DTYPE_CHOICES)}, got "
+                f"{kv_dtype!r}")
+        kv_dtype = kv_dtype.lower()
+        if kv_dtype == "int8" and kernel == "pallas" and \
+                ints[c.INFERENCE_PAGE_SIZE] % 32:
+            # the int8 decode kernel needs the int8 sublane tile; with
+            # kernel "auto" a misaligned page_size silently takes the
+            # XLA fallback (documented), but a FORCED kernel must fail
+            # here, not as a Mosaic tiling error at bucket warmup
+            raise DeepSpeedConfigError(
+                f"inference.kernel \"pallas\" with kv_cache_dtype "
+                f"\"int8\" needs page_size % 32 == 0 (the int8 sublane "
+                f"tile), got {ints[c.INFERENCE_PAGE_SIZE]}")
 
     drain_deadline = inf.get(c.INFERENCE_DRAIN_DEADLINE,
                              c.INFERENCE_DRAIN_DEADLINE_DEFAULT)
@@ -453,6 +470,115 @@ def _parse_inference_retry(block):
 
     return {"max_attempts": attempts, "backoff_base_ms": float(base),
             "backoff_cap_ms": float(cap), "jitter": float(jitter)}
+
+
+def parse_quantization_block(d):
+    """Parse + validate the "quantization" block (docs/quantization.md):
+    serving int8 weights, the delayed-scaling fp8/int8 FFN, and
+    error-feedback compressed gradients. Module-level so the
+    `InferenceEngine` can validate a raw config dict (it consumes
+    ``weights``); the training engine consumes ``ffn`` and
+    ``gradient_compression``. Same parse-time strictness as the
+    "checkpoint" block.
+
+    Returns {"weights": str|None, "ffn": dict|None,
+    "gradient_compression": bool} or False when absent/disabled."""
+    qz = d.get(c.QUANTIZATION)
+    if qz is None:
+        return False
+    if not isinstance(qz, dict):
+        raise DeepSpeedConfigError(
+            f"'{c.QUANTIZATION}' must be an object, got "
+            f"{type(qz).__name__}")
+    known = {c.QUANTIZATION_ENABLED, c.QUANTIZATION_WEIGHTS,
+             c.QUANTIZATION_FFN, c.QUANTIZATION_GRAD_COMPRESSION}
+    unknown = sorted(set(qz) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown '{c.QUANTIZATION}' key(s) {unknown}; valid keys: "
+            f"{sorted(known)}")
+    enabled = qz.get(c.QUANTIZATION_ENABLED,
+                     c.QUANTIZATION_ENABLED_DEFAULT)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            f"{c.QUANTIZATION}.{c.QUANTIZATION_ENABLED} must be a "
+            f"boolean, got {enabled!r}")
+    if not enabled:
+        return False
+
+    weights = qz.get(c.QUANTIZATION_WEIGHTS,
+                     c.QUANTIZATION_WEIGHTS_DEFAULT)
+    if weights is not None and \
+            weights not in c.QUANTIZATION_WEIGHTS_CHOICES:
+        raise DeepSpeedConfigError(
+            f"{c.QUANTIZATION}.{c.QUANTIZATION_WEIGHTS} must be null or "
+            f"one of {list(c.QUANTIZATION_WEIGHTS_CHOICES)}, got "
+            f"{weights!r}")
+
+    ffn = qz.get(c.QUANTIZATION_FFN)
+    if ffn is not None:
+        if not isinstance(ffn, dict):
+            raise DeepSpeedConfigError(
+                f"{c.QUANTIZATION}.{c.QUANTIZATION_FFN} must be an "
+                f"object, got {type(ffn).__name__}")
+        fknown = {c.QUANTIZATION_FFN_RECIPE, c.QUANTIZATION_FFN_HISTORY,
+                  c.QUANTIZATION_FFN_MARGIN}
+        funknown = sorted(set(ffn) - fknown)
+        if funknown:
+            raise DeepSpeedConfigError(
+                f"Unknown '{c.QUANTIZATION}.{c.QUANTIZATION_FFN}' "
+                f"key(s) {funknown}; valid keys: {sorted(fknown)}")
+        recipe = ffn.get(c.QUANTIZATION_FFN_RECIPE)
+        if recipe not in c.QUANTIZATION_FFN_RECIPE_CHOICES:
+            raise DeepSpeedConfigError(
+                f"{c.QUANTIZATION}.{c.QUANTIZATION_FFN}."
+                f"{c.QUANTIZATION_FFN_RECIPE} is required and must be "
+                f"one of {list(c.QUANTIZATION_FFN_RECIPE_CHOICES)}, got "
+                f"{recipe!r}")
+        hist = as_int(ffn.get(c.QUANTIZATION_FFN_HISTORY,
+                              c.QUANTIZATION_FFN_HISTORY_DEFAULT),
+                      f"{c.QUANTIZATION}.{c.QUANTIZATION_FFN}."
+                      f"{c.QUANTIZATION_FFN_HISTORY}")
+        if hist < 1:
+            raise DeepSpeedConfigError(
+                f"{c.QUANTIZATION}.{c.QUANTIZATION_FFN}."
+                f"{c.QUANTIZATION_FFN_HISTORY} must be >= 1, got {hist}")
+        margin = ffn.get(c.QUANTIZATION_FFN_MARGIN,
+                         c.QUANTIZATION_FFN_MARGIN_DEFAULT)
+        if not isinstance(margin, (int, float)) or \
+                isinstance(margin, bool) or margin <= 0:
+            raise DeepSpeedConfigError(
+                f"{c.QUANTIZATION}.{c.QUANTIZATION_FFN}."
+                f"{c.QUANTIZATION_FFN_MARGIN} must be a number > 0, got "
+                f"{margin!r}")
+        ffn = {"recipe": recipe, "amax_history_len": hist,
+               "margin": float(margin)}
+
+    gc = qz.get(c.QUANTIZATION_GRAD_COMPRESSION)
+    grad_compression = False
+    if gc is not None:
+        if not isinstance(gc, dict):
+            raise DeepSpeedConfigError(
+                f"{c.QUANTIZATION}.{c.QUANTIZATION_GRAD_COMPRESSION} "
+                f"must be an object, got {type(gc).__name__}")
+        gknown = {c.QUANTIZATION_GRAD_COMPRESSION_ENABLED}
+        gunknown = sorted(set(gc) - gknown)
+        if gunknown:
+            raise DeepSpeedConfigError(
+                f"Unknown '{c.QUANTIZATION}."
+                f"{c.QUANTIZATION_GRAD_COMPRESSION}' key(s) {gunknown}; "
+                f"valid keys: {sorted(gknown)}")
+        grad_compression = gc.get(
+            c.QUANTIZATION_GRAD_COMPRESSION_ENABLED,
+            c.QUANTIZATION_GRAD_COMPRESSION_ENABLED_DEFAULT)
+        if not isinstance(grad_compression, bool):
+            raise DeepSpeedConfigError(
+                f"{c.QUANTIZATION}.{c.QUANTIZATION_GRAD_COMPRESSION}."
+                f"{c.QUANTIZATION_GRAD_COMPRESSION_ENABLED} must be a "
+                f"boolean, got {grad_compression!r}")
+
+    return {"weights": weights, "ffn": ffn,
+            "gradient_compression": grad_compression}
 
 
 class DeepSpeedConfigWriter:
@@ -722,6 +848,10 @@ class DeepSpeedConfig:
         # so InferenceEngine validates raw dicts identically.
         self.inference_params = parse_inference_block(d)
         self.inference_enabled = bool(self.inference_params)
+
+        # Low-precision hot paths (docs/quantization.md); module-level
+        # parse so InferenceEngine validates raw dicts identically.
+        self.quantization_config = parse_quantization_block(d) or None
 
         # Fork additions: gradient storage for debugging.
         self.store_gradients = bool(

@@ -423,9 +423,14 @@ INFERENCE_SEED_DEFAULT = 0
 INFERENCE_KERNEL = "kernel"
 INFERENCE_KERNEL_DEFAULT = "auto"
 INFERENCE_KERNEL_CHOICES = ("auto", "pallas", "xla")
-# KV-cache storage dtype: null = the params' compute dtype
+# KV-cache storage dtype: null = the params' compute dtype. Validated
+# at parse time against the POOL dtypes the cache actually implements —
+# any other resolve_precision spelling used to slip through and surface
+# as a late kernel error far from the config.
 INFERENCE_KV_DTYPE = "kv_cache_dtype"
 INFERENCE_KV_DTYPE_DEFAULT = None
+INFERENCE_KV_DTYPE_CHOICES = ("bfloat16", "bf16", "float16", "fp16",
+                              "half", "float32", "fp32", "float", "int8")
 
 # Graceful drain (SIGTERM): stop admissions, finish in-flight sequences
 # for at most this many seconds, flush Serve/* telemetry, exit 0.
@@ -474,3 +479,29 @@ INFERENCE_RETRY_JITTER_DEFAULT = 0.25
 # serving fault injection (runtime/fault_injection.py serving kinds);
 # same schema as training_health.fault_injection
 INFERENCE_FAULT_INJECTION = "fault_injection"
+
+# ---------------------------------------------------------------------------
+# Quantization (docs/quantization.md): low-precision hot paths — serving
+# int8 weights, delayed-scaling fp8/int8 FFN matmuls, compressed
+# cross-host gradients on the explicit ZeRO-3 schedule
+# ---------------------------------------------------------------------------
+QUANTIZATION = "quantization"
+QUANTIZATION_ENABLED = "enabled"
+QUANTIZATION_ENABLED_DEFAULT = True
+# serving weight-only quantization (module_inject.prepare_inference_params)
+QUANTIZATION_WEIGHTS = "weights"
+QUANTIZATION_WEIGHTS_DEFAULT = None
+QUANTIZATION_WEIGHTS_CHOICES = ("int8",)
+# delayed-scaling quantized FFN (training; ops/pallas/quant_matmul)
+QUANTIZATION_FFN = "ffn"
+QUANTIZATION_FFN_RECIPE = "recipe"
+QUANTIZATION_FFN_RECIPE_CHOICES = ("int8", "fp8")
+QUANTIZATION_FFN_HISTORY = "amax_history_len"
+QUANTIZATION_FFN_HISTORY_DEFAULT = 16
+QUANTIZATION_FFN_MARGIN = "margin"
+QUANTIZATION_FFN_MARGIN_DEFAULT = 1.0
+# error-feedback compressed gradients on the cross-host DP axis of the
+# explicit ZeRO-3 schedule (runtime/comm/compressed.py)
+QUANTIZATION_GRAD_COMPRESSION = "gradient_compression"
+QUANTIZATION_GRAD_COMPRESSION_ENABLED = "enabled"
+QUANTIZATION_GRAD_COMPRESSION_ENABLED_DEFAULT = True

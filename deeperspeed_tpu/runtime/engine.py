@@ -83,6 +83,18 @@ def _place_opt_state(opt_state, master, master_sh, mesh):
     return type(opt_state)(*[place_field(f) for f in opt_state])
 
 
+class QuantState(NamedTuple):
+    """Quantization state riding `EngineState.quant` (docs/quantization.md):
+    ``amax`` is the delayed-scaling FFN's per-layer amax history
+    [L, 4, H] (None when quantization.ffn is off); ``ef`` the
+    error-feedback buffers of the compressed-gradient reduce-scatter,
+    [dp, L, dp, S] sharded over the data axis (None when
+    quantization.gradient_compression is off). Both are checkpointed in
+    model_states for bit-exact resume."""
+    amax: Any = None
+    ef: Any = None
+
+
 class EngineState(NamedTuple):
     """Device-resident training state; a pytree carried through jit."""
     params: Any               # compute-dtype params (ZeRO-3: sharded)
@@ -95,6 +107,11 @@ class EngineState(NamedTuple):
     # "training_health" block is enabled; None otherwise — None is an
     # empty pytree node, so every existing path traces unchanged.
     health: Any = None
+    # Quantization state (QuantState: amax history + error-feedback
+    # buffers) when the "quantization" block arms a training path; the
+    # same trailing-default discipline as `health` — every
+    # quantization-off path traces unchanged.
+    quant: Any = None
 
 
 class StepMetrics(NamedTuple):
@@ -442,7 +459,13 @@ class DeepSpeedEngine:
             # run silently trains with cross-document attention / dense
             # kernels the config said to replace
             or bool(getattr(self._config, "packing_params", None))
-            or bool(getattr(self._config, "sparse_attention", None)))
+            or bool(getattr(self._config, "sparse_attention", None))
+            # quantization.ffn swaps the FFN matmuls for the
+            # delayed-scaling quantized pair — a model that cannot
+            # consume it must fail loudly, or the run silently trains
+            # full-precision
+            or bool((getattr(self._config, "quantization_config", None)
+                     or {}).get("ffn")))
         if model_blocks_active:
             from .pipe.module import PipelineModule
             if self._config.moe_enabled and \
@@ -488,6 +511,15 @@ class DeepSpeedEngine:
         zsched = self._config.zero_config.schedule
         if zsched.mode == "explicit":
             self._configure_explicit_zero3(zsched)
+
+        # --- quantization (docs/quantization.md): delayed-scaling FFN
+        # amax history and/or compressed-gradient error feedback ride
+        # EngineState.quant (after _init_state + the explicit schedule:
+        # the EF buffers need the schedule's layer-plan geometry) ------
+        self._quant_step_active = False
+        qz = self._config.quantization_config
+        if qz and (qz.get("ffn") or qz.get("gradient_compression")):
+            self._configure_quantization(qz)
 
         # --- bookkeeping --------------------------------------------------
         self.global_steps = 0
@@ -738,6 +770,192 @@ class DeepSpeedEngine:
                 mesh=self.mesh, data_axis=self.data_axis,
                 param_specs=specs, param_padinfo=self._param_padinfo,
                 schedule=sched)
+
+    def _configure_quantization(self, qz):
+        """Arm the training-side quantization paths (docs/quantization.md)
+        and seat their state in `EngineState.quant`:
+
+        - ``quantization.ffn``: the model's FFN matmuls already run the
+          delayed-scaling recipe (`apply_ds_config` wired it before
+          param init); here the per-layer amax history is allocated and
+          the step threads it through `loss_fn(..., ffn_amax=)`.
+        - ``quantization.gradient_compression``: the explicit ZeRO-3
+          schedule's layer-gather transposes swap to the error-feedback
+          sign-compressed reduce-scatter; the EF buffers are allocated
+          dp-sharded here.
+
+        Both states are checkpointed in model_states for bit-exact
+        resume. Unsupported combos reject loudly — a silently inert
+        quantization block is the failure mode this method exists to
+        prevent."""
+        ffn = qz.get("ffn")
+        compress = bool(qz.get("gradient_compression"))
+        if self._onebit_packed_active():
+            raise DeepSpeedConfigError(
+                "the quantization block + packed-transport 1-bit "
+                "optimizers is unsupported (the 1-bit optimizer already "
+                "owns the compressed wire and the whole-step shard_map)")
+        if self.host_offload or self.param_offload or \
+                self._tiered is not None:
+            raise DeepSpeedConfigError(
+                "quantization.ffn/gradient_compression on the offload "
+                "tiers is unsupported (their step bodies do not thread "
+                "the quantization state); drop offload_param/"
+                "offload_optimizer or the quantization block")
+        if self._config.pld_enabled:
+            raise DeepSpeedConfigError(
+                "quantization + progressive_layer_drop is unsupported "
+                "(theta and the amax state cannot both thread through "
+                "the block scan yet)")
+
+        amax = None
+        if ffn:
+            if self._explicit_zero3_loss is not None:
+                raise DeepSpeedConfigError(
+                    "quantization.ffn with the explicit ZeRO-3 schedule "
+                    "is unsupported (the scheduled block scan does not "
+                    "thread amax state); use schedule.mode \"gspmd\", "
+                    "or drop quantization.ffn and keep "
+                    "gradient_compression")
+            if not hasattr(self.module_obj, "init_ffn_amax"):
+                raise DeepSpeedConfigError(
+                    "quantization.ffn needs a model exposing "
+                    "init_ffn_amax()/loss_fn(ffn_amax=...) "
+                    "(models.gpt_neox.GPTNeoX implements it)")
+            amax = self.module_obj.init_ffn_amax()
+            if amax is None:
+                raise DeepSpeedConfigError(
+                    "quantization.ffn is configured but the model has "
+                    "no ffn_quant recipe — apply_ds_config did not "
+                    "reach it (pass the config to deepspeed.initialize)")
+
+        ef = None
+        if compress:
+            if self._explicit_zero3_loss is None:
+                raise DeepSpeedConfigError(
+                    "quantization.gradient_compression requires the "
+                    "explicit ZeRO-3 schedule "
+                    "(zero_optimization.schedule.mode \"explicit\"): "
+                    "only the scheduled program owns its gradient "
+                    "collectives — the GSPMD partitioner's cannot be "
+                    "swapped for the compressed transport")
+            if self._config.loss_scaling_enabled:
+                raise DeepSpeedConfigError(
+                    "quantization.gradient_compression + fp16 loss "
+                    "scaling is unsupported: the error-feedback buffers "
+                    "accumulate SCALED-gradient residuals, so a dynamic "
+                    "scale change would replay carried error at the "
+                    "wrong magnitude; use bf16/fp32 (no loss scaling)")
+            from ..parallel.schedule import LayerPlan
+            sched = self._config.zero_config.schedule
+            world = int(self.mesh.shape[self.data_axis])
+            specs = jax.tree_util.tree_map(lambda sh: sh.spec,
+                                           self._param_sh)
+            plan = LayerPlan(
+                self.state.params["blocks"][0], specs["blocks"][0],
+                self._param_padinfo["blocks"][0], self.data_axis, world,
+                sched.bucket_bytes)
+            L = len(self.state.params["blocks"])
+            # per-rank error buffer = [L, world, S] (the cotangent of
+            # each layer's gathered row); leading dp dim shards each
+            # rank's buffer to its owner — the 1-bit Adam EF layout
+            ef = jax.device_put(
+                jnp.zeros((world, L, world, plan.shard_size),
+                          jnp.float32),
+                NamedSharding(self.mesh,
+                              PartitionSpec(self.data_axis)))
+            self._ef_template_shape = (world, L, world, plan.shard_size)
+
+        self.state = self.state._replace(quant=QuantState(amax=amax,
+                                                          ef=ef))
+        self._quant_step_active = True
+        log_dist(
+            f"quantization armed: ffn="
+            f"{ffn['recipe'] if ffn else None}, "
+            f"gradient_compression={compress}", ranks=[0])
+
+    def _quant_state_dict(self):
+        """Host snapshot of `EngineState.quant` for model_states (None
+        when no quantization path is armed). The amax history is
+        replicated and snapshots everywhere; the EF buffers are
+        dp-SHARDED — on a multi-process mesh they are not fully
+        addressable from one host, so they degrade to None (resume
+        restarts error feedback from zeros; warned ONCE per engine —
+        autosave cadence would otherwise spam every save) rather than
+        killing every save. Per-shard EF payloads need the zero-shard
+        writer discipline — ROADMAP item 5."""
+        q = getattr(self.state, "quant", None)
+        if q is None:
+            return None
+        ef = None
+        if q.ef is not None:
+            if jax.process_count() == 1:
+                ef = np.asarray(q.ef)
+            elif not getattr(self, "_warned_ef_multiproc", False):
+                self._warned_ef_multiproc = True
+                logger.warning(
+                    "gradient-compression error-feedback buffers are "
+                    "dp-sharded across processes and are not "
+                    "checkpointed on multi-process meshes yet; a resume "
+                    "restarts error feedback from zeros")
+        return {
+            "amax": np.asarray(q.amax) if q.amax is not None else None,
+            "ef": ef,
+        }
+
+    def _restore_quant_state(self, payload):
+        """Re-seat checkpointed quantization state. Rules:
+        - engine armed + payload present: restore (amax always; EF only
+          when the dp topology matches — a dp change re-deals the
+          gather geometry, so stale error buffers would compensate
+          gradients that no longer exist: warn + reinit zeros).
+        - engine armed + no payload (older checkpoint / was off):
+          keep the freshly-initialized zero state.
+        - engine not armed: a payload is ignored with a warning (the
+          run continues full-precision as configured)."""
+        q = getattr(self.state, "quant", None)
+        if q is None:
+            if payload and (payload.get("amax") is not None or
+                            payload.get("ef") is not None):
+                logger.warning(
+                    "checkpoint carries quantization state but this "
+                    "engine has no quantization block — ignoring it "
+                    "(the run continues as configured)")
+            return
+        if not payload:
+            logger.warning(
+                "quantization is armed but the checkpoint has no "
+                "quantization state (saved before the block was "
+                "enabled?) — amax history / error feedback restart "
+                "from zeros")
+            return
+        amax, ef = q.amax, q.ef
+        if amax is not None and payload.get("amax") is not None:
+            saved = jnp.asarray(payload["amax"], jnp.float32)
+            if saved.shape == amax.shape:
+                amax = saved
+            else:
+                logger.warning(
+                    f"saved amax history {saved.shape} does not match "
+                    f"the configured {amax.shape} "
+                    f"(amax_history_len/layer change?) — restarting "
+                    f"from zeros")
+        if ef is not None and payload.get("ef") is not None:
+            saved = payload["ef"]
+            if tuple(saved.shape) == tuple(
+                    getattr(self, "_ef_template_shape", ef.shape)):
+                ef = jax.device_put(
+                    jnp.asarray(saved, jnp.float32),
+                    NamedSharding(self.mesh,
+                                  PartitionSpec(self.data_axis)))
+            else:
+                logger.warning(
+                    f"saved error-feedback buffers {tuple(saved.shape)} "
+                    f"do not match the current dp topology "
+                    f"{tuple(ef.shape)} — error feedback restarts from "
+                    f"zeros (a dp change re-deals the gather geometry)")
+        self.state = self.state._replace(quant=QuantState(amax=amax,
+                                                          ef=ef))
 
     @staticmethod
     def _resolve_model(model):
@@ -1393,8 +1611,13 @@ class DeepSpeedEngine:
     # jitted step builders
     # ------------------------------------------------------------------
 
-    def _loss_and_grads(self, params, batch, rng, scale, pld_theta=None):
-        """(scaled loss grads, unscaled loss); grads constrained for ZeRO-2."""
+    def _loss_and_grads(self, params, batch, rng, scale, pld_theta=None,
+                        quant=None):
+        """(scaled loss grads, unscaled loss); grads constrained for
+        ZeRO-2. With ``quant`` (the step's `QuantState`) the return is
+        (loss, grads, new_quant): the delayed-scaling FFN threads its
+        amax history through `loss_fn(ffn_amax=)`, the explicit schedule
+        threads the compressed-gradient error feedback."""
         kw = {}
         if pld_theta is not None and self._pld_in_loss:
             kw["pld_theta"] = pld_theta
@@ -1405,8 +1628,28 @@ class DeepSpeedEngine:
             # boundaries are scheduled in the program, and the grads
             # come back already in the stage-3 storage sharding — the
             # GSPMD constraint below would be a no-op
-            return self._explicit_zero3_loss(params, batch, rng,
-                                             scale=scale)
+            if quant is not None and quant.ef is not None:
+                loss, grads, new_ef = self._explicit_zero3_loss(
+                    params, batch, rng, scale=scale, ef=quant.ef)
+                return loss, grads, quant._replace(ef=new_ef)
+            out = self._explicit_zero3_loss(params, batch, rng,
+                                            scale=scale)
+            return out + (quant,) if quant is not None else out
+
+        if quant is not None and quant.amax is not None:
+            def scaled_loss_q(p):
+                loss, new_amax = self.loss_fn(
+                    self._compute_view(p), batch, rng,
+                    ffn_amax=quant.amax, **kw)
+                return loss * scale.astype(loss.dtype), (loss, new_amax)
+
+            (_, (loss, new_amax)), grads = jax.value_and_grad(
+                scaled_loss_q, has_aux=True)(params)
+            if self.zero_rules.stage >= 2:
+                grads = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, grads,
+                    self._grad_sh)
+            return loss, grads, quant._replace(amax=new_amax)
 
         direct = getattr(self.loss_fn, "loss_and_grads", None)
         # gated on flat-padded params: the slow path's VJP through
@@ -1434,7 +1677,8 @@ class DeepSpeedEngine:
                 jax.lax.with_sharding_constraint, grads, self._grad_sh)
         return loss, grads
 
-    def _apply_update(self, state, grads, lr, axis_name=None, loss=None):
+    def _apply_update(self, state, grads, lr, axis_name=None, loss=None,
+                      quant=None):
         """Unscale, clip, update masters, recast; skip cleanly on overflow.
 
         `loss` (standard train_batch path) feeds the training-health
@@ -1577,6 +1821,18 @@ class DeepSpeedEngine:
         # `skipped_steps` stays the loss-scale skip counter (reference
         # semantics); sentinel quarantines are counted separately in
         # HealthState.quarantined. Neither advances `global_steps`.
+        # quant state rides the SAME branchless skip as masters/moments:
+        # a skipped step's grads are overflowed/anomalous by definition,
+        # and carrying their amax/error-feedback forward would poison
+        # the history (scale=mean|NaN|=NaN → every later step NaN — the
+        # exact spiral the skip machinery exists to break)
+        new_quant = state.quant
+        if quant is not None:
+            new_quant = quant if skip is False else \
+                jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(skip, o, n), quant,
+                    state.quant)
+
         new_state = EngineState(
             params=new_params,
             master=new_master if state.master is not None else None,
@@ -1586,7 +1842,8 @@ class DeepSpeedEngine:
             jnp.where(skip, 0, 1).astype(jnp.int32),
             skipped_steps=state.skipped_steps +
             jnp.where(overflow, 1, 0).astype(jnp.int32),
-            health=new_health)
+            health=new_health,
+            quant=new_quant)
         return new_state, StepMetrics(loss=jnp.asarray(0.0), grad_norm=grad_norm,
                                       overflow=overflow, loss_scale=scale)
 
@@ -1764,38 +2021,51 @@ class DeepSpeedEngine:
         if self._onebit_packed_active():
             return self._onebit_packed_step(accum_steps)
 
-        def step_tail(state, loss, grads, lr, fault):
+        def step_tail(state, loss, grads, lr, fault, new_quant=None):
             """Shared tail: optional fault injection, then the update
             (the probe inside `_apply_update` sees the step loss)."""
             if with_fault:
                 from .fault_injection import apply_fault
                 loss, grads = apply_fault(loss, grads, fault)
             new_state, metrics = self._apply_update(state, grads, lr,
-                                                    loss=loss)
+                                                    loss=loss,
+                                                    quant=new_quant)
             return new_state, metrics._replace(
                 loss=loss.astype(jnp.float32))
 
         def train_step(state, batches, rng, lr, fault=None):
             scale = state.scale.cur_scale
             theta = self._pld_theta_in_jit(state.global_steps)
+            quant = state.quant if self._quant_step_active else None
 
             if accum_steps == 1:
                 # no accumulation: skip the zeros-init/add/divide passes
                 # over the gradient tree (the optimizer casts to fp32
                 # inside its own fused update)
                 mb = jax.tree_util.tree_map(lambda b: b[0], batches)
-                loss, grads = self._loss_and_grads(state.params, mb, rng,
-                                                   scale, pld_theta=theta)
-                return step_tail(state, loss, grads, lr, fault)
+                res = self._loss_and_grads(state.params, mb, rng,
+                                           scale, pld_theta=theta,
+                                           quant=quant)
+                if quant is not None:
+                    loss, grads, new_quant = res
+                else:
+                    (loss, grads), new_quant = res, None
+                return step_tail(state, loss, grads, lr, fault, new_quant)
 
             def micro(carry, xs):
-                grads_acc, loss_acc = carry
+                grads_acc, loss_acc, q = carry
                 mb, mb_rng = xs
-                loss, grads = self._loss_and_grads(state.params, mb, mb_rng,
-                                                   scale, pld_theta=theta)
+                res = self._loss_and_grads(state.params, mb, mb_rng,
+                                           scale, pld_theta=theta,
+                                           quant=q)
+                if q is not None:
+                    loss, grads, q = res
+                else:
+                    loss, grads = res
                 grads_acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
-                return (grads_acc, loss_acc + loss.astype(jnp.float32)), None
+                return (grads_acc, loss_acc + loss.astype(jnp.float32),
+                        q), None
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
@@ -1804,13 +2074,13 @@ class DeepSpeedEngine:
                     jax.lax.with_sharding_constraint, zero_grads,
                     self._grad_sh)
             rngs = jax.random.split(rng, accum_steps)
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro, (zero_grads, jnp.asarray(0.0, jnp.float32)),
+            (grads, loss_sum, new_quant), _ = jax.lax.scan(
+                micro, (zero_grads, jnp.asarray(0.0, jnp.float32), quant),
                 (batches, rngs))
             grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
             mean_loss = loss_sum / accum_steps
 
-            return step_tail(state, mean_loss, grads, lr, fault)
+            return step_tail(state, mean_loss, grads, lr, fault, new_quant)
 
         return train_step
 
@@ -2403,6 +2673,12 @@ class DeepSpeedEngine:
             raise RuntimeError(
                 "forward/backward/step needs full params on device; with "
                 "offload_param use train_batch (layer-streamed)")
+        if self._quant_step_active:
+            raise RuntimeError(
+                "the manual forward()/backward()/step() API does not "
+                "thread the quantization state (amax history / "
+                "error-feedback buffers would silently go stale); use "
+                "train_batch()/train_steps()")
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         self._assert_comm_precision()

@@ -31,3 +31,20 @@ def resolve_precision(type_str):
 def needs_loss_scaling(dtype):
     """Only fp16 needs loss scaling; bf16 has fp32's exponent range."""
     return dtype == jnp.float16
+
+
+def resolve_kv_cache_dtype(type_str):
+    """Map an "inference.kv_cache_dtype" spelling to a jnp POOL dtype —
+    the float spellings plus ``"int8"`` (quantized pages with per-page
+    scale pools, `inference.kv_cache`). Parse-time validation lists the
+    choices (`constants.INFERENCE_KV_DTYPE_CHOICES`); this resolver
+    raises identically for direct callers."""
+    from .constants import INFERENCE_KV_DTYPE_CHOICES
+    s = str(type_str).lower()
+    if s not in INFERENCE_KV_DTYPE_CHOICES:
+        raise DeepSpeedConfigError(
+            f"Unknown kv_cache_dtype {type_str!r}; expected one of "
+            f"{sorted(INFERENCE_KV_DTYPE_CHOICES)}")
+    if s == "int8":
+        return jnp.int8
+    return resolve_precision(s)

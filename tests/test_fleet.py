@@ -750,7 +750,8 @@ class TestDispatchReport:
     def test_accessor_shape(self):
         from deeperspeed_tpu.ops import dispatch_report
         report = dispatch_report()
-        assert set(report) == {"flash", "decode_attention"}
+        assert set(report) == {"flash", "decode_attention",
+                               "quant_matmul"}
         assert isinstance(report["flash"], dict)
 
     def test_decode_records_backend_and_logs_once(self, ds_logs):
