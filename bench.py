@@ -45,6 +45,13 @@ KEYS (default all):
              TTFT degradation storm-vs-clean, and the chaos invariants
              (server up, zero leaked pages, zero post-warmup
              recompiles); opt-in via DS_BENCH_SERVE_CHAOS=1)
+  - serve_prefix (prefix-cache + speculative-decode serving row: a
+             bursty 80%-shared-prefix stream run cache-off, then with
+             the prefix registry + a small draft model after a
+             two-stream warmup; prefix hit rate, effective prefill
+             tok/s vs cache-off, spec acceptance rate, p50 inter-token
+             speedup, steady-state compile delta (must be 0); opt-in
+             via DS_BENCH_SERVE_PREFIX=1)
   - elastic  (supervised-restart recovery: a hard mid-run kill under the
              elasticity supervisor — kill -> resumed-step wall clock
              (MTTR) and steps lost vs the committed checkpoint; opt-in
@@ -88,6 +95,7 @@ ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
                "moe": 800, "serve": 800, "serve_chaos": 900,
+               "serve_prefix": 900,
                "zero3": 800, "pipe": 900, "offload": 1100,
                "elastic": 600, "fleet": 600,
                "quant": 1100}  # moe/longseq/quant walk both engines
@@ -1396,6 +1404,140 @@ def row_serve_chaos():
     return out
 
 
+def row_serve_prefix():
+    """Prefix-cache + speculative-decode serving row (opt-in via
+    DS_BENCH_SERVE_PREFIX=1): a bursty stream where 80% of the prompts
+    share one long prefix — the archetypal system-prompt fleet — run
+    through (1) a cache-off baseline engine and (2) an engine with the
+    prefix registry AND a small draft model, measured on its third
+    stream (two warmup streams: the first compiles the miss-path
+    buckets, the second the registry-hit chunk buckets — steady state
+    from there, pinned by serve_prefix_compile_delta == 0). Reports the
+    prefix hit rate, effective prefill tokens/s for both engines (full
+    context tokens per prefill-wall-second — shared pages make the
+    cache-on number rise above the compute rate), the speculative
+    acceptance rate, and the p50 inter-token speedup vs the
+    non-speculative baseline."""
+    jax = _setup_jax()
+    cfg, model, params = _headline_setup(jax)
+
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    # the draft: same vocab/window, a fraction of the depth/width — big
+    # enough to agree with the target often, cheap enough that a k-step
+    # propose costs less than the verified forward it saves
+    draft_cfg = GPTNeoXConfig(vocab_size=cfg.vocab_size, hidden_size=256,
+                              num_layers=4, num_heads=8,
+                              max_seq_len=cfg.max_seq_len)
+    draft = GPTNeoX(draft_cfg, use_pallas=True)
+    draft_params = draft.init_params(jax.random.PRNGKey(3))
+
+    max_new = int(os.environ.get("DS_BENCH_SERVE_NEW", "32"))
+    n_req = int(os.environ.get("DS_BENCH_SERVE_REQUESTS", "32"))
+    prefix_len = int(os.environ.get("DS_BENCH_SERVE_PREFIX_LEN", "256"))
+    spec_k = int(os.environ.get("DS_BENCH_SERVE_SPEC_K", "4"))
+
+    def make_prompts(rng, shared):
+        out = []
+        for i in range(n_req):
+            tail = list(rng.integers(
+                1, cfg.vocab_size, size=int(rng.integers(8, 48))))
+            if i % 5 == 4:                   # 20% cold prompts
+                out.append(list(rng.integers(
+                    1, cfg.vocab_size, size=prefix_len)) + tail)
+            else:
+                out.append(shared + tail)
+        return out
+
+    def stream(eng, prompts):
+        """One bursty stream: submit everything, drain, return wall
+        inter-token p50 + the engine-stats deltas."""
+        before = dict(eng.stats)
+        last, itl = {}, []
+        for p in prompts:
+            eng.submit(p, max_new_tokens=max_new)
+        while eng.scheduler.has_work:
+            eng.step()
+            now = time.perf_counter()
+            for r in list(eng.scheduler.running):
+                k = len(r.generated)
+                if k and r.request_id in last and \
+                        k > last[r.request_id][1]:
+                    # spec appends several tokens per step: one step's
+                    # gap amortizes over every token it appended
+                    gap = (now - last[r.request_id][0]) / \
+                        (k - last[r.request_id][1])
+                    itl.extend([gap] * (k - last[r.request_id][1]))
+                if k:
+                    last[r.request_id] = (now, k)
+        eng.scheduler.pop_finished()
+        delta = {k: v - before[k] for k, v in eng.stats.items()
+                 if isinstance(v, (int, float))}
+        p50 = float(np.percentile(np.asarray(itl), 50)) if itl else None
+        return delta, p50
+
+    def thunk():
+        from deeperspeed_tpu.inference import InferenceEngine
+        base_block = {
+            "enabled": True, "page_size": 64,
+            "num_pages": int(os.environ.get("DS_BENCH_SERVE_PAGES",
+                                            "513")),
+            "max_batch_size": 8, "token_budget": 2048,
+            "prefill_batch_sizes": [4], "decode_batch_sizes": [8]}
+        rng = np.random.default_rng(0)
+        # ONE shared prefix for the whole row — the registry warms on
+        # stream one and every later shared prompt hits it
+        shared = list(rng.integers(1, cfg.vocab_size, size=prefix_len))
+
+        base = InferenceEngine(model, config={"inference": base_block},
+                               params=params)
+        stream(base, make_prompts(rng, shared))        # warmup
+        base_delta, base_p50 = stream(base, make_prompts(rng, shared))
+
+        both_block = dict(base_block)
+        both_block["prefix_cache"] = {"enabled": True}
+        both_block["speculative"] = {"enabled": True,
+                                     "num_draft_tokens": spec_k}
+        eng = InferenceEngine(model, config={"inference": both_block},
+                              params=params, draft_model=draft,
+                              draft_params=draft_params)
+        stream(eng, make_prompts(rng, shared))         # warmup 1: misses
+        stream(eng, make_prompts(rng, shared))         # warmup 2: hits
+        warm = eng.compile_count()
+        pcs_before = dict(eng.prefix_cache.stats)
+        delta, p50 = stream(eng, make_prompts(rng, shared))
+
+        pcs = {k: v - pcs_before[k]
+               for k, v in eng.prefix_cache.stats.items()}
+        out = {
+            "serve_prefix_requests": n_req,
+            "serve_prefix_shared_len": prefix_len,
+            "serve_prefix_hit_rate": round(
+                pcs["lookups"] and pcs["hits"] / pcs["lookups"], 3),
+            "serve_prefix_saved_tokens": pcs["saved_prefill_tokens"],
+            # effective prefill throughput: FULL context tokens per
+            # prefill-wall-second (the cache-on engine only computes
+            # the unshared suffixes, so its effective rate rises)
+            "serve_prefix_base_prefill_tok_s": round(
+                base_delta["prefill_tokens"] /
+                max(base_delta["prefill_s"], 1e-9), 1),
+            "serve_prefix_prefill_tok_s": round(
+                delta["prefill_tokens"] /
+                max(delta["prefill_s"], 1e-9), 1),
+            "serve_prefix_spec_acceptance": round(
+                delta["spec_proposed"] and
+                delta["spec_accepted"] / delta["spec_proposed"], 3),
+            "serve_prefix_base_p50_token_ms": round(base_p50 * 1e3, 2),
+            "serve_prefix_p50_token_ms": round(p50 * 1e3, 2),
+            "serve_prefix_p50_speedup": round(base_p50 / p50, 2),
+            # steady-state pin: the measured stream compiled nothing
+            "serve_prefix_compile_delta": eng.compile_count() - warm,
+        }
+        return out
+
+    return _ladder([("neox125m", thunk)], {}, "serve_prefix")
+
+
 _ELASTIC_WORKER = '''
 import json, os, sys, time
 workdir, target, crash = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
@@ -1829,6 +1971,7 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "sentinel": row_sentinel, "telemetry": row_telemetry,
            "packed": row_packed, "serve": row_serve,
            "serve_chaos": row_serve_chaos,
+           "serve_prefix": row_serve_prefix,
            "elastic": row_elastic, "fleet": row_fleet,
            "pipe": row_pipe, "offload": row_offload,
            "quant": row_quant}
@@ -1856,6 +1999,9 @@ def rows_enabled():
     if os.environ.get("DS_BENCH_SERVE_CHAOS", "0") not in \
             ("0", "", "false"):
         order.append("serve_chaos")
+    if os.environ.get("DS_BENCH_SERVE_PREFIX", "0") not in \
+            ("0", "", "false"):
+        order.append("serve_prefix")
     if os.environ.get("DS_BENCH_ELASTIC", "0") not in ("0", "", "false"):
         order.append("elastic")
     if os.environ.get("DS_BENCH_FLEET", "0") not in ("0", "", "false"):
@@ -1874,8 +2020,8 @@ def rows_enabled():
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
-                   "serve_chaos", "elastic", "fleet", "pipe", "offload",
-                   "quant"):
+                   "serve_chaos", "serve_prefix", "elastic", "fleet",
+                   "pipe", "offload", "quant"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

@@ -4,7 +4,9 @@ Pallas paged decode-attention kernel (`docs/inference.md`).
 - `InferenceEngine` — the serving loop: bucketed prefill/decode split
   at fixed compiled shapes, params-only checkpoint loading, telemetry.
 - `PagedKVCache` — the preallocated, mesh-sharded page pool + its
-  host-side allocator.
+  host-side refcounting allocator.
+- `PrefixCache` — the radix-style prefix registry over the page pool
+  (cross-request KV reuse; docs/inference.md "Prefix/radix cache").
 - `ContinuousBatchingScheduler` / `Request` — per-step admission and
   eviction under a token + page budget.
 - `AdmissionController` + the typed request-terminal errors
@@ -17,10 +19,11 @@ from .admission import (AdmissionController, DeadlineExceeded,
                         DrainAborted, PRIORITIES, RequestFailed,
                         RequestRejected, REQUEST_STATUSES)
 from .engine import InferenceEngine
-from .kv_cache import PagedKVCache, pages_for_tokens
+from .kv_cache import PagedKVCache, PrefixCache, pages_for_tokens
 from .scheduler import ContinuousBatchingScheduler, Request, StepPlan
 
-__all__ = ["InferenceEngine", "PagedKVCache", "pages_for_tokens",
+__all__ = ["InferenceEngine", "PagedKVCache", "PrefixCache",
+           "pages_for_tokens",
            "ContinuousBatchingScheduler", "Request", "StepPlan",
            "AdmissionController", "RequestRejected", "DeadlineExceeded",
            "RequestFailed", "DrainAborted", "PRIORITIES",

@@ -34,6 +34,7 @@ config seed folded with the step counter — the same request stream
 always produces the same tokens.
 """
 
+import math
 import random
 import time
 import types
@@ -50,6 +51,7 @@ from ..models import gpt2 as gpt2_mod
 from ..models import gpt_neox as neox
 from ..module_inject.replace_module import prepare_inference_params
 from ..ops.pallas.decode_attention import paged_decode_attention
+from ..ops.pallas.flash_attention import NEG_INF
 from ..parallel.mesh import MODEL_AXIS
 from ..runtime.config import (DeepSpeedConfig, parse_inference_block,
                               parse_quantization_block)
@@ -61,9 +63,11 @@ from ..utils.kv_retry import backoff_delay
 from ..utils.logging import logger
 from .admission import (AdmissionController, DrainAborted, RequestFailed,
                         validate_priority)
-from .kv_cache import (PagedKVCache, QuantizedPages, pages_for_tokens,
-                       quantize_kv)
-from .metrics import REQUEST_STATUS_FAMILIES, ServeRequestMetrics
+from .kv_cache import (PagedKVCache, PrefixCache, QuantizedPages,
+                       pages_for_tokens, quantize_kv)
+from .metrics import (PREFIX_HIT_RATE, PREFIX_PAGES_SHARED,
+                      PREFIX_SAVED_PREFILL_TOKENS, REQUEST_STATUS_FAMILIES,
+                      SPEC_ACCEPTANCE_RATE, ServeRequestMetrics)
 from .scheduler import (FINISHED, RUNNING, ContinuousBatchingScheduler,
                         Request)
 
@@ -113,6 +117,14 @@ class _Family:
             x = x + params["embed"]["wpe"][positions][:, None, :]
         return x
 
+    def embed_at(self, params, tokens, positions):
+        """tokens [B, S] at per-token absolute `positions` [B, S] →
+        [B, S, H] (the chunk programs: a window starting mid-sequence)."""
+        x = params["embed"]["wte"][tokens]
+        if self.kind == "gpt2":
+            x = x + params["embed"]["wpe"][positions]
+        return x
+
     def cos_sin_prefill(self, seqlen):
         return (self._cos[:seqlen], self._sin[:seqlen], self.rot_dim)
 
@@ -121,6 +133,11 @@ class _Family:
         return (self._cos[positions][:, None, :],
                 self._sin[positions][:, None, :], self.rot_dim)
 
+    def cos_sin_at(self, positions):
+        """Per-token rotary rows at `positions` [B, S] →
+        ([B, S, rot], ...) — `apply_rotary` takes the 3-D form."""
+        return (self._cos[positions], self._sin[positions], self.rot_dim)
+
     def head(self, params, h):
         """Final-norm hidden [B, H] → logits [B, V] (fp32)."""
         if self.kind == "gpt2":
@@ -128,6 +145,16 @@ class _Family:
         else:
             wte = params.get("embed_out", params["embed"])["wte"]
         return jnp.einsum("bh,vh->bv", h, wte.astype(h.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def head_all(self, params, h):
+        """Final-norm hidden [B, S, H] → logits [B, S, V] (fp32) —
+        the speculative verify needs every window position's logits."""
+        if self.kind == "gpt2":
+            wte = params["embed"]["wte"]
+        else:
+            wte = params.get("embed_out", params["embed"])["wte"]
+        return jnp.einsum("bsh,vh->bsv", h, wte.astype(h.dtype),
                           preferred_element_type=jnp.float32)
 
 
@@ -141,7 +168,8 @@ class InferenceEngine:
     """
 
     def __init__(self, model, config=None, config_params=None, params=None,
-                 mesh=None, rng=None, monitor=None):
+                 mesh=None, rng=None, monitor=None, draft_model=None,
+                 draft_params=None):
         self.model = model
         cfg = model.config
         if getattr(cfg, "moe_num_experts", 0):
@@ -271,13 +299,81 @@ class InferenceEngine:
             num_layers=cfg.num_layers, num_pages=ip["num_pages"],
             num_heads=cfg.num_heads, page_size=self.page_size,
             head_dim=cfg.head_dim, dtype=self.kv_cache_dtype, mesh=mesh)
+        # -- prefix/radix cache + speculative decoding (both default-off:
+        #    without their config sub-blocks the engine is bit-identical
+        #    to the plain PR 8 serving loop) --------------------------------
+        self.prefix_cache = None
+        if ip["prefix_cache"] is not None:
+            if self.mp > 1:
+                raise DeepSpeedConfigError(
+                    "inference.prefix_cache with a model-parallel mesh is "
+                    "unsupported: the chunk-prefill attention gathers the "
+                    "head-sharded pools without a shard_map yet — serve "
+                    "the prefix cache on a replicated (mp=1) mesh")
+            self.prefix_cache = PrefixCache(
+                self.cache, max_pages=ip["prefix_cache"]["max_pages"])
+        self.spec_k = 0
+        self.draft_model = None
+        self.draft_cache = None
+        if ip["speculative"] is not None:
+            sp = ip["speculative"]
+            if draft_model is None:
+                raise DeepSpeedConfigError(
+                    "inference.speculative is enabled but no draft_model "
+                    "was passed to InferenceEngine (the draft proposes "
+                    "the tokens the target verifies)")
+            if self.mp > 1:
+                raise DeepSpeedConfigError(
+                    "inference.speculative with a model-parallel mesh is "
+                    "unsupported: the draft pools and the verify chunk "
+                    "have no tensor-parallel placement yet — serve "
+                    "speculation on a replicated (mp=1) mesh")
+            dcfg = draft_model.config
+            if getattr(dcfg, "moe_num_experts", 0):
+                raise DeepSpeedConfigError(
+                    "an MoE draft model is not supported (the decode "
+                    "block would silently drop the expert routing)")
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise DeepSpeedConfigError(
+                    f"draft vocab_size {dcfg.vocab_size} != target "
+                    f"vocab_size {cfg.vocab_size}: draft proposals would "
+                    f"index a different token space")
+            if dcfg.max_seq_len < self.max_seq_len:
+                raise DeepSpeedConfigError(
+                    f"draft max_seq_len {dcfg.max_seq_len} is smaller "
+                    f"than the serving window {self.max_seq_len}: the "
+                    f"draft could not reach every decode position")
+            self.spec_k = sp["num_draft_tokens"]
+            self.draft_model = draft_model
+            if draft_params is None:
+                draft_params = draft_model.init_params(
+                    jax.random.PRNGKey(self.seed))
+            self.draft_params = prepare_inference_params(
+                draft_params, self.compute_dtype,
+                weight_quant=sp["draft_weight_quant"])
+            self.draft_stacked = self._stacked_blocks(self.draft_params)
+            self.draft_family = _Family(draft_model, self.max_seq_len)
+            # the draft's shadow pools MIRROR the target allocator: same
+            # num_pages/page_size, so one page id addresses a sequence's
+            # K/V in both models and no second allocator exists — every
+            # write path (prefill twin, chunk twin, propose) lands draft
+            # K/V at the page ids the target's scheduler handed out
+            self.draft_cache = PagedKVCache(
+                num_layers=dcfg.num_layers, num_pages=ip["num_pages"],
+                num_heads=dcfg.num_heads, page_size=self.page_size,
+                head_dim=dcfg.head_dim, dtype=self.kv_cache_dtype)
+            # host-side rejection sampling (temperature > 0): its own
+            # deterministic stream, separate from the jax sampling keys
+            self._spec_rng = np.random.default_rng(self.seed)
+
         self.scheduler = ContinuousBatchingScheduler(
             self.cache, max_seq_len=self.max_seq_len,
             token_budget=ip["token_budget"],
             max_batch_size=self.max_batch_size,
             prefill_lengths=self.prefill_lengths,
             prefill_batch_sizes=self.prefill_batch_sizes,
-            decode_batch_sizes=self.decode_batch_sizes)
+            decode_batch_sizes=self.decode_batch_sizes,
+            prefix_cache=self.prefix_cache, spec_tokens=self.spec_k)
         self.n_pages_max = pages_for_tokens(self.max_seq_len,
                                             self.page_size)
         # precision identity of this serving engine: the bench serve row
@@ -311,7 +407,11 @@ class InferenceEngine:
                       "requests_ok": 0, "requests_shed": 0,
                       "requests_deadline_exceeded": 0,
                       "requests_failed": 0,
-                      "quarantines": 0, "retries": 0}
+                      "quarantines": 0, "retries": 0,
+                      # speculative decoding: proposed/accepted draft
+                      # tokens and verify steps (0 when speculation off)
+                      "spec_steps": 0, "spec_proposed": 0,
+                      "spec_accepted": 0}
         # request-level latency histograms (inference/metrics.py):
         # admission-wait / TTFT / inter-token distributions, fanned out
         # to the monitor's export backends (Prometheus histogram
@@ -373,6 +473,15 @@ class InferenceEngine:
                     x, NamedSharding(self.mesh, P(None, *s))),
                 stacked, specs["blocks"][0])
         self.params_stacked = stacked
+        # a weight hot-swap invalidates every registered prefix page:
+        # the cached K/V is a function of the OLD weights, so new
+        # requests must not share it — drop the registry and detach
+        # waiting attachments (running requests keep decoding on their
+        # old-weights K/V, the pre-existing hot-swap semantics)
+        pc = getattr(self, "prefix_cache", None)
+        if pc is not None:
+            pc.clear()
+            self.scheduler.detach_waiting_prefixes()
 
     def load_checkpoint(self, load_dir, tag=None):
         """Params-only restore through the manifest-verified loader:
@@ -576,6 +685,220 @@ class InferenceEngine:
         self._compiled[key] = fn
         return fn
 
+    def _chunk_fn(self, batch, seqlen, which, mode):
+        """The mid-sequence window program: run `seqlen` tokens per row
+        starting at per-row absolute positions (`start`, `n_new` valid),
+        writing their K/V into the row's pages and attending over the
+        WHOLE page table (earlier positions included — that is what
+        makes it a continuation, not a fresh prefill). One program
+        serves three duties, compiled per (model, duty, shape):
+
+        - ``("target", "sample")`` — prefix-cache suffix prefill: the
+          shared pages already hold the prefix K/V, the window covers
+          only the suffix, and the first token samples at the last
+          valid position;
+        - ``("target", "verify")`` — speculative verify: the window is
+          [last token, k proposals]; returns per-position argmax tokens
+          (greedy) or fp32 next-token probs (sampled acceptance);
+        - ``("draft", "write")`` — draft-pool twin of any prefill
+          (full or suffix): writes draft K/V only, no head.
+        """
+        key = ("chunk", which, mode, batch, seqlen)
+        if key in self._compiled:
+            return self._compiled[key]
+        model = self.model if which == "target" else self.draft_model
+        fam = self.family if which == "target" else self.draft_family
+        cfg = model.config
+        ps = self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+        NP = self.n_pages_max
+        window = self.max_seq_len
+        sm_scale = 1.0 / math.sqrt(D)
+
+        def chunk(params, stacked, tokens, start, n_new, page_table,
+                  k_pool, v_pool, rng):
+            B, S = tokens.shape
+            offs = jnp.arange(S, dtype=jnp.int32)[None, :]
+            pos = start[:, None] + offs
+            valid = offs < n_new[:, None]
+            pos_c = jnp.clip(pos, 0, window - 1)
+            x = fam.embed_at(params, tokens, pos_c)
+            cos, sin, rot_dim = fam.cos_sin_at(pos_c)
+            # invalid window slots write to the trash page (the padding
+            # idiom everywhere else in this engine)
+            page_idx = jnp.take_along_axis(page_table, pos_c // ps, axis=1)
+            page_idx = jnp.where(valid, page_idx, 0)
+            slot = pos_c % ps
+            # per-query attention bound: position p sees cache slots
+            # 0..p; invalid rows see nothing (safe-softmax zeros them)
+            qpos = jnp.where(valid, pos_c, -1)
+
+            def store(pool, new):
+                """Window K/V rows [B, S, H, D] into their (page, slot)
+                cells; int8 pools quantize per (head, token) vector —
+                the same `quantize_kv` every other write path uses, so
+                identical tokens produce identical page bytes."""
+                if isinstance(pool, QuantizedPages):
+                    q8, sc = quantize_kv(new)
+                    return QuantizedPages(
+                        pool.data.at[page_idx, :, slot].set(q8),
+                        pool.scale.at[page_idx, :, slot].set(
+                            sc.astype(pool.scale.dtype)))
+                return pool.at[page_idx, :, slot].set(
+                    new.astype(pool.dtype))
+
+            def gather(pool):
+                """Row-gathered cache [B, H, NP·ps, D] (the XLA decode
+                fallback's layout; int8 dequantizes at the gather)."""
+                if isinstance(pool, QuantizedPages):
+                    d = pool.data[page_table].astype(jnp.float32) * \
+                        pool.scale[page_table].astype(jnp.float32)[..., None]
+                else:
+                    d = pool[page_table]
+                return jnp.moveaxis(d, 2, 1).reshape(B, H, NP * ps, D)
+
+            def attend(q, kp, vp):
+                k = gather(kp)
+                v = gather(vp)
+                q = jnp.moveaxis(q, 2, 1)              # [B, H, S, D]
+                q = (q.astype(jnp.float32)
+                     if isinstance(kp, QuantizedPages)
+                     else q.astype(k.dtype))
+                s = jnp.einsum("bhsd,bhkd->bhsk", q, k,
+                               preferred_element_type=jnp.float32)
+                s = s * sm_scale
+                kpos = jnp.arange(NP * ps, dtype=jnp.int32)
+                mask = kpos[None, None, None, :] <= qpos[:, None, :, None]
+                s = jnp.where(mask, s, NEG_INF)
+                m = jnp.max(s, axis=-1, keepdims=True)
+                prob = jnp.exp(s - m)
+                prob = jnp.where(s <= NEG_INF * 0.5, 0.0, prob)
+                l = jnp.sum(prob, axis=-1, keepdims=True)
+                l = jnp.where(l == 0.0, 1.0, l)
+                out = jnp.einsum("bhsk,bhkd->bhsd",
+                                 (prob / l).astype(v.dtype), v,
+                                 preferred_element_type=jnp.float32)
+                return jnp.moveaxis(out, 1, 2).reshape(B, S, H * D)
+
+            def body(carry, xs):
+                bp, kp, vp = xs
+                q, k, v = neox._block_qkv(cfg, bp, carry, cos, sin,
+                                          rot_dim, H)
+                # write BEFORE attending: every window key is visible,
+                # causal masking (qpos) keeps attention autoregressive
+                kp = store(kp, k)
+                vp = store(vp, v)
+                attn = attend(q, kp, vp).astype(carry.dtype)
+                out = neox._block_post_attn(cfg, bp, carry, attn,
+                                            reduce_fn=lambda t: t)
+                return out, (kp, vp)
+
+            x, (k_pool, v_pool) = jax.lax.scan(
+                body, x, (stacked, k_pool, v_pool))
+            if mode == "write":
+                return k_pool, v_pool
+            if mode == "sample":
+                idx = jnp.clip(n_new - 1, 0, S - 1)
+                h_last = x[jnp.arange(B), idx][:, None, :]
+                h_last = neox.layer_norm(
+                    h_last, params["final_ln"]["scale"],
+                    params["final_ln"]["bias"], cfg.layernorm_eps)
+                logits = fam.head(params, h_last[:, 0])
+                return self._sample(logits, rng), k_pool, v_pool
+            # mode == "verify": every position's next-token view
+            h = neox.layer_norm(x, params["final_ln"]["scale"],
+                                params["final_ln"]["bias"],
+                                cfg.layernorm_eps)
+            logits = fam.head_all(params, h)
+            if self.temperature <= 0.0:
+                out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                out = jax.nn.softmax(logits / self.temperature, axis=-1)
+            return out, k_pool, v_pool
+
+        fn = jax.jit(chunk, donate_argnums=(6, 7))
+        self._compiled[key] = fn
+        return fn
+
+    def _propose_fn(self, batch):
+        """The draft program: k+1 unrolled decode steps through the
+        draft model against the draft pools (same page ids as the
+        target's). Steps 0..k-1 argmax-propose the next token; the
+        final step writes the last proposal's K/V without sampling, so
+        the draft cache always covers every token the target may
+        accept. Per-row `windows` gate writes (and attention) past a
+        row's speculative window to the trash page — a row at its
+        max_new_tokens edge (window 0) still gets its pending token's
+        draft K/V written and nothing else."""
+        key = ("spec_propose", batch)
+        if key in self._compiled:
+            return self._compiled[key]
+        cfg = self.draft_model.config
+        fam = self.draft_family
+        ps = self.page_size
+        H, D = cfg.num_heads, cfg.head_dim
+        k_steps = self.spec_k
+        window = self.max_seq_len
+
+        def propose(params, stacked, tokens, lengths, windows, page_table,
+                    k_pool, v_pool):
+            B = tokens.shape[0]
+            base = jnp.maximum(lengths - 1, 0)
+            proposed = []
+            tok = tokens
+            for j in range(k_steps + 1):
+                pos = jnp.clip(base + j, 0, window - 1)
+                active = (j <= windows) & (lengths > 0)
+                x = fam.embed_decode(params, tok, pos)
+                cos, sin, rot_dim = fam.cos_sin_decode(pos)
+                page_idx = jnp.take_along_axis(
+                    page_table, (pos // ps)[:, None], axis=1)[:, 0]
+                page_idx = jnp.where(active, page_idx, 0)
+                slot = pos % ps
+                att_len = jnp.where(active, pos + 1, 0)
+
+                def store(pool, vec, page_idx=page_idx, slot=slot):
+                    if isinstance(pool, QuantizedPages):
+                        q8, sc = quantize_kv(vec)
+                        return QuantizedPages(
+                            pool.data.at[page_idx, :, slot].set(q8),
+                            pool.scale.at[page_idx, :, slot].set(
+                                sc.astype(pool.scale.dtype)))
+                    return pool.at[page_idx, :, slot].set(
+                        vec.astype(pool.dtype))
+
+                def body(carry, xs, cos=cos, sin=sin, rot_dim=rot_dim,
+                         store=store, att_len=att_len):
+                    bp, kp, vp = xs
+                    q, k, v = neox._block_qkv(cfg, bp, carry, cos, sin,
+                                              rot_dim, H)
+                    kp = store(kp, k[:, 0])
+                    vp = store(vp, v[:, 0])
+                    qrow = q[:, 0] if isinstance(kp, QuantizedPages) \
+                        else q[:, 0].astype(kp.dtype)
+                    attn = self._attention(qrow, kp, vp, page_table,
+                                           att_len)
+                    attn = attn.astype(carry.dtype)
+                    out = neox._block_post_attn(
+                        cfg, bp, carry, attn.reshape(B, 1, H * D),
+                        reduce_fn=lambda t: t)
+                    return out, (kp, vp)
+
+                x, (k_pool, v_pool) = jax.lax.scan(
+                    body, x, (stacked, k_pool, v_pool))
+                if j < k_steps:
+                    h = neox.layer_norm(x, params["final_ln"]["scale"],
+                                        params["final_ln"]["bias"],
+                                        cfg.layernorm_eps)
+                    logits = fam.head(params, h[:, 0])
+                    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    proposed.append(tok)
+            return jnp.stack(proposed, axis=1), k_pool, v_pool
+
+        fn = jax.jit(propose, donate_argnums=(6, 7))
+        self._compiled[key] = fn
+        return fn
+
     # ------------------------------------------------------------------
     # the serving loop
     # ------------------------------------------------------------------
@@ -715,6 +1038,7 @@ class InferenceEngine:
         # append garbage tokens — skip it; the evicted requests
         # re-prefill on later steps
         decodes_intact = all(r.state == RUNNING for r in plan.decodes)
+        produced = 0
         if plan.decodes and decodes_intact:
             stall = self._fault_fired("decode_stall")
             if stall is not None:
@@ -727,13 +1051,17 @@ class InferenceEngine:
                     if fault is not None:
                         raise InjectedServingFault(
                             "injected decode_error fault")
-                    self._run_decode(plan)
+                    if self.spec_k:
+                        produced = self._run_speculative(plan)
+                    else:
+                        produced = self._run_decode(plan)
                 except Exception as e:  # noqa: BLE001
                     ok = False
+                    produced = 0
                     self._quarantine_batch(plan.decodes, e, "decode")
             self.stats["decode_s"] += time.perf_counter() - t0
             if ok:
-                self.stats["decode_tokens"] += len(plan.decodes)
+                self.stats["decode_tokens"] += produced
 
         finished = len(self.scheduler.finished) - finished_before
         self.stats["finished"] += finished
@@ -755,9 +1083,19 @@ class InferenceEngine:
             # monitor backend (Prometheus gauges + JSONL events)
             for status, tag in REQUEST_STATUS_FAMILIES.items():
                 scalars[tag] = float(self.stats[f"requests_{status}"])
+            if self.prefix_cache is not None:
+                pcs = self.prefix_cache.stats
+                scalars[PREFIX_HIT_RATE] = \
+                    pcs["hits"] / max(pcs["lookups"], 1)
+                scalars[PREFIX_PAGES_SHARED] = float(pcs["pages_shared"])
+                scalars[PREFIX_SAVED_PREFILL_TOKENS] = \
+                    float(pcs["saved_prefill_tokens"])
+            if self.spec_k:
+                scalars[SPEC_ACCEPTANCE_RATE] = \
+                    self.stats["spec_accepted"] / \
+                    max(self.stats["spec_proposed"], 1)
             self.monitor.record(total, scalars)
-        return {"prefilled": len(plan.prefills),
-                "decoded": len(plan.decodes) if decodes_intact else 0,
+        return {"prefilled": len(plan.prefills), "decoded": produced,
                 "evicted": len(plan.evicted), "finished": finished}
 
     def _sync_status_counts(self):
@@ -867,6 +1205,17 @@ class InferenceEngine:
             "— rebuilding zeroed pools and re-prefilling every running "
             "sequence")
         self.cache.reset_pools()
+        if self.draft_cache is not None:
+            # the draft pools ride the same compiled calls (donated):
+            # assume them consumed too and rebuild — the re-prefills
+            # rewrite both models' K/V from the full token history
+            self.draft_cache.reset_pools()
+        if self.prefix_cache is not None:
+            # registered prefix K/V died with the pools: drop every
+            # chain and detach not-yet-admitted attachments, or new
+            # requests would share zeroed pages
+            self.prefix_cache.clear()
+            self.scheduler.detach_waiting_prefixes()
         while self.scheduler.running:
             self.scheduler._evict_victim(now)
 
@@ -882,11 +1231,23 @@ class InferenceEngine:
                     else 1) >= 1
         if plan.empty:
             return False
-        if plan.prefills and not warm(
-                ("prefill", plan.prefill_batch, plan.prefill_len)):
-            return False
-        if plan.decodes and not warm(("decode", plan.decode_batch)):
-            return False
+        if plan.prefills:
+            B, S = plan.prefill_batch, plan.prefill_len
+            pkey = (("chunk", "target", "sample", B, S)
+                    if plan.prefill_kind == "chunk"
+                    else ("prefill", B, S))
+            if not warm(pkey):
+                return False
+            if self.spec_k and not warm(("chunk", "draft", "write", B, S)):
+                return False
+        if plan.decodes:
+            B = plan.decode_batch
+            if self.spec_k:
+                if not warm(("spec_propose", B)) or not warm(
+                        ("chunk", "target", "verify", B, self.spec_k + 1)):
+                    return False
+            elif not warm(("decode", B)):
+                return False
         return True
 
     def _on_serving_hang(self):
@@ -927,25 +1288,43 @@ class InferenceEngine:
                     f"request/{req.request_id}", req.submitted_at,
                     (req.last_token_at or now) - req.submitted_at)
 
-    def _run_prefill(self, plan):
-        B, S = plan.prefill_batch, plan.prefill_len
-        n_pages_row = S // self.page_size
+    def _chunk_arrays(self, reqs, B, S):
+        """Window inputs for the chunk programs: each request's suffix
+        (everything past its shared prefix pages — the whole context
+        when nothing is shared) at its absolute positions, plus the
+        full-width page table (shared pages included: the window
+        attends over the prefix K/V it did not write)."""
         tokens = np.zeros((B, S), np.int32)
-        lengths = np.zeros((B,), np.int32)
-        page_table = np.zeros((B, n_pages_row), np.int32)
-        for i, req in enumerate(plan.prefills):
-            ctx = req.context
-            tokens[i, :len(ctx)] = ctx
-            lengths[i] = len(ctx)
+        start = np.zeros((B,), np.int32)
+        n_new = np.zeros((B,), np.int32)
+        page_table = np.zeros((B, self.n_pages_max), np.int32)
+        for i, req in enumerate(reqs):
+            shared = req.n_shared * self.page_size
+            suffix = req.context[shared:]
+            tokens[i, :len(suffix)] = suffix
+            start[i] = shared
+            n_new[i] = len(suffix)
             page_table[i, :len(req.pages)] = req.pages
-        fn = self._prefill_fn(B, S)
-        nxt, self.cache.k, self.cache.v = fn(
-            self.params, self.params_stacked, jnp.asarray(tokens),
-            jnp.asarray(lengths), jnp.asarray(page_table), self.cache.k,
-            self.cache.v, self._next_rng())
-        nxt = np.asarray(nxt)
+        return tokens, start, n_new, page_table
+
+    def _draft_prefill_twin(self, reqs, B, S):
+        """Mirror a prefill into the draft pools (speculation on): the
+        draft's K/V for every newly written position lands at the SAME
+        page ids, so the next propose step attends over a complete
+        draft view of the sequence. Shared prefix pages already hold
+        the registrant's draft K/V and are not rewritten. The rng slot
+        is dead in write mode — a constant key keeps the target
+        sampling stream identical to a non-speculative run."""
+        tokens, start, n_new, pt = self._chunk_arrays(reqs, B, S)
+        fn = self._chunk_fn(B, S, "draft", "write")
+        self.draft_cache.k, self.draft_cache.v = fn(
+            self.draft_params, self.draft_stacked, jnp.asarray(tokens),
+            jnp.asarray(start), jnp.asarray(n_new), jnp.asarray(pt),
+            self.draft_cache.k, self.draft_cache.v, jax.random.PRNGKey(0))
+
+    def _complete_prefills(self, reqs, nxt):
         now = time.perf_counter()
-        for i, req in enumerate(plan.prefills):
+        for i, req in enumerate(reqs):
             self.scheduler.complete_prefill(req, int(nxt[i]))
             # TTFT: once per request, from the ORIGINAL submit — an
             # evicted request's re-prefill resamples a token it already
@@ -958,6 +1337,38 @@ class InferenceEngine:
                     # the shedding signal: measured TTFT EMA vs SLOs
                     self.admission.observe_ttft(ttft_s * 1e3)
             req.last_token_at = now
+
+    def _run_prefill(self, plan):
+        B, S = plan.prefill_batch, plan.prefill_len
+        if plan.prefill_kind == "chunk":
+            # prefix-cache hit batch: suffix-only window through the
+            # chunk program (the full-prefill scatter would overwrite
+            # the shared pages other requests are reading)
+            tokens, start, n_new, pt = self._chunk_arrays(
+                plan.prefills, B, S)
+            fn = self._chunk_fn(B, S, "target", "sample")
+            nxt, self.cache.k, self.cache.v = fn(
+                self.params, self.params_stacked, jnp.asarray(tokens),
+                jnp.asarray(start), jnp.asarray(n_new), jnp.asarray(pt),
+                self.cache.k, self.cache.v, self._next_rng())
+        else:
+            n_pages_row = S // self.page_size
+            tokens = np.zeros((B, S), np.int32)
+            lengths = np.zeros((B,), np.int32)
+            page_table = np.zeros((B, n_pages_row), np.int32)
+            for i, req in enumerate(plan.prefills):
+                ctx = req.context
+                tokens[i, :len(ctx)] = ctx
+                lengths[i] = len(ctx)
+                page_table[i, :len(req.pages)] = req.pages
+            fn = self._prefill_fn(B, S)
+            nxt, self.cache.k, self.cache.v = fn(
+                self.params, self.params_stacked, jnp.asarray(tokens),
+                jnp.asarray(lengths), jnp.asarray(page_table), self.cache.k,
+                self.cache.v, self._next_rng())
+        if self.spec_k:
+            self._draft_prefill_twin(plan.prefills, B, S)
+        self._complete_prefills(plan.prefills, np.asarray(nxt))
 
     def _run_decode(self, plan):
         B = plan.decode_batch
@@ -981,6 +1392,122 @@ class InferenceEngine:
                 self.request_metrics.observe_inter_token(
                     now - req.last_token_at)
             req.last_token_at = now
+        return len(plan.decodes)
+
+    # ------------------------------------------------------------------
+    # speculative decoding (docs/inference.md "Speculative decoding")
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _accept_greedy(tgt, proposed, w):
+        """Greedy acceptance: `tgt[j]` (the verify forward's argmax at
+        window index j) IS the token sequential greedy decode would
+        produce there — proposals only decide how many of them land in
+        one step. Accept while the draft agrees; the first disagreement
+        appends the target's correction and stops; full agreement earns
+        the bonus token `tgt[w]`. Token-identical to non-speculative
+        greedy decode by construction (pinned by test)."""
+        out = []
+        for j in range(w):
+            out.append(int(tgt[j]))
+            if int(proposed[j]) != int(tgt[j]):
+                return out
+        out.append(int(tgt[w]))
+        return out
+
+    def _accept_sampled(self, probs, proposed, w):
+        """Rejection-sampling acceptance against the target's
+        temperature-scaled distributions (`probs` [S, V] fp32). The
+        draft proposes greedily — a delta distribution q = δ(x) — so
+        the standard accept test `u < p(x)/q(x)` reduces to `u < p(x)`
+        and the residual (p - q)⁺ to p with x zeroed: each emitted
+        token is distributed exactly as sequential sampling from p,
+        whatever the draft proposed."""
+        out = []
+        for j in range(w):
+            p = np.asarray(probs[j], np.float64)
+            x = int(proposed[j])
+            if self._spec_rng.random() < p[x]:
+                out.append(x)
+                continue
+            p[x] = 0.0
+            total = p.sum()
+            if total <= 0.0:
+                out.append(x)     # p WAS the delta at x: accept it
+            else:
+                out.append(int(self._spec_rng.choice(len(p),
+                                                     p=p / total)))
+            return out
+        p = np.asarray(probs[w], np.float64)
+        out.append(int(self._spec_rng.choice(len(p), p=p / p.sum())))
+        return out
+
+    def _run_speculative(self, plan):
+        """One speculative decode step: the draft proposes up to k
+        tokens per row, the target verifies the whole window in ONE
+        chunk forward, and acceptance appends 1..k+1 tokens per row.
+        Pages grown for tokens the shrinking window will never reach
+        roll back through the allocator (`_rollback_spec_pages`).
+        Returns the number of tokens appended across the batch."""
+        B = plan.decode_batch
+        reqs = plan.decodes
+        tokens = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        windows = np.full((B,), -1, np.int32)
+        page_table = np.zeros((B, self.n_pages_max), np.int32)
+        for i, req in enumerate(reqs):
+            tokens[i] = req.generated[-1]
+            lengths[i] = req.cached + 1
+            windows[i] = self.scheduler._spec_window(req)
+            page_table[i, :len(req.pages)] = req.pages
+        pt = jnp.asarray(page_table)
+        fn = self._propose_fn(B)
+        proposed, self.draft_cache.k, self.draft_cache.v = fn(
+            self.draft_params, self.draft_stacked, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(windows), pt,
+            self.draft_cache.k, self.draft_cache.v)
+        proposed = np.asarray(proposed)
+
+        # verify window per row: [pending token, proposals[:w]] at
+        # positions cached..cached+w — the pending token's K/V enters
+        # the target cache here, exactly like a plain decode step
+        S = self.spec_k + 1
+        wtokens = np.zeros((B, S), np.int32)
+        n_new = np.zeros((B,), np.int32)
+        for i in range(len(reqs)):
+            w = int(windows[i])
+            wtokens[i, 0] = tokens[i]
+            wtokens[i, 1:1 + w] = proposed[i, :w]
+            n_new[i] = w + 1
+        start = np.maximum(lengths - 1, 0).astype(np.int32)
+        vfn = self._chunk_fn(B, S, "target", "verify")
+        out, self.cache.k, self.cache.v = vfn(
+            self.params, self.params_stacked, jnp.asarray(wtokens),
+            jnp.asarray(start), jnp.asarray(n_new), pt, self.cache.k,
+            self.cache.v, self._next_rng())
+        out = np.asarray(out)
+
+        now = time.perf_counter()
+        produced = 0
+        for i, req in enumerate(reqs):
+            w = int(windows[i])
+            if self.temperature <= 0.0:
+                accepted = self._accept_greedy(out[i], proposed[i], w)
+            else:
+                accepted = self._accept_sampled(out[i], proposed[i], w)
+            self.stats["spec_proposed"] += w
+            self.stats["spec_accepted"] += len(accepted) - 1
+            appended = self.scheduler.complete_speculative(req, accepted)
+            produced += appended
+            if req.last_token_at is not None and appended:
+                # the user-visible cadence: one step emitted `appended`
+                # tokens, so each token's inter-token gap is dt/appended
+                per_token = (now - req.last_token_at) / appended
+                for _ in range(appended):
+                    self.request_metrics.observe_inter_token(per_token)
+            req.last_token_at = now
+        self.stats["spec_steps"] += 1
+        return produced
 
     # ------------------------------------------------------------------
     # graceful drain (SIGTERM from the pod scheduler)
@@ -1124,6 +1651,17 @@ class InferenceEngine:
         was attached."""
         out = dict(self.stats)
         out.update(self.request_metrics.summary())
+        if self.prefix_cache is not None:
+            pcs = self.prefix_cache.stats
+            out["prefix_lookups"] = pcs["lookups"]
+            out["prefix_hits"] = pcs["hits"]
+            out["prefix_hit_rate"] = pcs["hits"] / max(pcs["lookups"], 1)
+            out["prefix_pages_shared"] = pcs["pages_shared"]
+            out["prefix_saved_prefill_tokens"] = \
+                pcs["saved_prefill_tokens"]
+        if self.spec_k:
+            out["spec_acceptance_rate"] = self.stats["spec_accepted"] / \
+                max(self.stats["spec_proposed"], 1)
         total = out["prefill_tokens"] + out["decode_tokens"]
         if self.monitor is not None:
             self.monitor.record(

@@ -30,6 +30,14 @@ ADMISSION_WAIT = "Serve/admission_wait_ms"
 TTFT = "Serve/ttft_ms"
 INTER_TOKEN = "Serve/inter_token_ms"
 
+# prefix-cache / speculative-decode gauge families (PR 16): recorded as
+# monitor scalars every step, like REQUEST_STATUS_FAMILIES below —
+# latest-value gauges on the Prometheus scrape
+PREFIX_HIT_RATE = "Serve/prefix_cache/hit_rate"
+PREFIX_PAGES_SHARED = "Serve/prefix_cache/pages_shared"
+PREFIX_SAVED_PREFILL_TOKENS = "Serve/prefix_cache/saved_prefill_tokens"
+SPEC_ACCEPTANCE_RATE = "Serve/speculative/acceptance_rate"
+
 # per-terminal-status request counters (admission.REQUEST_STATUSES):
 # the engine records these every step as monitor scalars, so they ride
 # the single buffered drain into EVERY export backend — latest-value

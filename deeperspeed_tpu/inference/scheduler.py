@@ -55,6 +55,18 @@ Robustness layer (docs/inference.md "Serving under failure"):
   (`quarantine_request`) with a capped-jittered ``retry_at``; they
   re-admit at the queue front once eligible (eviction-regrowth
   machinery reused: budget exemption, drain re-admission).
+
+Serving-speedup layer (docs/inference.md "Prefix/radix cache" +
+"Speculative decoding"; both default-off):
+
+- with a `PrefixCache`, `add_request` (retried at admission) attaches
+  the longest registered page chain matching the prompt — the request
+  shares those pages by refcount and its prefill covers only the
+  SUFFIX (a "chunk" step plan); `complete_prefill` registers the full
+  prompt pages back into the chain;
+- with ``spec_tokens`` = k > 0, decode rows budget/grow for a k-token
+  draft window; `complete_speculative` applies the accepted run and
+  rolls tail pages the next window cannot reach back to the allocator.
 """
 
 import math
@@ -86,6 +98,12 @@ class Request:
     generated: list = field(default_factory=list)
     pages: list = field(default_factory=list)
     cached: int = 0          # tokens whose K/V sit in `pages`
+    # prefix-cache attachment: the first `n_shared` entries of `pages`
+    # are registry pages this request only READS (retained, never
+    # written); `prefix_node` is the deepest matched/registered chain
+    # node (kv_cache.PrefixCache)
+    n_shared: int = 0
+    prefix_node: object = None
     state: str = WAITING
     evictions: int = 0
     enqueued_at: float = None
@@ -129,6 +147,11 @@ class StepPlan:
     decodes: list             # in-flight requests decoding this step
     decode_batch: int         # batch bucket (0 = no decode this step)
     evicted: list             # requests preempted while planning
+    # "full" = whole-context prefill (every page written); "chunk" =
+    # prefix-cache suffix prefill (rows carry shared pages that are
+    # only read; prefill_len buckets the SUFFIX) — one kind per call,
+    # like the length bucket
+    prefill_kind: str = "full"
 
     @property
     def empty(self):
@@ -150,12 +173,18 @@ class ContinuousBatchingScheduler:
     fixed-seed open-loop stream relies on this)."""
 
     def __init__(self, cache, max_seq_len, token_budget, max_batch_size,
-                 prefill_lengths, prefill_batch_sizes, decode_batch_sizes):
+                 prefill_lengths, prefill_batch_sizes, decode_batch_sizes,
+                 prefix_cache=None, spec_tokens=0):
         self.cache = cache
         self.page_size = cache.page_size
         self.max_seq_len = int(max_seq_len)
         self.token_budget = int(token_budget)
         self.max_batch_size = int(max_batch_size)
+        # prefix/radix reuse (kv_cache.PrefixCache) and speculative
+        # decoding (k draft tokens verified per decode step); both off
+        # by default — the plain PR 8 behavior is bit-identical then
+        self.prefix_cache = prefix_cache
+        self.spec_tokens = int(spec_tokens)
         if self.max_seq_len % self.page_size:
             raise ValueError(
                 f"max_seq_len {self.max_seq_len} is not a multiple of "
@@ -232,8 +261,63 @@ class ContinuousBatchingScheduler:
         if request.deadline_at is None and request.deadline_ms is not None \
                 and now is not None:
             request.deadline_at = now + float(request.deadline_ms) / 1e3
+        if self.prefix_cache is not None:
+            self._attach_prefix(request, count_lookup=True)
         self.waiting.append(request)
         return request.request_id
+
+    # -- prefix/radix cache (kv_cache.PrefixCache) -------------------------
+
+    def _attach_prefix(self, request, count_lookup=False):
+        """Share the longest registered page chain matching the
+        prompt: bump refcounts and start the request's page list with
+        the shared pages — its prefill then covers only the suffix.
+        Runs at `add_request` and retried at admission for misses (the
+        registry may have warmed in between); hit/shared stats count
+        once per request either way."""
+        pc = self.prefix_cache
+        if count_lookup:
+            pc.stats["lookups"] += 1
+        chain = pc.lookup(request.prompt)
+        if not chain:
+            return
+        pages = [n.page for n in chain]
+        self.cache.retain(pages)
+        request.pages = list(pages)
+        request.n_shared = len(pages)
+        request.prefix_node = chain[-1]
+        pc.stats["hits"] += 1
+        pc.stats["pages_shared"] += len(pages)
+        pc.stats["saved_prefill_tokens"] += len(pages) * self.page_size
+
+    def _register_prefix(self, request):
+        """After a completed prefill, register every FULL prompt page
+        not already covered by the matched chain (generated tokens and
+        partial tail pages never register — their content is not a pure
+        function of the prompt prefix)."""
+        ps = self.page_size
+        n_full = len(request.prompt) // ps
+        if n_full <= request.n_shared:
+            return
+        keys = [self.prefix_cache.page_key(request.prompt[i * ps:
+                                                          (i + 1) * ps])
+                for i in range(request.n_shared, n_full)]
+        pages = request.pages[request.n_shared:n_full]
+        request.prefix_node = self.prefix_cache.register(
+            request.prefix_node, keys, pages)
+
+    def detach_waiting_prefixes(self):
+        """Drop prefix attachments from every not-yet-admitted request
+        (waiting + quarantined): on a weight hot-swap or pool loss the
+        shared pages' K/V no longer matches the model, so the requests
+        must re-prefill their full prompt. Admitted (running) requests
+        are the engine's problem — it evicts them on pool loss."""
+        for req in list(self.waiting) + list(self.quarantined):
+            if req.n_shared:
+                self.cache.free(req.pages[:req.n_shared])
+                req.pages = req.pages[req.n_shared:]
+                req.n_shared = 0
+            req.prefix_node = None
 
     @property
     def has_work(self):
@@ -275,6 +359,15 @@ class ContinuousBatchingScheduler:
 
     # -- terminal statuses -------------------------------------------------
 
+    def _release_pages(self, request):
+        """Drop every page reference a request holds (owned AND
+        prefix-shared — shared pages just lose one refcount and live on
+        under the registry) and reset its prefix attachment."""
+        self.cache.free(request.pages)
+        request.pages = []
+        request.n_shared = 0
+        request.prefix_node = None
+
     def _finish(self, request, status, error=None):
         """The ONLY exit gate: pull the request out of whatever
         collection holds it, free its pages, and stamp its terminal
@@ -293,8 +386,7 @@ class ContinuousBatchingScheduler:
             self.waiting.remove(request)
         except ValueError:
             pass
-        self.cache.free(request.pages)
-        request.pages = []
+        self._release_pages(request)
         request.status = status
         if error is not None:
             request.error = error
@@ -343,8 +435,7 @@ class ContinuousBatchingScheduler:
             self.waiting.remove(request)
         except ValueError:
             pass
-        self.cache.free(request.pages)
-        request.pages = []
+        self._release_pages(request)
         request.cached = 0
         request.evictions += 1
         request.state = WAITING
@@ -385,8 +476,7 @@ class ContinuousBatchingScheduler:
                             is not None else math.inf,
                             kv[0]))[1]
         self.running.remove(req)
-        self.cache.free(req.pages)
-        req.pages = []
+        self._release_pages(req)
         req.cached = 0
         req.evictions += 1
         req.state = WAITING
@@ -400,8 +490,22 @@ class ContinuousBatchingScheduler:
     # for callers/tests that drive an explicit eviction round-trip
     _evict_youngest = _evict_victim
 
+    def _spec_window(self, req):
+        """Draft tokens to propose for `req` this step: the configured
+        k, capped so (a) the request can still USE that many — accepting
+        w drafts appends w+1 tokens, bounded by max_new_tokens — and
+        (b) every window position cached..cached+w stays inside the
+        serving window. 0 when speculation is off (or the request can
+        only take one more token: plain decode)."""
+        if not self.spec_tokens:
+            return 0
+        remaining = req.max_new_tokens - len(req.generated)
+        return max(0, min(self.spec_tokens, remaining - 1,
+                          self.max_seq_len - 1 - req.cached))
+
     def _grow_running(self, evicted, now=None):
-        """Give every running sequence the page its next token needs;
+        """Give every running sequence the page(s) its next step needs
+        — one token, or the whole speculative window cached..cached+w;
         evict youngest-first when the pool runs dry. A sequence can
         never evict itself out of existence: with one running request
         the pool math guarantees its page fits or the config was
@@ -409,7 +513,9 @@ class ContinuousBatchingScheduler:
         for req in list(self.running):
             if req not in self.running:           # evicted by an earlier turn
                 continue
-            pos = req.cached                      # slot the next token takes
+            # last slot this step's writes reach (the speculative
+            # verify writes the full window before acceptance)
+            pos = req.cached + self._spec_window(req)
             page_idx = pos // self.page_size
             while page_idx >= len(req.pages):
                 got = self.cache.allocate(1)
@@ -437,10 +543,14 @@ class ContinuousBatchingScheduler:
         evicted = []
         self._grow_running(evicted, now)
         decodes = list(self.running)
-        budget = self.token_budget - len(decodes)
+        # a decode step costs 1 token per row — plus its speculative
+        # window: the verify forward computes window+1 positions
+        budget = self.token_budget - sum(1 + self._spec_window(r)
+                                         for r in decodes)
 
         prefills = []
         step_len = 0
+        step_kind = "full"
         max_prefill_batch = self.prefill_batch_sizes[-1]
         while self.waiting and len(prefills) < max_prefill_batch and \
                 len(self.running) < self.max_batch_size:
@@ -450,7 +560,17 @@ class ContinuousBatchingScheduler:
                 # queue is fresh ⇒ everything behind it is too — evicted
                 # requests requeue at the FRONT)
                 break
-            length = _bucket(len(req.context), self._prefill_ladder)
+            if self.prefix_cache is not None and not req.n_shared and \
+                    not req.evictions and not req.generated:
+                # miss at submit time — the registry may have warmed
+                # since (the bursty shared-prefix case: the whole burst
+                # queues before the first prefill registers)
+                self._attach_prefix(req)
+            # a prefix-attached request prefills only its SUFFIX (the
+            # shared pages already hold the prefix K/V): bucket that
+            req_kind = "chunk" if req.n_shared else "full"
+            suffix_len = len(req.context) - req.n_shared * self.page_size
+            length = _bucket(suffix_len, self._prefill_ladder)
             if length is None:
                 # unreachable: the ladder tops at the aligned window and
                 # running contexts stay below it (_maybe_finish) — kept
@@ -462,10 +582,11 @@ class ContinuousBatchingScheduler:
                     f"({len(req.context)} tokens) outgrew the prefill "
                     f"bucket ladder after eviction; raise "
                     f"prefill_lengths or num_pages")
-            # one length bucket per prefill call: shorter prompts pad up
-            # into the batch's bucket, a LONGER one waits for the next
-            # step (mixed buckets would force a recompile-sized shape)
-            if prefills and length > step_len:
+            # one length bucket AND one kind per prefill call: shorter
+            # prompts pad up into the batch's bucket, a LONGER one (or
+            # a kind mismatch — the chunk and full programs have
+            # different shapes) waits for the next step
+            if prefills and (length > step_len or req_kind != step_kind):
                 break
             row_len = step_len if prefills else length
             if row_len > budget and (prefills or not req.evictions):
@@ -481,8 +602,12 @@ class ContinuousBatchingScheduler:
                 break                      # pool full: wait for completions
             budget -= row_len
             step_len = row_len
+            step_kind = req_kind
             self.waiting.popleft()
-            req.pages = pages
+            # shared prefix pages (if any) stay in front; the freshly
+            # allocated suffix/bucket pages follow — page i of the list
+            # always holds context tokens [i·ps, (i+1)·ps)
+            req.pages = req.pages + pages
             req.cached = 0
             req.state = RUNNING
             req.admitted_at = now
@@ -500,7 +625,8 @@ class ContinuousBatchingScheduler:
                 f"bucket ladder {self.decode_batch_sizes}")
         return StepPlan(prefills=prefills, prefill_batch=prefill_batch or 0,
                         prefill_len=prefill_len, decodes=decodes,
-                        decode_batch=decode_batch or 0, evicted=evicted)
+                        decode_batch=decode_batch or 0, evicted=evicted,
+                        prefill_kind=step_kind)
 
     # -- results -----------------------------------------------------------
 
@@ -508,6 +634,8 @@ class ContinuousBatchingScheduler:
         """Record a prefill's result: the prompt's K/V is cached and the
         first generated token sampled."""
         request.cached = len(request.context)
+        if self.prefix_cache is not None:
+            self._register_prefix(request)
         request.generated.append(int(first_token))
         request.failures = 0     # a completed step ends the failure run
         self._maybe_finish(request)
@@ -519,6 +647,47 @@ class ContinuousBatchingScheduler:
         request.generated.append(int(token))
         request.failures = 0
         self._maybe_finish(request)
+
+    def complete_speculative(self, request, tokens):
+        """Record one speculative window: `tokens` are the accepted
+        draft tokens plus the verifier's correction/bonus token, in
+        order. Each appended token's PREDECESSOR has its K/V in the
+        cache (the verify forward wrote the whole window), so `cached`
+        advances one per append — exactly the sequential `complete_
+        decode` accounting, n times. Appending stops at the request's
+        natural end (eos / max_new_tokens / window), dropping the rest
+        of the accepted tokens; surviving requests then roll back the
+        tail pages the next window can no longer reach. Returns the
+        number of tokens actually appended."""
+        appended = 0
+        for t in tokens:
+            request.cached += 1
+            request.generated.append(int(t))
+            appended += 1
+            total = len(request.prompt) + len(request.generated)
+            if request.done or total >= self.max_seq_len:
+                break
+        request.failures = 0
+        self._maybe_finish(request)
+        if request.status is None:
+            self._rollback_spec_pages(request)
+        return appended
+
+    def _rollback_spec_pages(self, request):
+        """Release owned tail pages past the NEXT speculative window's
+        horizon — the allocator-rollback of pages grown for rejected
+        tokens the shrinking window (max_new_tokens nearly spent, or
+        the serving window's edge) will never write again. Growth and
+        rollback use the same horizon, so pages a full-k window still
+        needs are kept, not churned. Shared prefix pages are never
+        rolled back."""
+        if not self.spec_tokens:
+            return
+        limit = min(request.cached + self._spec_window(request),
+                    self.max_seq_len - 1)
+        needed = max(limit // self.page_size + 1, request.n_shared)
+        while len(request.pages) > needed:
+            self.cache.free([request.pages.pop()])
 
     def _maybe_finish(self, request):
         total = len(request.prompt) + len(request.generated)

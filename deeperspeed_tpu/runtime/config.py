@@ -153,7 +153,8 @@ def parse_inference_block(d):
              c.INFERENCE_SEED, c.INFERENCE_KERNEL, c.INFERENCE_KV_DTYPE,
              c.INFERENCE_DRAIN_DEADLINE, c.INFERENCE_DEFAULT_PRIORITY,
              c.INFERENCE_HANG_TIMEOUT, c.INFERENCE_ADMISSION,
-             c.INFERENCE_RETRY, c.INFERENCE_FAULT_INJECTION}
+             c.INFERENCE_RETRY, c.INFERENCE_FAULT_INJECTION,
+             c.INFERENCE_PREFIX_CACHE, c.INFERENCE_SPECULATIVE}
     unknown = sorted(set(inf) - known)
     if unknown:
         raise DeepSpeedConfigError(
@@ -305,6 +306,10 @@ def parse_inference_block(d):
     admission = _parse_inference_admission(
         inf.get(c.INFERENCE_ADMISSION))
     retry = _parse_inference_retry(inf.get(c.INFERENCE_RETRY))
+    prefix_cache = _parse_inference_prefix_cache(
+        inf.get(c.INFERENCE_PREFIX_CACHE))
+    speculative = _parse_inference_speculative(
+        inf.get(c.INFERENCE_SPECULATIVE))
 
     fault_spec = inf.get(c.INFERENCE_FAULT_INJECTION)
     if fault_spec is not None:
@@ -331,6 +336,8 @@ def parse_inference_block(d):
         "admission": admission,
         "retry": retry,
         "fault_injection": fault_spec,
+        "prefix_cache": prefix_cache,
+        "speculative": speculative,
     }
 
 
@@ -470,6 +477,95 @@ def _parse_inference_retry(block):
 
     return {"max_attempts": attempts, "backoff_base_ms": float(base),
             "backoff_cap_ms": float(cap), "jitter": float(jitter)}
+
+
+def _parse_inference_prefix_cache(block):
+    """Validate the ``inference.prefix_cache`` sub-block -> params dict,
+    or None when absent/disabled (no cross-request KV reuse: the
+    pre-prefix-cache behavior)."""
+    if block is None:
+        return None
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_PREFIX_CACHE} must be an object, "
+            f"got {type(block).__name__}")
+    known = {c.INFERENCE_PREFIX_CACHE_ENABLED,
+             c.INFERENCE_PREFIX_CACHE_MAX_PAGES}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'inference.{c.INFERENCE_PREFIX_CACHE}' key(s) "
+            f"{unknown}; valid keys: {sorted(known)}")
+    enabled = block.get(c.INFERENCE_PREFIX_CACHE_ENABLED,
+                        c.INFERENCE_PREFIX_CACHE_ENABLED_DEFAULT)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_PREFIX_CACHE}."
+            f"{c.INFERENCE_PREFIX_CACHE_ENABLED} must be a boolean, got "
+            f"{enabled!r}")
+    if not enabled:
+        return None
+
+    where = f"inference.{c.INFERENCE_PREFIX_CACHE}"
+    max_pages = block.get(c.INFERENCE_PREFIX_CACHE_MAX_PAGES,
+                          c.INFERENCE_PREFIX_CACHE_MAX_PAGES_DEFAULT)
+    if max_pages is not None:
+        max_pages = as_int(
+            max_pages, f"{where}.{c.INFERENCE_PREFIX_CACHE_MAX_PAGES}")
+        if max_pages < 1:
+            raise DeepSpeedConfigError(
+                f"{where}.{c.INFERENCE_PREFIX_CACHE_MAX_PAGES} must be "
+                f">= 1 or null (registry bounded only by the pool), got "
+                f"{max_pages}")
+
+    return {"max_pages": max_pages}
+
+
+def _parse_inference_speculative(block):
+    """Validate the ``inference.speculative`` sub-block -> params dict,
+    or None when absent/disabled (plain one-token-per-step decode)."""
+    if block is None:
+        return None
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_SPECULATIVE} must be an object, "
+            f"got {type(block).__name__}")
+    known = {c.INFERENCE_SPECULATIVE_ENABLED,
+             c.INFERENCE_SPECULATIVE_NUM_DRAFT,
+             c.INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'inference.{c.INFERENCE_SPECULATIVE}' key(s) "
+            f"{unknown}; valid keys: {sorted(known)}")
+    enabled = block.get(c.INFERENCE_SPECULATIVE_ENABLED,
+                        c.INFERENCE_SPECULATIVE_ENABLED_DEFAULT)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_SPECULATIVE}."
+            f"{c.INFERENCE_SPECULATIVE_ENABLED} must be a boolean, got "
+            f"{enabled!r}")
+    if not enabled:
+        return None
+
+    where = f"inference.{c.INFERENCE_SPECULATIVE}"
+    k = as_int(block.get(c.INFERENCE_SPECULATIVE_NUM_DRAFT,
+                         c.INFERENCE_SPECULATIVE_NUM_DRAFT_DEFAULT),
+               f"{where}.{c.INFERENCE_SPECULATIVE_NUM_DRAFT}")
+    if k < 1:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_SPECULATIVE_NUM_DRAFT} must be >= 1, "
+            f"got {k}")
+
+    quant = block.get(c.INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT,
+                      c.INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT_DEFAULT)
+    if quant is not None and quant not in c.QUANTIZATION_WEIGHTS_CHOICES:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT} must "
+            f"be null or one of {list(c.QUANTIZATION_WEIGHTS_CHOICES)}, "
+            f"got {quant!r}")
+
+    return {"num_draft_tokens": k, "draft_weight_quant": quant}
 
 
 def parse_quantization_block(d):

@@ -480,6 +480,29 @@ INFERENCE_RETRY_JITTER_DEFAULT = 0.25
 # same schema as training_health.fault_injection
 INFERENCE_FAULT_INJECTION = "fault_injection"
 
+# prefix/radix KV-cache reuse sub-block (inference/kv_cache.PrefixCache):
+# shared-prompt prefills attach registered page chains by refcount
+INFERENCE_PREFIX_CACHE = "prefix_cache"
+INFERENCE_PREFIX_CACHE_ENABLED = "enabled"
+INFERENCE_PREFIX_CACHE_ENABLED_DEFAULT = False
+# registry size cap in pages (null = bounded only by pool pressure:
+# allocation shortfalls reclaim LRU unshared registry pages)
+INFERENCE_PREFIX_CACHE_MAX_PAGES = "max_pages"
+INFERENCE_PREFIX_CACHE_MAX_PAGES_DEFAULT = None
+
+# speculative decoding sub-block: a draft model proposes
+# num_draft_tokens per decode step; the target verifies the window in
+# one batched forward (engine arg `draft_model` supplies the drafter)
+INFERENCE_SPECULATIVE = "speculative"
+INFERENCE_SPECULATIVE_ENABLED = "enabled"
+INFERENCE_SPECULATIVE_ENABLED_DEFAULT = False
+INFERENCE_SPECULATIVE_NUM_DRAFT = "num_draft_tokens"
+INFERENCE_SPECULATIVE_NUM_DRAFT_DEFAULT = 4
+# int8 weight-only quantization for the DRAFT params (the draft step is
+# weight-bandwidth bound too); null = the target's compute dtype
+INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT = "draft_weight_quant"
+INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT_DEFAULT = None
+
 # ---------------------------------------------------------------------------
 # Quantization (docs/quantization.md): low-precision hot paths — serving
 # int8 weights, delayed-scaling fp8/int8 FFN matmuls, compressed

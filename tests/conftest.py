@@ -22,6 +22,12 @@ import jax  # noqa: E402  (import after env setup)
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: do NOT point jax_compilation_cache_dir at bench's .xla_cache here.
+# On the CPU backend under jax 0.4.37, executables deserialized from the
+# persistent cache mis-execute (trajectory divergence in the tiered-offload
+# parity suites, glibc "free(): invalid next size" aborts) — the suite must
+# compile fresh every run.
+
 import pytest  # noqa: E402
 
 

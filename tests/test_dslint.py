@@ -194,6 +194,35 @@ def test_parse_only_key_kwarg_and_param_consumption(tmp_path):
     assert result.findings == []
 
 
+def test_parse_only_key_harvests_serving_blocks():
+    """The real-repo harvest must see the `inference.prefix_cache` and
+    `inference.speculative` sub-block keys — pins that the rule's
+    enforcement covers the serving config blocks (renaming a parser's
+    known-set variable would silently drop them from the gate)."""
+    from tools.dslint.config_keys import (_constants_aliases,
+                                          _constants_tables,
+                                          _known_set_assignments,
+                                          _resolve_key)
+    sources = []
+    for rel in (os.path.join("deeperspeed_tpu", "runtime", "config.py"),
+                os.path.join("deeperspeed_tpu", "runtime",
+                             "constants.py")):
+        ap = os.path.join(REPO_ROOT, rel)
+        with open(ap) as f:
+            sources.append(SourceFile(ap, rel, f.read()))
+    tables = _constants_tables(sources)
+    harvested = set()
+    for src in sources:
+        aliases = _constants_aliases(src, tables)
+        for assign in _known_set_assignments(src):
+            for elt in assign.value.elts:
+                key = _resolve_key(elt, aliases)
+                if key is not None:
+                    harvested.add(key)
+    assert {"prefix_cache", "speculative", "max_pages",
+            "num_draft_tokens", "draft_weight_quant"} <= harvested
+
+
 # ---------------------------------------------------------------------------
 # seeding: each fixture bug class injected into a copy of runtime code
 # is caught (the acceptance-criteria drill)

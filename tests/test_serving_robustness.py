@@ -956,3 +956,127 @@ class TestChaosSoak:
             list(range(1, eng.cache.num_pages))
         # zero post-warmup recompiles
         assert eng.compile_count() == warm
+
+
+# ---------------------------------------------------------------------------
+# eviction x deadline-expiry x quarantine interleavings (PR 16 audit):
+# a quarantined request holds NO pages (quarantine_request releases them
+# up front) and `_evict_victim` only ever scans `running` — so the
+# eviction machinery cannot double-free a quarantined request's pages or
+# pick a parked request as victim. Pinned here against refactors of
+# either routine, plus each pairwise interleaving of the three
+# preemption paths and the triple at engine level.
+# ---------------------------------------------------------------------------
+
+class TestPreemptionInterleavings:
+    def _running_pair(self):
+        cache, s = _sched(pages=32)
+        a = Request(prompt=list(range(1, 20)), max_new_tokens=30)
+        b = Request(prompt=list(range(1, 18)), max_new_tokens=30)
+        s.add_request(a, now=0.0)
+        s.add_request(b, now=0.0)
+        s.schedule(now=0.0)
+        s.complete_prefill(a, 5)
+        s.complete_prefill(b, 5)
+        return cache, s, a, b
+
+    def test_quarantined_request_holds_no_pages_and_is_never_victim(self):
+        cache, s, a, b = self._running_pair()
+        free_before = cache.num_free
+        held = len(a.pages)
+        s.quarantine_request(a, retry_at=10**9, now=1.0)
+        # pages released AT quarantine time, not at readmission
+        assert a.pages == [] and a.cached == 0
+        assert cache.num_free == free_before + held
+        # the victim scan cannot reach the parked request
+        assert s._evict_victim(now=1.0) is b
+        assert a in s.quarantined
+        assert s._evict_victim(now=1.0) is None     # running empty
+        # free-list exact: quarantine + both evictions leaked nothing
+        assert cache.num_free == cache.num_pages - 1
+        assert sorted(cache._free) == list(range(1, cache.num_pages))
+
+    def test_eviction_then_deadline_expiry_while_waiting(self):
+        cache, s, a, b = self._running_pair()
+        a.deadline_at = 5.0
+        victim = s._evict_victim(now=1.0)           # both evictable
+        assert victim in (a, b)
+        if victim is not a:
+            s._evict_victim(now=1.0)                # force a out too
+        assert a.pages == [] and a.evictions == 1
+        # the deadline lapses while a sits in the requeue: it must
+        # terminate from `waiting` without another prefill or page grab
+        expired = s.expire_deadlines(now=6.0)
+        assert a in expired
+        assert a.status == "deadline_exceeded"
+        assert a not in list(s.waiting)
+        assert isinstance(a.error, DeadlineExceeded)
+
+    def test_quarantine_then_deadline_expiry_during_backoff(self):
+        cache, s, a, b = self._running_pair()
+        a.deadline_at = 5.0
+        s.quarantine_request(a, retry_at=10**9, now=1.0)
+        # expiry must reach INTO the quarantine (a parked request's
+        # clock keeps running) and pull it out of that collection
+        expired = s.expire_deadlines(now=6.0)
+        assert a in expired
+        assert a.status == "deadline_exceeded"
+        assert s.quarantined == []
+        # b is untouched and still schedulable
+        plan = s.schedule(now=7.0)
+        assert plan.decodes == [b]
+        assert cache.num_free == \
+            cache.num_pages - 1 - len(b.pages)
+
+    def test_eviction_of_readmitted_quarantine_survivor(self):
+        cache, s, a, b = self._running_pair()
+        s.quarantine_request(a, retry_at=0.0, now=1.0)
+        # backoff elapsed: readmission puts it at the queue FRONT and
+        # re-prefills the full context (prompt + generated so far)
+        plan = s.schedule(now=2.0)
+        assert a in plan.prefills
+        assert a.evictions == 1
+        s.complete_prefill(a, 6)
+        # now evict the survivor again: the counters accumulate and the
+        # pages cycle cleanly through a second preemption
+        victim = s._evict_victim(now=3.0)
+        assert victim in (a, b)
+        assert victim.evictions >= 1
+        assert victim.pages == []
+        total_held = sum(len(r.pages) for r in s.running)
+        assert cache.num_free == cache.num_pages - 1 - total_held
+
+    def test_triple_interleaving_engine_level(self):
+        """All three preemption paths in ONE stream: page-pool pressure
+        evicts, an injected decode fault quarantines, a tight deadline
+        expires — every request still reaches exactly one terminal
+        status and the free list is exact."""
+        eng, cfg, params = _tiny_engine(
+            num_pages=9, max_seq_len=64, prefill_lengths=[32],
+            max_batch_size=2, decode_batch_sizes=[1, 2],
+            retry={"max_attempts": 3, "backoff_base_ms": 1,
+                   "backoff_cap_ms": 2, "jitter": 0.0},
+            fault_injection={"faults": [
+                {"kind": "decode_error", "step": 3, "times": 1},
+                {"kind": "page_pool_pressure", "step": 5, "times": 2,
+                 "factor": 0.9}]})
+        rng = np.random.default_rng(21)
+        prompts = [list(rng.integers(1, cfg.vocab_size, size=30))
+                   for _ in range(2)]
+        ids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        doomed = eng.submit(
+            list(rng.integers(1, cfg.vocab_size, size=30)),
+            max_new_tokens=34, deadline_ms=1.0)
+        t0 = time.time()
+        while eng.scheduler.has_work and time.time() - t0 < 30:
+            eng.step()
+        done = {r.request_id: r for r in eng.scheduler.pop_finished()}
+        assert done[doomed].status == "deadline_exceeded"
+        for p, rid in zip(prompts, ids):
+            assert done[rid].status == "ok"
+            assert list(done[rid].generated) == \
+                _teacher_forced(cfg, params, p, 6)
+        assert eng.stats["quarantines"] >= 1
+        assert eng.cache.num_free == eng.cache.num_pages - 1
+        assert sorted(eng.cache._free) == \
+            list(range(1, eng.cache.num_pages))
