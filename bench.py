@@ -72,6 +72,10 @@ KEYS (default all):
              included), compressed vs dense cross-host DP-grad step
              time on the explicit ZeRO-3 schedule; knobs in
              quant_knobs; opt-in via DS_BENCH_QUANT=1)
+  - plan     (schedule-planner row: build_plan's planner-chosen config
+             vs the hand-default explicit schedule on the 125M zero3
+             ladder, plan fingerprint + chosen label in extra; opt-in
+             via DS_BENCH_PLAN=1)
 
 The zero3 row additionally measures `zero3_explicit` — the explicit
 shard_map collective schedule (layer-ahead bucketed all-gather prefetch,
@@ -98,7 +102,8 @@ ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "serve_prefix": 900,
                "zero3": 800, "pipe": 900, "offload": 1100,
                "elastic": 600, "fleet": 600,
-               "quant": 1100}  # moe/longseq/quant walk both engines
+               "quant": 1100,  # moe/longseq/quant walk both engines
+               "plan": 1100}  # two full 125m variants (race both ways)
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -262,6 +267,98 @@ def row_zero3():
     gc.collect()
     return _ladder([(f"bs{b}", run(b, True)) for b in bs_ladder],
                    out, "zero3_explicit")
+
+
+# The hand-tuned explicit schedule the planner races against — the
+# BENCH_r05 zero3 defaults (and the planner's own tie-break anchor).
+HAND_DEFAULT_SCHEDULE = {"mode": "explicit", "prefetch_depth": 2,
+                         "bucket_mb": 32.0, "group_layers": 4,
+                         "remat": False}
+
+
+def row_plan():
+    """Schedule-planner row (opt-in via DS_BENCH_PLAN=1): `build_plan`
+    resolves a schedule for the headline 125M shape (analytic cost
+    model + memory screen; the measured probe ladder engages only where
+    the kernel autotuners would probe too), then the planner-chosen
+    config races the hand-default explicit schedule (prefetch 2 /
+    bucket 32 MB / group 4 / no remat) on the zero3 bs ladder.
+    Acceptance: plan_vs_hand_default >= 1.0."""
+    jax = _setup_jax()
+    n_chips = len(jax.devices())
+    peak = peak_flops_per_chip(jax.devices()[0])
+    cfg, model, params = _headline_setup(jax)
+    seq = min(int(os.environ.get("DS_BENCH_SEQ", "1024")),
+              cfg.max_seq_len)
+    bs_ladder = [int(b) for b in os.environ.get(
+        "DS_BENCH_ZERO3_BS", "48,32").split(",")]
+    # CPU-proxy knob: 125M steps are seconds on TPU but ~30s each on a
+    # 1-core host — shrink the timing window without changing the race
+    steps = int(os.environ.get("DS_BENCH_PLAN_STEPS", "12"))
+    warmup = max(1, min(4, steps // 3))
+
+    from deeperspeed_tpu.planner import build_plan
+    from deeperspeed_tpu.planner.cost_model import ModelShape
+    shape = ModelShape(num_layers=cfg.num_layers,
+                       hidden_size=cfg.hidden_size,
+                       num_heads=cfg.num_heads, seq_len=seq,
+                       vocab_size=cfg.vocab_size,
+                       batch_per_chip=bs_ladder[0])
+    # force=True, save=False: the bench must exercise a fresh plan of
+    # THIS run's shape, not whatever a previous session cached
+    plan = build_plan(shape, force=True, save=False)
+    plan_cfg = plan.config
+
+    def run(bs, planned):
+        def thunk():
+            batch = bs * n_chips
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, cfg.vocab_size, size=(1, batch, seq),
+                                  dtype=np.int32)
+            if planned:
+                tag = "plan_chosen"
+                zero_cfg = dict(plan_cfg["zero_optimization"])
+                extra_cfg = {k: v for k, v in plan_cfg.items()
+                             if k != "zero_optimization"}
+            else:
+                tag = "plan_hand_default"
+                zero_cfg = {"stage": 3,
+                            "schedule": dict(HAND_DEFAULT_SCHEDULE)}
+                extra_cfg = None
+            eng = _neox_engine(model, params, batch, zero_cfg, extra_cfg)
+            dt, _ = timed_steps(eng, (tokens, tokens), steps=steps,
+                                warmup=warmup)
+            tps = batch * seq * steps / dt / n_chips
+            return {f"{tag}_tokens_per_sec_chip": round(tps, 1),
+                    f"{tag}_mfu": round(
+                        tps * _flops_per_token(cfg, seq) / peak, 4)}
+        return thunk
+
+    out = {"plan_fingerprint": plan.fingerprint,
+           "plan_chosen_label": plan.payload["chosen"],
+           "plan_probed": plan.payload["probed"]}
+    out = _ladder([(f"bs{b}", run(b, True)) for b in bs_ladder],
+                  out, "plan_chosen")
+    # When analytic ties resolve to the hand-tuned defaults (world=1:
+    # every collective term is zero), the two race legs are the same
+    # program — report the identity instead of timing the same config
+    # twice and publishing scheduler noise as a ratio.
+    plan_zero = plan_cfg["zero_optimization"]
+    matches_hand = (plan_zero.get("schedule") == HAND_DEFAULT_SCHEDULE
+                    and "offload_optimizer" not in plan_zero
+                    and "quantization" not in plan_cfg)
+    out["plan_matches_hand_default"] = matches_hand
+    if matches_hand:
+        out["plan_vs_hand_default"] = 1.0
+        return out
+    gc.collect()
+    out = _ladder([(f"bs{b}", run(b, False)) for b in bs_ladder],
+                  out, "plan_hand_default")
+    chosen_tps = out.get("plan_chosen_tokens_per_sec_chip")
+    hand_tps = out.get("plan_hand_default_tokens_per_sec_chip")
+    if chosen_tps and hand_tps:
+        out["plan_vs_hand_default"] = round(chosen_tps / hand_tps, 3)
+    return out
 
 
 def row_pipe():
@@ -1974,7 +2071,7 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "serve_prefix": row_serve_prefix,
            "elastic": row_elastic, "fleet": row_fleet,
            "pipe": row_pipe, "offload": row_offload,
-           "quant": row_quant}
+           "quant": row_quant, "plan": row_plan}
 
 
 # ---------------------------------------------------------------------------
@@ -2012,6 +2109,8 @@ def rows_enabled():
         order.append("offload")
     if os.environ.get("DS_BENCH_QUANT", "0") not in ("0", "", "false"):
         order.append("quant")
+    if os.environ.get("DS_BENCH_PLAN", "0") not in ("0", "", "false"):
+        order.append("plan")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -2021,7 +2120,7 @@ def rows_enabled():
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
                    "serve_chaos", "serve_prefix", "elastic", "fleet",
-                   "pipe", "offload", "quant"):
+                   "pipe", "offload", "quant", "plan"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

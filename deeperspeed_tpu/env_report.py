@@ -94,6 +94,13 @@ def env_fingerprint():
         info["dslint_ruleset"] = RULESET_VERSION
     except Exception:  # noqa: BLE001 - absent or foreign tools package
         info["dslint_ruleset"] = None
+    # newest persisted schedule plan, if any — ties a captured trace /
+    # CI run to the exact plan that shaped its schedule (docs/planner.md)
+    try:
+        from .planner import latest_plan_fingerprint
+        info["plan_fingerprint"] = latest_plan_fingerprint()
+    except Exception:  # noqa: BLE001 - unreadable plan cache
+        info["plan_fingerprint"] = None
     return info
 
 

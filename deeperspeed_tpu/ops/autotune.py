@@ -91,6 +91,53 @@ class Autotuner:
 
 _global_tuner = Autotuner()
 
+
+def ladder_pick(key, candidates, measure, tuner=None, *,
+                measurable=True, default=None):
+    """The screen→measure→cache spine shared by every kernel picker in
+    this module and by the planner's probe phase
+    (`deeperspeed_tpu.planner`). Before this helper the five pickers
+    each hand-rolled the same five steps; now they only supply their
+    candidate ladder, their probe, and their degrade verdict.
+
+    1. cache hit for (key, device kind) → returned unmeasured
+       (measure-once-use-forever);
+    2. `measurable` false (caller's verdict: interpret-mode Pallas,
+       probe-byte cap, analytic-only planning) or a multi-host run
+       (per-host wall-clock picks can disagree → different programs per
+       host → deadlock at the first collective) → the deterministic
+       `default` is stored without touching the device. When `default`
+       is None the candidate ladder's first entry is stored instead;
+    3. a ladder that collapses to one survivor → stored unmeasured;
+    4. otherwise each candidate is timed via `measure(candidate)` with
+       `perf_counter` OUTSIDE traced code and the winner is cached.
+
+    `candidates`, `measurable` and `default` may be zero-arg callables:
+    they are resolved only on a cache miss (and `default` only when
+    degrading), so expensive screens — the grouped-matmul AOT memory
+    screen lowers a composite fwd+bwd program per candidate — and
+    cap-exceeded log lines are paid once per (key, device kind), not
+    per call."""
+    tuner = tuner or _global_tuner
+    hit = tuner.cached(key)
+    if hit is not None:
+        return hit
+    if callable(measurable):
+        measurable = measurable()
+    degraded = not measurable or jax.process_count() > 1
+    if degraded:
+        if callable(default):
+            default = default()
+        if default is not None:
+            return tuner.store(key, default)
+    cands = list(candidates() if callable(candidates) else candidates)
+    if not cands:
+        raise ValueError(
+            f"autotune: no viable candidates for key {key!r}")
+    if len(cands) == 1 or degraded:
+        return tuner.store(key, cands[0])
+    return tuner.pick(key, cands, measure)
+
 # Candidate (block_q, block_k) geometries for the flash kernels, fattest
 # first (the v5e-measured winner ordering). Non-square entries exist for
 # the compacted causal grid: its trapezoid rows grow with qi, so a fat
@@ -270,23 +317,11 @@ def grouped_matmul_blocks(capacity, k_dim, n_dim, dtype, tuner=None):
     if not autotune_enabled():
         return screened[0]
 
-    tuner = tuner or _global_tuner
     key = ("gmm", int(capacity), int(k_dim), int(n_dim), str(dtype))
-    hit = tuner.cached(key)
-    if hit is not None:
-        return hit
 
     import jax.numpy as jnp
     from .pallas.grouped_matmul import _interpret, grouped_matmul, \
         pick_span
-
-    if len(screened) == 1 or jax.process_count() > 1 or _interpret():
-        # multi-host: per-host wall-clock picks can disagree → different
-        # programs per host → deadlock at the first collective.
-        # interpret mode (no TPU): timing the Pallas interpreter ranks
-        # XLA-emulation cost, not kernel geometry — and compiling the
-        # chained fwd+bwd probe through the interpreter takes minutes
-        return tuner.store(key, screened[0])
 
     n_groups = 8
 
@@ -309,28 +344,32 @@ def grouped_matmul_blocks(capacity, k_dim, n_dim, dtype, tuner=None):
             return jnp.sum(out.astype(jnp.float32))
         return run, x, (bm, cand[1])
 
-    # AOT memory screen before spending a timed run on a candidate;
-    # dedupe candidates that fit to the same deployed geometry
-    survivors, seen = [], set()
-    for cand in screened:
-        run, x, fitted = build(cand)
-        if fitted in seen:
-            continue
-        fits, _ = memory_feasible(
-            jax.grad(run), (jax.ShapeDtypeStruct(x.shape, x.dtype),))
-        if fits:
-            seen.add(fitted)
-            survivors.append(cand)
-    if not survivors:
-        survivors = [screened[0]]
-    if len(survivors) == 1:
-        return tuner.store(key, survivors[0])
+    def survivors():
+        # AOT memory screen before spending a timed run on a candidate;
+        # dedupe candidates that fit to the same deployed geometry.
+        # Resolved lazily by ladder_pick: in interpret mode or
+        # multi-host this (expensive — one AOT fwd+bwd lowering per
+        # candidate) never runs
+        out, seen = [], set()
+        for cand in screened:
+            run, x, fitted = build(cand)
+            if fitted in seen:
+                continue
+            fits, _ = memory_feasible(
+                jax.grad(run), (jax.ShapeDtypeStruct(x.shape, x.dtype),))
+            if fits:
+                seen.add(fitted)
+                out.append(cand)
+        return out or [screened[0]]
 
     def measure(cand):
         run, x, _ = build(cand)
         return jax.grad(run)(x)
 
-    return tuner.pick(key, survivors, measure)
+    return ladder_pick(
+        key, screened if len(screened) == 1 else survivors, measure,
+        tuner,
+        measurable=lambda: not _interpret(), default=screened[0])
 
 
 # ---------------------------------------------------------------------------
@@ -375,41 +414,54 @@ def quant_matmul_blocks(m, k, n, dtype, tuner=None):
     if not autotune_enabled():
         return screened[0]
 
-    tuner = tuner or _global_tuner
     key = ("qmm", int(m), int(k), int(n), str(dtype))
-    hit = tuner.cached(key)
-    if hit is not None:
-        return hit
 
     import jax.numpy as jnp
     from .pallas.quant_matmul import (_fit, _interpret, quant_matmul,
                                       quantize_weight)
 
-    if len(screened) == 1 or jax.process_count() > 1 or _interpret():
-        # multi-host: per-host wall-clock picks can disagree → different
-        # programs per host → deadlock at the first collective.
-        # interpret mode: timing the Pallas interpreter ranks emulation
-        # cost, not kernel geometry
-        return tuner.store(key, screened[0])
+    def fitted():
+        # dedupe candidates on their FITTED geometry
+        out, seen = [], set()
+        for c in screened:
+            fit = (_fit(c[0], m, 8), _fit(c[1], k, 32),
+                   _fit(c[2], n, 128))
+            if fit in seen:
+                continue
+            seen.add(fit)
+            out.append(c)
+        return out
 
-    # dedupe candidates on their FITTED geometry
-    fitted, seen = [], set()
-    for c in screened:
-        fit = (_fit(c[0], m, 8), _fit(c[1], k, 32), _fit(c[2], n, 128))
-        if fit in seen:
-            continue
-        seen.add(fit)
-        fitted.append(c)
-    if len(fitted) == 1:
-        return tuner.store(key, fitted[0])
-
-    x = jnp.zeros((m, k), dtype)
-    qw = quantize_weight(jnp.zeros((k, n), jnp.float32))
+    probe = {}
 
     def measure(cand):
-        return quant_matmul(x, qw, backend="pallas", blocks=cand)
+        if not probe:  # built once, on the first warmup call only
+            probe["x"] = jnp.zeros((m, k), dtype)
+            probe["qw"] = quantize_weight(jnp.zeros((k, n), jnp.float32))
+        return quant_matmul(probe["x"], probe["qw"], backend="pallas",
+                            blocks=cand)
 
-    return tuner.pick(key, fitted, measure)
+    return ladder_pick(key, fitted, measure, tuner,
+                       measurable=lambda: not _interpret(),
+                       default=screened[0])
+
+
+def _fitted_flash_candidates(shape, fit_block, supported):
+    """FLASH_BLOCK_CANDIDATES fitted to the call shape and deduped on
+    the fitted geometry — several requests can collapse to the same
+    block pair and must be measured once. Shared by the fwd and bwd
+    pickers (their fit loops were copy-identical)."""
+    _, s, _, _ = shape
+    out = []
+    for c in FLASH_BLOCK_CANDIDATES:
+        fit = (fit_block(c[0], s), fit_block(c[1], s))
+        if 0 in fit or not supported(shape, *c):
+            continue
+        if fit not in out:
+            out.append(fit)
+    if not out:
+        raise ValueError(f"no flash block candidates fit shape {shape}")
+    return out
 
 
 def flash_bwd_blocks_for(shape, dtype, causal, fwd_blocks=None,
@@ -440,37 +492,35 @@ def flash_bwd_blocks_for(shape, dtype, causal, fwd_blocks=None,
     import numpy as np
     import jax.numpy as jnp
 
-    tuner = tuner or _global_tuner
     key = ("flash_bwd", tuple(shape), str(dtype), bool(causal))
-    hit = tuner.cached(key)
-    if hit is not None:
-        return hit
+    candidates = _fitted_flash_candidates(shape, _fit_block,
+                                          flash_attention_supported)
 
-    candidates = []
-    for c in FLASH_BLOCK_CANDIDATES:
-        fit = (_fit_block(c[0], s), _fit_block(c[1], s))
-        if 0 in fit or not flash_attention_supported(shape, *c):
-            continue
-        if fit not in candidates:
-            candidates.append(fit)
-    if not candidates:
-        raise ValueError(f"no flash block candidates fit shape {shape}")
-    if len(candidates) == 1 or jax.process_count() > 1 or _interpret():
-        # multi-host: divergent picks lower different programs per host;
-        # interpret mode: timing the interpreter ranks emulation cost
-        return tuner.store(key, candidates[0])
-    itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
-    if b * s * h * d * itemsize * 8 > _MAX_TUNE_BYTES:
-        from ..utils.logging import logger
-        logger.info(
-            f"flash bwd autotune: shape {tuple(shape)} exceeds the probe "
-            f"memory cap; reusing forward blocks")
-        return tuner.store(key, tuple(fwd_blocks)
-                           if fwd_blocks is not None else candidates[0])
+    capped = []
+
+    def measurable():
+        if _interpret():
+            # timing the interpreter ranks emulation cost
+            return False
+        itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 \
+            else 2
+        if b * s * h * d * itemsize * 8 > _MAX_TUNE_BYTES:
+            from ..utils.logging import logger
+            logger.info(
+                f"flash bwd autotune: shape {tuple(shape)} exceeds the "
+                f"probe memory cap; reusing forward blocks")
+            capped.append(True)
+            return False
+        return True
+
+    def default():
+        # probe-cap degrade inherits the forward geometry; every other
+        # degrade (interpret, multi-host) takes the fattest fit
+        if capped and fwd_blocks is not None:
+            return tuple(fwd_blocks)
+        return candidates[0]
 
     fbq, fbk = fwd_blocks if fwd_blocks is not None else candidates[0]
-    zeros = jnp.zeros(shape, dtype)
-
     bwd_cache = {}
 
     def measure(cand):
@@ -480,14 +530,17 @@ def flash_bwd_blocks_for(shape, dtype, causal, fwd_blocks=None,
         # iterations apply only the bwd closure
         f_bwd = bwd_cache.get(cand)
         if f_bwd is None:
+            zeros = bwd_cache.setdefault("zeros",
+                                         jnp.zeros(shape, dtype))
             _, f_bwd = jax.vjp(
                 lambda q, k, v: flash_attention(q, k, v, causal, None,
                                                 fbq, fbk, tuple(cand)),
                 zeros, zeros, zeros)
             bwd_cache[cand] = f_bwd
-        return f_bwd(zeros)
+        return f_bwd(bwd_cache["zeros"])
 
-    return tuner.pick(key, candidates, measure)
+    return ladder_pick(key, candidates, measure, tuner,
+                       measurable=measurable, default=default)
 
 
 # block-sparse attention (group_q, fanout) candidates, fattest first:
@@ -515,26 +568,23 @@ def sparse_block_params(layout, shape, dtype, causal, sm_scale=None,
     import numpy as np
     import jax.numpy as jnp
 
-    tuner = tuner or _global_tuner
     lay = np.asarray(layout)
     key = ("sparse_gf", lay.shape, round(float((lay != 0).mean()), 3),
            tuple(shape), str(dtype), bool(causal))
-    hit = tuner.cached(key)
-    if hit is not None:
-        return hit
-    if jax.process_count() > 1 or _interpret():
-        return tuner.store(key, default)
 
-    zeros = jnp.zeros(shape, dtype)
+    probe = {}
 
     def measure(cand):
+        zeros = probe.setdefault("z", jnp.zeros(shape, dtype))
         attn = BlockSparseAttention(lay, block=128, causal=causal,
                                     sm_scale=sm_scale, group=cand[0],
                                     fanout=cand[1])
         return jax.grad(lambda q: jnp.sum(
             attn(q, zeros, zeros).astype(jnp.float32)))(zeros)
 
-    return tuner.pick(key, SPARSE_GF_CANDIDATES, measure)
+    return ladder_pick(key, SPARSE_GF_CANDIDATES, measure, tuner,
+                       measurable=lambda: not _interpret(),
+                       default=default)
 
 
 def flash_blocks_for(shape, dtype, causal, tuner=None):
@@ -578,52 +628,43 @@ def tuned_flash_blocks(shape, dtype, causal, tuner=None):
     import numpy as np
     import jax.numpy as jnp
 
-    tuner = tuner or _global_tuner
+    from .pallas.flash_attention import _interpret
     b, s, h, d = shape
     key = ("flash", tuple(shape), str(dtype), bool(causal))
-    hit = tuner.cached(key)  # before candidate fitting: repeat calls
-    if hit is not None:      # (incl. stored fallbacks) skip the scan
-        return hit
 
-    # dedupe candidates on their FITTED geometry — several requests can
-    # collapse to the same block pair and must be measured once
-    candidates = []
-    for c in FLASH_BLOCK_CANDIDATES:
-        fit = (_fit_block(c[0], s), _fit_block(c[1], s))
-        if 0 in fit or not flash_attention_supported(shape, *c):
-            continue
-        if fit not in candidates:
-            candidates.append(fit)
-    if not candidates:
-        raise ValueError(f"no flash block candidates fit shape {shape}")
-    if len(candidates) == 1:
-        return tuner.store(key, candidates[0])
-    # Multi-host SPMD: per-host wall-clock picks can disagree, lowering
-    # DIFFERENT programs per host → deadlock at the first collective.
-    # Interpret mode (CPU): measuring would rank Pallas-interpreter
-    # emulation cost — and a 16k probe takes MINUTES per candidate
-    # there. Take the deterministic default instead of measuring.
-    from .pallas.flash_attention import _interpret
-    if jax.process_count() > 1 or _interpret():
-        return tuner.store(key, candidates[0])
-    # x8: the fwd+bwd probe's live set is q/k/v/out + saved residuals +
-    # the cotangent and dq/dk/dv inside _bwd — about twice the old
-    # forward-only probe's four arrays
-    itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 else 2
-    if b * s * h * d * itemsize * 8 > _MAX_TUNE_BYTES:
-        # not silent: the shapes most likely to hit this cap (big GSPMD
-        # global batches at 16k+) are exactly the ones tuning targets
-        from ..utils.logging import logger
-        logger.info(
-            f"flash autotune: shape {tuple(shape)} exceeds the probe "
-            f"memory cap; using default blocks {candidates[0]}")
-        return tuner.store(key, candidates[0])
+    def candidates():
+        return _fitted_flash_candidates(shape, _fit_block,
+                                        flash_attention_supported)
 
-    zeros = jnp.zeros(shape, dtype)
+    def measurable():
+        # Interpret mode (CPU): measuring would rank Pallas-interpreter
+        # emulation cost — and a 16k probe takes MINUTES per candidate
+        # there. (Multi-host degrade lives in ladder_pick.)
+        if _interpret():
+            return False
+        # x8: the fwd+bwd probe's live set is q/k/v/out + saved
+        # residuals + the cotangent and dq/dk/dv inside _bwd — about
+        # twice the old forward-only probe's four arrays
+        itemsize = np.dtype(dtype).itemsize if dtype != jnp.bfloat16 \
+            else 2
+        if b * s * h * d * itemsize * 8 > _MAX_TUNE_BYTES:
+            # not silent: the shapes most likely to hit this cap (big
+            # GSPMD global batches at 16k+) are exactly what tuning
+            # targets
+            from ..utils.logging import logger
+            logger.info(
+                f"flash autotune: shape {tuple(shape)} exceeds the "
+                f"probe memory cap; using the fattest fitted blocks")
+            return False
+        return True
+
+    probe = {}
 
     def run(cand):
+        zeros = probe.setdefault("z", jnp.zeros(shape, dtype))
         return jax.grad(lambda q: jnp.sum(
             flash_attention(q, zeros, zeros, causal, None, *cand)
             .astype(jnp.float32)))(zeros)
 
-    return tuner.pick(key, candidates, run)
+    return ladder_pick(key, candidates, run, tuner,
+                       measurable=measurable)

@@ -1,0 +1,88 @@
+"""Consume a persisted plan inside the engine's config pipeline.
+
+`DeepSpeedConfig` parses the strict ``"planner"`` block, then hands the
+RAW param dict here: the plan's resolved config is merged UNDER the
+user's explicit keys (a hand-set `prefetch_depth` always beats the
+plan — the planner provides defaults, never overrides), before the
+zero/activation-checkpointing/quantization blocks parse. A plan emitted
+for a different device kind warns by default and raises under
+``strict_device_match``.
+"""
+
+import os
+
+from ..utils.logging import logger
+from .plan import cached_plan, load_plan
+
+
+def _merge_under(dst, src):
+    """Recursively fill `dst` with `src` values for keys the user did
+    not set; returns the list of dotted keys the plan contributed."""
+    applied = []
+    for key, val in src.items():
+        if isinstance(val, dict) and isinstance(dst.get(key), dict):
+            applied.extend(f"{key}.{sub}"
+                           for sub in _merge_under(dst[key], val))
+        elif key not in dst:
+            dst[key] = val
+            applied.append(key)
+    return applied
+
+
+def resolve_plan(planner_cfg, device_kind=None, shape_key=None):
+    """The plan a parsed planner block points at, or None. An explicit
+    `plan_file` that does not exist raises (a typo'd path silently
+    training unplanned is the parse-only-key bug class all over)."""
+    path = planner_cfg.get("plan_file")
+    if path:
+        path = os.path.expanduser(path)
+        if not os.path.exists(path):
+            from ..runtime.config_utils import DeepSpeedConfigError
+            raise DeepSpeedConfigError(
+                f"planner.plan_file {path!r} does not exist — emit it "
+                f"with ds_plan, or drop the planner block")
+        return load_plan(path)
+    if device_kind is not None and shape_key is not None:
+        return cached_plan(device_kind, shape_key)
+    return None
+
+
+def overlay_plan(param_dict, planner_cfg):
+    """Merge the configured plan's resolved config under `param_dict`.
+
+    Returns ``(fingerprint, applied_keys)`` — the applied plan's
+    fingerprint plus the dotted keys the plan (not the user)
+    contributed, or ``(None, [])`` when the block is disabled or points
+    at nothing. Called BEFORE the schedule/offload/quantization blocks
+    parse, so the merged keys go through the exact same strict
+    validation a hand-written config would; the applied-keys list is
+    what lets the engine tell a plan-provided knob (advisory — may
+    degrade) from a user-set one (contractual — must raise)."""
+    if not planner_cfg or not planner_cfg.get("enabled", True):
+        return None, []
+    plan = resolve_plan(planner_cfg)
+    if plan is None:
+        return None, []
+
+    try:
+        from ..ops.autotune import _device_kind
+        here = _device_kind()
+    except Exception:  # noqa: BLE001 - backendless config parse
+        here = "unknown"
+    if plan.device_kind not in ("unknown", here):
+        msg = (f"planner: plan {plan.fingerprint} was emitted for "
+               f"device kind {plan.device_kind!r}, this host runs "
+               f"{here!r}")
+        if planner_cfg.get("strict_device_match"):
+            from ..runtime.config_utils import DeepSpeedConfigError
+            raise DeepSpeedConfigError(
+                f"{msg} (planner.strict_device_match is set — re-plan "
+                f"on this device kind with ds_plan)")
+        logger.warning(f"{msg}; applying anyway (its measured ranking "
+                       f"may not transfer)")
+
+    applied = _merge_under(param_dict, plan.config)
+    logger.info(f"planner: applied plan {plan.fingerprint} "
+                f"({plan.payload.get('chosen', '?')}); plan-provided "
+                f"keys: {applied or 'none (user config covers all)'}")
+    return plan.fingerprint, applied

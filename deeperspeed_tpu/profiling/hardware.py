@@ -1,10 +1,13 @@
-"""Per-device-kind hardware peaks (shared by bench.py and the in-engine
-telemetry layer, `runtime/telemetry.py`).
+"""Per-device-kind hardware peaks (shared by bench.py, the in-engine
+telemetry layer `runtime/telemetry.py`, and the schedule planner's
+analytic cost model, `deeperspeed_tpu/planner`).
 
-One table, two consumers: `bench.py` computes offline MFU from measured
-tokens/s, and the telemetry layer turns `compiled.cost_analysis()` flops
-into a live `Train/Samples/mfu` scalar. Keeping the table here means the
-two can never disagree about what "peak" means for a chip.
+One table per quantity, several consumers: `bench.py` computes offline
+MFU from measured tokens/s, the telemetry layer turns
+`compiled.cost_analysis()` flops into a live `Train/Samples/mfu`
+scalar, and the planner prices candidate schedules (compute from peak
+flops, collectives from ICI bandwidth). Keeping the tables here means
+the consumers can never disagree about what "peak" means for a chip.
 
 Import-light on purpose: no jax at module scope — callers hand in device
 objects (or kind strings), so config parsing never pays a backend init.
@@ -24,13 +27,45 @@ PEAK_FLOPS_BY_KIND = {
 PEAK_FLOPS_DEFAULT = 197e12
 
 
-def peak_flops_per_chip(device):
-    """bf16 peak FLOPS for a jax device (or a device-kind string)."""
+# Per-chip ICI all-gather/reduce-scatter bandwidth in bytes/s (public
+# spec sheet aggregate link bandwidth, derated to a sustained-collective
+# estimate). Matched like PEAK_FLOPS_BY_KIND. The planner's collective
+# model divides bucket bytes by this; it is a ranking signal, not a
+# simulator — only relative candidate ordering matters.
+ICI_BANDWIDTH_BY_KIND = {
+    "v5 lite": 180e9, "v5e": 180e9,
+    "v5p": 600e9, "v5": 600e9,
+    "v4": 300e9,
+    "v6": 360e9, "v6e": 360e9,
+}
+
+# CPU/unknown backends: a deliberately low figure so the planner treats
+# collectives as expensive and prefers overlap-friendly schedules there.
+ICI_BANDWIDTH_DEFAULT = 10e9
+
+# Fixed per-collective launch/latency cost (seconds). Prices the
+# many-tiny-buckets failure mode: a 1 MB bucket ladder pays this per
+# bucket and loses to fewer, fatter buckets on the analytic ladder.
+COLLECTIVE_LATENCY_S = 5e-6
+
+
+def _by_kind(device, table, default):
     kind = getattr(device, "device_kind", None)
     if kind is None:
         kind = str(device)
     kind = (kind or "").lower()
-    for key, val in PEAK_FLOPS_BY_KIND.items():
+    for key, val in table.items():
         if key in kind:
             return val
-    return PEAK_FLOPS_DEFAULT
+    return default
+
+
+def peak_flops_per_chip(device):
+    """bf16 peak FLOPS for a jax device (or a device-kind string)."""
+    return _by_kind(device, PEAK_FLOPS_BY_KIND, PEAK_FLOPS_DEFAULT)
+
+
+def ici_bandwidth_per_chip(device):
+    """Sustained per-chip collective bandwidth (bytes/s) for a jax
+    device or a device-kind string."""
+    return _by_kind(device, ICI_BANDWIDTH_BY_KIND, ICI_BANDWIDTH_DEFAULT)

@@ -135,6 +135,58 @@ def _parse_sparse_attention(param_dict):
     return common
 
 
+def parse_planner_block(d):
+    """Parse + validate the "planner" block (the profile-guided
+    schedule planner, `deeperspeed_tpu/planner`; docs/planner.md) at
+    checkpoint-block strictness. Module-level so `ds_plan` tooling can
+    validate raw dicts identically.
+
+    Returns the validated params dict, or None when the block is
+    absent. `plan_file` is REQUIRED when enabled: the engine has no
+    model-shape key at config-parse time, so there is no implicit
+    cache lookup to fall back on — a planner block that silently
+    applied nothing would be the parse-only-key bug class."""
+    block = d.get(c.PLANNER)
+    if block is None:
+        return None
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f"'{c.PLANNER}' must be a dict, got {block!r}")
+    known = {c.PLANNER_ENABLED, c.PLANNER_PLAN_FILE,
+             c.PLANNER_STRICT_DEVICE_MATCH}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown '{c.PLANNER}' key(s) {unknown}; valid keys: "
+            f"{sorted(known)}")
+    enabled = block.get(c.PLANNER_ENABLED, c.PLANNER_ENABLED_DEFAULT)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            f"{c.PLANNER}.{c.PLANNER_ENABLED} must be a boolean, got "
+            f"{enabled!r}")
+    plan_file = block.get(c.PLANNER_PLAN_FILE,
+                          c.PLANNER_PLAN_FILE_DEFAULT)
+    if plan_file is not None and not isinstance(plan_file, str):
+        raise DeepSpeedConfigError(
+            f"{c.PLANNER}.{c.PLANNER_PLAN_FILE} must be a string path "
+            f"to a ds_plan-emitted plan, got {plan_file!r}")
+    strict = block.get(c.PLANNER_STRICT_DEVICE_MATCH,
+                       c.PLANNER_STRICT_DEVICE_MATCH_DEFAULT)
+    if not isinstance(strict, bool):
+        raise DeepSpeedConfigError(
+            f"{c.PLANNER}.{c.PLANNER_STRICT_DEVICE_MATCH} must be a "
+            f"boolean, got {strict!r}")
+    if enabled and plan_file is None:
+        raise DeepSpeedConfigError(
+            f"{c.PLANNER}.{c.PLANNER_PLAN_FILE} is required when the "
+            f"block is enabled (emit one with: ds_plan --preset 125m)")
+    return {
+        c.PLANNER_ENABLED: enabled,
+        c.PLANNER_PLAN_FILE: plan_file,
+        c.PLANNER_STRICT_DEVICE_MATCH: strict,
+    }
+
+
 def parse_inference_block(d):
     """Parse + validate the "inference" block (the serving engine,
     `deeperspeed_tpu/inference`). Module-level so `InferenceEngine` can
@@ -754,6 +806,20 @@ class DeepSpeedConfig:
                 micro_batch_size
             self._param_dict[c.GRADIENT_ACCUMULATION_STEPS] = gas
             self.elastic_valid_gpus = valid_gpus
+
+        # Profile-guided schedule planner: resolve and overlay the
+        # configured plan BEFORE the blocks parse — plan-provided keys
+        # then pass the exact same strict validation a hand-written
+        # config would, and user-set keys always win the merge.
+        self.planner_config = parse_planner_block(self._param_dict)
+        if self.planner_config is not None:
+            from ..planner.apply import overlay_plan
+            (self.planner_plan_fingerprint,
+             self.planner_applied_keys) = overlay_plan(
+                self._param_dict, self.planner_config)
+        else:
+            self.planner_plan_fingerprint = None
+            self.planner_applied_keys = []
 
         self._initialize_params(self._param_dict)
         self._configure_train_batch_size()

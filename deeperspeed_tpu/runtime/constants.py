@@ -504,6 +504,20 @@ INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT = "draft_weight_quant"
 INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT_DEFAULT = None
 
 # ---------------------------------------------------------------------------
+# Profile-guided schedule planner (docs/planner.md): the engine-side
+# hook consuming a persisted `ds_plan` plan file — its resolved config
+# (zero_optimization.schedule, activation checkpointing, offload tier,
+# quantization recipe) merges UNDER the user's explicit keys
+# ---------------------------------------------------------------------------
+PLANNER = "planner"
+PLANNER_ENABLED = "enabled"
+PLANNER_ENABLED_DEFAULT = True
+PLANNER_PLAN_FILE = "plan_file"
+PLANNER_PLAN_FILE_DEFAULT = None
+PLANNER_STRICT_DEVICE_MATCH = "strict_device_match"
+PLANNER_STRICT_DEVICE_MATCH_DEFAULT = False
+
+# ---------------------------------------------------------------------------
 # Quantization (docs/quantization.md): low-precision hot paths — serving
 # int8 weights, delayed-scaling fp8/int8 FFN matmuls, compressed
 # cross-host gradients on the explicit ZeRO-3 schedule

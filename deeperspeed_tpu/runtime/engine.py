@@ -179,6 +179,41 @@ class DeepSpeedEngine:
         self._config = DeepSpeedConfig(config_arg, mpu=mpu,
                                        param_dict=config_params,
                                        world_size=self.dp_world_size)
+        self.plan_fingerprint = getattr(
+            self._config, "planner_plan_fingerprint", None)
+        if self.plan_fingerprint:
+            log_dist(f"schedule planner: training under plan "
+                     f"{self.plan_fingerprint} "
+                     f"(planner.plan_file="
+                     f"{self._config.planner_config.get('plan_file')})",
+                     ranks=[0])
+            # A plan's schedule knobs are advisory: when the plan (not
+            # the user) set mode "explicit" but this model lacks the
+            # explicit-schedule hook, degrade to the GSPMD schedule
+            # with a warning — only a USER-set "explicit" is a hard
+            # config error (that contract is checked later, in
+            # _configure_explicit_zero3).
+            sched_from_plan = any(
+                k in ("zero_optimization",
+                      "zero_optimization.schedule",
+                      "zero_optimization.schedule.mode")
+                for k in getattr(self._config, "planner_applied_keys",
+                                 ()))
+            zconf = self._config.zero_config
+            if (sched_from_plan and zconf.schedule.mode == "explicit"
+                    and not hasattr(self.module_obj,
+                                    "build_explicit_zero3_loss")):
+                import dataclasses
+                logger.warning(
+                    f"planner: plan {self.plan_fingerprint} schedules "
+                    f"mode \"explicit\" but "
+                    f"{type(self.module_obj).__name__} does not expose "
+                    f"build_explicit_zero3_loss(...); falling back to "
+                    f"the GSPMD schedule (the plan's prefetch/bucket/"
+                    f"group knobs do not apply)")
+                self._config.zero_config = dataclasses.replace(
+                    zconf, schedule=dataclasses.replace(
+                        zconf.schedule, mode="gspmd"))
 
         # --- precision / zero --------------------------------------------
         self.compute_dtype = self._config.precision

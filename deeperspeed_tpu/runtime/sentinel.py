@@ -267,7 +267,11 @@ class HangWatchdog:
                                and not self._fired
                                and time.monotonic() > self._deadline)
                     if expired:
+                        # one-shot per armed step: consume the deadline
+                        # so the (slow) callback can't race a re-check —
+                        # only the next arm() re-enables expiry
                         self._fired = True
+                        self._deadline = None
                 if expired:
                     try:
                         getattr(owner, on_expire_name)()

@@ -223,6 +223,35 @@ def test_parse_only_key_harvests_serving_blocks():
             "num_draft_tokens", "draft_weight_quant"} <= harvested
 
 
+def test_parse_only_key_harvests_planner_block():
+    """Same drill for the schedule planner's `planner` block: its keys
+    are declared through `c.PLANNER_*` constants, so the harvest must
+    resolve them via the constants table — and the rule then demands a
+    real consumer for each (planner/apply.py reads plan_file and
+    strict_device_match; enabled gates the overlay)."""
+    from tools.dslint.config_keys import (_constants_aliases,
+                                          _constants_tables,
+                                          _known_set_assignments,
+                                          _resolve_key)
+    sources = []
+    for rel in (os.path.join("deeperspeed_tpu", "runtime", "config.py"),
+                os.path.join("deeperspeed_tpu", "runtime",
+                             "constants.py")):
+        ap = os.path.join(REPO_ROOT, rel)
+        with open(ap) as f:
+            sources.append(SourceFile(ap, rel, f.read()))
+    tables = _constants_tables(sources)
+    harvested = set()
+    for src in sources:
+        aliases = _constants_aliases(src, tables)
+        for assign in _known_set_assignments(src):
+            for elt in assign.value.elts:
+                key = _resolve_key(elt, aliases)
+                if key is not None:
+                    harvested.add(key)
+    assert {"enabled", "plan_file", "strict_device_match"} <= harvested
+
+
 # ---------------------------------------------------------------------------
 # seeding: each fixture bug class injected into a copy of runtime code
 # is caught (the acceptance-criteria drill)

@@ -118,7 +118,11 @@ def test_full_saves_strictly_less_than_none():
                                  remat_policy=policy)
         pshapes = jax.tree_util.tree_map(
             lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), PARAMS)
-        toks = jax.ShapeDtypeStruct((8, 128), jnp.int32)
+        # batch/seq sized so saved-activation volume dominates XLA
+        # buffer-assignment noise: at (8, 128) the two programs differ
+        # by ~2% of temp bytes and the ordering flips across backend
+        # versions; at (32, 512) full remat holds ~28% fewer temp bytes
+        toks = jax.ShapeDtypeStruct((32, 512), jnp.int32)
         return compiled_memory_stats(
             lambda p, t: jax.grad(
                 lambda q: model.loss_fn(q, (t, t)))(p),

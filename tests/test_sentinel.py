@@ -373,11 +373,14 @@ def test_abort_after_consecutive_anomalies(devices):
 
 @pytest.mark.fault_injection
 def test_watchdog_dumps_stacks_on_stalled_step(devices):
+    # deadline sized well above a non-stalled step on a loaded 1-core
+    # host (0.25s double-fired there: the dump itself slowed step 3
+    # past the deadline) while the stall still overshoots it 2.5x
     engine = make_engine(cfg(
         training_health=th(
-            hang_timeout_seconds=0.25,
+            hang_timeout_seconds=1.0,
             fault_injection={"faults": [
-                {"kind": "stall", "step": 2, "seconds": 0.7}]}),
+                {"kind": "stall", "step": 2, "seconds": 2.5}]}),
     ))
     batches = list(random_batches(4, BATCH, HIDDEN, seed=3))
     for b in batches:
@@ -397,9 +400,9 @@ def test_watchdog_requests_preemption_save(tmp_path, devices):
         checkpoint={"save_dir": str(tmp_path),
                     "save_on_preemption": True},
         training_health=th(
-            hang_timeout_seconds=0.25,
+            hang_timeout_seconds=1.0,
             fault_injection={"faults": [
-                {"kind": "stall", "step": 1, "seconds": 0.7}]}),
+                {"kind": "stall", "step": 1, "seconds": 2.5}]}),
     ))
     batches = list(random_batches(3, BATCH, HIDDEN, seed=3))
     engine.train_batch(batch=stack1(batches[0]))
