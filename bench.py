@@ -76,6 +76,13 @@ KEYS (default all):
              vs the hand-default explicit schedule on the 125M zero3
              ladder, plan fingerprint + chosen label in extra; opt-in
              via DS_BENCH_PLAN=1)
+  - rl       (online-RL row: the co-located train+serve PPO loop on a
+             CPU-proxy NeoX — rollout tokens/s under the
+             continuous-batching scheduler, update-step ms, train->serve
+             hot-swap latency, the zero-recompile pin (compile delta 0
+             after warmup), and the co-residency tax: the same
+             pretraining step timed alone vs with the RL pair resident
+             (<=10% degradation target); opt-in via DS_BENCH_RL=1)
 
 The zero3 row additionally measures `zero3_explicit` — the explicit
 shard_map collective schedule (layer-ahead bucketed all-gather prefetch,
@@ -103,7 +110,9 @@ ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "zero3": 800, "pipe": 900, "offload": 1100,
                "elastic": 600, "fleet": 600,
                "quant": 1100,  # moe/longseq/quant walk both engines
-               "plan": 1100}  # two full 125m variants (race both ways)
+               "plan": 1100,  # two full 125m variants (race both ways)
+               "rl": 900}
+
 ROW_TIMEOUT_DEFAULT = 420
 
 
@@ -2062,6 +2071,124 @@ def row_quant():
     return out
 
 
+def row_rl():
+    """Online-RL row (docs/rl.md): the co-located train+serve loop on a
+    CPU-proxy NeoX. Measures rollout throughput under the
+    continuous-batching scheduler, the PPO update step, train->serve
+    hot-swap latency, the zero-recompile pin (compile delta across the
+    timed iterations must be 0), and the co-residency tax — the SAME
+    pretraining step timed alone vs with the RL engine pair (train
+    engine + serving engine + its KV pool) resident; the acceptance
+    target is <=10% degradation. Scale with DS_BENCH_RL_{HIDDEN,
+    LAYERS,BS,ITERS,...}; opt-in via DS_BENCH_RL=1."""
+    jax = _setup_jax()
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+    from deeperspeed_tpu.rl import RLDriver
+
+    n_chips = len(jax.devices())
+    hidden = int(os.environ.get("DS_BENCH_RL_HIDDEN", "256"))
+    layers = int(os.environ.get("DS_BENCH_RL_LAYERS", "4"))
+    heads = int(os.environ.get("DS_BENCH_RL_HEADS", "8"))
+    vocab = int(os.environ.get("DS_BENCH_RL_VOCAB", "8192"))
+    bs = int(os.environ.get("DS_BENCH_RL_BS", "8"))    # rollouts / chip
+    iters = int(os.environ.get("DS_BENCH_RL_ITERS", "4"))
+    steps = int(os.environ.get("DS_BENCH_RL_STEPS", "6"))
+    max_new = int(os.environ.get("DS_BENCH_RL_MAX_NEW", "16"))
+    prompt_len = int(os.environ.get("DS_BENCH_RL_PROMPT", "32"))
+
+    bs += bs % 2                       # group_size-2 pairing
+    rollouts = bs * n_chips
+    seq = -(-(prompt_len + max_new) // 8) * 8
+
+    cfg = GPTNeoXConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=max(seq, 128))
+    model = GPTNeoX(cfg, use_pallas=False)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    lm_tokens = rng.integers(0, vocab, size=(1, rollouts, seq),
+                             dtype=np.int32)
+    out = {}
+
+    def train_engine(extra_cfg=None):
+        config = {"train_batch_size": rollouts,
+                  "gradient_accumulation_steps": 1,
+                  "steps_per_print": 10_000,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-4}}}
+        config.update(extra_cfg or {})
+        eng, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params=config)
+        return eng
+
+    def run():
+        # (a) pure-pretraining baseline: the degradation denominator,
+        # measured BEFORE the RL pair exists
+        base = train_engine()
+        dt, _ = timed_steps(base, (lm_tokens, lm_tokens), steps=steps,
+                            warmup=2)
+        pre_ms = dt / steps * 1e3
+
+        # (b) the RL loop: warmup iteration compiles every path (serve
+        # buckets, eval logits, PPO update), then the timed iterations
+        # must hold the zero-recompile pin
+        rl_engine = train_engine({"rl": {
+            "enabled": True, "loss": "ppo_clip",
+            "rollouts_per_iteration": rollouts, "group_size": 2,
+            "max_new_tokens": max_new, "sequence_length": seq}})
+        pages_per = -(-seq // 16)
+        serve_config = {"inference": {
+            "enabled": True, "page_size": 16,
+            "num_pages": 2 * rollouts * pages_per,
+            "max_batch_size": min(rollouts, 8),
+            "token_budget": max(2 * rollouts * seq, 512),
+            "prefill_lengths": [-(-prompt_len // 16) * 16],
+            "prefill_batch_sizes": [1, 2, 4],
+            "decode_batch_sizes": [1, 2, 4, 8],
+            "temperature": 1.0, "seed": 7}}
+        prompts = [list(map(int,
+                            rng.integers(1, vocab, size=prompt_len)))
+                   for _ in range(max(rollouts // 2, 4))]
+        driver = RLDriver(rl_engine, prompts,
+                          lambda pr, resp: float(len(set(resp))),
+                          serve_config)
+        driver.run_iteration()
+        t0 = time.perf_counter()
+        rows = [driver.run_iteration() for _ in range(iters)]
+        wall = time.perf_counter() - t0
+
+        roll_s = sum(r["rollout_s"] for r in rows)
+        roll_tok = sum(r["rollout_tokens"] for r in rows)
+        res = {
+            "rl_rollout_tokens_per_s": round(
+                roll_tok / max(roll_s, 1e-9), 1),
+            # everything in the iteration that is not rollout: behavior/
+            # reference logprobs, batch build, the PPO update, the swap
+            "rl_update_step_ms": round((wall - roll_s) / iters * 1e3, 1),
+            "rl_swap_ms": round(
+                sum(r["swap_ms"] for r in rows) / iters, 2),
+            "rl_compile_delta": sum(r["compile_delta"] for r in rows),
+            "rl_mean_kl": round(rows[-1]["mean_kl"], 5),
+        }
+
+        # (c) co-residency tax: the SAME pretraining step, RL pair now
+        # resident (no recompile — same engine, same shapes)
+        dt2, _ = timed_steps(base, (lm_tokens, lm_tokens), steps=steps,
+                             warmup=1)
+        co_ms = dt2 / steps * 1e3
+        res["rl_pretrain_step_ms"] = round(pre_ms, 1)
+        res["rl_colocated_step_ms"] = round(co_ms, 1)
+        res["rl_train_step_degradation"] = round(co_ms / pre_ms - 1, 4)
+        return res
+
+    out = _ladder([("ppo", run)], out, "rl")
+    out["rl_knobs"] = {
+        "hidden": hidden, "layers": layers, "rollouts": rollouts,
+        "seq": seq, "max_new": max_new, "prompt": prompt_len,
+        "iters": iters, "steps": steps}
+    return out
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
@@ -2071,7 +2198,7 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "serve_prefix": row_serve_prefix,
            "elastic": row_elastic, "fleet": row_fleet,
            "pipe": row_pipe, "offload": row_offload,
-           "quant": row_quant, "plan": row_plan}
+           "quant": row_quant, "plan": row_plan, "rl": row_rl}
 
 
 # ---------------------------------------------------------------------------
@@ -2111,6 +2238,8 @@ def rows_enabled():
         order.append("quant")
     if os.environ.get("DS_BENCH_PLAN", "0") not in ("0", "", "false"):
         order.append("plan")
+    if os.environ.get("DS_BENCH_RL", "0") not in ("0", "", "false"):
+        order.append("rl")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -2120,7 +2249,7 @@ def rows_enabled():
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
                    "serve_chaos", "serve_prefix", "elastic", "fleet",
-                   "pipe", "offload", "quant", "plan"):
+                   "pipe", "offload", "quant", "plan", "rl"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

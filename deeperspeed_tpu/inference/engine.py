@@ -169,7 +169,7 @@ class InferenceEngine:
 
     def __init__(self, model, config=None, config_params=None, params=None,
                  mesh=None, rng=None, monitor=None, draft_model=None,
-                 draft_params=None):
+                 draft_params=None, owns_monitor=True):
         self.model = model
         cfg = model.config
         if getattr(cfg, "moe_num_experts", 0):
@@ -391,6 +391,12 @@ class InferenceEngine:
         #    wait is a per-request scalar — docs/inference.md) ------------
         from ..runtime.telemetry import build_telemetry
         self.monitor = monitor
+        # co-residency contract (docs/rl.md): when the monitor is BORROWED
+        # from a co-located training engine (owns_monitor=False), drain()
+        # flushes it but must not close it — the training engine still
+        # records Train/* scalars, and TensorBoardMonitor registers its
+        # own weak atexit close, so no second registration happens here
+        self._owns_monitor = bool(owns_monitor)
         self.telemetry = build_telemetry(telemetry_config, monitor=monitor,
                                          devices=jax.local_devices())
 
@@ -500,6 +506,46 @@ class InferenceEngine:
         # (same avals = jit cache hit) — no recompile ladder to repay
         self._set_params(params)
         return path, client_state
+
+    def hot_swap_weights(self, natural_params):
+        """In-process train->serve weight flow (docs/rl.md): re-run
+        `prepare_inference_params` (dtype cast + optional int8
+        requantization — weights AND scales are runtime jit args) and
+        swap via `_set_params`. The warmed bucket executables stay valid
+        because every compiled program takes params as runtime
+        arguments: same avals = jit cache hit, zero recompiles.
+
+        Returns ``{"swap_ms", "compile_delta"}``; a non-zero
+        compile_delta after warmup is the regression the satellite test
+        pins to 0."""
+        before = self.compile_count()
+        t0 = time.perf_counter()
+        params = prepare_inference_params(natural_params,
+                                          self.compute_dtype,
+                                          weight_quant=self.weight_quant)
+        self._set_params(params)
+        jax.block_until_ready(self.params)
+        swap_ms = (time.perf_counter() - t0) * 1e3
+        return {"swap_ms": swap_ms,
+                "compile_delta": self.compile_count() - before}
+
+    def sampler_state(self):
+        """Deterministic-replay snapshot of every sampling stream: the
+        fold_in step counter (`_next_rng`) and, when speculation is
+        armed, the host-side rejection-sampling PCG64 state. Pure data —
+        checkpointable via client_state."""
+        state = {"steps": int(self._steps)}
+        if self.spec_k:
+            state["spec_rng"] = self._spec_rng.bit_generator.state
+        return state
+
+    def restore_sampler_state(self, state):
+        """Restore `sampler_state()`; sampling is a pure function of
+        (seed, steps), so a restored engine reproduces the exact token
+        stream an uninterrupted run would have drawn."""
+        self._steps = int(state["steps"])
+        if self.spec_k and "spec_rng" in state:
+            self._spec_rng.bit_generator.state = state["spec_rng"]
 
     # ------------------------------------------------------------------
     # compiled programs (one per bucket — the no-recompile discipline)
@@ -1601,7 +1647,14 @@ class InferenceEngine:
         # per-status terminal counters — the DrainAborted failures land
         # in Serve/requests_failed BEFORE the monitor closes)
         if self.monitor is not None:
-            self.monitor.close()    # drain the buffered scalar queue
+            if self._owns_monitor:
+                self.monitor.close()  # drain the buffered scalar queue
+            else:
+                # borrowed from a co-resident training engine: flush the
+                # Serve/* scalars but leave it open for Train/* records
+                flush = getattr(self.monitor, "flush", None)
+                if flush is not None:
+                    flush()
         self.telemetry.close()
         self.restore_signal_handlers()
         logger.info(f"inference drain complete: {summary}")
@@ -1642,6 +1695,34 @@ class InferenceEngine:
             for r in self.scheduler.pop_finished():
                 done[r.request_id] = r
         return [list(done[i].generated) for i in ids]
+
+    def generate_rollouts(self, prompts, max_new_tokens, eos_token_id=None):
+        """RL rollout batch API (docs/rl.md): `generate` plus the
+        throughput/speculation accounting the driver's `Train/RL/*`
+        scalars and the bench row need. Returns ``(outputs, stats)``
+        where ``outputs[i]`` is prompt ``i``'s generated token list and
+        ``stats`` carries rollout wall time, generated-token counts and
+        the serve-side deltas (compile count, spec acceptance) for THIS
+        call only."""
+        before = {"compile": self.compile_count(),
+                  "spec_proposed": self.stats["spec_proposed"],
+                  "spec_accepted": self.stats["spec_accepted"]}
+        t0 = time.perf_counter()
+        outputs = self.generate(prompts, max_new_tokens,
+                                eos_token_id=eos_token_id)
+        rollout_s = time.perf_counter() - t0
+        tokens = sum(len(o) for o in outputs)
+        stats = {
+            "rollout_s": rollout_s,
+            "rollout_tokens": tokens,
+            "tokens_per_s": tokens / max(rollout_s, 1e-9),
+            "compile_delta": self.compile_count() - before["compile"],
+        }
+        if self.spec_k:
+            proposed = self.stats["spec_proposed"] - before["spec_proposed"]
+            accepted = self.stats["spec_accepted"] - before["spec_accepted"]
+            stats["spec_acceptance_rate"] = accepted / max(proposed, 1)
+        return outputs, stats
 
     def serve_stats(self):
         """Counters + phase seconds + request-latency percentiles

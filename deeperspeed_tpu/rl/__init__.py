@@ -1,0 +1,21 @@
+"""Online-RL driver: co-located train+serve loop (docs/rl.md).
+
+The training engine (`DeepSpeedEngine`) and the serving engine
+(`InferenceEngine`) live in ONE process: rollouts are generated under
+the continuous-batching scheduler, PPO-clip/DPO losses train on the
+existing engine substrate through the `loss_fn` registry hook, and
+updated weights flow train->serve by in-process hot-swap with zero
+recompiles (params are runtime jit args on both sides).
+"""
+
+from .losses import get_rl_loss, register_rl_loss, token_logprobs
+from .buffer import RolloutBuffer
+from .driver import RLDriver
+
+__all__ = [
+    "RLDriver",
+    "RolloutBuffer",
+    "get_rl_loss",
+    "register_rl_loss",
+    "token_logprobs",
+]

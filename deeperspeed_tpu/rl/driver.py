@@ -1,0 +1,282 @@
+"""RLDriver: the co-located train+serve online-RL loop (docs/rl.md).
+
+One process holds both engines: the `DeepSpeedEngine` whose `loss_fn`
+the "rl" config block swapped for PPO-clip/DPO, and an
+`InferenceEngine` generating rollouts under the continuous-batching
+scheduler from the SAME initial weights. Each iteration:
+
+    rollout (serve) -> reward -> reference/behavior logprobs ->
+    train_batch (one update) -> hot_swap_weights (train->serve)
+
+The loop is deterministic and replayable: rollout sampling is a pure
+function of (inference.seed, sampler step counter), the training side
+of (PR 3 full-state resume: micro_steps drive the train rng), and
+checkpoints COMMIT only at iteration boundaries with the driver state
+(iteration counter, prompt cursor, sampler keys, buffer counters) in
+`client_state` — so a SIGTERM/`os._exit` mid-iteration resumes from the
+last committed boundary and replays the killed iteration bit-exactly.
+"""
+
+import os
+
+import jax
+import numpy as np
+
+from ..inference.engine import InferenceEngine
+from ..runtime import constants as c
+from ..runtime.config import DeepSpeedConfigError
+from ..utils.logging import logger
+from .buffer import RolloutBuffer
+from .losses import token_logprobs
+
+# the frozen-reference snapshot rides NEXT TO the engine checkpoints:
+# written exactly once (iteration 0), loaded on resume — re-snapshotting
+# the CURRENT (trained) params as "reference" would silently zero the KL
+# anchor every restart
+REF_SNAPSHOT = "rl_ref_params.pt"
+
+
+def _round_up8(n):
+    return -(-n // 8) * 8
+
+
+class RLDriver:
+    """Drives the online-RL loop over a training engine built with an
+    enabled "rl" config block.
+
+    ``prompts`` is a list of token-id lists, cycled deterministically;
+    ``reward_fn(prompt_tokens, response_tokens) -> float`` scores each
+    engine-generated rollout; ``serve_config`` is the co-resident
+    serving engine's config (a dict with an "inference" block, or a
+    DeepSpeedConfig) — its ``seed`` is the rollout sampling seed.
+    """
+
+    def __init__(self, engine, prompts, reward_fn, serve_config,
+                 draft_model=None, draft_params=None, checkpoint_dir=None,
+                 eos_token_id=None):
+        p = getattr(engine._config, "rl_params", None)
+        if not p:
+            raise DeepSpeedConfigError(
+                "RLDriver needs an engine built with an enabled \"rl\" "
+                "config block (it installs the RL loss_fn at engine "
+                "init; there is no post-hoc swap)")
+        if engine.gradient_accumulation_steps() != 1:
+            raise DeepSpeedConfigError(
+                "the RL driver updates on exactly one rollout batch per "
+                "iteration: set gradient_accumulation_steps to 1")
+        if not prompts:
+            raise DeepSpeedConfigError("RLDriver needs at least one prompt")
+        prompts = [list(map(int, pr)) for pr in prompts]
+        if any(not pr for pr in prompts):
+            raise DeepSpeedConfigError("RLDriver prompts must be non-empty")
+
+        self.engine = engine
+        self.rl_params = p
+        self.prompts = prompts
+        self.reward_fn = reward_fn
+        self.checkpoint_dir = checkpoint_dir
+        self.eos_token_id = eos_token_id
+        self.loss_name = p[c.RL_LOSS]
+        self.group_size = p[c.RL_GROUP_SIZE]
+        self.rollouts_per_iteration = p[c.RL_ROLLOUTS_PER_ITERATION]
+        self.max_new_tokens = p[c.RL_MAX_NEW_TOKENS]
+        self.group_count = self.rollouts_per_iteration // self.group_size
+        self.checkpoint_interval = p[c.RL_CHECKPOINT_INTERVAL]
+
+        # ONE compiled train/eval shape for the whole run
+        longest = max(len(pr) for pr in prompts)
+        seq_len = p[c.RL_SEQUENCE_LENGTH] or _round_up8(
+            longest + self.max_new_tokens)
+        if longest + self.max_new_tokens > seq_len:
+            raise DeepSpeedConfigError(
+                f"rl.{c.RL_SEQUENCE_LENGTH} {seq_len} cannot hold the "
+                f"longest prompt ({longest}) + {c.RL_MAX_NEW_TOKENS} "
+                f"({self.max_new_tokens})")
+        model = engine.module_obj
+        if seq_len > model.config.max_seq_len:
+            raise DeepSpeedConfigError(
+                f"rl sequence_length {seq_len} exceeds the model's "
+                f"max_seq_len {model.config.max_seq_len}")
+        self.sequence_length = seq_len
+
+        # the update batch the engine was configured for must match the
+        # rollout geometry EXACTLY — a mismatch is a recompile per
+        # iteration at best, a silent wrong-batch at worst
+        update_rows = (self.rollouts_per_iteration
+                       if self.loss_name == "ppo_clip"
+                       else 2 * self.group_count)
+        if engine.train_batch_size() != update_rows:
+            raise DeepSpeedConfigError(
+                f"train_batch_size {engine.train_batch_size()} != the RL "
+                f"update batch {update_rows} rows ("
+                f"{'rollouts_per_iteration' if self.loss_name == 'ppo_clip' else 'one chosen/rejected pair per prompt group'}"
+                f"): align the batch triad with the rl block")
+
+        # -- frozen reference ------------------------------------------------
+        ref = None
+        if checkpoint_dir is not None:
+            ref_path = os.path.join(checkpoint_dir, REF_SNAPSHOT)
+            if os.path.exists(ref_path):
+                from ..checkpoint.serialization import load_obj
+                ref = load_obj(ref_path)
+                logger.info(f"rl: loaded frozen reference from {ref_path}")
+        if ref is None:
+            ref = jax.tree_util.tree_map(
+                np.asarray, engine.params_to_natural(engine.state.params))
+            if checkpoint_dir is not None:
+                from ..checkpoint.serialization import save_obj
+                os.makedirs(checkpoint_dir, exist_ok=True)
+                save_obj(ref, os.path.join(checkpoint_dir, REF_SNAPSHOT))
+
+        self.buffer = RolloutBuffer(model, ref, p, seq_len)
+
+        # -- co-resident serving engine (BORROWED monitor: Train/* and
+        #    Serve/* scalars interleave into one event stream without the
+        #    serve drain closing it under the training engine) -------------
+        self.serve = InferenceEngine(
+            model, config=serve_config,
+            params=engine.params_to_natural(engine.state.params),
+            monitor=engine.monitor, owns_monitor=False,
+            draft_model=draft_model, draft_params=draft_params)
+
+        self.iteration = 0
+        self.cursor = 0
+        self.last_iteration_stats = None
+        self.stats = {"iterations": 0, "rollout_tokens": 0,
+                      "rollout_s": 0.0, "swap_ms": 0.0,
+                      "compile_delta": 0}
+
+    # -- checkpoint / resume -----------------------------------------------
+
+    def _client_state(self):
+        return {"rl": {
+            "iteration": int(self.iteration),
+            "cursor": int(self.cursor),
+            "sampler": self.serve.sampler_state(),
+            "buffer": self.buffer.state_dict(),
+        }}
+
+    def save_checkpoint(self, tag=None):
+        if self.checkpoint_dir is None:
+            raise DeepSpeedConfigError(
+                "RLDriver was built without checkpoint_dir")
+        return self.engine.save_checkpoint(
+            self.checkpoint_dir, tag=tag,
+            client_state=self._client_state())
+
+    def resume(self, tag=None):
+        """Restore the last committed iteration boundary: engine full
+        state (params/optimizer/micro_steps -> train rng), driver
+        counters, serve sampler streams — then hot-swap the restored
+        weights into the serving engine so both sides resume from the
+        SAME policy. Returns True when a checkpoint was found."""
+        if self.checkpoint_dir is None:
+            raise DeepSpeedConfigError(
+                "RLDriver was built without checkpoint_dir")
+        path, client = self.engine.load_checkpoint(self.checkpoint_dir,
+                                                   tag=tag)
+        if path is None:
+            return False
+        rl = (client or {}).get("rl")
+        if rl is None:
+            raise DeepSpeedConfigError(
+                f"checkpoint {path} has no \"rl\" client_state: it was "
+                f"not written by an RLDriver (a pretraining checkpoint "
+                f"cannot pin the sampler streams)")
+        self.iteration = int(rl["iteration"])
+        self.cursor = int(rl["cursor"])
+        self.serve.restore_sampler_state(rl["sampler"])
+        self.buffer.load_state_dict(rl["buffer"])
+        self.serve.hot_swap_weights(
+            self.engine.params_to_natural(self.engine.state.params))
+        logger.info(f"rl: resumed at iteration {self.iteration} "
+                    f"from {path}")
+        return True
+
+    # -- the loop ------------------------------------------------------------
+
+    def _iteration_prompts(self):
+        idx = [(self.cursor + i) % len(self.prompts)
+               for i in range(self.group_count)]
+        return [self.prompts[i]
+                for i in idx for _ in range(self.group_size)]
+
+    def run_iteration(self):
+        """One full rollout->update->swap iteration; returns its stats
+        dict. Determinism contract: everything here is a pure function
+        of (committed engine state, committed sampler state, prompt
+        cursor) — the only checkpoint commit happens AFTER the swap, at
+        the iteration boundary."""
+        engine, serve = self.engine, self.serve
+        batch_prompts = self._iteration_prompts()
+        outputs, rstats = serve.generate_rollouts(
+            batch_prompts, self.max_new_tokens,
+            eos_token_id=self.eos_token_id)
+        rewards = [float(self.reward_fn(pr, out))
+                   for pr, out in zip(batch_prompts, outputs)]
+        rollouts = [{"prompt": pr, "response": out, "reward": rw}
+                    for pr, out, rw in zip(batch_prompts, outputs, rewards)]
+
+        tokens, mask = self.buffer.pad(rollouts)
+        ref_logp = self.buffer.ref_logprobs(tokens)
+        mean_kl = 0.0
+        if self.loss_name == "ppo_clip":
+            # behavior policy = the weights that SAMPLED this batch
+            # (pre-update), teacher-forced through the engine's fused
+            # eval path — fixed [N, S] shape, one compile at warmup
+            _, logits = engine.eval_batch(tokens, return_logits=True)
+            behavior = np.asarray(token_logprobs(logits, tokens))
+            denom = max(float(mask.sum()), 1.0)
+            mean_kl = float(((behavior - ref_logp) * mask).sum() / denom)
+            batch = self.buffer.build_ppo_batch(tokens, mask, behavior,
+                                                ref_logp, rewards)
+        else:
+            batch = self.buffer.build_dpo_batch(tokens, mask, ref_logp,
+                                                rewards)
+
+        # gas == 1: one pre-stacked [1, rows, ...] micro-batch
+        stacked = jax.tree_util.tree_map(lambda x: x[None], batch)
+        loss = float(engine.train_batch(batch=stacked))
+
+        swap = serve.hot_swap_weights(
+            engine.params_to_natural(engine.state.params))
+
+        self.iteration += 1
+        self.cursor = (self.cursor + self.group_count) % len(self.prompts)
+
+        out = {
+            "iteration": self.iteration,
+            "loss": loss,
+            "mean_reward": float(np.mean(rewards)),
+            "mean_kl": mean_kl,
+            "rollout_tokens": rstats["rollout_tokens"],
+            "rollout_tokens_per_s": rstats["tokens_per_s"],
+            "rollout_s": rstats["rollout_s"],
+            "swap_ms": swap["swap_ms"],
+            # compile growth this iteration (rollout + swap); 0 after
+            # the warmup iteration is the zero-recompile pin
+            "compile_delta": rstats["compile_delta"]
+            + swap["compile_delta"],
+        }
+        if "spec_acceptance_rate" in rstats:
+            out["spec_acceptance_rate"] = rstats["spec_acceptance_rate"]
+        self.last_iteration_stats = out
+        self.stats["iterations"] += 1
+        self.stats["rollout_tokens"] += out["rollout_tokens"]
+        self.stats["rollout_s"] += out["rollout_s"]
+        self.stats["swap_ms"] += out["swap_ms"]
+        self.stats["compile_delta"] += out["compile_delta"]
+
+        if engine.monitor is not None:
+            engine.monitor.record(engine.global_samples, {
+                f"Train/RL/{k}": float(v) for k, v in out.items()})
+
+        if self.checkpoint_dir is not None and \
+                self.iteration % self.checkpoint_interval == 0:
+            self.save_checkpoint()
+        return out
+
+    def train(self, num_iterations):
+        """Run `num_iterations` iterations; returns the per-iteration
+        stats list."""
+        return [self.run_iteration() for _ in range(num_iterations)]

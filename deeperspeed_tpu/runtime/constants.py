@@ -542,3 +542,42 @@ QUANTIZATION_FFN_MARGIN_DEFAULT = 1.0
 QUANTIZATION_GRAD_COMPRESSION = "gradient_compression"
 QUANTIZATION_GRAD_COMPRESSION_ENABLED = "enabled"
 QUANTIZATION_GRAD_COMPRESSION_ENABLED_DEFAULT = True
+
+# ---------------------------------------------------------------------------
+# Online RL (docs/rl.md): the co-located train+serve driver
+# (deeperspeed_tpu/rl) — rollout generation through the serving engine,
+# PPO-clip / DPO losses on the training engine, train→serve weight flow
+# by in-process hot-swap with zero recompiles
+# ---------------------------------------------------------------------------
+RL = "rl"
+RL_ENABLED = "enabled"
+RL_ENABLED_DEFAULT = False
+RL_LOSS = "loss"
+RL_LOSS_DEFAULT = "ppo_clip"
+RL_LOSS_CHOICES = ("ppo_clip", "dpo")
+# total rollouts generated per driver iteration (must be a multiple of
+# group_size; PPO updates on all of them, DPO on one pair per group)
+RL_ROLLOUTS_PER_ITERATION = "rollouts_per_iteration"
+RL_ROLLOUTS_PER_ITERATION_DEFAULT = 8
+# rollouts sampled per prompt: the advantage baseline group (PPO) /
+# the chosen-vs-rejected candidate pool (DPO, needs >= 2)
+RL_GROUP_SIZE = "group_size"
+RL_GROUP_SIZE_DEFAULT = 1
+RL_MAX_NEW_TOKENS = "max_new_tokens"
+RL_MAX_NEW_TOKENS_DEFAULT = 16
+# fixed padded rollout width (the ONE compiled train/logprob shape);
+# null = max prompt length + max_new_tokens, rounded up to 8
+RL_SEQUENCE_LENGTH = "sequence_length"
+RL_SEQUENCE_LENGTH_DEFAULT = None
+# PPO-clip knobs
+RL_CLIP_RATIO = "clip_ratio"
+RL_CLIP_RATIO_DEFAULT = 0.2
+RL_KL_COEF = "kl_coef"
+RL_KL_COEF_DEFAULT = 0.05
+# DPO preference temperature
+RL_BETA = "beta"
+RL_BETA_DEFAULT = 0.1
+# driver iterations between committed checkpoints (the deterministic-
+# resume granularity: a kill replays at most this many iterations)
+RL_CHECKPOINT_INTERVAL = "checkpoint_interval"
+RL_CHECKPOINT_INTERVAL_DEFAULT = 1

@@ -253,6 +253,14 @@ class DeepSpeedEngine:
             self.module_obj = model
             self.loss_fn = self._resolve_model(model)
 
+        # --- online-RL loss override (the "rl" block; docs/rl.md) ---------
+        # Swaps the model's LM loss_fn for a registered RL loss (PPO-clip
+        # / DPO) BEFORE the optimizer/ZeRO plumbing reads it: the RL loss
+        # rides jax.value_and_grad under every GSPMD ZeRO stage and the
+        # host-offload optimizer exactly like the LM loss it replaces.
+        if self._config.rl_params:
+            self._apply_rl_loss_override()
+
         # --- optimizer / schedulers --------------------------------------
         self.optimizer = self._configure_optimizer(optimizer)
         self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -991,6 +999,55 @@ class DeepSpeedEngine:
                     f"zeros (a dp change re-deals the gather geometry)")
         self.state = self.state._replace(quant=QuantState(amax=amax,
                                                           ef=ef))
+
+    def _apply_rl_loss_override(self):
+        """Install the configured RL loss (rl.losses registry) as
+        `self.loss_fn`, rejecting engine modes whose loss program is
+        HARDCODED to the LM objective: the explicit ZeRO-3 schedule and
+        the streamed/tiered param-offload executors build their own
+        fused loss-and-grad programs (`build_explicit_zero3_loss`), and
+        quantization.ffn threads an amax history through the model's own
+        loss_fn — none of them consult `self.loss_fn`, so silently
+        accepting them would train the WRONG objective. GSPMD ZeRO 0-3
+        and the host-offload optimizer go through
+        `jax.value_and_grad(self.loss_fn)` and compose (docs/rl.md)."""
+        p = self._config.rl_params
+        if getattr(self._config, "pipeline_config", None) is not None \
+                or hasattr(self, "pipeline_module"):
+            raise DeepSpeedConfigError(
+                "the \"rl\" block cannot ride pipeline parallelism: the "
+                "1F1B executor streams the LM loss between stages, not a "
+                "pluggable loss_fn")
+        if self._config.zero_config.schedule.mode == "explicit":
+            raise DeepSpeedConfigError(
+                "the \"rl\" block cannot ride "
+                "zero_optimization.schedule.mode \"explicit\": the "
+                "explicit ZeRO-3 schedule compiles its own fused LM "
+                "loss-and-grad program and bypasses loss_fn — use GSPMD "
+                "ZeRO (stage 0-3) for the policy engine")
+        if self._config.zero_config.offload_param is not None:
+            raise DeepSpeedConfigError(
+                "the \"rl\" block cannot ride zero_optimization."
+                "offload_param: the streamed/tiered executors hardcode "
+                "the LM objective — use offload_optimizer (host CPU "
+                "Adam) to free HBM for the co-resident serving engine")
+        if (self._config.quantization_config or {}).get("ffn"):
+            raise DeepSpeedConfigError(
+                "the \"rl\" block cannot ride quantization.ffn: the "
+                "delayed-scaling FFN path calls the model's own loss_fn "
+                "with an amax history the RL losses do not thread")
+        model = self.module_obj
+        if not (hasattr(model, "apply") and
+                hasattr(model, "loss_and_logits")):
+            raise DeepSpeedConfigError(
+                "the \"rl\" block needs a model exposing apply(params, "
+                "tokens) and loss_and_logits(params, batch) "
+                "(models.gpt_neox.GPTNeoX does); a bare loss_fn "
+                "callable has no logits to score rollouts with")
+        from . import constants as c
+        from ..rl.losses import get_rl_loss
+        self.loss_fn = get_rl_loss(p[c.RL_LOSS])(model, p)
+        log_dist(f"rl: loss_fn override -> {p[c.RL_LOSS]}", ranks=[0])
 
     @staticmethod
     def _resolve_model(model):

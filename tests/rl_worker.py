@@ -1,0 +1,86 @@
+"""Deterministic-resume child for the online-RL driver: runs the
+co-located train+serve PPO loop with per-iteration committed
+checkpoints, crashes hard (`os._exit`, no cleanup — the supervisor-kill
+stand-in) MID-ITERATION inside the reward callback on its first
+incarnation, and on the next incarnation resumes from the last
+committed iteration boundary. Every COMPLETED iteration appends one
+JSON line (iteration, full-precision loss, the sampled rollout token
+lists) to the given log, so the driving test can check the resumed
+trajectory is bit-identical to an uninterrupted reference run.
+
+Usage: python rl_worker.py <workdir> <log_name> <total_iters> <kill_iter>
+(kill_iter 0 = never crash — the reference-run mode; the crash fires in
+the killed iteration's SECOND reward call, i.e. after rollout
+generation, before the update and long before any checkpoint commit).
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    workdir, log_name = sys.argv[1], sys.argv[2]
+    total_iters, kill_iter = int(sys.argv[3]), int(sys.argv[4])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import deeperspeed_tpu
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+    from deeperspeed_tpu.rl import RLDriver
+
+    cfg = GPTNeoXConfig.tiny()
+    model = GPTNeoX(config=cfg, use_pallas=False)
+    engine, *_ = deeperspeed_tpu.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config_params={
+            "train_batch_size": 4,
+            "steps_per_print": 1000,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "rl": {"enabled": True, "loss": "ppo_clip",
+                   "rollouts_per_iteration": 4, "group_size": 2,
+                   "max_new_tokens": 4},
+        })
+    serve_config = {"inference": {
+        "enabled": True, "page_size": 16, "num_pages": 32,
+        "max_batch_size": 4, "token_budget": 128,
+        "prefill_lengths": [16], "prefill_batch_sizes": [1, 2],
+        "decode_batch_sizes": [1, 2, 4],
+        "temperature": 1.0, "seed": 11,
+    }}
+    prng = np.random.default_rng(3)
+    prompts = [list(map(int, prng.integers(1, cfg.vocab_size, size=6)))
+               for _ in range(3)]
+
+    responses = []
+    calls = {"n": 0}
+
+    def reward_fn(prompt, response):
+        calls["n"] += 1
+        if kill_iter and driver.iteration + 1 == kill_iter and \
+                calls["n"] % 4 == 2:
+            os._exit(9)  # mid-iteration: nothing committed for this one
+        responses.append(list(map(int, response)))
+        return float(sum(response) % 7)
+
+    driver = RLDriver(engine, prompts, reward_fn, serve_config,
+                      checkpoint_dir=os.path.join(workdir, "ckpt"))
+    if os.path.exists(os.path.join(workdir, "ckpt", "latest")):
+        assert driver.resume(), "committed checkpoint must load"
+
+    with open(os.path.join(workdir, log_name), "a") as log:
+        while driver.iteration < total_iters:
+            responses.clear()
+            out = driver.run_iteration()
+            log.write(json.dumps({"iteration": out["iteration"],
+                                  "loss": out["loss"],
+                                  "responses": responses}) + "\n")
+            log.flush()
+
+
+if __name__ == "__main__":
+    main()

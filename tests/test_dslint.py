@@ -252,6 +252,37 @@ def test_parse_only_key_harvests_planner_block():
     assert {"enabled", "plan_file", "strict_device_match"} <= harvested
 
 
+def test_parse_only_key_harvests_rl_block():
+    """Same drill for the online-RL driver's `rl` block: parse_rl_block
+    declares its known set through `c.RL_*` constants, so the harvest
+    must resolve every key via the constants table — the rule then
+    demands a real consumer for each (rl/driver.py and rl/losses.py
+    subscript the parsed dict; the engine hook reads `loss`)."""
+    from tools.dslint.config_keys import (_constants_aliases,
+                                          _constants_tables,
+                                          _known_set_assignments,
+                                          _resolve_key)
+    sources = []
+    for rel in (os.path.join("deeperspeed_tpu", "runtime", "config.py"),
+                os.path.join("deeperspeed_tpu", "runtime",
+                             "constants.py")):
+        ap = os.path.join(REPO_ROOT, rel)
+        with open(ap) as f:
+            sources.append(SourceFile(ap, rel, f.read()))
+    tables = _constants_tables(sources)
+    harvested = set()
+    for src in sources:
+        aliases = _constants_aliases(src, tables)
+        for assign in _known_set_assignments(src):
+            for elt in assign.value.elts:
+                key = _resolve_key(elt, aliases)
+                if key is not None:
+                    harvested.add(key)
+    assert {"loss", "rollouts_per_iteration", "group_size",
+            "max_new_tokens", "sequence_length", "clip_ratio",
+            "kl_coef", "beta", "checkpoint_interval"} <= harvested
+
+
 # ---------------------------------------------------------------------------
 # seeding: each fixture bug class injected into a copy of runtime code
 # is caught (the acceptance-criteria drill)
