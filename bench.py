@@ -83,6 +83,14 @@ KEYS (default all):
              after warmup), and the co-residency tax: the same
              pretraining step timed alone vs with the RL pair resident
              (<=10% degradation target); opt-in via DS_BENCH_RL=1)
+  - multislice (two-slice DCN drill on a CPU-drivable proxy: 1F1B split
+             across a simulated slice boundary with dcn_delay charged
+             per exposed crossing — classic vs comm-overlap wire
+             throughput ratio vs single-slice, the overlap wire holding
+             the <=10%-loss bar — plus a scripted slice_kill: detection
+             -> emergency checkpoint -> in-process re-partition MTTR,
+             zero survivor restarts, loss-trajectory alignment vs an
+             unfaulted reference; opt-in via DS_BENCH_MULTISLICE=1)
 
 The zero3 row additionally measures `zero3_explicit` — the explicit
 shard_map collective schedule (layer-ahead bucketed all-gather prefetch,
@@ -111,7 +119,8 @@ ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "elastic": 600, "fleet": 600,
                "quant": 1100,  # moe/longseq/quant walk both engines
                "plan": 1100,  # two full 125m variants (race both ways)
-               "rl": 900}
+               "rl": 900,
+               "multislice": 900}
 
 ROW_TIMEOUT_DEFAULT = 420
 
@@ -2189,6 +2198,173 @@ def row_rl():
     return out
 
 
+def row_multislice():
+    """Two-slice DCN drill (opt-in via DS_BENCH_MULTISLICE=1), on a
+    CPU-drivable NeoX proxy so the row runs on a single host exactly
+    like the fleet regime it models. Two measurements:
+
+    (a) throughput under injected cross-slice latency: the 4-stage 1F1B
+    pipeline split 2x2 across a simulated DCN boundary, with the
+    `dcn_delay` fault charging DS_BENCH_MS_DELAY_MS per EXPOSED
+    crossing every step, on the classic wire (2*n_micro exposed hops)
+    and the comm-overlap wire (fill+drain only). Reported as the
+    tokens/s ratio vs the same engine run single-slice — the overlap
+    wire is the one expected to hold the <=10%-loss bar.
+
+    (b) slice loss: a scripted slice_kill, heartbeat detection,
+    emergency checkpoint, in-process `repartition_after_slice_loss` to
+    the surviving 2-stage pipeline — MTTR seconds from detection to
+    the first surviving optimizer step, with zero survivor restarts by
+    construction, plus the loss-trajectory alignment bool vs an
+    unfaulted reference engine resumed from the same checkpoint."""
+    import copy
+    import shutil
+    import tempfile
+
+    jax = _setup_jax()
+    import deeperspeed_tpu
+    from deeperspeed_tpu.elasticity import (SliceLostError,
+                                            repartition_after_slice_loss)
+    from deeperspeed_tpu.models.gpt_neox import GPTNeoX, GPTNeoXConfig
+
+    n_chips = len(jax.devices())
+    stages = int(os.environ.get("DS_BENCH_MS_STAGES", "4"))
+    n_micro = int(os.environ.get("DS_BENCH_MS_MICRO", "8"))
+    delay_s = float(os.environ.get("DS_BENCH_MS_DELAY_MS", "1.0")) / 1e3
+    seq = int(os.environ.get("DS_BENCH_MS_SEQ", "256"))
+    steps = int(os.environ.get("DS_BENCH_MS_STEPS", "8"))
+    hidden = int(os.environ.get("DS_BENCH_MS_HIDDEN", "512"))
+    if n_chips % stages:
+        return {"multislice_error":
+                f"stages={stages} does not divide chips={n_chips}"}
+    dp = n_chips // stages
+    bs = 2 * n_micro * dp
+    cfg = GPTNeoXConfig(vocab_size=8192, hidden_size=hidden,
+                        num_layers=2 * stages,
+                        num_heads=max(hidden // 64, 2),
+                        max_seq_len=seq)
+    model = GPTNeoX(cfg, use_pallas=False)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, bs, seq),
+                          dtype=np.int32)
+    batch = (tokens, tokens)
+
+    def conf(overlap=False, multislice=False, faults=None, ckpt=None):
+        c = {"train_batch_size": bs,
+             "steps_per_print": 10_000,
+             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+             "pipeline": {"stages": stages, "micro_batches": n_micro,
+                          "comm_overlap": overlap}}
+        if multislice:
+            c["multislice"] = {"slices": 2, "names": ["s0", "s1"]}
+        if faults is not None:
+            c["multislice"]["slice_peers"] = {"s0": ["hostA"],
+                                              "s1": ["hostB"]}
+            c["elasticity"] = {"heartbeat": {
+                "enabled": True, "interval_s": 0.05,
+                "warn_after_s": 0.15, "fail_after_s": 0.3}}
+            c["training_health"] = {"fault_injection": {"faults": faults}}
+        if ckpt is not None:
+            c["checkpoint"] = {"save_dir": ckpt, "async_save": False}
+        return c
+
+    def engine(c):
+        eng, *_ = deeperspeed_tpu.initialize(
+            model=model, model_parameters=params, config_params=c)
+        return eng
+
+    out = {"multislice_dcn_delay_ms": delay_s * 1e3,
+           "multislice_n_micro": n_micro, "multislice_stages": stages}
+
+    def wire_race():
+        base = engine(conf())
+        dt_base, _ = timed_steps(base, batch, steps=steps, warmup=3)
+        out["multislice_single_slice_tokens_per_sec"] = round(
+            bs * seq * steps / dt_base, 1)
+        del base
+        gc.collect()
+        for overlap, tag in ((False, "classic"), (True, "overlap")):
+            # a far-future dcn_delay entry arms the injector; the
+            # per-step charge below drives the REAL stall path the
+            # fault kind uses, at `delay_s` per exposed crossing
+            eng = engine(conf(overlap=overlap, multislice=True,
+                              faults=[{"kind": "dcn_delay",
+                                       "step": 10 ** 9,
+                                       "seconds": delay_s}]))
+            exposed = eng._multislice.exposed_crossings(
+                n_micro, 2 if overlap else 1)
+            for _ in range(3):
+                eng.train_batch(batch=batch)
+            force(eng.state.params)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                eng._apply_host_fault({"kind": "dcn_delay",
+                                       "seconds": delay_s})
+                eng.train_batch(batch=batch)
+            force(eng.state.params)
+            dt = time.perf_counter() - t0
+            out[f"multislice_{tag}_exposed_crossings"] = exposed
+            out[f"multislice_{tag}_tput_ratio"] = round(dt_base / dt, 4)
+            del eng
+            gc.collect()
+        return {}
+
+    def chaos():
+        workdir = tempfile.mkdtemp(prefix="ds_bench_ms_")
+        eng = None
+        recovered = None
+        reference = None
+        try:
+            eng = engine(conf(multislice=True, ckpt=workdir,
+                              faults=[{"kind": "slice_kill", "step": 3,
+                                       "slice": "s1"}]))
+            err = None
+            try:
+                for _ in range(200):
+                    eng.train_batch(batch=batch)
+                    time.sleep(0.02)
+            except SliceLostError as e:
+                err = e
+            if err is None:
+                return {"multislice_chaos_error":
+                        "slice_kill never escalated"}
+            drill_conf = conf(multislice=True, ckpt=workdir,
+                              faults=[{"kind": "slice_kill", "step": 3,
+                                       "slice": "s1"}])
+            recovered, surv = repartition_after_slice_loss(
+                err, drill_conf,
+                lambda c: GPTNeoX(cfg, use_pallas=False), workdir)
+            recovered.train_batch(batch=batch)
+            force(recovered.state.params)
+            mttr = time.monotonic() - err.detected_at
+            ref_model = GPTNeoX(cfg, use_pallas=False)
+            reference, *_ = deeperspeed_tpu.initialize(
+                model=ref_model, config_params=copy.deepcopy(surv))
+            reference.load_checkpoint(workdir)
+            reference.train_batch(batch=batch)
+            rec_l = float(recovered.train_batch(batch=batch))
+            ref_l = float(reference.train_batch(batch=batch))
+            return {
+                "multislice_slice_kill_mttr_s": round(mttr, 2),
+                "multislice_survivor_stages": surv["pipeline"]["stages"],
+                "multislice_survivor_restarts": 0,
+                "multislice_trajectory_aligned": bool(
+                    abs(rec_l - ref_l) <= 1e-5 * max(abs(ref_l), 1.0)),
+            }
+        finally:
+            for e in (eng, recovered, reference):
+                if e is not None and \
+                        getattr(e, "peer_monitor", None) is not None:
+                    e.peer_monitor.stop()
+            shutil.rmtree(workdir, ignore_errors=True)
+            gc.collect()
+
+    _ladder([("wire", wire_race)], out, "multislice_wire")
+    _ladder([("chaos", chaos)], out, "multislice_chaos")
+    return out
+
+
 ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "bert512": row_bert512, "gpt2xl": row_gpt2xl,
            "longseq": row_longseq, "moe": row_moe, "ckpt": row_ckpt,
@@ -2198,7 +2374,8 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "serve_prefix": row_serve_prefix,
            "elastic": row_elastic, "fleet": row_fleet,
            "pipe": row_pipe, "offload": row_offload,
-           "quant": row_quant, "plan": row_plan, "rl": row_rl}
+           "quant": row_quant, "plan": row_plan, "rl": row_rl,
+           "multislice": row_multislice}
 
 
 # ---------------------------------------------------------------------------
@@ -2240,6 +2417,9 @@ def rows_enabled():
         order.append("plan")
     if os.environ.get("DS_BENCH_RL", "0") not in ("0", "", "false"):
         order.append("rl")
+    if os.environ.get("DS_BENCH_MULTISLICE", "0") not in \
+            ("0", "", "false"):
+        order.append("multislice")
     if sel in ("all", ""):
         return order
     if sel == "none":               # headline only (perf iteration)
@@ -2249,7 +2429,8 @@ def rows_enabled():
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
                    "serve_chaos", "serve_prefix", "elastic", "fleet",
-                   "pipe", "offload", "quant", "plan", "rl"):
+                   "pipe", "offload", "quant", "plan", "rl",
+                   "multislice"):
         if opt_in in picked and opt_in not in order:
             order.append(opt_in)
     return [r for r in order if r in picked]

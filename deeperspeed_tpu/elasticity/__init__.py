@@ -1,10 +1,12 @@
 from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
                      ElasticityIncompatibleWorldSize, PeerFailureError,
                      PoisonStepError, RestartBudgetExceededError,
-                     TopologyChangeError, parse_heartbeat_block,
-                     parse_resilience_config, parse_supervisor_block)
+                     SliceLostError, TopologyChangeError,
+                     parse_heartbeat_block, parse_resilience_config,
+                     parse_supervisor_block)
 from .elasticity import (compute_elastic_config, elasticity_enabled,
                          ensure_immutable_elastic_config)
 from .heartbeat import (InMemoryTransport, PeerHealthMonitor,
                         build_peer_monitor, suspect_peers)
+from .slices import repartition_after_slice_loss
 from .supervisor import Supervisor, supervised_exit_code

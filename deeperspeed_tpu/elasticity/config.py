@@ -51,6 +51,34 @@ class PoisonStepError(ElasticityError):
     code bug), so restarting would loop forever. Abort instead."""
 
 
+class SliceLostError(ElasticityError):
+    """A whole SLICE died (every failed heartbeat peer maps to a dead
+    slice) while this slice is healthy and `multislice.
+    survive_slice_loss` is armed.
+
+    Deliberately NOT a `SystemExit`: slice loss is recoverable
+    IN-PROCESS — the surviving slices re-partition the pipeline through
+    the natural-layout checkpoint stage-change path
+    (`elasticity.slices.repartition_after_slice_loss`) and resume
+    without a job-wide kill. Callers that do choose a supervised
+    re-launch should exit with `.exit_code`
+    (`constants.EXIT_CODE_SLICE_REPARTITION`), which the supervisor
+    books as recovery rather than a crashing step.
+
+    `detected_at` is the `time.monotonic()` stamp at escalation; the
+    recovered engine emits `Train/Elastic/slice_mttr_s` relative to it
+    at its first step boundary."""
+
+    def __init__(self, message, lost_slices=None, detected_at=None,
+                 peers=None, staleness_s=None):
+        self.lost_slices = list(lost_slices or [])
+        self.detected_at = detected_at
+        self.peers = list(peers or [])
+        self.staleness_s = staleness_s
+        self.exit_code = ec.EXIT_CODE_SLICE_REPARTITION
+        super().__init__(message)
+
+
 class TopologyChangeError(ElasticityError):
     """A checkpoint was saved under a topology this engine cannot
     elastically absorb (model-parallel/model-axis world changed): the

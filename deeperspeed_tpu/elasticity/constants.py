@@ -79,3 +79,10 @@ SUPERVISOR_FILE = "supervisor.json"      # supervisor: restart record
 # restartable by the supervisor, distinct from local crashes in logs
 # and MTTR accounting. 70-79 is free of shell/Python conventions.
 EXIT_CODE_PEER_FAILURE = 76
+
+# Exit code for "a SLICE died and this process re-launches with a
+# re-partitioned pipeline" (docs/multislice.md). The supervisor treats
+# it as recovery, not a crashing step: it never feeds the poison-step
+# detector (the step did not fail — the topology did), though it still
+# consumes restart budget.
+EXIT_CODE_SLICE_REPARTITION = 77

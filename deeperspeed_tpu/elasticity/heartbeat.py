@@ -194,6 +194,10 @@ class PeerHealthMonitor:
         self._simulated = {}
         self.failed = {}             # name -> staleness at death
         self.warned = set()
+        # peer name -> slice name (docs/multislice.md): when set, the
+        # SLICE becomes the unit of staleness escalation — one dead
+        # host breaks its slice's ICI mesh, so the whole slice is lost
+        self._slice_map = {}
         # quantitative per-host step skew from the fleet probe
         # (runtime/fleet.py note_skew): whole-dict swaps, read lock-free
         # from the poll thread so escalation logs can cite it
@@ -306,6 +310,67 @@ class PeerHealthMonitor:
         with self._lock:
             return {name: sim.delay_s
                     for name, sim in self._simulated.items() if sim.alive}
+
+    # -- slice granularity (docs/multislice.md) ----------------------------
+
+    def set_slice_map(self, peer_to_slice):
+        """Promote escalation to SLICE granularity: map each heartbeat
+        peer to its slice. Unmapped peers (and the COORDINATOR
+        pseudo-peer) keep host-granular semantics — their loss is never
+        a slice loss."""
+        self._slice_map = {str(p): str(s)
+                           for p, s in dict(peer_to_slice).items()}
+
+    def slice_of(self, name):
+        return self._slice_map.get(str(name))
+
+    def peers_in_slice(self, slice_name):
+        return sorted(p for p, s in self._slice_map.items()
+                      if s == str(slice_name))
+
+    @property
+    def failed_slices(self):
+        """Slice names with >= 1 dead member. A single dead host is a
+        hole in its slice's ICI mesh: the slice's collectives cannot
+        complete, so the slice — not the host — is the failure unit."""
+        return sorted({self._slice_map[p] for p in self.failed
+                       if p in self._slice_map})
+
+    def slice_status(self, now=None):
+        """{slice: {"status", "peers", "dead"}} — "ok" only when every
+        member is ok; any dead member makes the slice "dead"."""
+        per_peer = self.peer_status(now)
+        out = {}
+        for peer, sname in self._slice_map.items():
+            ent = out.setdefault(sname, {"status": "ok", "peers": [],
+                                         "dead": []})
+            ent["peers"].append(peer)
+            status = (per_peer.get(peer) or {}).get("status", "unknown")
+            if peer in self.failed or status == "dead":
+                ent["status"] = "dead"
+                ent["dead"].append(peer)
+            elif status == "slow" and ent["status"] == "ok":
+                ent["status"] = "slow"
+        for ent in out.values():
+            ent["peers"].sort()
+            ent["dead"].sort()
+        return out
+
+    def kill_slice(self, slice_name):
+        """Fault-injection hook: stop the heartbeats of every SIMULATED
+        member of `slice_name` (the `slice_kill` fault kind). Raises if
+        the slice has no simulated members — a silently inert kill
+        would pass the chaos drill without testing anything."""
+        members = self.peers_in_slice(slice_name)
+        sims = [p for p in members if p in self._simulated]
+        if not sims:
+            raise KeyError(
+                f"slice {slice_name!r} has no simulated peers "
+                f"registered (members: {members})")
+        for p in sims:
+            self.inject_peer_death(p)
+        logger.warning(f"fault injection: slice {slice_name} killed "
+                       f"({len(sims)} simulated peer(s))")
 
     # -- the observable core ----------------------------------------------
 

@@ -132,10 +132,18 @@ class Supervisor:
                              self.backoff_max_s, self.backoff_jitter,
                              self._rng)
 
-    def _record_crash_step(self):
+    def _record_crash_step(self, crashing=True):
+        """Book the exiting child's last step. ``crashing`` False (a
+        slice re-partition, `EXIT_CODE_SLICE_REPARTITION`) records the
+        step for the stats/restart record but does NOT feed the
+        poison-step detector: the step did not fail — the topology did
+        — and the re-partitioned child will legitimately replay it
+        (re-partition is recovery, not a crashing step)."""
         progress = read_progress(self.state_dir)
         step = None if progress is None else progress.get("global_steps")
         self.crash_steps.append(step)
+        if not crashing:
+            return step
         if step is not None and step == self._last_crash_step:
             self._same_step_crashes += 1
         else:
@@ -192,9 +200,14 @@ class Supervisor:
                             f"{rc}, not restarting")
                 return self.stats(exit_code=rc)
 
-            crash_step = self._record_crash_step()
-            kind = ("peer failure"
-                    if rc == ec.EXIT_CODE_PEER_FAILURE else "crash")
+            repartition = rc == ec.EXIT_CODE_SLICE_REPARTITION
+            crash_step = self._record_crash_step(crashing=not repartition)
+            if repartition:
+                kind = "slice re-partition"
+            elif rc == ec.EXIT_CODE_PEER_FAILURE:
+                kind = "peer failure"
+            else:
+                kind = "crash"
             if self._same_step_crashes >= self.poison_step_threshold:
                 raise PoisonStepError(
                     f"step {crash_step} crashed "

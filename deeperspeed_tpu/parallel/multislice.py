@@ -1,0 +1,196 @@
+"""Multi-slice mesh composition over a DCN fabric (docs/multislice.md).
+
+A "slice" is an ICI-connected accelerator island; slices are joined by
+a data-center network ~10x slower than ICI. Following the MPMD-pipeline
+mapping (arXiv:2412.14374), this module partitions an existing mesh
+axis into named slices WITHOUT changing the mesh itself: collectives
+keep their single-mesh semantics, and the slice structure only informs
+
+  * the p2p wire policy (`runtime.pipe.p2p.configure_multislice` —
+    which stage hops cross DCN and whether fp32 upcast is allowed
+    there),
+  * the analytic exposed-crossing model the `dcn_delay` fault kind
+    charges (`parallel.schedule.dcn_exposed_crossings`),
+  * the elastic layer's unit of staleness escalation
+    (`PeerHealthMonitor.set_slice_map` — a dead host kills its whole
+    slice's ICI mesh, so the SLICE is what fails), and
+  * slice-loss recovery (`elasticity.slices.repartition_after_slice_loss`
+    — surviving slices re-partition through the natural-layout
+    checkpoint stage-change path).
+
+No collective is ever issued from this module: slice-aware code paths
+route every wire operation through the schedule pass (enforced by the
+`multislice-collective-outside-schedule` dslint rule).
+"""
+
+import copy
+import logging
+
+from .schedule import dcn_exposed_crossings
+
+logger = logging.getLogger(__name__)
+
+
+class SliceTopology:
+    """Static slice partition of one mesh axis.
+
+    axis="pipe": ``n_slices`` contiguous equal spans of the pipeline
+    stages; ``stage_boundaries`` holds every stage index ``s`` whose
+    forward hop ``s -> s+1`` crosses a slice boundary (the wrap-around
+    hop ``last -> 0`` is the 1F1B ppermute's ring edge and crosses
+    whenever slices > 1 — it is counted separately by the exposed-
+    crossing model as part of the same ring).
+
+    axis="data": slices split the dp axis; there are no stage spans and
+    ``n_boundaries`` DCN cuts sit inside the dp reduction ring.
+    """
+
+    def __init__(self, names, axis, n_stages=None, peer_map=None):
+        self.names = list(names)
+        self.axis = axis
+        self.n_slices = len(self.names)
+        if self.n_slices < 2:
+            raise ValueError("a SliceTopology needs >= 2 slices")
+        self.n_stages = n_stages
+        self.stage_spans = {}
+        self.stage_boundaries = ()
+        if axis == "pipe":
+            if not n_stages or n_stages % self.n_slices != 0:
+                raise ValueError(
+                    f"slices ({self.n_slices}) must divide the stage "
+                    f"count ({n_stages})")
+            per = n_stages // self.n_slices
+            self.stage_spans = {
+                name: (i * per, (i + 1) * per)
+                for i, name in enumerate(self.names)}
+            self.stage_boundaries = tuple(
+                i * per - 1 for i in range(1, self.n_slices))
+        # peer_map: heartbeat peer name -> slice name (the escalation
+        # unit); empty when the config carries no slice_peers
+        self.peer_map = dict(peer_map or {})
+
+    @classmethod
+    def from_config(cls, ms_cfg, pipeline_config=None):
+        """Build from a validated `multislice_config` dict
+        (`runtime.config._parse_multislice_block`)."""
+        axis = ms_cfg["axis"]
+        n_stages = (pipeline_config["stages"]
+                    if axis == "pipe" and pipeline_config else None)
+        peer_map = {}
+        for sname, peers in (ms_cfg["slice_peers"] or {}).items():
+            for p in peers:
+                peer_map[p] = sname
+        return cls(ms_cfg["names"], axis, n_stages=n_stages,
+                   peer_map=peer_map)
+
+    @property
+    def n_boundaries(self):
+        """DCN cuts in the slice ring (= slices - 1 for the linear
+        chain both mappings model)."""
+        return self.n_slices - 1
+
+    def slice_of_stage(self, stage):
+        """Slice name owning pipeline stage `stage` (axis="pipe")."""
+        for name, (lo, hi) in self.stage_spans.items():
+            if lo <= stage < hi:
+                return name
+        raise ValueError(f"stage {stage} outside 0..{self.n_stages - 1}")
+
+    def slice_of_peer(self, peer):
+        """Slice name a heartbeat peer maps to, or None if unmapped
+        (e.g. the COORDINATOR pseudo-peer — its loss is a coordination
+        failure, never a slice failure)."""
+        return self.peer_map.get(peer)
+
+    def peers_of(self, slice_name):
+        """Heartbeat peers mapped to `slice_name` (may be empty)."""
+        return [p for p, s in self.peer_map.items() if s == slice_name]
+
+    def exposed_crossings(self, n_micro, wire_latency):
+        """Schedule-aware exposed DCN crossings per optimizer step —
+        see `parallel.schedule.dcn_exposed_crossings`."""
+        return dcn_exposed_crossings(self.n_boundaries, n_micro,
+                                     wire_latency,
+                                     pipelined=(self.axis == "pipe"))
+
+    def cross_slice_p2p_bytes(self, act_bytes, n_micro):
+        """Analytic bytes per step over DCN for the 1F1B stage-boundary
+        p2p: each micro-batch's activation crosses every boundary once
+        forward and its cotangent once backward."""
+        if self.axis != "pipe":
+            return 0
+        return 2 * int(n_micro) * self.n_boundaries * int(act_bytes)
+
+    def surviving(self, lost):
+        """Topology after losing `lost` (iterable of slice names):
+        (surviving names, surviving stage count). Raises if nothing
+        survives — that is a job loss, not a re-partition."""
+        lost = set(lost)
+        unknown = sorted(lost - set(self.names))
+        if unknown:
+            raise ValueError(f"unknown slice(s) {unknown}")
+        keep = [n for n in self.names if n not in lost]
+        if not keep:
+            raise ValueError("all slices lost — nothing to re-partition")
+        stages = None
+        if self.axis == "pipe":
+            per = self.n_stages // self.n_slices
+            stages = per * len(keep)
+        return keep, stages
+
+    def __repr__(self):
+        return (f"SliceTopology(axis={self.axis!r}, "
+                f"names={self.names!r}, spans={self.stage_spans!r})")
+
+
+def surviving_raw_config(raw_config, topology, lost):
+    """Re-partitioned raw config dict for the surviving slices: the
+    pipeline block shrinks to the surviving stage count and the
+    multislice block shrinks (or drops, when one slice remains) — the
+    natural-layout checkpoint stage-change path absorbs the rest
+    (docs/multislice.md walkthrough)."""
+    keep, stages = topology.surviving(lost)
+    cfg = copy.deepcopy(dict(raw_config))
+    if topology.axis == "pipe":
+        if stages < 2:
+            raise ValueError(
+                "surviving pipeline would have < 2 stages — the "
+                "checkpoint layout guard rejects pipeline -> "
+                "sequential re-partition (keep >= 2 stages per slice)")
+        cfg["pipeline"]["stages"] = stages
+        # micro_batches and comm_overlap carry over unchanged
+    ms = cfg.get("multislice")
+    if ms is not None:
+        if len(keep) < 2:
+            del cfg["multislice"]
+        else:
+            ms = dict(ms)
+            ms["slices"] = len(keep)
+            ms["names"] = list(keep)
+            peers = ms.get("slice_peers")
+            if peers:
+                ms["slice_peers"] = {
+                    s: list(p) for s, p in peers.items() if s in keep}
+                if not ms["slice_peers"]:
+                    ms.pop("slice_peers")
+            cfg["multislice"] = ms
+    # injected faults that acted on the LOST topology must not re-fire
+    # (or fail validation) in the survivor: slice_kill entries naming a
+    # lost slice go always; every multislice fault kind goes when the
+    # block itself was dropped
+    fi = (cfg.get("training_health") or {}).get("fault_injection")
+    if fi and fi.get("faults"):
+        from ..runtime.fault_injection import MULTISLICE_FAULT_KINDS
+        kept_faults = []
+        for f in fi["faults"]:
+            kind = f.get("kind")
+            if kind in MULTISLICE_FAULT_KINDS and "multislice" not in cfg:
+                continue
+            if kind == "slice_kill" and f.get("slice") not in keep:
+                continue
+            kept_faults.append(f)
+        fi["faults"] = kept_faults
+    logger.warning(
+        "multislice re-partition: lost %s, surviving %s (stages=%s)",
+        sorted(set(lost)), keep, stages)
+    return cfg

@@ -363,7 +363,7 @@ def plan_valid_mask(plan):
     return mask
 
 
-def make_ef_gather(plan):
+def make_ef_gather(plan, packed=None):
     """Wrap `plan.gather_row` in a `custom_vjp` whose BACKWARD replaces
     the plain `psum_scatter` transpose with the error-feedback
     sign-compressed reduce-scatter (`runtime.comm.compressed`): the
@@ -378,6 +378,9 @@ def make_ef_gather(plan):
     transpose). Error state is fp32 regardless of the wire dtype.
     Flat-pad lanes are masked out of the quantization scale and pinned
     to zero (`plan_valid_mask`).
+
+    ``packed`` selects the 8-signs/byte wire (None defers to the
+    module default pinned by `comm.compressed.configure_packed_wire`).
     """
     from ..runtime.comm.compressed import compressed_reduce_scatter
 
@@ -393,7 +396,8 @@ def make_ef_gather(plan):
 
     def bwd(werr, g):
         out, new_err = compressed_reduce_scatter(
-            g, werr, plan.axis_name, plan.world, valid=valid)
+            g, werr, plan.axis_name, plan.world, valid=valid,
+            packed=packed)
         return out.astype(plan.dtype), new_err
 
     gather_ef.defvjp(fwd, bwd)
@@ -561,6 +565,32 @@ def bubble_fraction(n_stages, n_micro, wire_latency=1):
     if m <= 0:
         return 0.0
     return w * (s - 1) / (m + w * (s - 1))
+
+
+def dcn_exposed_crossings(n_boundaries, n_micro, wire_latency=1,
+                          pipelined=True):
+    """Schedule-aware EXPOSED cross-slice DCN crossings per optimizer
+    step — the count the `dcn_delay` fault kind charges host-side
+    latency for (docs/multislice.md).
+
+    The model mirrors `bubble_fraction`'s wire treatment:
+
+    * classic wire (``wire_latency`` 1): every hop is serialized with
+      compute, so each of ``n_micro`` micro-batches exposes each DCN
+      boundary once forward and once backward — ``2 * b * m``.
+    * overlapped wire (``wire_latency`` >= 2): steady-state transfers
+      hide behind stage compute; only the fill/drain hops are exposed
+      — ``2 * b`` regardless of micro count.
+    * data-axis split (``pipelined`` False): the dp reduction ring
+      crosses every boundary twice per step (reduce + gather phases)
+      — ``2 * b``.
+    """
+    b = int(n_boundaries)
+    if b <= 0:
+        return 0
+    if not pipelined or int(wire_latency) >= 2:
+        return 2 * b
+    return 2 * b * int(n_micro)
 
 
 def pipeline_1f1b_overlapped_ticks(stage_apply, diff_args, buf_template,

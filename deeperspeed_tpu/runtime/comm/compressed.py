@@ -82,6 +82,25 @@ def compressed_allreduce_dense_two_phase(x, worker_error, server_error,
     return out, new_worker_error, new_server_error
 
 
+def _sign_scale_parts(compensated, valid=None):
+    """`_sign_scale_masked` with the wire ingredients exposed:
+    (scale, signs, q, new_error) where ``signs`` is the boolean sign
+    plane (what the packed transport actually ships, 8/byte) and
+    ``q = where(signs, scale, -scale) [* valid]``. Both wire variants
+    derive from the SAME (q, new_error) — the error-feedback state is
+    computed before the collective and is bit-identical packed or
+    dense."""
+    signs = compensated >= 0
+    if valid is None:
+        scale = jnp.mean(jnp.abs(compensated))
+        q = jnp.where(signs, scale, -scale)
+    else:
+        n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+        scale = jnp.sum(jnp.abs(compensated)) / n_valid
+        q = jnp.where(signs, scale, -scale) * valid
+    return scale, signs, q, compensated - q
+
+
 def _sign_scale_masked(compensated, valid=None):
     """The quantization law shared by the reduce-scatter transport and
     its host oracle: sign() with an L1-mean magnitude over the VALID
@@ -91,18 +110,34 @@ def _sign_scale_masked(compensated, valid=None):
     (`LayerPlan` rebuild slices them away) and leak into grad norms and
     the flat-padded Adam moment/master tails (the hazard
     `compressed_allreduce_dense_two_phase` documents)."""
-    if valid is None:
-        scale = jnp.mean(jnp.abs(compensated))
-        q = jnp.where(compensated >= 0, scale, -scale)
-    else:
-        n_valid = jnp.maximum(jnp.sum(valid), 1.0)
-        scale = jnp.sum(jnp.abs(compensated)) / n_valid
-        q = jnp.where(compensated >= 0, scale, -scale) * valid
-    return q, compensated - q
+    _, _, q, new_error = _sign_scale_parts(compensated, valid)
+    return q, new_error
+
+
+# Process-global default for the reduce-scatter wire variant. The
+# engine PINS this to its config every init (same discipline as
+# `runtime.pipe.p2p.configure`): modules must not inherit a previous
+# engine's wire in the same process.
+_PACKED_WIRE = False
+
+
+def configure_packed_wire(packed=False):
+    """Pin the module default for `compressed_reduce_scatter`'s wire:
+    True ships 8 packed signs/byte + one fp32 scale per rank
+    (all_to_all + all_gather), False ships the dense psum_scatter.
+    Armed from `quantization.gradient_compression.packed_wire` or
+    `multislice.dcn.packed_wire` — over a DCN fabric the 8x byte
+    reduction is the difference between hidden and exposed wire time."""
+    global _PACKED_WIRE
+    _PACKED_WIRE = bool(packed)
+
+
+def packed_wire_enabled():
+    return _PACKED_WIRE
 
 
 def compressed_reduce_scatter(x, worker_error, axis_name, world,
-                              valid=None):
+                              valid=None, packed=None):
     """Error-compensated 1-bit **reduce-scatter** — the worker phase of
     the reference's two-phase allreduce without the server broadcast,
     which is exactly what the explicit ZeRO-3 schedule needs at the
@@ -118,22 +153,56 @@ def compressed_reduce_scatter(x, worker_error, axis_name, world,
       valid: optional static [world, S] 0/1 mask of REAL lanes —
         flat-pad tails are excluded from the scale and pinned to 0 in
         the output and the error buffer (`_sign_scale_masked`).
+      packed: wire variant — None defers to the module default
+        (`configure_packed_wire`). False ships the quantized fp32
+        values over a dense `psum_scatter` (4·n bytes; parity targets
+        the quantization numerics — the original transport
+        discipline). True ships the ACTUAL 1-bit wire: 8 packed
+        signs/byte via all_to_all plus one fp32 scale per rank via
+        all_gather — ≈ n/8 + 4·world bytes, 8x fewer than the dense
+        wire's quantized floats and ~32x fewer than an uncompressed
+        reduce-scatter, which is what makes the cross-slice dp
+        reduction DCN-rated (docs/multislice.md).
     Returns ([S] sign-compressed rank-SUM of this rank's chunk,
-    new_worker_error). Wire volume ≈ n/8 sign bytes + one fp32 scale per
-    rank vs 4·n bytes for the fp32 reduce-scatter (here carried by dense
-    collectives — the repo's documented transport discipline: parity
-    targets the quantization numerics, a packed wire swaps in under the
-    same API).
+    new_worker_error). Both wires reconstruct the same per-source
+    `±scale` values, so outputs differ only in floating-point summation
+    order; the error buffer is computed BEFORE the collective and is
+    bit-identical — packed vs dense resume states are interchangeable.
     """
+    if packed is None:
+        packed = _PACKED_WIRE
     compensated = x.astype(jnp.float32) + worker_error
     if valid is not None:
         compensated = compensated * valid
-    quantized, new_error = _sign_scale_masked(compensated, valid)
+    scale, signs, quantized, new_error = _sign_scale_parts(compensated,
+                                                           valid)
     if axis_name is None or world == 1:
         return quantized.reshape(-1), new_error
-    out = jax.lax.psum_scatter(quantized, axis_name,
-                               scatter_dimension=0, tiled=True)
-    return out.reshape(-1), new_error
+    if not packed:
+        out = jax.lax.psum_scatter(quantized, axis_name,
+                                   scatter_dimension=0, tiled=True)
+        return out.reshape(-1), new_error
+
+    # packed wire: chunk j of [world, S] belongs to rank j, so the sign
+    # planes all_to_all along the chunk dim (this rank keeps every
+    # source's chunk `rank`) and the scalar scales all_gather — the
+    # `compressed_allreduce_two_phase` phase-1 transport, minus the
+    # server requantization the reduce-scatter has no consumer for.
+    s = x.shape[-1]
+    s8 = -(-s // 8) * 8
+    if s8 != s:
+        signs = jnp.pad(signs, ((0, 0), (0, s8 - s)))
+    wire = pack_signs(signs)                                # [w, s8/8] u8
+    recv = jax.lax.all_to_all(wire, axis_name, 0, 0, tiled=False)
+    recv = recv.reshape(world, s8 // 8)
+    scales = jax.lax.all_gather(scale, axis_name)           # [w] f32
+    vals = unpack_signs(recv)[:, :s] * scales[:, None]      # [w, s]
+    if valid is not None:
+        # every source's chunk `rank` shares the plan-static mask row
+        # `rank`; pad lanes' sign bits are wire noise until re-masked
+        rank = jax.lax.axis_index(axis_name)
+        vals = vals * jax.lax.dynamic_slice_in_dim(valid, rank, 1, 0)
+    return jnp.sum(vals, axis=0), new_error
 
 
 def compressed_reduce_scatter_host(xs, worker_errors, valid=None):

@@ -800,12 +800,14 @@ def parse_quantization_block(d):
 
     gc = qz.get(c.QUANTIZATION_GRAD_COMPRESSION)
     grad_compression = False
+    grad_compression_packed = False
     if gc is not None:
         if not isinstance(gc, dict):
             raise DeepSpeedConfigError(
                 f"{c.QUANTIZATION}.{c.QUANTIZATION_GRAD_COMPRESSION} "
                 f"must be an object, got {type(gc).__name__}")
-        gknown = {c.QUANTIZATION_GRAD_COMPRESSION_ENABLED}
+        gknown = {c.QUANTIZATION_GRAD_COMPRESSION_ENABLED,
+                  c.QUANTIZATION_GRAD_COMPRESSION_PACKED}
         gunknown = sorted(set(gc) - gknown)
         if gunknown:
             raise DeepSpeedConfigError(
@@ -820,9 +822,18 @@ def parse_quantization_block(d):
                 f"{c.QUANTIZATION}.{c.QUANTIZATION_GRAD_COMPRESSION}."
                 f"{c.QUANTIZATION_GRAD_COMPRESSION_ENABLED} must be a "
                 f"boolean, got {grad_compression!r}")
+        packed = gc.get(c.QUANTIZATION_GRAD_COMPRESSION_PACKED,
+                        c.QUANTIZATION_GRAD_COMPRESSION_PACKED_DEFAULT)
+        if not isinstance(packed, bool):
+            raise DeepSpeedConfigError(
+                f"{c.QUANTIZATION}.{c.QUANTIZATION_GRAD_COMPRESSION}."
+                f"{c.QUANTIZATION_GRAD_COMPRESSION_PACKED} must be a "
+                f"boolean, got {packed!r}")
+        grad_compression_packed = grad_compression and packed
 
     return {"weights": weights, "ffn": ffn,
-            "gradient_compression": grad_compression}
+            "gradient_compression": grad_compression,
+            "gradient_compression_packed": grad_compression_packed}
 
 
 class DeepSpeedConfigWriter:
@@ -1116,6 +1127,10 @@ class DeepSpeedConfig:
         # parse so InferenceEngine validates raw dicts identically.
         self.quantization_config = parse_quantization_block(d) or None
 
+        # Multi-slice composition over DCN (docs/multislice.md) — parsed
+        # after pipeline + quantization, whose blocks it composes with.
+        self._parse_multislice_block(d)
+
         # Fork additions: gradient storage for debugging.
         self.store_gradients = bool(
             d.get(c.STORE_GRADIENTS, c.STORE_GRADIENTS_DEFAULT))
@@ -1212,6 +1227,176 @@ class DeepSpeedConfig:
             "stages": stages,
             "micro_batches": micro,
             "comm_overlap": overlap,
+        }
+
+    def _parse_multislice_block(self, d):
+        """Parse + validate the "multislice" block (docs/multislice.md):
+        the mesh is partitioned into named slices joined by a ~10x
+        slower DCN fabric, and the slice becomes the unit of staleness
+        escalation for the elastic layer. Checkpoint-block strictness —
+        a silently inert multislice block would run every stage
+        boundary over "DCN" without the wire policy the user asked for.
+
+        Must run AFTER `_parse_pipeline_block` and
+        `parse_quantization_block`: axis="pipe" partitions the pipeline
+        stages, axis="data" routes the cross-slice dp reduction over
+        the EF compressed wire (requires gradient_compression)."""
+        ms = d.get(c.MULTISLICE)
+        if ms is None:
+            self.multislice_config = None
+            return
+        if not isinstance(ms, dict):
+            raise DeepSpeedConfigError(
+                f"'{c.MULTISLICE}' must be a dict, got {ms!r}")
+        known = {c.MULTISLICE_SLICES, c.MULTISLICE_AXIS,
+                 c.MULTISLICE_NAMES, c.MULTISLICE_SLICE_PEERS,
+                 c.MULTISLICE_DCN, c.MULTISLICE_SURVIVE}
+        unknown = sorted(set(ms) - known)
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"Unknown '{c.MULTISLICE}' key(s) {unknown}; valid "
+                f"keys: {sorted(known)}")
+        if c.MULTISLICE_SLICES not in ms:
+            raise DeepSpeedConfigError(
+                f"{c.MULTISLICE}.{c.MULTISLICE_SLICES} is required "
+                f"(the number of slices, >= 2)")
+        slices = as_int(ms[c.MULTISLICE_SLICES],
+                        f"{c.MULTISLICE}.{c.MULTISLICE_SLICES}")
+        if slices < 2:
+            raise DeepSpeedConfigError(
+                f"{c.MULTISLICE}.{c.MULTISLICE_SLICES} must be >= 2 "
+                f"(a single slice has no DCN boundary — drop the "
+                f"block), got {slices}")
+
+        axis = ms.get(c.MULTISLICE_AXIS, c.MULTISLICE_AXIS_DEFAULT)
+        if axis not in c.MULTISLICE_AXIS_CHOICES:
+            raise DeepSpeedConfigError(
+                f"{c.MULTISLICE}.{c.MULTISLICE_AXIS} must be one of "
+                f"{list(c.MULTISLICE_AXIS_CHOICES)}, got {axis!r}")
+
+        names = ms.get(c.MULTISLICE_NAMES)
+        if names is None:
+            names = [f"slice{i}" for i in range(slices)]
+        else:
+            if not isinstance(names, list) or \
+                    not all(isinstance(n, str) and n for n in names):
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_NAMES} must be a "
+                    f"list of non-empty strings, got {names!r}")
+            if len(names) != slices:
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_NAMES} must name "
+                    f"every slice (len {slices}), got {len(names)}")
+            if len(set(names)) != len(names):
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_NAMES} must be "
+                    f"unique, got {names!r}")
+
+        slice_peers = ms.get(c.MULTISLICE_SLICE_PEERS)
+        if slice_peers is not None:
+            if not isinstance(slice_peers, dict):
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_SLICE_PEERS} must "
+                    f"be a dict of slice name -> [peer names], got "
+                    f"{slice_peers!r}")
+            bad = sorted(set(slice_peers) - set(names))
+            if bad:
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_SLICE_PEERS} names "
+                    f"unknown slice(s) {bad}; slices: {names}")
+            seen = {}
+            for sname, peers in slice_peers.items():
+                if not isinstance(peers, list) or not peers or \
+                        not all(isinstance(p, str) and p for p in peers):
+                    raise DeepSpeedConfigError(
+                        f"{c.MULTISLICE}.{c.MULTISLICE_SLICE_PEERS}."
+                        f"{sname} must be a non-empty list of peer "
+                        f"names, got {peers!r}")
+                for p in peers:
+                    if p in seen:
+                        raise DeepSpeedConfigError(
+                            f"peer {p!r} is mapped to both slice "
+                            f"{seen[p]!r} and {sname!r} — a host lives "
+                            f"in exactly one slice")
+                    seen[p] = sname
+            slice_peers = {s: list(p) for s, p in slice_peers.items()}
+
+        dcn = ms.get(c.MULTISLICE_DCN) or {}
+        if not isinstance(dcn, dict):
+            raise DeepSpeedConfigError(
+                f"{c.MULTISLICE}.{c.MULTISLICE_DCN} must be a dict, "
+                f"got {dcn!r}")
+        dknown = {c.MULTISLICE_DCN_FP32_COMM, c.MULTISLICE_DCN_PACKED_WIRE,
+                  c.MULTISLICE_DCN_COMPRESS}
+        dunknown = sorted(set(dcn) - dknown)
+        if dunknown:
+            raise DeepSpeedConfigError(
+                f"Unknown '{c.MULTISLICE}.{c.MULTISLICE_DCN}' key(s) "
+                f"{dunknown}; valid keys: {sorted(dknown)}")
+        dcn_out = {}
+        for key, default in (
+                (c.MULTISLICE_DCN_FP32_COMM,
+                 c.MULTISLICE_DCN_FP32_COMM_DEFAULT),
+                (c.MULTISLICE_DCN_PACKED_WIRE,
+                 c.MULTISLICE_DCN_PACKED_WIRE_DEFAULT),
+                (c.MULTISLICE_DCN_COMPRESS,
+                 c.MULTISLICE_DCN_COMPRESS_DEFAULT)):
+            val = dcn.get(key, default)
+            if not isinstance(val, bool):
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_DCN}.{key} must be "
+                    f"a boolean, got {val!r}")
+            dcn_out[key] = val
+
+        survive = ms.get(c.MULTISLICE_SURVIVE, c.MULTISLICE_SURVIVE_DEFAULT)
+        if not isinstance(survive, bool):
+            raise DeepSpeedConfigError(
+                f"{c.MULTISLICE}.{c.MULTISLICE_SURVIVE} must be a "
+                f"boolean, got {survive!r}")
+
+        # -- composition: the slice cut must land on a real axis ---------
+        if axis == "pipe":
+            if self.pipeline_config is None:
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE} axis \"pipe\" needs the pipeline "
+                    f"block: slices partition the 1F1B stages "
+                    f"(docs/multislice.md)")
+            stages = self.pipeline_config["stages"]
+            if stages % slices != 0:
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_SLICES} ({slices}) "
+                    f"must divide pipeline.stages ({stages}): slices "
+                    f"hold contiguous equal-size stage spans")
+            if survive and stages // slices < 2:
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE}.{c.MULTISLICE_SURVIVE} needs >= 2 "
+                    f"stages per slice: losing a slice must leave a "
+                    f">= 2-stage pipeline (the checkpoint layout guard "
+                    f"rejects a pipeline -> sequential re-partition), "
+                    f"got {stages}//{slices} = {stages // slices}")
+        else:  # axis == "data"
+            if self.pipeline_config is not None:
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE} axis \"data\" + the pipeline "
+                    f"block is unsupported (pipeline dp reduction is "
+                    f"stage-local); use axis \"pipe\"")
+            if dcn_out[c.MULTISLICE_DCN_COMPRESS] and not (
+                    self.quantization_config
+                    and self.quantization_config["gradient_compression"]):
+                raise DeepSpeedConfigError(
+                    f"{c.MULTISLICE} axis \"data\" with "
+                    f"{c.MULTISLICE_DCN}.{c.MULTISLICE_DCN_COMPRESS} "
+                    f"needs quantization.gradient_compression: only "
+                    f"the EF sign-compressed wire is DCN-rated for the "
+                    f"cross-slice dp reduction")
+
+        self.multislice_config = {
+            "slices": slices,
+            "axis": axis,
+            "names": names,
+            "slice_peers": slice_peers,
+            "dcn": dcn_out,
+            "survive_slice_loss": survive,
         }
 
     def _parse_moe_block(self, d):

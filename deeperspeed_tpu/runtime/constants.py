@@ -389,6 +389,42 @@ PIPELINE_COMM_OVERLAP = "comm_overlap"
 PIPELINE_COMM_OVERLAP_DEFAULT = False
 
 # ---------------------------------------------------------------------------
+# Multislice block (slice-partitioned mesh over a DCN fabric;
+# parallel/multislice.py + docs/multislice.md)
+# ---------------------------------------------------------------------------
+MULTISLICE = "multislice"
+# number of named slices the mesh is partitioned into (>= 2)
+MULTISLICE_SLICES = "slices"
+# which mesh axis the slice boundary cuts: "pipe" maps contiguous
+# pipeline-stage spans to slices (stage-boundary p2p crosses DCN);
+# "data" splits the dp axis (the EF compressed reduce-scatter crosses)
+MULTISLICE_AXIS = "axis"
+MULTISLICE_AXIS_DEFAULT = "pipe"
+MULTISLICE_AXIS_CHOICES = ("pipe", "data")
+# optional slice names (len == slices, unique); default slice0..N-1
+MULTISLICE_NAMES = "names"
+# optional {slice name: [heartbeat peer names]} — the unit of staleness
+# escalation; required for slice_kill faults and slice-loss survival
+MULTISLICE_SLICE_PEERS = "slice_peers"
+# DCN wire sub-block
+MULTISLICE_DCN = "dcn"
+# allow fp32 upcast on cross-slice hops (default: refuse — the DCN
+# fabric is ~10x slower, doubling hop bytes there is a perf foot-gun)
+MULTISLICE_DCN_FP32_COMM = "fp32_comm"
+MULTISLICE_DCN_FP32_COMM_DEFAULT = False
+# pack 8 signs/byte on the EF compressed wire (axis="data")
+MULTISLICE_DCN_PACKED_WIRE = "packed_wire"
+MULTISLICE_DCN_PACKED_WIRE_DEFAULT = True
+# route cross-slice dp reduction over the EF sign-compressed wire
+# (axis="data"; requires quantization.gradient_compression)
+MULTISLICE_DCN_COMPRESS = "compress_dp_reduce"
+MULTISLICE_DCN_COMPRESS_DEFAULT = True
+# dead slice => in-process re-partition (SliceLostError) instead of a
+# job-wide PeerFailureError kill
+MULTISLICE_SURVIVE = "survive_slice_loss"
+MULTISLICE_SURVIVE_DEFAULT = True
+
+# ---------------------------------------------------------------------------
 # Inference block (serving engine; deeperspeed_tpu/inference)
 # ---------------------------------------------------------------------------
 INFERENCE = "inference"
@@ -542,6 +578,10 @@ QUANTIZATION_FFN_MARGIN_DEFAULT = 1.0
 QUANTIZATION_GRAD_COMPRESSION = "gradient_compression"
 QUANTIZATION_GRAD_COMPRESSION_ENABLED = "enabled"
 QUANTIZATION_GRAD_COMPRESSION_ENABLED_DEFAULT = True
+# pack 8 signs/byte on the compressed wire (8x fewer DCN bytes; same
+# quantization law, bit-exact EF state — runtime/comm/compressed.py)
+QUANTIZATION_GRAD_COMPRESSION_PACKED = "packed_wire"
+QUANTIZATION_GRAD_COMPRESSION_PACKED_DEFAULT = False
 
 # ---------------------------------------------------------------------------
 # Online RL (docs/rl.md): the co-located train+serve driver

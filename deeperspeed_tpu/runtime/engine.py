@@ -487,6 +487,83 @@ class DeepSpeedEngine:
                 "peer-health monitor; enable the "
                 "elasticity.heartbeat block to use them")
 
+        # --- multi-slice composition over DCN (parallel/multislice.py,
+        # docs/multislice.md): pins the p2p wire policy + the packed EF
+        # wire, promotes the heartbeat monitor to SLICE granularity, and
+        # validates the multislice fault kinds. The pins are process-
+        # global (same discipline as _pin_comm_precision) so they are
+        # set on EVERY init — a non-multislice engine must not inherit a
+        # previous engine's wire policy.
+        self._multislice = None
+        self._multislice_survive = False
+        self._slice_recovery_record = None
+        self._slice_mttr_emitted = False
+        self._pending_dcn_delay_s = 0.0
+        ms_cfg = getattr(self._config, "multislice_config", None)
+        from .pipe import p2p as _p2p
+        from .comm import compressed as _compressed
+        qz_cfg = self._config.quantization_config or {}
+        packed_wire = bool(qz_cfg.get("gradient_compression_packed"))
+        if ms_cfg is not None:
+            from ..parallel.multislice import SliceTopology
+            self._multislice = SliceTopology.from_config(
+                ms_cfg, self._config.pipeline_config)
+            self._multislice_survive = ms_cfg["survive_slice_loss"]
+            packed_wire = packed_wire or (
+                ms_cfg["axis"] == "data"
+                and ms_cfg["dcn"]["compress_dp_reduce"]
+                and ms_cfg["dcn"]["packed_wire"])
+            _p2p.configure_multislice(
+                boundaries=self._multislice.stage_boundaries,
+                fp32_over_dcn=ms_cfg["dcn"]["fp32_comm"])
+            if self.peer_monitor is not None and self._multislice.peer_map:
+                self.peer_monitor.set_slice_map(self._multislice.peer_map)
+                if jax.process_count() == 1:
+                    # single-host simulation: slice members heartbeat as
+                    # simulated peers until a slice_kill fault fires
+                    for peer in sorted(self._multislice.peer_map):
+                        self.peer_monitor.ensure_simulated_peer(peer)
+            log_dist(
+                f"multislice armed: axis={ms_cfg['axis']} "
+                f"slices={self._multislice.names} "
+                f"boundaries={self._multislice.stage_boundaries} "
+                f"dcn={ms_cfg['dcn']} "
+                f"survive_slice_loss={self._multislice_survive}",
+                ranks=[0])
+        else:
+            _p2p.configure_multislice(boundaries=(), fp32_over_dcn=True)
+        _compressed.configure_packed_wire(packed_wire)
+        if self._fault_injector is not None and \
+                self._fault_injector.has_multislice_faults:
+            if self._multislice is None:
+                raise DeepSpeedConfigError(
+                    "fault_injection dcn_delay/slice_kill faults need "
+                    "the multislice block (they act on the slice "
+                    "topology — docs/multislice.md)")
+            kills = [f["slice"] for f in self._fault_injector.faults
+                     if f["kind"] == "slice_kill"]
+            if kills:
+                if self.peer_monitor is None:
+                    raise DeepSpeedConfigError(
+                        "fault_injection slice_kill faults act on the "
+                        "peer-health monitor; enable the "
+                        "elasticity.heartbeat block to use them")
+                unknown = sorted(set(kills)
+                                 - set(self._multislice.names))
+                if unknown:
+                    raise DeepSpeedConfigError(
+                        f"fault_injection slice_kill names unknown "
+                        f"slice(s) {unknown}; multislice.names: "
+                        f"{self._multislice.names}")
+                unpeered = sorted(
+                    s for s in kills
+                    if not self._multislice.peers_of(s))
+                if unpeered:
+                    raise DeepSpeedConfigError(
+                        f"fault_injection slice_kill needs multislice."
+                        f"slice_peers entries for {unpeered} (the "
+                        f"simulated peers whose heartbeats stop)")
+
         # --- config-drivable model features (moe / sequence parallel /
         # activation checkpointing): applied BEFORE param init so the
         # model builds expert weights / SP attention / remat-policy spans
@@ -3012,6 +3089,13 @@ class DeepSpeedEngine:
             from ..parallel.schedule import bubble_fraction
             scalars["Train/Pipe/bubble_fraction"] = bubble_fraction(
                 ps["stages"], ps["n_micro"], ps["wire_latency"])
+            if self._multislice is not None:
+                # exposed DCN crossings of the running schedule — the
+                # unit dcn_delay faults charge and the denominator of
+                # the two-slice throughput-ratio bench row
+                scalars["Train/Multislice/dcn_exposed_crossings"] = \
+                    float(self._multislice.exposed_crossings(
+                        ps["n_micro"], ps["wire_latency"]))
         if self.peer_monitor is not None:
             # worst peer-heartbeat staleness: a rising series is a peer
             # going quiet BEFORE the fail threshold declares it dead
@@ -3089,6 +3173,19 @@ class DeepSpeedEngine:
                 scalars["Train/Elastic/mttr_s"] = \
                     _time.time() - float(record["crash_time"])  # dslint: disable=wall-clock
             self.monitor.record(self.global_samples, scalars)
+        if self._slice_recovery_record is not None and \
+                not self._slice_mttr_emitted and self.monitor is not None:
+            # once, at the FIRST completed step after a slice-loss
+            # re-partition: detection-to-resumed-step IS the slice MTTR
+            # (monotonic is valid — recovery stayed in this process)
+            self._slice_mttr_emitted = True
+            import time as _time
+            record = self._slice_recovery_record
+            self.monitor.record(self.global_samples, {
+                "Train/Elastic/slice_mttr_s":
+                    _time.monotonic() - float(record["detected_at"]),
+                "Train/Elastic/lost_slices":
+                    float(len(record["lost_slices"]))})
         if self.peer_monitor is not None and self.peer_monitor.has_failure:
             self._escalate_peer_failure()
 
@@ -3099,15 +3196,40 @@ class DeepSpeedEngine:
         the supervisor recognizes as restartable. Mirrors the preemption
         flow — detection happened on the monitor thread, the action runs
         here on the main thread at a step boundary where device state is
-        consistent."""
+        consistent.
+
+        With the multislice block armed, escalation is SLICE-granular
+        first (docs/multislice.md): when every failed peer maps to a
+        dead slice and survivors remain, the emergency save still runs
+        (it is the re-partition source) but the exit is a recoverable
+        `SliceLostError` — the caller re-partitions in-process
+        (`elasticity.slices.repartition_after_slice_loss`) instead of a
+        job-wide kill. Unmapped failures (the COORDINATOR pseudo-peer,
+        hosts outside slice_peers) and all-slices-lost keep the
+        PeerFailureError path."""
         monitor = self.peer_monitor
         peers = sorted(monitor.failed)
-        log_dist(f"PEER FAILURE: peer(s) {peers} declared dead; "
-                 f"saving emergency checkpoint and exiting for a "
-                 f"supervised restart", ranks=[0])
+        slice_loss = None
+        if self._multislice is not None and self._multislice_survive:
+            dead_slices = monitor.failed_slices
+            unmapped = [p for p in peers if monitor.slice_of(p) is None]
+            survivors = [n for n in self._multislice.names
+                         if n not in dead_slices]
+            if dead_slices and not unmapped and survivors:
+                slice_loss = dead_slices
+        if slice_loss:
+            log_dist(f"SLICE FAILURE: slice(s) {slice_loss} declared "
+                     f"dead (peers {peers}); saving emergency "
+                     f"checkpoint for an in-process re-partition",
+                     ranks=[0])
+        else:
+            log_dist(f"PEER FAILURE: peer(s) {peers} declared dead; "
+                     f"saving emergency checkpoint and exiting for a "
+                     f"supervised restart", ranks=[0])
         telemetry = getattr(self, "telemetry", None)
         if telemetry is not None:
-            telemetry.on_anomaly(self, "peer_failure")
+            telemetry.on_anomaly(
+                self, "slice_failure" if slice_loss else "peer_failure")
         manager = self.checkpoint_manager
         if self._peer_emergency_save and manager.save_dir:
             try:
@@ -3119,6 +3241,17 @@ class DeepSpeedEngine:
                 logger.error(f"emergency checkpoint before peer-failure "
                              f"exit failed: {e}")
         monitor.stop()
+        if slice_loss:
+            from ..elasticity.config import SliceLostError
+            import time as _time
+            staleness = max(monitor.failed.values(), default=None)
+            raise SliceLostError(
+                f"slice(s) {slice_loss} lost (dead peer(s) {peers}); "
+                f"surviving slices re-partition via "
+                f"elasticity.slices.repartition_after_slice_loss",
+                lost_slices=slice_loss,
+                detected_at=_time.monotonic(),
+                peers=peers, staleness_s=staleness)
         monitor.raise_if_failed()
 
     def _apply_host_fault(self, fault):
@@ -3135,6 +3268,17 @@ class DeepSpeedEngine:
         elif kind == "slow_peer":
             self.peer_monitor.inject_slow_peer(fault["peer"],
                                                fault["seconds"])
+        elif kind == "dcn_delay":
+            # schedule-aware injected cross-slice latency: `seconds`
+            # per EXPOSED DCN crossing of this step (the overlapped
+            # wire hides steady-state hops; docs/multislice.md), slept
+            # host-side on the same path as the `stall` kind
+            ps = getattr(self, "pipeline_schedule", None) or {}
+            crossings = self._multislice.exposed_crossings(
+                ps.get("n_micro", 1), ps.get("wire_latency", 1))
+            self._pending_dcn_delay_s += fault["seconds"] * crossings
+        elif kind == "slice_kill":
+            self.peer_monitor.kill_slice(fault["slice"])
 
     def _step_program_ready(self, gas, fault):
         """Is the program the coming step will run already compiled?
@@ -3184,6 +3328,12 @@ class DeepSpeedEngine:
             # runs while training continues — exactly the real timeline
             for host_fault in self._fault_injector.take_host_faults():
                 self._apply_host_fault(host_fault)
+            if self._pending_dcn_delay_s > 0:
+                # injected cross-slice wire latency rides the stall
+                # sleep below — serialized with the step, like the
+                # exposed crossings it models
+                stall_s += self._pending_dcn_delay_s
+                self._pending_dcn_delay_s = 0.0
             fault = (jax.device_put(np.int32(mode),
                                     self._replicated_sharding),
                      jax.device_put(np.float32(factor),

@@ -21,6 +21,7 @@ Fault schema (all faults validated at parse time)::
 
     {"kind": "nan_grads" | "loss_spike" | "stall"
              | "peer_death" | "slow_peer" | "barrier_timeout"
+             | "dcn_delay" | "slice_kill"
              | "prefill_error" | "decode_error" | "decode_stall"
              | "page_pool_pressure",
      "step": N,          # 0-based optimizer-step serial in this process
@@ -30,8 +31,13 @@ Fault schema (all faults validated at parse time)::
                          # page pool seized for the step (0 < f <= 1,
                          # default 0.9)
      "seconds": 1.0,     # stall/decode_stall: sleep length;
-                         # slow_peer: heartbeat gap
-     "peer": "sim0"}     # peer_death/slow_peer: simulated peer name
+                         # slow_peer: heartbeat gap;
+                         # dcn_delay: injected latency PER EXPOSED
+                         # cross-slice crossing (the engine multiplies
+                         # by the schedule-aware crossing count —
+                         # parallel.schedule.dcn_exposed_crossings)
+     "peer": "sim0",     # peer_death/slow_peer: simulated peer name
+     "slice": "slice1"}  # slice_kill: multislice slice name to kill
 
 ``step`` counts train_batch invocations in THIS process (a monotonic
 serial, never rewound by rollback) — so a replayed window after a
@@ -47,6 +53,16 @@ reproduce exactly what a dead/wedged remote host looks like to the
 observer; ``barrier_timeout`` arms `utils.distributed.barrier` to raise
 a typed `BarrierTimeoutError` on its next rendezvous (e.g. the next
 checkpoint commit), driving the fail-fast-and-hand-off path.
+
+The MULTISLICE kinds (docs/multislice.md; require the ``multislice``
+config block) make the two-slice regime drivable single-host:
+``dcn_delay`` injects cross-slice wire latency host-side and
+SCHEDULE-AWARE — ``seconds`` is charged once per EXPOSED DCN crossing
+of the step (overlapped wire exposes only fill/drain crossings, the
+classic wire every micro-batch hop), folded into the same host sleep
+the ``stall`` kind uses; ``slice_kill`` stops the heartbeats of every
+simulated peer of the named slice (`PeerHealthMonitor.kill_slice`),
+driving slice-granular escalation -> `SliceLostError` -> re-partition.
 
 The SERVING kinds are host faults too, consumed by `InferenceEngine`
 (the training engine ignores them): ``prefill_error`` /
@@ -70,11 +86,12 @@ from .config_utils import DeepSpeedConfigError
 
 SERVING_FAULT_KINDS = ("prefill_error", "decode_error", "decode_stall",
                        "page_pool_pressure")
+MULTISLICE_FAULT_KINDS = ("dcn_delay", "slice_kill")
 FAULT_KINDS = ("nan_grads", "loss_spike", "stall",
                "peer_death", "slow_peer", "barrier_timeout") + \
-    SERVING_FAULT_KINDS
+    MULTISLICE_FAULT_KINDS + SERVING_FAULT_KINDS
 HOST_FAULT_KINDS = ("peer_death", "slow_peer", "barrier_timeout") + \
-    SERVING_FAULT_KINDS
+    MULTISLICE_FAULT_KINDS + SERVING_FAULT_KINDS
 DEFAULT_SIM_PEER = "sim_peer_0"
 PAGE_POOL_PRESSURE_DEFAULT_FRACTION = 0.9
 
@@ -111,7 +128,8 @@ def validate_fault_spec(spec, where="training_health.fault_injection"):
         raise DeepSpeedConfigError(
             f"{where}.faults must be a list, got "
             f"{type(faults).__name__}")
-    known = {"kind", "step", "times", "factor", "seconds", "peer"}
+    known = {"kind", "step", "times", "factor", "seconds", "peer",
+             "slice"}
     out = []
     for i, fault in enumerate(faults):
         if not isinstance(fault, dict):
@@ -163,9 +181,21 @@ def validate_fault_spec(spec, where="training_health.fault_injection"):
             raise DeepSpeedConfigError(
                 f"{where}.faults[{i}].peer only applies to "
                 f"peer_death/slow_peer faults, not {kind!r}")
+        slice_name = fault.get("slice")
+        if kind == "slice_kill":
+            if not isinstance(slice_name, str) or not slice_name:
+                raise DeepSpeedConfigError(
+                    f"{where}.faults[{i}].slice is required for a "
+                    f"slice_kill fault (the multislice slice name to "
+                    f"kill), got {slice_name!r}")
+        elif "slice" in fault:
+            raise DeepSpeedConfigError(
+                f"{where}.faults[{i}].slice only applies to slice_kill "
+                f"faults, not {kind!r}")
         out.append({"kind": kind, "step": step, "times": times,
                     "factor": float(factor), "seconds": float(seconds),
-                    "peer": peer, "remaining": times})
+                    "peer": peer, "slice": slice_name,
+                    "remaining": times})
     return out
 
 
@@ -211,6 +241,11 @@ class FaultInjector:
     @property
     def has_serving_faults(self):
         return any(f["kind"] in SERVING_FAULT_KINDS for f in self.faults)
+
+    @property
+    def has_multislice_faults(self):
+        return any(f["kind"] in MULTISLICE_FAULT_KINDS
+                   for f in self.faults)
 
     @property
     def simulated_peers(self):

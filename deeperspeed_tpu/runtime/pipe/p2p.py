@@ -19,10 +19,26 @@ These helpers are used by the compiled 1F1B executor
 (`parallel/pipeline_spmd.py`) when `pipeline.fp32_comm` is set in config.
 """
 
+import logging
+
 import jax
 import jax.numpy as jnp
 
+logger = logging.getLogger(__name__)
+
 _FP32_COMM = False
+
+# Multi-slice wire policy (docs/multislice.md): stage indices whose
+# forward hop crosses a DCN slice boundary, and whether fp32 upcast is
+# allowed on a wire that includes DCN edges. A ppermute moves EVERY
+# stage's tensor in one collective — one dtype for the whole ring — so
+# when any hop crosses DCN and fp32-over-DCN is off, the whole wire
+# stays in the compute dtype (warned once; the within-slice hops lose
+# the upcast too, which is the honest trade of a single-collective
+# transport).
+_DCN_BOUNDARIES = ()
+_FP32_OVER_DCN = True
+_DCN_DOWNGRADE_WARNED = False
 
 
 def configure(fp32_comm=False):
@@ -41,6 +57,23 @@ def fp32_comm_enabled():
     return _FP32_COMM
 
 
+def configure_multislice(boundaries=(), fp32_over_dcn=True):
+    """Pin the slice-boundary wire policy (same engine-pinned global
+    discipline as `configure`): ``boundaries`` are the stage indices
+    whose forward hop crosses DCN (`SliceTopology.stage_boundaries`);
+    ``fp32_over_dcn`` False refuses the fp32 upcast whenever the wire
+    includes a DCN edge — doubling hop bytes on a ~10x slower fabric is
+    the exact foot-gun `multislice.dcn.fp32_comm` defaults off."""
+    global _DCN_BOUNDARIES, _FP32_OVER_DCN, _DCN_DOWNGRADE_WARNED
+    _DCN_BOUNDARIES = tuple(int(b) for b in boundaries)
+    _FP32_OVER_DCN = bool(fp32_over_dcn)
+    _DCN_DOWNGRADE_WARNED = False
+
+
+def dcn_boundaries():
+    return _DCN_BOUNDARIES
+
+
 def init_process_groups(grid=None):
     """No-op: ppermute needs no per-pair groups (reference p2p.py:14-19
     builds a 2-rank group per adjacent stage pair)."""
@@ -48,7 +81,17 @@ def init_process_groups(grid=None):
 
 
 def _maybe_upcast(tensor, fp32_comm):
+    global _DCN_DOWNGRADE_WARNED
     fp32_comm = _FP32_COMM if fp32_comm is None else fp32_comm
+    if fp32_comm and _DCN_BOUNDARIES and not _FP32_OVER_DCN:
+        if not _DCN_DOWNGRADE_WARNED:
+            _DCN_DOWNGRADE_WARNED = True
+            logger.warning(
+                "fp32_comm requested but the pipe wire crosses DCN "
+                "slice boundaries %s and multislice.dcn.fp32_comm is "
+                "false: keeping the compute dtype on the whole wire "
+                "(one ppermute = one dtype)", _DCN_BOUNDARIES)
+        fp32_comm = False
     if fp32_comm and tensor.dtype in (jnp.bfloat16, jnp.float16):
         return tensor.astype(jnp.float32), tensor.dtype
     return tensor, None

@@ -15,10 +15,13 @@ the one policy both now share:
   law): transient coordination-service blips are absorbed before any
   caller-visible failure.
 - With ``degrade_to_local=True`` (the fleet posture), attempts
-  exhausting on an op logs ONE warning and permanently degrades to an
-  in-process `InMemoryTransport` — callers keep own-host behavior (rank
-  0 still aggregates its own summaries) instead of erroring every
-  window.
+  exhausting on an op logs ONE warning and degrades to an in-process
+  `InMemoryTransport` — callers keep own-host behavior (rank 0 still
+  aggregates its own summaries) instead of erroring every window. The
+  degrade is NOT permanent: a capped-backoff re-probe periodically
+  retries the real transport (one call, no retry loop), and the first
+  success promotes back — a transient coordination-service blip no
+  longer disables fleet scalars/peer health for the rest of the job.
 - With ``degrade_to_local=False`` (the heartbeat posture), the final
   error is re-raised: `PeerHealthMonitor.poll_once` MUST see persistent
   failure — its continuous-outage escalation (declare the coordination
@@ -57,7 +60,9 @@ class RetryingKVTransport:
 
     def __init__(self, transport, attempts=3, backoff_base_s=0.05,
                  backoff_cap_s=1.0, jitter=0.5, degrade_to_local=False,
-                 name="kv", rng=None, sleep=time.sleep):
+                 name="kv", rng=None, sleep=time.sleep,
+                 reprobe_base_s=5.0, reprobe_cap_s=300.0,
+                 clock=time.monotonic):
         if attempts < 1:
             raise ValueError(f"attempts must be >= 1, got {attempts}")
         if not 0 <= jitter < 1:
@@ -71,9 +76,20 @@ class RetryingKVTransport:
         self.name = str(name)
         self._rng = rng if rng is not None else random.Random()
         self._sleep = sleep
-        self._local = None           # set once degraded
+        self._clock = clock
+        self._local = None           # set while degraded
         self.retry_count = 0
         self.error_count = 0
+        # capped-backoff re-probe of the real transport while degraded:
+        # probe intervals follow the shared backoff law (base·2^k up to
+        # cap) so a long outage settles at one cheap probe per cap
+        # rather than hammering a struggling coordinator
+        self.reprobe_base_s = float(reprobe_base_s)
+        self.reprobe_cap_s = float(reprobe_cap_s)
+        self._reprobe_failures = 0
+        self._next_reprobe_at = None
+        self.reprobe_count = 0
+        self.recovered_count = 0
 
     @property
     def degraded(self):
@@ -86,8 +102,46 @@ class RetryingKVTransport:
         return backoff_delay(attempt, self.backoff_base_s,
                              self.backoff_cap_s, self.jitter, self._rng)
 
+    def _schedule_reprobe(self):
+        self._reprobe_failures += 1
+        delay = backoff_delay(self._reprobe_failures, self.reprobe_base_s,
+                              self.reprobe_cap_s, self.jitter, self._rng)
+        self._next_reprobe_at = self._clock() + delay
+
+    def _try_reprobe(self, op, args):
+        """While degraded, opportunistically retry the REAL transport
+        when the probe deadline has passed — one bare call, no retry
+        loop (a dead coordinator must not add attempts × backoff of
+        latency to every degraded op). Success promotes back and
+        returns the real result; failure re-schedules and returns None
+        (caller falls through to the local store)."""
+        if self._next_reprobe_at is None or \
+                self._clock() < self._next_reprobe_at:
+            return None
+        self.reprobe_count += 1
+        try:
+            out = getattr(self.transport, op)(*args)
+        except Exception as e:  # noqa: BLE001 - the policy seam
+            self.error_count += 1
+            self._schedule_reprobe()
+            logger.debug(f"{self.name}: re-probe {self.reprobe_count} "
+                         f"failed ({type(e).__name__}: {e})")
+            return None
+        self._local = None
+        self._reprobe_failures = 0
+        self._next_reprobe_at = None
+        self.recovered_count += 1
+        logger.warning(
+            f"{self.name}: coordination-service KV transport recovered "
+            f"after {self.reprobe_count} re-probe(s) — promoting back "
+            f"from the local in-memory store")
+        return out
+
     def _call(self, op, *args):
         if self._local is not None:
+            out = self._try_reprobe(op, args)
+            if out is not None or not self.degraded:
+                return out
             return getattr(self._local, op)(*args)
         last = None
         for attempt in range(1, self.attempts + 1):
@@ -101,15 +155,19 @@ class RetryingKVTransport:
                     self._sleep(self._backoff_s(attempt))
         if not self.degrade_to_local:
             raise last
-        # single-warning degrade-to-local: all further ops run against
-        # an in-process store, preserving own-host behavior
+        # single-warning degrade-to-local: further ops run against an
+        # in-process store, preserving own-host behavior, until a
+        # capped-backoff re-probe finds the real transport healthy
         from ..elasticity.heartbeat import InMemoryTransport
         self._local = InMemoryTransport()
+        self._reprobe_failures = 0
+        self._schedule_reprobe()
         logger.warning(
             f"{self.name}: coordination-service KV {op} still failing "
             f"after {self.attempts} attempt(s) "
             f"({type(last).__name__}: {last}) — degrading to a local "
-            f"in-memory store (this host only; warned once)")
+            f"in-memory store (this host only; re-probing with capped "
+            f"backoff from {self.reprobe_base_s:.0f}s)")
         return getattr(self._local, op)(*args)
 
     def publish(self, peer, payload):

@@ -1,0 +1,17 @@
+"""True positives for multislice-collective-outside-schedule."""
+import jax
+
+from deeperspeed_tpu.parallel.multislice import SliceTopology
+
+
+def dp_reduce_over_dcn(grads, topology: SliceTopology, axis_name):
+    g = jax.lax.psum(grads, axis_name)        # BAD: bypasses DCN policy
+    if topology.n_boundaries:
+        g = jax.lax.all_gather(g, axis_name)  # BAD: raw fp32 on the wire
+    return g
+
+
+def boundary_permute(x, axis_name):
+    from deeperspeed_tpu.elasticity import slices  # noqa: F401
+    return jax.lax.ppermute(  # dslint: disable=multislice-collective-outside-schedule
+        x, axis_name, [(0, 1)])

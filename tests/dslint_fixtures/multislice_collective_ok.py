@@ -1,0 +1,17 @@
+"""True negatives for multislice-collective-outside-schedule."""
+import jax
+
+from deeperspeed_tpu.parallel.multislice import SliceTopology
+
+
+def plain_dp_reduce(grads, axis_name):
+    # not slice-aware: raw collectives in pre-existing step closures
+    # are out of scope for this rule
+    return jax.lax.psum(grads, axis_name)
+
+
+def plan_boundaries(names, n_stages):
+    # slice-aware but pure topology math: no collective issued
+    topo = SliceTopology(names=tuple(names), axis="pipe",
+                         n_stages=n_stages)
+    return topo.stage_boundaries

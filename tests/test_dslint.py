@@ -45,6 +45,8 @@ RULE_FIXTURES = {
                              "swallowed_thread_exc_ok.py"),
     "timed-pallas-no-interpret": ("timed_pallas_no_interpret_bad.py", 1,
                                   "timed_pallas_no_interpret_ok.py"),
+    "multislice-collective-outside-schedule": (
+        "multislice_collective_bad.py", 2, "multislice_collective_ok.py"),
 }
 
 
@@ -461,7 +463,7 @@ def test_gate_runs_all_rules():
                       use_baseline=False)
     assert set(result.rules_run) == set(REGISTRY)
     assert set(RULE_FIXTURES) | {"parse-only-key"} == set(REGISTRY)
-    assert len(REGISTRY) == 8
+    assert len(REGISTRY) == 9
     assert DEFAULT_PATHS == ("deeperspeed_tpu", "bench.py", "tests/perf")
 
 

@@ -634,7 +634,8 @@ class TestQuantConfig:
     def test_defaults(self):
         p = parse_quantization_block({"quantization": {}})
         assert p == {"weights": None, "ffn": None,
-                     "gradient_compression": False}
+                     "gradient_compression": False,
+                     "gradient_compression_packed": False}
 
     def test_full_block(self):
         p = parse_quantization_block({"quantization": {

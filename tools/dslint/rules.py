@@ -462,5 +462,87 @@ class TimedPallasNoInterpretRule(Rule):
                 f"deterministic default first.")
 
 
-# Project-scope rule 8 registers itself on import.
+# ---------------------------------------------------------------------------
+# 8. collectives issued outside the schedule pass in slice-aware code
+# ---------------------------------------------------------------------------
+
+@register
+class MultisliceCollectiveRule(Rule):
+    name = "multislice-collective-outside-schedule"
+    summary = ("jax.lax collective issued outside the schedule pass in "
+               "slice-aware code — DCN wire ops must route through the "
+               "schedule/transport layer")
+    incident = ("PR 19 (docs/multislice.md): a collective issued "
+                "directly from slice-management code bypasses the DCN "
+                "wire policy (fp32 refusal, packed signs, exposed-"
+                "crossing accounting) — it would silently ship "
+                "uncompressed fp32 over the slow fabric")
+
+    # the schedule pass + transport layer, where collectives BELONG
+    _SCHEDULE_PATHS = (
+        "deeperspeed_tpu/parallel/schedule.py",
+        "deeperspeed_tpu/parallel/pipeline_spmd.py",
+        "deeperspeed_tpu/runtime/comm/",
+        "deeperspeed_tpu/runtime/pipe/",
+    )
+    # modules whose code is slice-aware in its entirety
+    _SLICE_MODULES = ("parallel/multislice.py", "elasticity/slices.py")
+    _COLLECTIVES = {
+        "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+        "jax.lax.psum_scatter", "jax.lax.all_gather",
+        "jax.lax.all_to_all", "jax.lax.ppermute",
+    }
+
+    def _is_slice_aware(self, fn, aliases):
+        """Does this function reference the multislice layer — an
+        imported multislice/slices name, or an in-function import of
+        one of those modules?"""
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names]
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    mods.append(node.module)
+                if any("multislice" in m or m.endswith("slices")
+                       for m in mods):
+                    return True
+            elif isinstance(node, ast.Name):
+                dotted = aliases.get(node.id, "")
+                if "multislice" in dotted or \
+                        dotted.endswith(("elasticity.slices", ".slices")):
+                    return True
+        return False
+
+    def _collective_calls(self, root, aliases):
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                dotted = resolve_dotted(aliases, call_name(node))
+                if dotted in self._COLLECTIVES:
+                    yield node, dotted
+
+    def check_file(self, src, ctx):
+        if any(p in src.path for p in self._SCHEDULE_PATHS):
+            return
+        aliases = src.aliases()
+        whole_module = any(src.path.endswith(m)
+                           for m in self._SLICE_MODULES)
+        seen = set()
+        roots = [src.tree] if whole_module else [
+            n for n in src.nodes()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and self._is_slice_aware(n, aliases)]
+        for root in roots:
+            for node, dotted in self._collective_calls(root, aliases):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                yield from _emit(
+                    src, self.name, node,
+                    f"'{dotted}(...)' issued from slice-aware code "
+                    f"outside the schedule pass: route the wire op "
+                    f"through parallel/schedule.py / runtime/comm / "
+                    f"runtime/pipe so the DCN policy (fp32 refusal, "
+                    f"packed signs, crossing accounting) applies.")
+
+
+# Project-scope rule 9 registers itself on import.
 from . import config_keys  # noqa: E402,F401  (registration side effect)
