@@ -52,6 +52,13 @@ KEYS (default all):
              tok/s vs cache-off, spec acceptance rate, p50 inter-token
              speedup, steady-state compile delta (must be 0); opt-in
              via DS_BENCH_SERVE_PREFIX=1)
+  - serve_disagg (disaggregated prefill/decode serving row: the bursty
+             80%-shared-prefix stream run unified vs a prefill-pool +
+             decode-pool split over the in-memory handoff transport;
+             tokens/s for both layouts, decode-side p50/p99 inter-token
+             latency under the prefill bursts, handoff round-trip p50
+             ms, post-warmup compile delta over both pools (must be 0);
+             opt-in via DS_BENCH_SERVE_DISAGG=1)
   - elastic  (supervised-restart recovery: a hard mid-run kill under the
              elasticity supervisor — kill -> resumed-step wall clock
              (MTTR) and steps lost vs the committed checkpoint; opt-in
@@ -114,7 +121,7 @@ ROW_ORDER = ["zero3", "bert128", "bert512", "gpt2xl", "longseq", "moe"]
 ROW_TIMEOUT = {"gpt2xl": 1100, "longseq": 1100, "ckpt": 600,
                "sentinel": 600, "telemetry": 600, "packed": 800,
                "moe": 800, "serve": 800, "serve_chaos": 900,
-               "serve_prefix": 900,
+               "serve_prefix": 900, "serve_disagg": 900,
                "zero3": 800, "pipe": 900, "offload": 1100,
                "elastic": 600, "fleet": 600,
                "quant": 1100,  # moe/longseq/quant walk both engines
@@ -1653,6 +1660,135 @@ def row_serve_prefix():
     return _ladder([("neox125m", thunk)], {}, "serve_prefix")
 
 
+def row_serve_disagg():
+    """Disaggregated prefill/decode serving row (opt-in via
+    DS_BENCH_SERVE_DISAGG=1): the bursty 80%-shared-prefix stream run
+    through (1) a unified engine and (2) a prefill-pool + decode-pool
+    split over the in-memory handoff transport, both with the prefix
+    registry on. Two warmup streams per layout (the split needs both:
+    the first warms the outbox-batched install buckets, the second the
+    announcement-live staggered ones), then one measured stream.
+    Reports generated tokens/s for both layouts, the decode-side
+    p50/p99 inter-token latency while the prefill bursts land (the
+    cadence isolation the split buys), the handoff round-trip p50 ms,
+    and the post-warmup compile delta summed over BOTH pools (the
+    steady-state pin — must be 0)."""
+    jax = _setup_jax()
+    cfg, model, params = _headline_setup(jax)
+
+    max_new = int(os.environ.get("DS_BENCH_SERVE_NEW", "32"))
+    n_req = int(os.environ.get("DS_BENCH_SERVE_REQUESTS", "32"))
+    prefix_len = int(os.environ.get("DS_BENCH_SERVE_PREFIX_LEN", "256"))
+
+    def make_prompts(rng, shared):
+        out = []
+        for i in range(n_req):
+            tail = list(rng.integers(
+                1, cfg.vocab_size, size=int(rng.integers(8, 48))))
+            if i % 5 == 4:                   # 20% cold prompts
+                out.append(list(rng.integers(
+                    1, cfg.vocab_size, size=prefix_len)) + tail)
+            else:
+                out.append(shared + tail)
+        return out
+
+    def stream(front, decoder, engines, prompts):
+        """One bursty stream: submit on ``front``, step every engine
+        in lockstep, collect inter-token gaps on ``decoder``'s running
+        set (for the split that is the decode pool only). Returns
+        (wall_s, generated_tokens, itl_gaps)."""
+        last, itl = {}, []
+        t0 = time.perf_counter()
+        for p in prompts:
+            front.submit(p, max_new_tokens=max_new)
+        while any(e.scheduler.has_work or
+                  getattr(e, "_handoff_outbox", None) or
+                  getattr(e, "_pending_handoff", None)
+                  for e in engines):
+            for e in engines:
+                e.step()
+            now = time.perf_counter()
+            for r in list(decoder.scheduler.running):
+                k = len(r.generated)
+                if k and r.request_id in last and \
+                        k > last[r.request_id][1]:
+                    itl.append(now - last[r.request_id][0])
+                if k:
+                    last[r.request_id] = (now, k)
+        wall = time.perf_counter() - t0
+        finished = [r for e in engines
+                    for r in e.scheduler.pop_finished()]
+        assert len(finished) == n_req, (len(finished), n_req)
+        tokens = sum(len(r.generated) for r in finished)
+        return wall, tokens, itl
+
+    def thunk():
+        from deeperspeed_tpu.elasticity.heartbeat import \
+            InMemoryTransport
+        from deeperspeed_tpu.inference import InferenceEngine
+        base_block = {
+            "enabled": True, "page_size": 64,
+            "num_pages": int(os.environ.get("DS_BENCH_SERVE_PAGES",
+                                            "513")),
+            "max_batch_size": 8, "token_budget": 2048,
+            "prefill_batch_sizes": [4], "decode_batch_sizes": [8],
+            "prefix_cache": {"enabled": True}}
+        rng = np.random.default_rng(0)
+        shared = list(rng.integers(1, cfg.vocab_size, size=prefix_len))
+
+        uni = InferenceEngine(model, config={"inference": base_block},
+                              params=params)
+        for _ in range(2):                                   # warmup
+            stream(uni, uni, [uni], make_prompts(rng, shared))
+        uni_warm = uni.compile_count()
+        uni_wall, uni_tokens, uni_itl = stream(
+            uni, uni, [uni], make_prompts(rng, shared))
+
+        t = InMemoryTransport()
+        pools = {}
+        for role in ("prefill", "decode"):
+            block = dict(base_block)
+            block["disaggregation"] = {"role": role,
+                                       "pool_id": f"{role[:3]}0"}
+            pools[role] = InferenceEngine(
+                model, config={"inference": block}, params=params,
+                handoff_transport=t)
+        pre, dec = pools["prefill"], pools["decode"]
+        for _ in range(2):                                   # warmup
+            stream(pre, dec, [pre, dec], make_prompts(rng, shared))
+        warm = pre.compile_count() + dec.compile_count()
+        acked_before = pre.stats["handoff_acked"]
+        wall, tokens, itl = stream(pre, dec, [pre, dec],
+                                   make_prompts(rng, shared))
+
+        itl_ms = np.asarray(itl) * 1e3
+        uni_itl_ms = np.asarray(uni_itl) * 1e3
+        return {
+            "serve_disagg_requests": n_req,
+            "serve_disagg_shared_len": prefix_len,
+            "serve_disagg_unified_tok_s": round(uni_tokens /
+                                                max(uni_wall, 1e-9), 1),
+            "serve_disagg_tok_s": round(tokens / max(wall, 1e-9), 1),
+            "serve_disagg_unified_p50_token_ms": round(
+                float(np.percentile(uni_itl_ms, 50)), 2),
+            "serve_disagg_unified_p99_token_ms": round(
+                float(np.percentile(uni_itl_ms, 99)), 2),
+            "serve_disagg_p50_token_ms": round(
+                float(np.percentile(itl_ms, 50)), 2),
+            "serve_disagg_p99_token_ms": round(
+                float(np.percentile(itl_ms, 99)), 2),
+            "serve_disagg_handoffs": pre.stats["handoff_acked"] -
+                acked_before,
+            "serve_disagg_handoff_p50_ms":
+                pre.serve_stats().get("handoff_p50_ms"),
+            # steady-state pin across BOTH pools
+            "serve_disagg_compile_delta":
+                pre.compile_count() + dec.compile_count() - warm,
+        }
+
+    return _ladder([("neox125m", thunk)], {}, "serve_disagg")
+
+
 _ELASTIC_WORKER = '''
 import json, os, sys, time
 workdir, target, crash = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
@@ -2372,6 +2508,7 @@ ROW_FNS = {"zero3": row_zero3, "bert128": row_bert128,
            "packed": row_packed, "serve": row_serve,
            "serve_chaos": row_serve_chaos,
            "serve_prefix": row_serve_prefix,
+           "serve_disagg": row_serve_disagg,
            "elastic": row_elastic, "fleet": row_fleet,
            "pipe": row_pipe, "offload": row_offload,
            "quant": row_quant, "plan": row_plan, "rl": row_rl,
@@ -2403,6 +2540,9 @@ def rows_enabled():
     if os.environ.get("DS_BENCH_SERVE_PREFIX", "0") not in \
             ("0", "", "false"):
         order.append("serve_prefix")
+    if os.environ.get("DS_BENCH_SERVE_DISAGG", "0") not in \
+            ("0", "", "false"):
+        order.append("serve_disagg")
     if os.environ.get("DS_BENCH_ELASTIC", "0") not in ("0", "", "false"):
         order.append("elastic")
     if os.environ.get("DS_BENCH_FLEET", "0") not in ("0", "", "false"):
@@ -2428,7 +2568,8 @@ def rows_enabled():
     if "bert" in picked:            # back-compat alias
         picked |= {"bert128", "bert512"}
     for opt_in in ("ckpt", "sentinel", "telemetry", "packed", "serve",
-                   "serve_chaos", "serve_prefix", "elastic", "fleet",
+                   "serve_chaos", "serve_prefix", "serve_disagg",
+                   "elastic", "fleet",
                    "pipe", "offload", "quant", "plan", "rl",
                    "multislice"):
         if opt_in in picked and opt_in not in order:

@@ -86,6 +86,14 @@ class InMemoryTransport:
         with self._lock:
             return {k: dict(v) for k, v in self._beats.items()}
 
+    def discard(self, peer):
+        """Drop a key (best-effort; absent is fine). The KV-page
+        handoff channel retires consumed offer/ack slots through this
+        so a long-lived serving split cannot grow the store without
+        bound."""
+        with self._lock:
+            self._beats.pop(str(peer), None)
+
 
 class CoordinationTransport:
     """Heartbeats over the jax.distributed coordination-service KV store
@@ -149,6 +157,18 @@ class CoordinationTransport:
                     prev.get("serial", 0):
                 beats[peer] = payload
         return beats
+
+    def discard(self, peer):
+        """Best-effort delete of one key (absent / no-delete-support
+        clients are fine) — the handoff channel's slot retirement."""
+        if not self._can_delete:
+            return
+        try:
+            self._client.key_value_delete(f"{self._prefix}/{peer}")
+        except AttributeError:   # pragma: no cover - old jax client
+            self._can_delete = False
+        except Exception:        # already gone / service hiccup
+            pass
 
 
 class _SimulatedPeer:

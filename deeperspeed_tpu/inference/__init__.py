@@ -13,13 +13,19 @@ Pallas paged decode-attention kernel (`docs/inference.md`).
   (`RequestRejected` / `DeadlineExceeded` / `RequestFailed` /
   `DrainAborted`) — the SLO-aware robustness layer
   (docs/inference.md "Serving under failure").
+- `HandoffChannel` / `HandoffRejected` + `ServeRouter` — disaggregated
+  prefill/decode serving: the cross-pool KV-page handoff wire and the
+  SLO-aware front-end router (docs/inference.md "Disaggregated
+  serving").
 """
 
 from .admission import (AdmissionController, DeadlineExceeded,
                         DrainAborted, PRIORITIES, RequestFailed,
                         RequestRejected, REQUEST_STATUSES)
 from .engine import InferenceEngine
+from .handoff import HandoffChannel, HandoffRejected
 from .kv_cache import PagedKVCache, PrefixCache, pages_for_tokens
+from .router import ServeRouter
 from .scheduler import ContinuousBatchingScheduler, Request, StepPlan
 
 __all__ = ["InferenceEngine", "PagedKVCache", "PrefixCache",
@@ -27,4 +33,5 @@ __all__ = ["InferenceEngine", "PagedKVCache", "PrefixCache",
            "ContinuousBatchingScheduler", "Request", "StepPlan",
            "AdmissionController", "RequestRejected", "DeadlineExceeded",
            "RequestFailed", "DrainAborted", "PRIORITIES",
-           "REQUEST_STATUSES"]
+           "REQUEST_STATUSES",
+           "HandoffChannel", "HandoffRejected", "ServeRouter"]

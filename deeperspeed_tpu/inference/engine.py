@@ -63,6 +63,8 @@ from ..utils.kv_retry import backoff_delay
 from ..utils.logging import logger
 from .admission import (AdmissionController, DrainAborted, RequestFailed,
                         validate_priority)
+from .handoff import (ACCEPTED, HandoffChannel, HandoffRejected,
+                      check_geometry, encode_pages, write_pages)
 from .kv_cache import (PagedKVCache, PrefixCache, QuantizedPages,
                        pages_for_tokens, quantize_kv)
 from .metrics import (PREFIX_HIT_RATE, PREFIX_PAGES_SHARED,
@@ -169,7 +171,8 @@ class InferenceEngine:
 
     def __init__(self, model, config=None, config_params=None, params=None,
                  mesh=None, rng=None, monitor=None, draft_model=None,
-                 draft_params=None, owns_monitor=True):
+                 draft_params=None, owns_monitor=True,
+                 handoff_transport=None):
         self.model = model
         cfg = model.config
         if getattr(cfg, "moe_num_experts", 0):
@@ -417,7 +420,14 @@ class InferenceEngine:
                       # speculative decoding: proposed/accepted draft
                       # tokens and verify steps (0 when speculation off)
                       "spec_steps": 0, "spec_proposed": 0,
-                      "spec_accepted": 0}
+                      "spec_accepted": 0,
+                      # disaggregated prefill/decode handoff (all zero
+                      # on a unified engine): prefill-side offers
+                      # (sent/acked/rejected/expired) and decode-side
+                      # verdicts (installed/refused)
+                      "handoff_sent": 0, "handoff_acked": 0,
+                      "handoff_rejected": 0, "handoff_expired": 0,
+                      "handoff_installed": 0, "handoff_refused": 0}
         # request-level latency histograms (inference/metrics.py):
         # admission-wait / TTFT / inter-token distributions, fanned out
         # to the monitor's export backends (Prometheus histogram
@@ -450,6 +460,47 @@ class InferenceEngine:
             from ..runtime.sentinel import HangWatchdog
             self.watchdog = HangWatchdog(ip["hang_timeout_s"], self,
                                          "_on_serving_hang")
+
+        # -- disaggregated prefill/decode (docs/inference.md
+        #    "Disaggregated serving"): role, pool identity, and the
+        #    cross-pool KV-page handoff channel ---------------------------
+        dg = ip["disaggregation"]
+        self.role = dg["role"]
+        self.pool_id = dg["pool_id"]
+        self.handoff_timeout_s = dg["handoff_timeout_s"]
+        # the validated inference.router weights (None when absent) —
+        # a ServeRouter fronting this pool picks them up from here
+        self.router_params = ip["router"]
+        self.handoff = None
+        self._handoff_outbox = []      # prefilled requests awaiting offer
+        self._pending_handoff = {}     # offer key -> (request, offered_at)
+        self._handoff_draining = False
+        if self.role != "unified":
+            if handoff_transport is None:
+                raise DeepSpeedConfigError(
+                    f"inference.disaggregation.role={self.role!r} needs a "
+                    f"handoff_transport (the coordination-service KV the "
+                    f"pages travel over — elasticity.heartbeat."
+                    f"InMemoryTransport / CoordinationTransport)")
+            if self.mp > 1:
+                raise DeepSpeedConfigError(
+                    "disaggregated serving with a model-parallel mesh is "
+                    "unsupported: the page payload has no tensor-parallel "
+                    "placement yet — split pools on replicated (mp=1) "
+                    "meshes")
+            self.handoff = HandoffChannel(handoff_transport, self.pool_id)
+            if self.role == "decode":
+                # a decode pool never prefills FRESH requests: the drain
+                # gate blocks queue admissions permanently, while evicted
+                # / quarantined sequences (whose K/V must be rebuilt
+                # locally) still re-admit through it
+                self.scheduler.stop_admissions()
+            # stamp the scrape: every Serve/* family this pool exports
+            # carries its role + pool identity
+            if monitor is not None:
+                hook = getattr(monitor, "set_export_labels", None)
+                if hook is not None:
+                    hook({"role": self.role, "host": self.pool_id})
 
     # ------------------------------------------------------------------
     # weights
@@ -966,6 +1017,12 @@ class InferenceEngine:
         `RequestRejected` (terminal status ``shed``) carrying a
         retry-after hint from the measured drain rate — the request
         never enters the queue."""
+        if self.role == "decode":
+            raise RuntimeError(
+                f"decode-role pool {self.pool_id!r} does not accept "
+                f"fresh requests — submit to a prefill pool (or the "
+                f"front-end ServeRouter); its work arrives as KV-page "
+                f"handoffs")
         priority = self.default_priority if priority is None else priority
         validate_priority(priority)
         for name, value in (("deadline_ms", deadline_ms),
@@ -1024,6 +1081,16 @@ class InferenceEngine:
 
     def _step_inner(self):
         now = time.perf_counter()
+        if self.handoff is not None:
+            # pool discovery rides every step: the prefill side's dst
+            # pick and the router's gauges read the freshest announce
+            self.handoff.announce(self.role, self._pool_load())
+            if self.role == "decode":
+                # install BEFORE schedule(): a page set acked this step
+                # joins this step's decode batch
+                self._install_handoffs(now)
+            else:
+                self._poll_handoff_acks(now)
         t0 = now
         finished_before = len(self.scheduler.finished)
         with self.telemetry.span("schedule"):
@@ -1077,6 +1144,14 @@ class InferenceEngine:
                 # decode accounting starts
                 self.stats["prefill_tokens"] += \
                     sum(r.cached for r in plan.prefills)
+
+        if self.role == "prefill":
+            # a prefill pool never decodes: freshly prefilled sequences
+            # (first token sampled, K/V resident) leave the scheduler
+            # for the handoff outbox before the next schedule() can
+            # plan a decode batch over them
+            self._collect_handoffs()
+            self._dispatch_handoffs(now)
 
         # a mid-execution prefill failure may have run cache-loss
         # recovery, evicting EVERY running sequence (their K/V is
@@ -1140,6 +1215,11 @@ class InferenceEngine:
                 scalars[SPEC_ACCEPTANCE_RATE] = \
                     self.stats["spec_accepted"] / \
                     max(self.stats["spec_proposed"], 1)
+            if self.role != "unified":
+                for key in ("handoff_sent", "handoff_acked",
+                            "handoff_rejected", "handoff_expired",
+                            "handoff_installed", "handoff_refused"):
+                    scalars[f"Serve/{key}"] = float(self.stats[key])
             self.monitor.record(total, scalars)
         return {"prefilled": len(plan.prefills), "decoded": produced,
                 "evicted": len(plan.evicted), "finished": finished}
@@ -1264,6 +1344,191 @@ class InferenceEngine:
             self.scheduler.detach_waiting_prefixes()
         while self.scheduler.running:
             self.scheduler._evict_victim(now)
+        # outbox/pending-offer requests hold pages the loss consumed
+        # too: withdraw their offers and requeue for full re-prefill
+        for key, (req, _) in list(self._pending_handoff.items()):
+            self.handoff.withdraw(key)
+            self.stats["handoff_expired"] += 1
+            self.scheduler.requeue_handoff(req, now=now)
+        self._pending_handoff = {}
+        for req in self._handoff_outbox:
+            self.scheduler.requeue_handoff(req, now=now)
+        self._handoff_outbox = []
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode handoff (docs/inference.md)
+    # ------------------------------------------------------------------
+
+    def _pool_load(self):
+        """The load gauge this pool announces: backlog plus page-pool
+        occupancy (the fraction breaks ties between pools with equal
+        request counts) — the prefill side's least-loaded dst pick and
+        the router's weighted score both consume it."""
+        usable = max(self.cache.num_pages - 1, 1)
+        return (len(self.scheduler.running) +
+                len(self.scheduler.waiting) +
+                len(self.scheduler.quarantined) +
+                len(self._handoff_outbox) + len(self._pending_handoff) +
+                (1.0 - self.cache.num_free / usable))
+
+    def _collect_handoffs(self):
+        """Move every running sequence with a sampled token out of the
+        scheduler into the handoff outbox (prefill role only). The
+        request keeps its pages (freed on the accepted ack) but stops
+        being schedulable here — its decode happens on the other
+        pool."""
+        moved = [r for r in self.scheduler.running if r.generated]
+        for req in moved:
+            self.scheduler.running.remove(req)
+            self._handoff_outbox.append(req)
+
+    def _encode_handoff(self, req, now):
+        """One offer payload: the page bytes (`encode_pages` — int8
+        scales included) plus the request metadata the decode pool
+        rebuilds the `Request` from. Clocks do not cross the wire —
+        the deadline travels as REMAINING milliseconds."""
+        payload = encode_pages(self.cache, req.pages)
+        deadline_remaining_ms = None
+        if req.deadline_at is not None:
+            deadline_remaining_ms = (req.deadline_at - now) * 1e3
+        payload["request"] = {
+            "request_id": req.request_id,
+            "prompt": [int(t) for t in req.prompt],
+            "generated": [int(t) for t in req.generated],
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": req.eos_token_id,
+            "priority": req.priority,
+            "deadline_remaining_ms": deadline_remaining_ms,
+            "ttft_slo_ms": req.ttft_slo_ms,
+            "cached": int(req.cached),
+            "evictions": int(req.evictions),
+        }
+        return payload
+
+    def _dispatch_handoffs(self, now):
+        """Offer every outbox request to the least-loaded announced
+        decode pool. No decode pool announced yet → the outbox simply
+        waits (the requests hold their pages and re-offer next step)."""
+        if not self._handoff_outbox:
+            return
+        dst = self.handoff.choose_decode_pool()
+        if dst is None:
+            return
+        for req in self._handoff_outbox:
+            key = self.handoff.offer(dst, str(req.request_id),
+                                     self._encode_handoff(req, now))
+            self._pending_handoff[key] = (req, now)
+            self.stats["handoff_sent"] += 1
+        self._handoff_outbox = []
+
+    def _poll_handoff_acks(self, now):
+        """Prefill-side verdict sweep: free pages on ``accepted``
+        (the decode pool owns the sequence now), requeue with eviction
+        semantics on ``rejected``, and withdraw + requeue offers older
+        than ``handoff_timeout_s`` (a late ack for a withdrawn offer is
+        dropped as stale)."""
+        for key, _, payload in self.handoff.poll_acks():
+            entry = self._pending_handoff.pop(key, None)
+            self.handoff.retire(key)
+            if entry is None:
+                continue               # ack for a withdrawn offer
+            req, offered_at = entry
+            if payload.get("state") == ACCEPTED:
+                self.stats["handoff_acked"] += 1
+                self.request_metrics.observe_handoff(now - offered_at)
+                # registry-shared pages just lose this request's ref
+                self.scheduler._release_pages(req)
+                req.state = FINISHED
+            else:
+                self.stats["handoff_rejected"] += 1
+                self.scheduler.requeue_handoff(req, now=now)
+        for key, (req, offered_at) in list(self._pending_handoff.items()):
+            if now - offered_at <= self.handoff_timeout_s:
+                continue
+            del self._pending_handoff[key]
+            self.handoff.withdraw(key)
+            self.stats["handoff_expired"] += 1
+            self.scheduler.requeue_handoff(req, now=now)
+
+    def _install_handoffs(self, now):
+        """Decode-side sweep: install every offer addressed to this
+        pool, acking each with its verdict (the ack overwrites the
+        offer slot — the page bytes never outlive one trip)."""
+        for key, payload in self.handoff.poll_offers():
+            try:
+                self._install_handoff(payload, now)
+            except HandoffRejected as e:
+                self.stats["handoff_refused"] += 1
+                self.handoff.ack(key, ok=False, reason=e.reason)
+            else:
+                self.stats["handoff_installed"] += 1
+                self.handoff.ack(key, ok=True)
+
+    def _install_handoff(self, payload, now):
+        """Land one offered request in this pool: geometry/capacity
+        checks, prefix-cache dedupe (chain pages this pool already
+        holds are retained, not rewritten), page allocation + batched
+        scatter, then mid-stream admission straight into `running` —
+        sampled tokens, priority, and remaining deadline intact. Raises
+        typed `HandoffRejected`; every rejection path leaves this
+        pool's free list and refcounts exactly as it found them."""
+        if self._handoff_draining or self._drain_requested:
+            raise HandoffRejected(
+                f"pool {self.pool_id!r} is draining", reason="draining")
+        if len(self.scheduler.running) >= self.max_batch_size:
+            raise HandoffRejected(
+                f"pool {self.pool_id!r} decode batch is full "
+                f"({self.max_batch_size})", reason="busy")
+        check_geometry(self.cache, payload)
+        meta = payload["request"]
+        prompt = [int(t) for t in meta["prompt"]]
+        shared_pages, prefix_node = [], None
+        if self.prefix_cache is not None:
+            chain = self.prefix_cache.lookup(prompt)
+            if chain:
+                shared_pages = [n.page for n in chain]
+                prefix_node = chain[-1]
+        n_shared = len(shared_pages)
+        # retain the chain BEFORE allocating: an allocation-shortfall
+        # reclaim sweep skips pages with live request references, so
+        # the matched chain cannot be reclaimed out from under us
+        self.cache.retain(shared_pages)
+        own = self.cache.allocate(payload["n"] - n_shared)
+        if own is None:
+            self.cache.free(shared_pages)
+            raise HandoffRejected(
+                f"pool {self.pool_id!r} has no room for "
+                f"{payload['n'] - n_shared} page(s)", reason="pool_full")
+        try:
+            write_pages(self.cache, own, payload, skip=n_shared)
+        except HandoffRejected:
+            self.cache.free(own + shared_pages)
+            raise
+        req = Request(
+            prompt=prompt,
+            max_new_tokens=int(meta["max_new_tokens"]),
+            eos_token_id=meta["eos_token_id"],
+            request_id=meta["request_id"],
+            priority=meta.get("priority", self.default_priority),
+            ttft_slo_ms=meta.get("ttft_slo_ms"),
+            generated=[int(t) for t in meta["generated"]],
+            pages=shared_pages + own,
+            cached=int(meta["cached"]),
+            n_shared=n_shared,
+            prefix_node=prefix_node,
+            evictions=int(meta.get("evictions", 0)),
+            # TTFT was observed ONCE, on the prefill pool: a non-None
+            # first_token_at blocks any re-count here (a local eviction
+            # re-prefill included); inter-token starts at install
+            submitted_at=now, first_token_at=now, last_token_at=now)
+        remaining_ms = meta.get("deadline_remaining_ms")
+        if remaining_ms is not None:
+            req.deadline_ms = float(remaining_ms)
+            req.deadline_at = now + float(remaining_ms) / 1e3
+        self.scheduler.admit_handoff(req, now=now)
+        if self.prefix_cache is not None:
+            self.scheduler._register_prefix(req)
+        return req
 
     def _programs_warm(self, plan):
         """True when every compiled program this plan dispatches has
@@ -1620,14 +1885,35 @@ class InferenceEngine:
         deadline_s = (self.drain_deadline_s if deadline_s is None
                       else float(deadline_s))
         self.scheduler.stop_admissions()
+        # a draining decode pool refuses fresh handoff offers (typed
+        # ``draining`` rejection — the prefill side re-offers to a
+        # surviving pool); a draining prefill pool still steps until
+        # its outbox and pending offers resolve
+        self._handoff_draining = True
         t0 = time.perf_counter()
         deadline_hit = False
-        while self.scheduler.has_inflight_work:
+        while (self.scheduler.has_inflight_work or
+               self._handoff_outbox or self._pending_handoff):
             if time.perf_counter() - t0 > deadline_s:
                 deadline_hit = True
                 break
             self.step()
         abandoned = 0
+        for key, (req, _) in list(self._pending_handoff.items()):
+            self.handoff.withdraw(key)
+            self.scheduler.finish_failed(req, DrainAborted(
+                f"graceful-drain deadline ({deadline_s:.1f}s) elapsed "
+                f"with request {req.request_id}'s handoff offer still "
+                f"unacked", attempts=req.failures))
+            abandoned += 1
+        self._pending_handoff = {}
+        for req in self._handoff_outbox:
+            self.scheduler.finish_failed(req, DrainAborted(
+                f"graceful-drain deadline ({deadline_s:.1f}s) elapsed "
+                f"with request {req.request_id} still awaiting a decode "
+                f"pool", attempts=req.failures))
+            abandoned += 1
+        self._handoff_outbox = []
         for req in self.scheduler.inflight_requests():
             self.scheduler.finish_failed(req, DrainAborted(
                 f"graceful-drain deadline ({deadline_s:.1f}s) elapsed "
@@ -1673,7 +1959,8 @@ class InferenceEngine:
             if self._drain_requested:
                 self.drain()
                 raise SystemExit(0)
-            if not self.scheduler.has_work:
+            if not (self.scheduler.has_work or self._handoff_outbox or
+                    self._pending_handoff):
                 break
             self.step()
             steps += 1

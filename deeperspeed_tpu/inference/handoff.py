@@ -1,0 +1,270 @@
+"""Cross-pool KV-page handoff for disaggregated prefill/decode serving.
+
+A prefill-role engine (docs/inference.md "Disaggregated prefill/
+decode") runs admission + prefill only; when a request's prefill
+completes, its KV pages leave the prefill pool and the request
+continues mid-stream on a decode-role engine. The pages travel over the
+same coordination-service KV transport the PR 9/10 heartbeats and fleet
+summaries ride (`elasticity.heartbeat.InMemoryTransport` /
+`CoordinationTransport`), so a two-pool split is single-host drivable
+in tests and cross-host in production with zero new infrastructure.
+
+Wire format (`encode_pages` / `decode_pages`): page rows are gathered
+host-side from the ``[L, P, H, page_size, D]`` pools into an
+``[L, n, H, page_size, D]`` block and shipped as base64 raw bytes —
+bit-exact round-trips by construction, pinned by test for bf16 AND int8
+pools. Int8 pages are SELF-DESCRIBING: the per-page bf16 scale rows
+``[L, n, H, page_size]`` travel in the same payload, so an installed
+page dequantizes identically on the decode pool. Page 0 (the reserved
+trash page) never ships — `encode_pages` refuses it loudly.
+
+Offer/ack protocol (`HandoffChannel`): one KV slot per offer, keyed
+``ds_serve:offer:<dst>:<src>:<uid>``. The prefill side publishes the
+offer (state ``offer``, pages + request metadata); the decode side
+installs and OVERWRITES the slot with a small ack tombstone (state
+``accepted`` / ``rejected``) — the page bytes never outlive their one
+trip. The prefill side frees its local pages on ``accepted``, requeues
+the request (eviction semantics: full-context re-prefill, then a fresh
+offer) on ``rejected``, and withdraws + requeues offers that outlive
+``handoff_timeout_s``. Consumed slots are retired via the transports'
+best-effort ``discard`` so a long-lived split cannot grow the store
+without bound. The timeout path trades a fencing lease for simplicity:
+an ack that lands after the withdrawal is dropped as stale, but a
+decode pool that installed in exactly that window generates a
+duplicate — set the timeout well above the transport RTT.
+"""
+
+import base64
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .kv_cache import QuantizedPages
+
+# transport key namespaces (shared store with the heartbeats — the
+# prefix keeps read_all filtering cheap and collision-free). ":" as
+# the segment separator, NOT "/": CoordinationTransport.read_all
+# collapses keys to their first "/" segment (the heartbeat per-peer
+# convention), so channel keys must be single-segment under it
+_POOL_PREFIX = "ds_serve:pool"
+_OFFER_PREFIX = "ds_serve:offer"
+
+OFFER = "offer"
+ACCEPTED = "accepted"
+REJECTED = "rejected"
+WITHDRAWN = "withdrawn"
+
+
+class HandoffRejected(Exception):
+    """The decode pool could not install an offered request. ``reason``
+    is machine-readable (``busy`` / ``pool_full`` / ``geometry`` /
+    ``draining``) and rides the ack back to the prefill side."""
+
+    def __init__(self, message, reason):
+        super().__init__(message)
+        self.reason = reason
+
+
+def _b64(arr):
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode(
+        "ascii")
+
+
+def _unb64(text, dtype, shape):
+    buf = base64.b64decode(text.encode("ascii"))
+    return np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+
+
+def encode_pages(cache, page_ids):
+    """Serialize page rows of a `PagedKVCache` into a JSON-safe dict.
+
+    Gathers ``[L, n, H, page_size, D]`` blocks from the K and V pools
+    (plus the per-page scale rows for int8 pools) and base64-encodes
+    the raw bytes — the round-trip is bit-exact. Page 0 is the
+    reserved trash page and must never ship."""
+    ids = [int(p) for p in page_ids]
+    if any(p <= 0 or p >= cache.num_pages for p in ids):
+        raise ValueError(
+            f"cannot encode page ids {ids}: page 0 is the reserved "
+            f"trash page and ids must sit inside the pool")
+    idx = jnp.asarray(ids, jnp.int32)
+    out = {
+        "n": len(ids),
+        "kv_dtype": str(jnp.dtype(cache.dtype)),
+        "shape": [cache.num_layers, cache.num_heads, cache.page_size,
+                  cache.head_dim],
+    }
+    for name, pool in (("k", cache.k), ("v", cache.v)):
+        if isinstance(pool, QuantizedPages):
+            out[name] = _b64(np.asarray(pool.data[:, idx]))
+            out[f"{name}_scale"] = _b64(np.asarray(pool.scale[:, idx]))
+        else:
+            out[name] = _b64(np.asarray(pool[:, idx]))
+    return out
+
+
+def decode_pages(payload):
+    """Inverse of `encode_pages`: returns ``(k, v, k_scale, v_scale)``
+    numpy blocks (scales None for non-int8 payloads)."""
+    L, H, ps, D = payload["shape"]
+    n = payload["n"]
+    dtype = jnp.dtype(payload["kv_dtype"])
+    k = _unb64(payload["k"], dtype, (L, n, H, ps, D))
+    v = _unb64(payload["v"], dtype, (L, n, H, ps, D))
+    k_scale = v_scale = None
+    if "k_scale" in payload:
+        k_scale = _unb64(payload["k_scale"], jnp.bfloat16, (L, n, H, ps))
+        v_scale = _unb64(payload["v_scale"], jnp.bfloat16, (L, n, H, ps))
+    return k, v, k_scale, v_scale
+
+
+def write_pages(cache, page_ids, payload, skip=0):
+    """Install decoded page rows into ``page_ids`` of ``cache`` (rows
+    ``skip..n`` of the payload — a prefix-cache dedupe hit skips the
+    rows whose pages the registry already holds). One batched scatter
+    per pool leaf; the functional pools are rebound on the cache like
+    every compiled-call rebind, so no new compiled shapes appear."""
+    if not page_ids:
+        return
+    if payload["kv_dtype"] != str(jnp.dtype(cache.dtype)):
+        raise HandoffRejected(
+            "page payload precision does not match the pool "
+            f"(payload {payload['kv_dtype']}, pool "
+            f"{jnp.dtype(cache.dtype)})", reason="geometry")
+    k, v, k_scale, v_scale = decode_pages(payload)
+    idx = jnp.asarray([int(p) for p in page_ids], jnp.int32)
+    quant = isinstance(cache.k, QuantizedPages)
+    if quant:
+        cache.k = QuantizedPages(
+            cache.k.data.at[:, idx].set(jnp.asarray(k[:, skip:])),
+            cache.k.scale.at[:, idx].set(jnp.asarray(k_scale[:, skip:])))
+        cache.v = QuantizedPages(
+            cache.v.data.at[:, idx].set(jnp.asarray(v[:, skip:])),
+            cache.v.scale.at[:, idx].set(jnp.asarray(v_scale[:, skip:])))
+    else:
+        cache.k = cache.k.at[:, idx].set(jnp.asarray(k[:, skip:]))
+        cache.v = cache.v.at[:, idx].set(jnp.asarray(v[:, skip:]))
+
+
+def check_geometry(cache, payload):
+    """Reject (typed) a payload whose page geometry or pool precision
+    cannot land in ``cache`` — a decode pool configured with a
+    different page_size/head layout must bounce the offer back, not
+    corrupt its pool."""
+    want = [cache.num_layers, cache.num_heads, cache.page_size,
+            cache.head_dim]
+    if list(payload["shape"]) != want:
+        raise HandoffRejected(
+            f"page geometry {payload['shape']} does not match the "
+            f"decode pool {want}", reason="geometry")
+    if payload["kv_dtype"] != str(jnp.dtype(cache.dtype)):
+        raise HandoffRejected(
+            f"page precision {payload['kv_dtype']!r} does not match "
+            f"the decode pool {jnp.dtype(cache.dtype)}",
+            reason="geometry")
+
+
+class HandoffChannel:
+    """The offer/ack wire over one KV transport (module docstring).
+
+    All payloads carry a per-channel monotonic ``serial`` — the
+    CoordinationTransport append-only fallback keys on it, and pool
+    announcements resolve freshest-wins through it."""
+
+    def __init__(self, transport, pool_id):
+        self.transport = transport
+        self.pool_id = str(pool_id)
+        self._serial = 0
+
+    def _next_serial(self):
+        self._serial += 1
+        return self._serial
+
+    # -- pool discovery ---------------------------------------------------
+
+    def announce(self, role, load):
+        """Publish this pool's role + load gauge (one overwritten slot
+        per pool) — the prefill side's weighted least-load dst pick and
+        the router's pool map both read these."""
+        self.transport.publish(f"{_POOL_PREFIX}:{self.pool_id}", {
+            "serial": self._next_serial(), "pool_id": self.pool_id,
+            "role": str(role), "load": float(load)})
+
+    def pools(self, role=None):
+        """{pool_id: announcement} of every announced pool (filtered by
+        role when given)."""
+        out = {}
+        for key, payload in self.transport.read_all().items():
+            if not str(key).startswith(_POOL_PREFIX + ":"):
+                continue
+            if role is not None and payload.get("role") != role:
+                continue
+            out[payload.get("pool_id", key)] = payload
+        return out
+
+    def choose_decode_pool(self):
+        """Least-loaded announced decode pool, or None."""
+        pools = self.pools(role="decode")
+        if not pools:
+            return None
+        return min(pools, key=lambda p: pools[p].get("load", 0.0))
+
+    # -- offers / acks ----------------------------------------------------
+
+    def offer(self, dst, uid, payload):
+        """Publish one offer to pool ``dst``; returns the slot key the
+        ack comes back on."""
+        key = f"{_OFFER_PREFIX}:{dst}:{self.pool_id}:{uid}"
+        body = dict(payload)
+        body["state"] = OFFER
+        body["serial"] = self._next_serial()
+        self.transport.publish(key, body)
+        return key
+
+    def poll_offers(self):
+        """Un-acked offers addressed to this pool: [(key, payload)]."""
+        mine = f"{_OFFER_PREFIX}:{self.pool_id}:"
+        out = []
+        for key, payload in self.transport.read_all().items():
+            if str(key).startswith(mine) and \
+                    payload.get("state") == OFFER:
+                out.append((str(key), payload))
+        out.sort(key=lambda kv: kv[1].get("serial", 0))
+        return out
+
+    def ack(self, key, ok, reason=None):
+        """Overwrite an offer slot with its ack tombstone — the page
+        bytes are gone from the store the moment the verdict lands."""
+        self.transport.publish(key, {
+            "state": ACCEPTED if ok else REJECTED,
+            "reason": reason, "serial": self._next_serial()})
+
+    def withdraw(self, key):
+        """Overwrite a timed-out offer so a late decode-side read skips
+        it instead of installing a request the prefill side already
+        requeued."""
+        self.transport.publish(key, {
+            "state": WITHDRAWN, "serial": self._next_serial()})
+
+    def poll_acks(self):
+        """Acks for offers THIS pool published: [(key, uid, payload)]."""
+        out = []
+        for key, payload in self.transport.read_all().items():
+            key = str(key)
+            if not key.startswith(_OFFER_PREFIX + ":"):
+                continue
+            if payload.get("state") not in (ACCEPTED, REJECTED):
+                continue
+            parts = key[len(_OFFER_PREFIX) + 1:].split(":", 2)
+            if len(parts) != 3 or parts[1] != self.pool_id:
+                continue
+            out.append((key, parts[2], payload))
+        return out
+
+    def retire(self, key):
+        """Best-effort removal of a consumed slot (transports without
+        delete leave the small tombstone behind — bounded growth)."""
+        discard = getattr(self.transport, "discard", None)
+        if discard is not None:
+            discard(str(key))

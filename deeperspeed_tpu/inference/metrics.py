@@ -29,6 +29,19 @@ from .admission import REQUEST_STATUSES
 ADMISSION_WAIT = "Serve/admission_wait_ms"
 TTFT = "Serve/ttft_ms"
 INTER_TOKEN = "Serve/inter_token_ms"
+# disaggregated handoff (PR 20): offer-publish → ack-receipt round
+# trip, observed on the prefill pool (inference/handoff.py)
+HANDOFF = "Serve/handoff_ms"
+
+# front-end router gauge families (inference/router.py): cumulative
+# routed/shed counts, the cross-pool handoff p50, per-pool load scores,
+# and the advisory autoscaling bit — recorded as monitor scalars by
+# `ServeRouter.serve_stats` (latest-value gauges on the scrape)
+ROUTER_ROUTED = "Serve/router/routed"
+ROUTER_SHED = "Serve/router/shed"
+ROUTER_HANDOFF_MS = "Serve/router/handoff_ms"
+ROUTER_POOL_LOAD = "Serve/router/load"
+ROUTER_ADVISE_SCALE_UP = "Serve/router/advise_scale_up"
 
 # prefix-cache / speculative-decode gauge families (PR 16): recorded as
 # monitor scalars every step, like REQUEST_STATUS_FAMILIES below —
@@ -54,6 +67,7 @@ class ServeRequestMetrics:
         self.admission_wait = Histogram(buckets)
         self.ttft = Histogram(buckets)
         self.inter_token = Histogram(buckets)
+        self.handoff = Histogram(buckets)
 
     def _observe(self, hist, tag, ms):
         ms = max(float(ms), 0.0)
@@ -72,13 +86,17 @@ class ServeRequestMetrics:
     def observe_inter_token(self, seconds):
         self._observe(self.inter_token, INTER_TOKEN, seconds * 1e3)
 
+    def observe_handoff(self, seconds):
+        self._observe(self.handoff, HANDOFF, seconds * 1e3)
+
     def summary(self):
         """p50/p99 scalars (ms) for `serve_stats` — None-valued entries
         are omitted (no observations yet)."""
         out = {}
         for name, hist in (("admission_wait", self.admission_wait),
                            ("ttft", self.ttft),
-                           ("inter_token", self.inter_token)):
+                           ("inter_token", self.inter_token),
+                           ("handoff", self.handoff)):
             for q, label in ((0.5, "p50"), (0.99, "p99")):
                 value = hist.percentile(q)
                 if value is not None:

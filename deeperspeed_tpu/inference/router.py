@@ -1,0 +1,236 @@
+"""SLO-aware front-end router over a set of serving pools.
+
+A disaggregated deployment (docs/inference.md "Disaggregated serving")
+has several engines a client could submit to — prefill-role pools (and
+unified ones, in a mixed fleet). `ServeRouter` is the single front
+door: it scores every admitting pool by the saturation gauges the
+engines already export (queue depth, page-pool utilization, measured
+TTFT EMA) and routes each request to the weighted least-loaded pool.
+The weights are the validated ``inference.router`` config sub-block
+(`runtime.config._parse_inference_router`); absent, the defaults in
+`runtime.constants` apply.
+
+Shedding stays TYPED end to end: each pool's own admission controller
+raises `RequestRejected` with a drain-rate retry-after hint, and when
+EVERY candidate pool sheds, the router re-raises one `RequestRejected`
+carrying the SMALLEST hint across pools — the soonest any pool expects
+room. A client that honors it comes back exactly when capacity does.
+
+Scale-down is a graceful drain: `drain(name)` removes the pool from
+rotation first (no new requests can race in), then runs the engine's
+own `drain()` — in-flight sequences finish or fail typed, never
+silently.
+
+Everything here is advisory-observable: `serve_stats()` records the
+``Serve/router/*`` gauge families (routed/shed counters, per-pool load
+scores, the cross-pool handoff p50, and an ``advise_scale_up`` bit that
+flips when every routable pool's page pool sits above
+``router.scale_up_util``) through the attached monitor, so a fleet
+autoscaler can act on the scrape without any new plumbing.
+"""
+
+from ..runtime import constants as c
+from ..utils.logging import logger
+from .admission import RequestRejected
+from .metrics import (ROUTER_ADVISE_SCALE_UP, ROUTER_HANDOFF_MS,
+                      ROUTER_POOL_LOAD, ROUTER_ROUTED, ROUTER_SHED)
+
+_ROUTER_DEFAULTS = {
+    c.INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT:
+        c.INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT_DEFAULT,
+    c.INFERENCE_ROUTER_POOL_UTIL_WEIGHT:
+        c.INFERENCE_ROUTER_POOL_UTIL_WEIGHT_DEFAULT,
+    c.INFERENCE_ROUTER_TTFT_WEIGHT:
+        c.INFERENCE_ROUTER_TTFT_WEIGHT_DEFAULT,
+    c.INFERENCE_ROUTER_SCALE_UP_UTIL:
+        c.INFERENCE_ROUTER_SCALE_UP_UTIL_DEFAULT,
+}
+
+
+class ServeRouter:
+    """Weighted least-load routing over named serving pools.
+
+    ``pools`` maps pool name -> `InferenceEngine`. Only admitting
+    roles route (``prefill`` / ``unified``); a decode-role engine may
+    be passed for observability but never receives a submit. ``config``
+    is the validated ``inference.router`` params dict; None picks up
+    the first pool's own parsed ``inference.router`` block (engines
+    carry it as ``router_params``), falling back to the defaults."""
+
+    def __init__(self, pools, config=None, monitor=None):
+        if not pools:
+            raise ValueError("ServeRouter needs at least one pool")
+        self.pools = dict(pools)
+        self.monitor = monitor
+        if config is None:
+            config = next(
+                (eng.router_params for eng in self.pools.values()
+                 if getattr(eng, "router_params", None)), None)
+        params = dict(_ROUTER_DEFAULTS)
+        if config:
+            params.update(config)
+        self.queue_depth_weight = \
+            params[c.INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT]
+        self.pool_util_weight = params[c.INFERENCE_ROUTER_POOL_UTIL_WEIGHT]
+        self.ttft_weight = params[c.INFERENCE_ROUTER_TTFT_WEIGHT]
+        self.scale_up_util = params[c.INFERENCE_ROUTER_SCALE_UP_UTIL]
+        self._draining = set()
+        self.stats = {"routed": 0, "shed": 0, "drained_pools": 0}
+        # per-pool routed counts (serve_stats exports them as one
+        # gauge per pool)
+        self.routed_by_pool = {name: 0 for name in self.pools}
+
+    # -- load scoring ------------------------------------------------------
+
+    @staticmethod
+    def _pool_gauges(engine):
+        """(queue_depth, page_pool_util, ttft_ema_ms) read live off the
+        engine — the same saturation signals its admission controller
+        sheds on."""
+        queue_depth = (len(engine.scheduler.waiting) +
+                       len(engine.scheduler.quarantined))
+        usable = max(engine.cache.num_pages - 1, 1)
+        util = 1.0 - engine.cache.num_free / usable
+        ttft_ema = 0.0
+        if engine.admission is not None and \
+                engine.admission.ttft_ema_ms is not None:
+            ttft_ema = engine.admission.ttft_ema_ms
+        return queue_depth, util, ttft_ema
+
+    def load_score(self, name):
+        """The weighted load this router routes by (lower = preferred)."""
+        queue_depth, util, ttft_ema = self._pool_gauges(self.pools[name])
+        return (self.queue_depth_weight * queue_depth +
+                self.pool_util_weight * util +
+                self.ttft_weight * ttft_ema)
+
+    def routable_pools(self):
+        """Names of pools a submit may target, best-scored first:
+        admitting roles only, draining pools excluded."""
+        names = [name for name, eng in self.pools.items()
+                 if name not in self._draining and eng.role != "decode"]
+        return sorted(names, key=self.load_score)
+
+    # -- routing -----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens, **kwargs):
+        """Route one request to the least-loaded admitting pool;
+        returns ``(pool_name, request_id)``. Pools that shed are tried
+        in load order; when ALL shed, re-raises a `RequestRejected`
+        carrying the smallest retry-after hint across them."""
+        candidates = self.routable_pools()
+        if not candidates:
+            raise RuntimeError(
+                "ServeRouter has no routable pools (all draining or "
+                "decode-role)")
+        rejections = []
+        for name in candidates:
+            try:
+                rid = self.pools[name].submit(prompt, max_new_tokens,
+                                              **kwargs)
+            except RequestRejected as e:
+                rejections.append(e)
+                continue
+            self.stats["routed"] += 1
+            self.routed_by_pool[name] += 1
+            return name, rid
+        self.stats["shed"] += 1
+        soonest = min(rejections, key=lambda e: e.retry_after_s)
+        raise RequestRejected(
+            f"all {len(candidates)} routable pool(s) shed the request "
+            f"(soonest retry-after {soonest.retry_after_s:.2f}s): "
+            f"{soonest}", retry_after_s=soonest.retry_after_s,
+            reason=soonest.reason, request=soonest.request)
+
+    # -- scale-down --------------------------------------------------------
+
+    def drain(self, name):
+        """Scale a pool out: remove it from rotation FIRST (a racing
+        submit cannot land on it), then run the engine's graceful
+        drain — in-flight work finishes or fails typed. Returns the
+        engine's drain summary; the pool stays in `pools` for
+        observability but never routes again."""
+        if name not in self.pools:
+            raise KeyError(f"unknown pool {name!r}")
+        self._draining.add(name)
+        summary = self.pools[name].drain()
+        self.stats["drained_pools"] += 1
+        logger.info(f"router: pool {name!r} drained out of rotation: "
+                    f"{summary}")
+        return summary
+
+    # -- convenience driving ----------------------------------------------
+
+    @property
+    def has_work(self):
+        return any(eng.scheduler.has_work or eng._handoff_outbox or
+                   eng._pending_handoff
+                   for name, eng in self.pools.items()
+                   if name not in self._draining)
+
+    def step(self):
+        """One step of every non-drained pool (single-host driving:
+        tests and the bench run prefill and decode pools in one
+        process)."""
+        for name, eng in self.pools.items():
+            if name not in self._draining:
+                eng.step()
+
+    def pop_finished(self):
+        """Finished requests across every pool (drained ones included —
+        their last results must not strand)."""
+        out = []
+        for eng in self.pools.values():
+            out.extend(eng.scheduler.pop_finished())
+        return out
+
+    # -- observability -----------------------------------------------------
+
+    def serve_stats(self):
+        """Router gauges, recorded as ``Serve/router/*`` monitor
+        scalars when a monitor is attached: routed/shed totals,
+        per-pool load scores, the cross-pool handoff p50 (merged over
+        every pool's handoff histogram), and the advisory scale-up
+        bit."""
+        out = dict(self.stats)
+        loads = {name: self.load_score(name) for name in self.pools}
+        out["pool_loads"] = loads
+        for name, count in self.routed_by_pool.items():
+            out[f"routed_{name}"] = count
+        # merge the per-pool handoff distributions: the bucket ladders
+        # are shared, so bucket-wise sums ARE the merged histogram
+        merged = None
+        for eng in self.pools.values():
+            hist = eng.request_metrics.handoff
+            if hist.count == 0:
+                continue
+            if merged is None:
+                from ..runtime.exporters import Histogram
+                merged = Histogram(hist.edges)
+            merged.counts = [a + b for a, b in zip(merged.counts,
+                                                   hist.counts)]
+            merged.inf_count += hist.inf_count
+            merged.total += hist.total
+            merged.count += hist.count
+        if merged is not None:
+            out["handoff_p50_ms"] = merged.percentile(0.5)
+            out["handoff_p99_ms"] = merged.percentile(0.99)
+        routable = [n for n in self.pools if n not in self._draining and
+                    self.pools[n].role != "decode"]
+        saturated = bool(routable) and all(
+            self._pool_gauges(self.pools[n])[1] > self.scale_up_util
+            for n in routable)
+        out["advise_scale_up"] = 1.0 if saturated else 0.0
+        if self.monitor is not None:
+            scalars = {ROUTER_ROUTED: float(out["routed"]),
+                       ROUTER_SHED: float(out["shed"]),
+                       ROUTER_ADVISE_SCALE_UP: out["advise_scale_up"]}
+            if "handoff_p50_ms" in out:
+                scalars[ROUTER_HANDOFF_MS] = float(out["handoff_p50_ms"])
+            for name, load in loads.items():
+                scalars[f"{ROUTER_POOL_LOAD}/{name}"] = float(load)
+            total = sum(e.stats["prefill_tokens"] +
+                        e.stats["decode_tokens"]
+                        for e in self.pools.values())
+            self.monitor.record(total, scalars)
+        return out

@@ -443,6 +443,35 @@ class ContinuousBatchingScheduler:
         request.retry_at = float(retry_at)
         self.quarantined.append(request)
 
+    def admit_handoff(self, request, now=None):
+        """Admit a request whose prefill happened on ANOTHER pool
+        (disaggregated serving): its pages are already allocated and
+        written, its first token already sampled — it enters `running`
+        directly, bypassing admission and the prefill queue. The
+        decode-role drain gate does not apply: a handed-off request IS
+        in-flight work."""
+        if request.request_id is None:
+            request.request_id = self._counter
+        self._counter += 1
+        request.state = RUNNING
+        request.admitted_at = now
+        self.running.append(request)
+
+    def requeue_handoff(self, request, now=None):
+        """Put a request whose handoff failed (rejected / timed-out
+        offer) back at the FRONT of the waiting queue with eviction
+        semantics: pages freed, K/V rebuilt by a full-context
+        re-prefill, then a fresh offer. `evictions` counting keeps it
+        admissible through a prefill-pool drain."""
+        if request in self.running:
+            self.running.remove(request)
+        self._release_pages(request)
+        request.cached = 0
+        request.evictions += 1
+        request.state = WAITING
+        request.enqueued_at = now
+        self.waiting.appendleft(request)
+
     def _release_quarantined(self, now):
         """Move backoff-expired quarantined requests to the FRONT of
         the waiting queue (like any evicted request — their partial

@@ -206,7 +206,8 @@ def parse_inference_block(d):
              c.INFERENCE_DRAIN_DEADLINE, c.INFERENCE_DEFAULT_PRIORITY,
              c.INFERENCE_HANG_TIMEOUT, c.INFERENCE_ADMISSION,
              c.INFERENCE_RETRY, c.INFERENCE_FAULT_INJECTION,
-             c.INFERENCE_PREFIX_CACHE, c.INFERENCE_SPECULATIVE}
+             c.INFERENCE_PREFIX_CACHE, c.INFERENCE_SPECULATIVE,
+             c.INFERENCE_DISAGGREGATION, c.INFERENCE_ROUTER}
     unknown = sorted(set(inf) - known)
     if unknown:
         raise DeepSpeedConfigError(
@@ -362,6 +363,16 @@ def parse_inference_block(d):
         inf.get(c.INFERENCE_PREFIX_CACHE))
     speculative = _parse_inference_speculative(
         inf.get(c.INFERENCE_SPECULATIVE))
+    disaggregation = _parse_inference_disaggregation(
+        inf.get(c.INFERENCE_DISAGGREGATION))
+    router = _parse_inference_router(inf.get(c.INFERENCE_ROUTER))
+    if disaggregation["role"] != "unified" and speculative is not None:
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_DISAGGREGATION} role "
+            f"{disaggregation['role']!r} cannot combine with "
+            f"inference.{c.INFERENCE_SPECULATIVE}: the draft model's "
+            f"shadow KV pools cannot be reconstructed from a page "
+            f"handoff yet — run speculation on unified pools")
 
     fault_spec = inf.get(c.INFERENCE_FAULT_INJECTION)
     if fault_spec is not None:
@@ -390,6 +401,8 @@ def parse_inference_block(d):
         "fault_injection": fault_spec,
         "prefix_cache": prefix_cache,
         "speculative": speculative,
+        "disaggregation": disaggregation,
+        "router": router,
     }
 
 
@@ -714,6 +727,101 @@ def _parse_inference_speculative(block):
             f"got {quant!r}")
 
     return {"num_draft_tokens": k, "draft_weight_quant": quant}
+
+
+def _parse_inference_disaggregation(block):
+    """Validate the ``inference.disaggregation`` sub-block -> params
+    dict. ALWAYS returns a dict (role "unified" when absent — today's
+    single-engine behavior), so the engine reads one shape."""
+    if block is None:
+        block = {}
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_DISAGGREGATION} must be an object, "
+            f"got {type(block).__name__}")
+    known = {c.INFERENCE_DISAGG_ROLE, c.INFERENCE_DISAGG_POOL_ID,
+             c.INFERENCE_DISAGG_HANDOFF_TIMEOUT}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'inference.{c.INFERENCE_DISAGGREGATION}' key(s) "
+            f"{unknown}; valid keys: {sorted(known)}")
+    where = f"inference.{c.INFERENCE_DISAGGREGATION}"
+
+    role = block.get(c.INFERENCE_DISAGG_ROLE,
+                     c.INFERENCE_DISAGG_ROLE_DEFAULT)
+    if role not in c.INFERENCE_DISAGG_ROLE_CHOICES:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_DISAGG_ROLE} must be one of "
+            f"{list(c.INFERENCE_DISAGG_ROLE_CHOICES)}, got {role!r}")
+
+    pool_id = block.get(c.INFERENCE_DISAGG_POOL_ID,
+                        c.INFERENCE_DISAGG_POOL_ID_DEFAULT)
+    if pool_id is None:
+        pool_id = f"{role}-0"
+    if not isinstance(pool_id, str) or not pool_id or \
+            any(ch in pool_id for ch in "/:"):
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_DISAGG_POOL_ID} must be a non-empty "
+            f"string without '/' or ':' (it namespaces transport "
+            f"keys), got {pool_id!r}")
+
+    timeout = block.get(c.INFERENCE_DISAGG_HANDOFF_TIMEOUT,
+                        c.INFERENCE_DISAGG_HANDOFF_TIMEOUT_DEFAULT)
+    if not isinstance(timeout, (int, float)) or \
+            isinstance(timeout, bool) or timeout <= 0:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_DISAGG_HANDOFF_TIMEOUT} must be a "
+            f"number > 0 (seconds), got {timeout!r}")
+
+    return {"role": role, "pool_id": pool_id,
+            "handoff_timeout_s": float(timeout)}
+
+
+def _parse_inference_router(block):
+    """Validate the ``inference.router`` sub-block -> params dict, or
+    None when absent (`ServeRouter` then runs on defaults)."""
+    if block is None:
+        return None
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f"inference.{c.INFERENCE_ROUTER} must be an object, got "
+            f"{type(block).__name__}")
+    known = {c.INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT,
+             c.INFERENCE_ROUTER_POOL_UTIL_WEIGHT,
+             c.INFERENCE_ROUTER_TTFT_WEIGHT,
+             c.INFERENCE_ROUTER_SCALE_UP_UTIL}
+    unknown = sorted(set(block) - known)
+    if unknown:
+        raise DeepSpeedConfigError(
+            f"Unknown 'inference.{c.INFERENCE_ROUTER}' key(s) "
+            f"{unknown}; valid keys: {sorted(known)}")
+    where = f"inference.{c.INFERENCE_ROUTER}"
+
+    out = {}
+    for key, default in (
+            (c.INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT,
+             c.INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT_DEFAULT),
+            (c.INFERENCE_ROUTER_POOL_UTIL_WEIGHT,
+             c.INFERENCE_ROUTER_POOL_UTIL_WEIGHT_DEFAULT),
+            (c.INFERENCE_ROUTER_TTFT_WEIGHT,
+             c.INFERENCE_ROUTER_TTFT_WEIGHT_DEFAULT)):
+        value = block.get(key, default)
+        if not isinstance(value, (int, float)) or \
+                isinstance(value, bool) or value < 0:
+            raise DeepSpeedConfigError(
+                f"{where}.{key} must be a number >= 0, got {value!r}")
+        out[key] = float(value)
+
+    util = block.get(c.INFERENCE_ROUTER_SCALE_UP_UTIL,
+                     c.INFERENCE_ROUTER_SCALE_UP_UTIL_DEFAULT)
+    if not isinstance(util, (int, float)) or isinstance(util, bool) or \
+            not 0 < util <= 1:
+        raise DeepSpeedConfigError(
+            f"{where}.{c.INFERENCE_ROUTER_SCALE_UP_UTIL} must be a "
+            f"number in (0, 1], got {util!r}")
+    out[c.INFERENCE_ROUTER_SCALE_UP_UTIL] = float(util)
+    return out
 
 
 def parse_quantization_block(d):

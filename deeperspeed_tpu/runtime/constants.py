@@ -539,6 +539,41 @@ INFERENCE_SPECULATIVE_NUM_DRAFT_DEFAULT = 4
 INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT = "draft_weight_quant"
 INFERENCE_SPECULATIVE_DRAFT_WEIGHT_QUANT_DEFAULT = None
 
+# disaggregated prefill/decode serving sub-block (docs/inference.md
+# "Disaggregated prefill/decode"): an engine's pool role — a prefill
+# pool runs admission + prefill and hands completed requests' KV pages
+# to a decode pool over the coordination-service transport; "unified"
+# (the default) is the single-engine behavior
+INFERENCE_DISAGGREGATION = "disaggregation"
+INFERENCE_DISAGG_ROLE = "role"
+INFERENCE_DISAGG_ROLE_DEFAULT = "unified"
+INFERENCE_DISAGG_ROLE_CHOICES = ("unified", "prefill", "decode")
+# pool identity: the handoff transport key namespace AND the
+# role/host labels on the Serve/* Prometheus families (null = derived
+# from the role, e.g. "prefill-0")
+INFERENCE_DISAGG_POOL_ID = "pool_id"
+INFERENCE_DISAGG_POOL_ID_DEFAULT = None
+# an offer the decode side has not acked within this window is treated
+# as rejected: pages return to the prefill pool's free list and the
+# request requeues for a fresh prefill + re-offer
+INFERENCE_DISAGG_HANDOFF_TIMEOUT = "handoff_timeout_s"
+INFERENCE_DISAGG_HANDOFF_TIMEOUT_DEFAULT = 30.0
+
+# front-end SLO router sub-block (inference/router.py): weighted
+# least-load admission across pools on the queue-depth / page-pool /
+# TTFT-EMA gauges the admission controller already maintains
+INFERENCE_ROUTER = "router"
+INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT = "queue_depth_weight"
+INFERENCE_ROUTER_QUEUE_DEPTH_WEIGHT_DEFAULT = 1.0
+INFERENCE_ROUTER_POOL_UTIL_WEIGHT = "pool_util_weight"
+INFERENCE_ROUTER_POOL_UTIL_WEIGHT_DEFAULT = 32.0
+INFERENCE_ROUTER_TTFT_WEIGHT = "ttft_weight"
+INFERENCE_ROUTER_TTFT_WEIGHT_DEFAULT = 0.01
+# advisory autoscaling threshold: when every routable pool's page-pool
+# utilization sits above this, Serve/router/advise_scale_up goes to 1
+INFERENCE_ROUTER_SCALE_UP_UTIL = "scale_up_util"
+INFERENCE_ROUTER_SCALE_UP_UTIL_DEFAULT = 0.85
+
 # ---------------------------------------------------------------------------
 # Profile-guided schedule planner (docs/planner.md): the engine-side
 # hook consuming a persisted `ds_plan` plan file — its resolved config

@@ -101,15 +101,39 @@ class PrometheusBackend:
     docstring). Thread-safe: the serving loop observes while the scrape
     handler renders."""
 
-    def __init__(self, port=None, host="127.0.0.1"):
+    def __init__(self, port=None, host="127.0.0.1", labels=None):
         self._lock = threading.Lock()
         self._gauges = {}        # tag -> float
         self._hists = {}         # tag -> Histogram
+        self._labels = {}        # constant labels on every family
         self._server = None
         self._thread = None
         self.port = None
+        if labels:
+            self.set_labels(labels)
         if port is not None:
             self.start_http(port, host=host)
+
+    def set_labels(self, labels):
+        """Constant labels rendered on EVERY sample (`role`/`host` for
+        a disaggregated serving pool — a fleet scrape can then tell a
+        prefill host's ``ds_serve_queue_depth`` from a decode host's).
+        Values are escaped per the text format; an empty dict restores
+        label-less rendering."""
+        clean = {}
+        for key, value in dict(labels).items():
+            value = (str(value).replace("\\", "\\\\")
+                     .replace('"', '\\"').replace("\n", "\\n"))
+            clean[str(key)] = value
+        with self._lock:
+            self._labels = clean
+
+    @staticmethod
+    def _label_str(labels, extra=""):
+        body = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        if extra:
+            body = f"{extra},{body}" if body else extra
+        return "{" + body + "}" if body else ""
 
     # -- sink API (fed from the monitor's drain) -------------------------
 
@@ -147,12 +171,14 @@ class PrometheusBackend:
             gauges = dict(self._gauges)
             hists = {tag: (h.cumulative(), h.total, h.count)
                      for tag, h in self._hists.items()}
+            labels = dict(self._labels)
+        lbl = self._label_str(labels)
         lines = []
         for tag in sorted(gauges):
             name = prometheus_name(tag)
             lines.append(f"# HELP {name} DeeperSpeed-TPU scalar {tag}")
             lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {self._fmt(gauges[tag])}")
+            lines.append(f"{name}{lbl} {self._fmt(gauges[tag])}")
         for tag in sorted(hists):
             name = prometheus_name(tag)
             cumulative, total, count = hists[tag]
@@ -160,9 +186,10 @@ class PrometheusBackend:
             lines.append(f"# TYPE {name} histogram")
             for edge, cum in cumulative:
                 le = "+Inf" if edge == float("inf") else self._fmt(edge)
-                lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
-            lines.append(f"{name}_sum {self._fmt(total)}")
-            lines.append(f"{name}_count {count}")
+                bucket_lbl = self._label_str(labels, extra=f'le="{le}"')
+                lines.append(f"{name}_bucket{bucket_lbl} {cum}")
+            lines.append(f"{name}_sum{lbl} {self._fmt(total)}")
+            lines.append(f"{name}_count{lbl} {count}")
         return "\n".join(lines) + "\n"
 
     # -- HTTP endpoint ----------------------------------------------------

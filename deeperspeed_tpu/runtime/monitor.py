@@ -132,6 +132,17 @@ class TensorBoardMonitor:
             # drain it — draining blocks the training loop on telemetry
             self.flush(drain=False)
 
+    def set_export_labels(self, labels):
+        """Stamp constant labels (``role``/``host`` for a disaggregated
+        serving pool) onto every export backend that renders them —
+        today the Prometheus scrape. No-op for label-less sinks."""
+        if not self.enabled:
+            return
+        for backend in self._export_backends:
+            hook = getattr(backend, "set_labels", None)
+            if hook is not None:
+                hook(labels)
+
     def observe_histogram(self, tag, value, edges=None):
         """Feed one histogram observation (serving latencies:
         admission wait / TTFT / inter-token) to every export backend

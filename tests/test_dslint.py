@@ -254,6 +254,38 @@ def test_parse_only_key_harvests_planner_block():
     assert {"enabled", "plan_file", "strict_device_match"} <= harvested
 
 
+def test_parse_only_key_harvests_disagg_blocks():
+    """Same drill for the disaggregated-serving sub-blocks: the
+    `inference.disaggregation` and `inference.router` keys are declared
+    through `c.INFERENCE_DISAGG_*` / `c.INFERENCE_ROUTER_*` constants,
+    so the harvest must resolve them via the constants table — a typo'd
+    role or router weight then fails the parse-only-key gate instead of
+    silently running on defaults."""
+    from tools.dslint.config_keys import (_constants_aliases,
+                                          _constants_tables,
+                                          _known_set_assignments,
+                                          _resolve_key)
+    sources = []
+    for rel in (os.path.join("deeperspeed_tpu", "runtime", "config.py"),
+                os.path.join("deeperspeed_tpu", "runtime",
+                             "constants.py")):
+        ap = os.path.join(REPO_ROOT, rel)
+        with open(ap) as f:
+            sources.append(SourceFile(ap, rel, f.read()))
+    tables = _constants_tables(sources)
+    harvested = set()
+    for src in sources:
+        aliases = _constants_aliases(src, tables)
+        for assign in _known_set_assignments(src):
+            for elt in assign.value.elts:
+                key = _resolve_key(elt, aliases)
+                if key is not None:
+                    harvested.add(key)
+    assert {"disaggregation", "role", "pool_id", "handoff_timeout_s",
+            "router", "queue_depth_weight", "pool_util_weight",
+            "ttft_weight", "scale_up_util"} <= harvested
+
+
 def test_parse_only_key_harvests_rl_block():
     """Same drill for the online-RL driver's `rl` block: parse_rl_block
     declares its known set through `c.RL_*` constants, so the harvest
